@@ -1,0 +1,17 @@
+"""deepvision_tpu — a TPU-native (JAX/Flax/XLA) computer-vision framework.
+
+Re-creation of the capabilities of zackdilan/deep-vision (reference mounted at
+/root/reference) designed TPU-first: Flax modules for the networks, optax for
+optimization, jit/pjit SPMD steps over a `jax.sharding.Mesh` for scaling, tf.data
+host pipelines for input, and Orbax for checkpointing.
+
+Layout (mirrors SURVEY.md layer map):
+  core/      — trainer loop, train state, steps, config, checkpoint, metrics, schedules
+  parallel/  — mesh construction, sharding rules, collectives helpers
+  data/      — dataset parsers + input pipelines (MNIST idx, ImageNet TFRecord, ...)
+  models/    — Flax model zoo (LeNet..ResNet..YOLO..CycleGAN)
+  ops/       — numerics shared across models (boxes/IoU/NMS/heatmaps, pallas kernels)
+  utils/     — registry, logging helpers
+"""
+
+__version__ = "0.1.0"

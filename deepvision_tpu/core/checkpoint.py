@@ -1,0 +1,100 @@
+"""Checkpoint save/restore via Orbax.
+
+One mechanism replacing the reference's four (SURVEY.md §5.4): torch dict-per-epoch
+(`ResNet/pytorch/train.py:417-428`), Keras hdf5 callback, save-best weights with the
+metric in the filename (`YOLO/tensorflow/train.py:244-257`), and
+`tf.train.Checkpoint`+Manager (`CycleGAN/tensorflow/train.py:134-148`). Payload is
+`{params, batch_stats, opt_state, step}` plus host metadata (epoch, plateau state,
+metric history), with keep-latest and keep-best policies and atomic writes (safe for
+preemption — a gap called out in SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .train_state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3, keep_best: bool = True,
+                 best_mode: str = "max"):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = keep
+        self.keep_best = keep_best
+        self.best_mode = best_mode
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                best_fn=(lambda m: m.get("best_metric", 0.0)) if keep_best else None,
+                best_mode=best_mode if keep_best else "max",
+                keep_checkpoints_without_metrics=True,
+                create=True,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, epoch: int, state: TrainState, host_state: Optional[Dict[str, Any]] = None,
+             metric: Optional[float] = None):
+        """Save at `epoch` (reference saves per-epoch with epoch in the payload,
+        ResNet/pytorch/train.py:417-428)."""
+        payload = {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+        metrics = {"best_metric": float(metric)} if metric is not None else None
+        self._mgr.save(
+            epoch,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(payload),
+                host=ocp.args.JsonSave(host_state or {}),
+            ),
+            metrics=metrics,
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def best_epoch(self) -> Optional[int]:
+        return self._mgr.best_step()
+
+    def restore(self, state: TrainState, epoch: Optional[int] = None):
+        """Restore into an abstract/concrete TrainState template; returns
+        (state, host_state, epoch). `epoch=None` → latest (auto-resume-from-latest)."""
+        if epoch is None:
+            epoch = self._mgr.latest_step()
+        if epoch is None:
+            return state, {}, None
+        template = {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+        restored = self._mgr.restore(
+            epoch,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                host=ocp.args.JsonRestore(),
+            ),
+        )
+        payload = restored["state"]
+        new_state = state.replace(
+            step=payload["step"], params=payload["params"],
+            batch_stats=payload["batch_stats"], opt_state=payload["opt_state"])
+        return new_state, dict(restored["host"] or {}), epoch
+
+    def close(self):
+        self._mgr.close()

@@ -1,0 +1,84 @@
+"""Typed training configs.
+
+The reference's UX is ``python train.py -m <model> [-c <checkpoint>]`` with an in-file
+config registry holding batch size / optimizer / scheduler / epochs per model name
+(`ResNet/pytorch/train.py:26-215`, `ResNet/tensorflow/train.py:21-62`). We keep that
+exact surface but as dataclasses, with hyperparameters paper-cited in the per-model
+config modules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    name: str = "sgd"               # sgd | momentum | rmsprop | adam | adamw
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 0.0       # decoupled (adamw) or L2-coupled (sgd) per optimizer
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    rmsprop_decay: float = 0.9
+    grad_clip_norm: Optional[float] = None
+
+
+@dataclasses.dataclass
+class ScheduleConfig:
+    name: str = "constant"          # constant | step | cosine | plateau | linear_decay
+    warmup_epochs: float = 0.0
+    # step schedule (reference MultiStepLR / StepLR, ResNet/pytorch/train.py:141-164)
+    boundaries_epochs: Tuple[float, ...] = ()
+    decay_factor: float = 0.1
+    # plateau (reference ReduceLROnPlateau, ResNet/pytorch/train.py:171-176 and
+    # the hand-rolled YOLO variant YOLO/tensorflow/train.py:56-68) — host-driven.
+    plateau_patience: int = 2
+    plateau_factor: float = 0.1
+    plateau_mode: str = "max"       # watch val top-1 ('max') or val loss ('min')
+    min_lr: float = 0.0
+    # linear_decay (CycleGAN/tensorflow/utils.py:5-28)
+    decay_start_epoch: int = 100
+
+
+@dataclasses.dataclass
+class DataConfig:
+    dataset: str = "synthetic"
+    data_dir: str = ""
+    image_size: int = 224
+    num_classes: int = 1000
+    train_examples: int = 1281167   # hard-coded in the reference: ResNet/tensorflow/train.py:223
+    val_examples: int = 50000
+    shuffle_buffer: int = 10000
+    num_parallel_calls: int = 16    # reference num_workers=16, ResNet/pytorch/train.py:229
+    cache_val: bool = False
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    name: str = "model"
+    model: str = "resnet50"
+    model_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: int = 256           # global batch
+    eval_batch_size: Optional[int] = None
+    total_epochs: int = 100
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    schedule: ScheduleConfig = dataclasses.field(default_factory=ScheduleConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    loss: str = "softmax_xent"
+    label_smoothing: float = 0.0    # absent from the reference; needed for the 75.3% bar
+    aux_loss_weight: float = 0.3    # GoogLeNet aux heads (fixes reference's latent gap,
+                                    # Inception/pytorch/models/inception_v1.py:112-113)
+    dtype: str = "bfloat16"         # compute dtype on MXU; params stay f32
+    seed: int = 0
+    log_every_steps: int = 10       # reference prints every 10 batches, train.py:472
+    checkpoint_dir: str = "checkpoints"
+    keep_checkpoints: int = 3
+    keep_best: bool = True          # save-best policy, YOLO/tensorflow/train.py:244-246
+    model_parallel: int = 1
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,58 @@
+"""Shared classification losses & metrics.
+
+Semantics mirror the reference: CrossEntropyLoss (`ResNet/pytorch/train.py:358-360`),
+top-1/top-5 accuracy (`ResNet/pytorch/train.py:524-538`,
+`ResNet/tensorflow/train.py:217`), plus label smoothing (absent from the reference —
+part of the modern recipe required to hit BASELINE.md's 75.3% bar) and properly
+weighted GoogLeNet auxiliary losses (the reference never combined them — SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray,
+                 label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer labels."""
+    num_classes = logits.shape[-1]
+    onehot = optax.smooth_labels(
+        jnp.eye(num_classes, dtype=jnp.float32)[labels], label_smoothing)
+    return optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
+
+
+def classification_loss(outputs, labels, label_smoothing: float = 0.0,
+                        aux_weight: float = 0.3) -> jnp.ndarray:
+    """Main + weighted auxiliary-head loss.
+
+    `outputs` is either logits or a (main, aux1, aux2, ...) tuple as produced by
+    Inception V1 in train mode (reference returns the tuple but never sums it:
+    `Inception/pytorch/models/inception_v1.py:112-113`; GoogLeNet paper weights the
+    aux classifiers by 0.3).
+    """
+    if isinstance(outputs, (tuple, list)):
+        main, *aux = outputs
+        loss = softmax_xent(main, labels, label_smoothing)
+        for a in aux:
+            loss = loss + aux_weight * softmax_xent(a, labels, label_smoothing)
+        return loss
+    return softmax_xent(outputs, labels, label_smoothing)
+
+
+def topk_accuracies(logits: jnp.ndarray, labels: jnp.ndarray,
+                    ks: Sequence[int] = (1, 5)) -> dict:
+    """Top-k accuracy fractions (reference `accuracy()`,
+    ResNet/pytorch/train.py:524-538)."""
+    if isinstance(logits, (tuple, list)):
+        logits = logits[0]
+    k_max = min(max(ks), logits.shape[-1])
+    top = jnp.argsort(logits, axis=-1)[..., ::-1][..., :k_max]
+    correct = top == labels[..., None]
+    out = {}
+    for k in ks:
+        kk = min(k, logits.shape[-1])
+        out[f"top{k}"] = correct[..., :kk].any(axis=-1).mean()
+    return out

@@ -1,0 +1,76 @@
+"""Metrics logging.
+
+One `MetricsLogger` replaces the reference's three stores (hand-rolled `loggers` dict
+`ResNet/pytorch/train.py:260-285`, per-epoch pickles `ResNet/tensorflow/train.py:140-144`,
+TensorBoard writers `YOLO/tensorflow/train.py:159-179`): console prints every N steps,
+JSONL persistence, and an in-memory history dict with the reference's
+`{epochs: [], value: []}` shape for checkpoint round-tripping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class MeanAccumulator:
+    """Running mean of scalar metrics (the tf.keras.metrics.Mean role,
+    CycleGAN/tensorflow/train.py:33-52), weighted by example count."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.weight = 0.0
+
+    def update(self, metrics: Dict[str, float], weight: float = 1.0):
+        for k, v in metrics.items():
+            if k == "count":
+                continue
+            self.totals[k] = self.totals.get(k, 0.0) + float(v) * weight
+        self.weight += weight
+
+    def result(self) -> Dict[str, float]:
+        if self.weight == 0:
+            return {}
+        return {k: v / self.weight for k, v in self.totals.items()}
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: Optional[str] = None, name: str = "train"):
+        self.log_dir = log_dir
+        self.name = name
+        self.history: Dict[str, Dict[str, list]] = {}
+        self._jsonl = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(log_dir, f"{name}.jsonl"), "a")
+        self._t0 = time.time()
+
+    def log(self, step: int, metrics: Dict[str, float], epoch: Optional[int] = None,
+            prefix: str = "", echo: bool = True):
+        metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        for k, v in metrics.items():
+            h = self.history.setdefault(prefix + k, {"epochs": [], "value": []})
+            h["epochs"].append(epoch if epoch is not None else step)
+            h["value"].append(v)
+        rec = {"step": step, "epoch": epoch, "t": round(time.time() - self._t0, 3),
+               **{prefix + k: round(v, 6) for k, v in metrics.items()}}
+        if self._jsonl:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if echo:
+            body = " ".join(f"{prefix + k}={v:.4f}" for k, v in metrics.items())
+            ep = f"epoch {epoch} " if epoch is not None else ""
+            print(f"[{self.name}] {ep}step {step}: {body}", flush=True)
+
+    def close(self):
+        if self._jsonl:
+            self._jsonl.close()
+
+
+def device_get_metrics(metrics) -> Dict[str, float]:
+    return {k: float(v) for k, v in jax.device_get(metrics).items()}

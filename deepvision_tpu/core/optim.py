@@ -1,0 +1,62 @@
+"""Optimizer factory.
+
+Covers the reference's optimizer set: SGD+momentum (+weight decay) for classification
+(`ResNet/pytorch/train.py:141-215`), Adam for YOLO/Hourglass/GANs
+(`YOLO/tensorflow/train.py:287`, `DCGAN/tensorflow/main.py:42-43`), RMSprop for
+Inception-style configs. Built as optax chains with an injectable LR so the host-side
+plateau scale (schedules.PlateauState) can rescale without recompiling.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from .config import OptimizerConfig, ScheduleConfig
+from .schedules import build_schedule
+
+
+def build_optimizer(opt_cfg: OptimizerConfig, sched_cfg: ScheduleConfig,
+                    steps_per_epoch: int, total_epochs: int) -> optax.GradientTransformation:
+    schedule = build_schedule(sched_cfg, opt_cfg.learning_rate, steps_per_epoch, total_epochs)
+
+    parts = []
+    if opt_cfg.grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
+
+    name = opt_cfg.name
+    if name in ("sgd", "momentum"):
+        # L2-coupled weight decay, matching torch.optim.SGD(weight_decay=...) used by
+        # the reference configs (e.g. resnet50: lr .1, momentum .9, wd 1e-4,
+        # ResNet/pytorch/train.py:141-164).
+        if opt_cfg.weight_decay:
+            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+        if opt_cfg.momentum:
+            parts.append(optax.trace(decay=opt_cfg.momentum, nesterov=opt_cfg.nesterov))
+    elif name == "rmsprop":
+        parts.append(optax.scale_by_rms(decay=opt_cfg.rmsprop_decay, eps=opt_cfg.eps))
+        if opt_cfg.weight_decay:
+            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+    elif name == "adam":
+        parts.append(optax.scale_by_adam(b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps))
+    elif name == "adamw":
+        parts.append(optax.scale_by_adam(b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps))
+        if opt_cfg.weight_decay:
+            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    # inject_hyperparams exposes opt_state.hyperparams['lr_scale'] so the host-side
+    # plateau schedule can rescale the LR between epochs without retracing the step.
+    def _lr(lr_scale: float):
+        chain = optax.chain(*parts, optax.scale_by_schedule(schedule),
+                            optax.scale(-1.0), optax.scale(lr_scale))
+        return chain
+
+    return optax.inject_hyperparams(lambda lr_scale: _lr(lr_scale))(lr_scale=1.0)
+
+
+def set_lr_scale(opt_state, scale: float):
+    """Write the plateau scale into an inject_hyperparams state (host side)."""
+    import jax.numpy as jnp
+    opt_state.hyperparams["lr_scale"] = jnp.asarray(scale, dtype=jnp.float32)
+    return opt_state

@@ -1,0 +1,87 @@
+"""LR schedules.
+
+Covers every schedule the reference uses: StepLR / MultiStepLR
+(`ResNet/pytorch/train.py:141-215`), ReduceLROnPlateau (`:171-176` and the hand-rolled
+plateau in `YOLO/tensorflow/train.py:56-68`), CycleGAN's LinearDecay
+(`CycleGAN/tensorflow/utils.py:5-28`), plus warmup+cosine (not in the reference — needed
+for the large-batch ResNet recipe per BASELINE.md).
+
+Step-based schedules are optax functions of the global step (traceable under jit).
+Plateau is inherently host-driven (it reacts to val metrics), so it is a small host-side
+state machine whose output multiplies a base schedule via a dynamic scale carried in the
+optimizer state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+from .config import ScheduleConfig
+
+
+def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: int,
+                   total_epochs: int) -> optax.Schedule:
+    warmup_steps = int(cfg.warmup_epochs * steps_per_epoch)
+    total_steps = max(1, int(total_epochs * steps_per_epoch))
+
+    if cfg.name == "constant" or cfg.name == "plateau":
+        # plateau: base schedule is constant; the host-side PlateauState scales it.
+        sched = optax.constant_schedule(base_lr)
+    elif cfg.name == "step":
+        boundaries = {int(e * steps_per_epoch): cfg.decay_factor for e in cfg.boundaries_epochs}
+        sched = optax.piecewise_constant_schedule(base_lr, boundaries)
+    elif cfg.name == "cosine":
+        sched = optax.cosine_decay_schedule(base_lr, max(1, total_steps - warmup_steps),
+                                            alpha=cfg.min_lr / base_lr if base_lr else 0.0)
+    elif cfg.name == "linear_decay":
+        # constant until decay_start_epoch, then linear to ~0 (CycleGAN LinearDecay).
+        decay_start = int(cfg.decay_start_epoch * steps_per_epoch)
+        sched = optax.join_schedules(
+            [optax.constant_schedule(base_lr),
+             optax.linear_schedule(base_lr, 0.0, max(1, total_steps - decay_start))],
+            [decay_start],
+        )
+    else:
+        raise ValueError(f"unknown schedule {cfg.name!r}")
+
+    if warmup_steps > 0 and cfg.name != "linear_decay":
+        sched = optax.join_schedules(
+            [optax.linear_schedule(0.0, base_lr, warmup_steps), sched],
+            [warmup_steps],
+        )
+    return sched
+
+
+@dataclasses.dataclass
+class PlateauState:
+    """Host-side ReduceLROnPlateau (semantics of torch's, used at
+    `ResNet/pytorch/train.py:412-415`): if the watched val metric hasn't improved for
+    `patience` epochs, multiply LR by `factor`. The resulting scale is injected into the
+    optimizer via optax's `scale_by_learning_rate` wrapper (see optim.build_optimizer).
+    """
+    patience: int = 2
+    factor: float = 0.1
+    mode: str = "max"
+    min_scale: float = 0.0
+    best: Optional[float] = None
+    num_bad_epochs: int = 0
+    scale: float = 1.0
+
+    def update(self, metric: float) -> float:
+        improved = (
+            self.best is None
+            or (self.mode == "max" and metric > self.best)
+            or (self.mode == "min" and metric < self.best)
+        )
+        if improved:
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+            if self.num_bad_epochs > self.patience:
+                self.scale = max(self.scale * self.factor, self.min_scale)
+                self.num_bad_epochs = 0
+        return self.scale

@@ -1,0 +1,85 @@
+"""Jitted SPMD train/eval steps for classification.
+
+TPU-native translation of the reference's three training-loop generations
+(SURVEY.md §0): the per-batch body of `train()` (`ResNet/pytorch/train.py:438-485`) and
+the MirroredStrategy per-replica step + SUM-reduce (`YOLO/tensorflow/train.py:70-103,
+131-151`) collapse into one pure function `train_step(state, batch, rng)` jitted over a
+`Mesh`. The batch is sharded over the 'data' axis; GSPMD inserts the gradient
+all-reduce (the NCCL `strategy.reduce` equivalent) over ICI. BatchNorm statistics are
+computed over the full global batch (sync-BN), unlike the reference's per-replica BN.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import losses
+from .train_state import TrainState
+from ..parallel.mesh import DATA_AXIS
+
+
+def make_classification_train_step(
+    *,
+    label_smoothing: float = 0.0,
+    aux_weight: float = 0.3,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    donate: bool = True,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step."""
+
+    def step(state: TrainState, images, labels, rng):
+        images = images.astype(compute_dtype)
+
+        def loss_fn(params):
+            outputs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"],
+                rngs={"dropout": jax.random.fold_in(rng, state.step)},
+            )
+            loss = losses.classification_loss(
+                outputs, labels, label_smoothing=label_smoothing, aux_weight=aux_weight)
+            return loss, (outputs, mutated)
+
+        (loss, (outputs, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        new_state = state.apply_gradients(grads).replace(
+            batch_stats=mutated.get("batch_stats", state.batch_stats))
+        metrics = {"loss": loss, **losses.topk_accuracies(outputs, labels)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P(DATA_AXIS))
+        jit_kwargs["out_shardings"] = (None, repl)
+    return jax.jit(step, **jit_kwargs)
+
+
+def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
+                                  mesh: Optional[Mesh] = None) -> Callable:
+    """Build a jitted `(state, images, labels) -> metrics` step (no_grad validate loop,
+    reference `validate()` ResNet/pytorch/train.py:488-520)."""
+
+    def step(state: TrainState, images, labels):
+        images = images.astype(compute_dtype)
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        loss = losses.classification_loss(outputs, labels)
+        m = {"loss": loss, **losses.topk_accuracies(outputs, labels)}
+        # also return per-batch example count so the host can weight partial batches
+        m["count"] = jnp.asarray(labels.shape[0], jnp.float32)
+        return m
+
+    jit_kwargs = {}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
+    return jax.jit(step, **jit_kwargs)

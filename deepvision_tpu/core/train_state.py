@@ -1,0 +1,58 @@
+"""Train state pytree.
+
+One struct covers every model family: `params` + optional `batch_stats` (BatchNorm
+running stats — under jit+GSPMD the BN reduction spans the full global batch, i.e.
+cross-replica sync-BN for free, unlike the reference's per-replica stats under
+MirroredStrategy), optax `opt_state`, and the global `step`. This replaces the
+reference's four checkpoint payload shapes (SURVEY.md §5.4) with one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    # static (not part of the pytree):
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params, opt_state=new_opt_state)
+
+    @classmethod
+    def create(cls, apply_fn, params, tx, batch_stats=None) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats if batch_stats is not None else FrozenDict({}),
+            opt_state=tx.init(params),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+
+def init_model(model, rng: jax.Array, sample_input, train: bool = True):
+    """Initialize a Flax module, splitting out batch_stats if present."""
+    variables = model.init({"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+                           sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", FrozenDict({}))
+    return params, batch_stats
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,217 @@
+"""Shared classification Trainer.
+
+Replaces the reference's md5-copied per-model training loops
+(`ResNet/pytorch/train.py:310-520` and its 5 copies; `ResNet/tensorflow/train.py:221-297`)
+with one implementation: epoch loop → jitted SPMD train step over the mesh →
+validation with top-1/top-5 → plateau/step/cosine LR → Orbax checkpoint with
+keep-latest + keep-best → metrics logging. The per-model `train.py` entrypoints are
+thin wrappers that build a TrainConfig and call `Trainer.fit()`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import steps
+from .checkpoint import CheckpointManager
+from .config import TrainConfig
+from .metrics import MeanAccumulator, MetricsLogger
+from .optim import build_optimizer, set_lr_scale
+from .schedules import PlateauState
+from .train_state import TrainState, init_model, param_count
+from ..parallel import mesh as mesh_lib
+from ..models import MODELS  # importing ..models registers the whole zoo
+
+
+def _is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+class Trainer:
+    """Classification trainer: `fit(train_data, val_data)` where each dataset is an
+    iterable of (images NHWC float32, labels int32) numpy batches per epoch."""
+
+    def __init__(self, config: TrainConfig, model=None,
+                 mesh: Optional[Any] = None, workdir: Optional[str] = None):
+        self.config = config
+        self.workdir = workdir or config.checkpoint_dir
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+            model_parallel=config.model_parallel)
+
+        if model is None:
+            model_ctor = MODELS.get(config.model)
+            kwargs = dict(config.model_kwargs)
+            kwargs.setdefault("num_classes", config.data.num_classes)
+            if config.dtype and "dtype" not in kwargs:
+                try:
+                    model = model_ctor(dtype=jnp.dtype(config.dtype), **kwargs)
+                except TypeError:
+                    model = model_ctor(**kwargs)
+            else:
+                model = model_ctor(**kwargs)
+        self.model = model
+
+        self.steps_per_epoch = max(
+            1, config.data.train_examples // config.batch_size)
+        self.tx = build_optimizer(config.optimizer, config.schedule,
+                                  self.steps_per_epoch, config.total_epochs)
+
+        compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        self.train_step = steps.make_classification_train_step(
+            label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
+            compute_dtype=compute_dtype, mesh=self.mesh)
+        self.eval_step = steps.make_classification_eval_step(
+            compute_dtype=compute_dtype, mesh=self.mesh)
+
+        self.plateau = PlateauState(
+            patience=config.schedule.plateau_patience,
+            factor=config.schedule.plateau_factor,
+            mode=config.schedule.plateau_mode,
+        ) if config.schedule.name == "plateau" else None
+
+        self.logger = MetricsLogger(self.workdir, name=config.name)
+        self.ckpt = CheckpointManager(
+            self.workdir + "/ckpt", keep=config.keep_checkpoints,
+            keep_best=config.keep_best,
+            best_mode=config.schedule.plateau_mode if self.plateau else "max")
+
+        self.rng = jax.random.PRNGKey(config.seed)
+        self.state: Optional[TrainState] = None
+        self.start_epoch = 1
+        self.best_metric: Optional[float] = None
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, sample_shape) -> TrainState:
+        init_rng, self.rng = jax.random.split(self.rng)
+        sample = jnp.zeros((2, *sample_shape), jnp.float32)
+        params, batch_stats = init_model(self.model, init_rng, sample)
+        state = TrainState.create(self.model.apply, params, self.tx, batch_stats)
+        # Replicate (or model-shard large tensors) across the mesh.
+        rules = mesh_lib.param_sharding_rules(self.mesh, state.params)
+        repl = mesh_lib.replicated(self.mesh)
+        state = state.replace(
+            params=jax.device_put(state.params, rules),
+            batch_stats=jax.device_put(state.batch_stats, repl),
+            opt_state=jax.device_put(state.opt_state, repl),
+            step=jax.device_put(state.step, repl),
+        )
+        self.state = state
+        if _is_main_process():
+            print(f"[{self.config.name}] model={self.config.model} "
+                  f"params={param_count(params):,} "
+                  f"mesh={dict(self.mesh.shape)} "
+                  f"steps/epoch={self.steps_per_epoch}", flush=True)
+        return state
+
+    def resume(self, epoch: Optional[int] = None) -> Optional[int]:
+        """Restore latest (or given) checkpoint — the `-c` / auto-resume UX
+        (`ResNet/pytorch/train.py:552-557`, `YOLO/tensorflow/train.py:300-304`)."""
+        assert self.state is not None, "call init_state first"
+        state, host, got = self.ckpt.restore(self.state, epoch)
+        if got is None:
+            return None
+        self.state = state
+        self.start_epoch = got + 1
+        self.best_metric = host.get("best_metric")
+        if self.plateau and "plateau" in host:
+            p = host["plateau"]
+            self.plateau.best = p.get("best")
+            self.plateau.num_bad_epochs = p.get("num_bad_epochs", 0)
+            self.plateau.scale = p.get("scale", 1.0)
+            self.state = self.state.replace(
+                opt_state=set_lr_scale(self.state.opt_state, self.plateau.scale))
+        if _is_main_process():
+            print(f"[{self.config.name}] resumed from epoch {got}", flush=True)
+        return got
+
+    # -- loops ------------------------------------------------------------
+    def train_epoch(self, epoch: int, data: Iterable) -> dict:
+        acc = MeanAccumulator()
+        t0 = time.time()
+        n_img = 0
+        step_rng = jax.random.fold_in(self.rng, epoch)
+        for i, (images, labels) in enumerate(data):
+            batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels))
+            self.state, metrics = self.train_step(self.state, *batch, step_rng)
+            n_img += len(labels)
+            if (i + 1) % self.config.log_every_steps == 0:
+                m = jax.device_get(metrics)
+                self.logger.log(int(self.state.step), m, epoch=epoch, prefix="train_",
+                                echo=_is_main_process())
+                acc.update(m, weight=self.config.log_every_steps)
+        jax.block_until_ready(self.state.params)
+        dt = time.time() - t0
+        out = acc.result()
+        out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
+        return out
+
+    def evaluate(self, data: Iterable) -> dict:
+        acc = MeanAccumulator()
+        for images, labels in data:
+            batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels))
+            m = jax.device_get(self.eval_step(self.state, *batch))
+            acc.update(m, weight=float(m.get("count", len(labels))))
+        return acc.result()
+
+    def fit(self, train_data_fn: Callable[[int], Iterable],
+            val_data_fn: Optional[Callable[[int], Iterable]] = None,
+            sample_shape=None, resume: bool = False,
+            total_epochs: Optional[int] = None) -> dict:
+        """`train_data_fn(epoch)` returns that epoch's batch iterable (re-shuffled).
+
+        Mirrors run_epochs (`ResNet/pytorch/train.py:310-428`): optional sanity
+        validate at epoch 0, then train/validate/schedule/checkpoint per epoch.
+        """
+        cfg = self.config
+        total_epochs = total_epochs or cfg.total_epochs
+        if self.state is None:
+            if sample_shape is None:
+                s = cfg.data.image_size
+                sample_shape = (s, s, 3)
+            self.init_state(sample_shape)
+        if resume:
+            self.resume()
+
+        watch_key = "top1" if (not self.plateau or self.plateau.mode == "max") else "loss"
+        last_val = {}
+        for epoch in range(self.start_epoch, total_epochs + 1):
+            train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
+            if _is_main_process():
+                self.logger.log(int(self.state.step), train_metrics, epoch=epoch,
+                                prefix="epoch_train_")
+            if val_data_fn is not None:
+                last_val = self.evaluate(val_data_fn(epoch))
+                if _is_main_process():
+                    self.logger.log(int(self.state.step), last_val, epoch=epoch,
+                                    prefix="val_")
+                metric = last_val.get(watch_key, 0.0)
+            else:
+                metric = train_metrics.get("top1", 0.0)
+
+            if self.best_metric is None or (
+                    metric > self.best_metric if watch_key != "loss"
+                    else metric < self.best_metric):
+                self.best_metric = metric
+
+            if self.plateau:
+                scale = self.plateau.update(metric)
+                self.state = self.state.replace(
+                    opt_state=set_lr_scale(self.state.opt_state, scale))
+
+            if _is_main_process():
+                host = {"best_metric": self.best_metric}
+                if self.plateau:
+                    host["plateau"] = {"best": self.plateau.best,
+                                       "num_bad_epochs": self.plateau.num_bad_epochs,
+                                       "scale": self.plateau.scale}
+                self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+        return {"best_metric": self.best_metric, **last_val}
+
+    def close(self):
+        self.logger.close()
+        self.ckpt.close()

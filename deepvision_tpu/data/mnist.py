@@ -1,0 +1,89 @@
+"""MNIST idx-format parser + batch iterator.
+
+Parity target: `LeNet/pytorch/data_load.py:12-57` — parses the raw idx binary files,
+pads 28x28 → 32x32, normalizes with the reference's mean/std (0.1307/0.3081), and
+yields NHWC float32 batches. Pure numpy; no torch/tf dependency on the input path.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+MEAN, STD = 0.1307, 0.3081  # reference Normalize values, LeNet/pytorch/train.py
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    """Parse an idx3-ubyte image file (magic 2051) → (N, 28, 28) uint8."""
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad magic {magic} (want 2051)")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    """Parse an idx1-ubyte label file (magic 2049) → (N,) uint8."""
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad magic {magic} (want 2049)")
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+def preprocess(images: np.ndarray) -> np.ndarray:
+    """uint8 (N,28,28) → normalized float32 (N,32,32,1), pad 28→32 like the
+    reference (`LeNet/pytorch/data_load.py:40-44`)."""
+    x = np.pad(images, ((0, 0), (2, 2), (2, 2)), mode="constant").astype(np.float32)
+    x = (x / 255.0 - MEAN) / STD
+    return x[..., None]
+
+
+FILES = {
+    "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def load_split(data_dir: str, split: str) -> Tuple[np.ndarray, np.ndarray]:
+    img_name, lbl_name = FILES[split]
+    img_path, lbl_path = os.path.join(data_dir, img_name), os.path.join(data_dir, lbl_name)
+    if not os.path.exists(img_path) and os.path.exists(img_path + ".gz"):
+        img_path += ".gz"
+    if not os.path.exists(lbl_path) and os.path.exists(lbl_path + ".gz"):
+        lbl_path += ".gz"
+    images = preprocess(read_idx_images(img_path))
+    labels = read_idx_labels(lbl_path).astype(np.int32)
+    return images, labels
+
+
+class MnistBatches:
+    def __init__(self, images: np.ndarray, labels: np.ndarray, batch_size: int,
+                 shuffle: bool = True, seed: int = 0, drop_remainder: bool = True):
+        self.images, self.labels = images, labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.RandomState(seed)
+        self.drop_remainder = drop_remainder
+
+    def __iter__(self):
+        idx = np.arange(len(self.labels))
+        if self.shuffle:
+            self.rng.shuffle(idx)
+        end = len(idx) - (len(idx) % self.batch_size) if self.drop_remainder else len(idx)
+        for i in range(0, end, self.batch_size):
+            sel = idx[i:i + self.batch_size]
+            yield self.images[sel], self.labels[sel]
+
+    def __len__(self):
+        n = len(self.labels) // self.batch_size
+        return n if self.drop_remainder else -(-len(self.labels) // self.batch_size)

@@ -1,0 +1,42 @@
+"""Synthetic in-memory datasets.
+
+The reference kept a commented-out random-tensor harness for local testing
+(`CycleGAN/tensorflow/train.py:338-342`); here it is a first-class backend so every
+trainer can run end-to-end with no data on disk (used by tests and smoke runs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class SyntheticClassification:
+    """Deterministic fake (image, label) batches with a fixed learnable signal:
+    the label is encoded in the mean of the image, so a model can actually fit it —
+    useful for loss-goes-down tests."""
+
+    def __init__(self, batch_size: int, image_size: int = 32, channels: int = 3,
+                 num_classes: int = 10, num_batches: int = 8, seed: int = 0,
+                 learnable: bool = True):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.channels = channels
+        self.num_classes = num_classes
+        self.num_batches = num_batches
+        self.seed = seed
+        self.learnable = learnable
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        rng = np.random.RandomState(self.seed)
+        for _ in range(self.num_batches):
+            labels = rng.randint(0, self.num_classes, size=(self.batch_size,))
+            images = rng.randn(self.batch_size, self.image_size, self.image_size,
+                               self.channels).astype(np.float32)
+            if self.learnable:
+                images += (labels / self.num_classes - 0.5)[:, None, None, None] * 4.0
+            yield images, labels.astype(np.int32)
+
+    def __len__(self):
+        return self.num_batches

@@ -1,0 +1,5 @@
+"""Model zoo — importing this package registers all models in MODELS."""
+
+from . import lenet, resnet  # noqa: F401
+
+from ..utils.registry import MODELS  # noqa: F401

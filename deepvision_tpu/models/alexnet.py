@@ -1,0 +1,86 @@
+"""AlexNet (Krizhevsky et al. 2012) V1 and V2 ("One weird trick", Krizhevsky 2014).
+
+Parity targets: `AlexNet/pytorch/models/alexnet_v1.py:11-125` (one-tower original with
+LRN and overlapping 3x3/2 max-pool) and `alexnet_v2.py:12-75` / the Keras functional
+variant `AlexNet/tensorflow/models/alexnet_v2.py:25-70`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import lrn
+
+
+@MODELS.register("alexnet1")
+class AlexNetV1(nn.Module):
+    """Original AlexNet: conv1 11x11/4 → LRN → pool, conv2 5x5 grouped-in-paper
+    (single tower here, like the reference), conv3-5 3x3, two 4096 FC + dropout."""
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv = partial(nn.Conv, dtype=self.dtype,
+                       bias_init=nn.initializers.ones)  # paper: bias 1 in some layers
+        x = nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = lrn(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(256, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = lrn(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(384, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = conv(384, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = conv(256, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+@MODELS.register("alexnet2")
+class AlexNetV2(nn.Module):
+    """"One weird trick" variant: no LRN, channel widths 64/192/384/256/256
+    (`AlexNet/pytorch/models/alexnet_v2.py:12-75`)."""
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=[(2, 2), (2, 2)],
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

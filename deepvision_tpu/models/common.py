@@ -1,0 +1,61 @@
+"""Shared building blocks for the model zoo."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from flax.linen.initializers import variance_scaling
+
+# He/Kaiming normal fan-out — the init the reference uses for ResNet/VGG
+# (`ResNet/pytorch/models/resnet50.py:150-160` nn.init.kaiming_normal_(fan_out)).
+he_normal_fanout = variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+class ConvBN(nn.Module):
+    """Conv → BatchNorm → (optional) ReLU.
+
+    The repeated conv+BN+relu triple of the reference zoo (e.g. `BasicConv2d`,
+    `Inception/pytorch/models/inception_v1.py:193-200`). BN runs in f32 regardless of
+    compute dtype; under jit+GSPMD its batch reduction spans the global batch
+    (sync-BN).
+    """
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    groups: int = 1
+    use_bias: bool = False
+    relu: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides, padding=self.padding,
+                    feature_group_count=self.groups, use_bias=self.use_bias,
+                    kernel_init=he_normal_fanout, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=self.bn_momentum,
+                         epsilon=self.bn_epsilon, dtype=jnp.float32)(x)
+        if self.relu:
+            x = nn.relu(x)
+        return x.astype(self.dtype)
+
+
+def lrn(x, depth_radius: int = 2, bias: float = 2.0, alpha: float = 1e-4,
+        beta: float = 0.75):
+    """Local response normalization (AlexNet §3.3; reference uses nn.LocalResponseNorm
+    `AlexNet/pytorch/models/alexnet_v1.py` and a custom Keras layer
+    `AlexNet/tensorflow/models/alexnet_v2.py:10-22`). Cross-channel, NHWC."""
+    x32 = x.astype(jnp.float32)
+    sq = x32 * x32
+    c = x.shape[-1]
+    # sum over a window of 2*depth_radius+1 channels via padded cumulative window
+    pads = [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)]
+    sq = jnp.pad(sq, pads)
+    win = sum(sq[..., i:i + c] for i in range(2 * depth_radius + 1))
+    denom = jnp.power(bias + alpha * win, beta)
+    return (x32 / denom).astype(x.dtype)

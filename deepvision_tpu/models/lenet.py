@@ -1,0 +1,38 @@
+"""LeNet-5 (LeCun et al. 1998, "Gradient-Based Learning Applied to Document
+Recognition").
+
+Parity target: `LeNet/pytorch/models/lenet5.py:8-67` and
+`LeNet/tensorflow/models/lenet5.py:7-34` — classic C1/S2/C3/S4/C5/F6 stack with tanh
+activations and average pooling, input 32x32x1 (MNIST padded 28→32 by the loader,
+`LeNet/pytorch/data_load.py:40-44`). NHWC layout for TPU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+
+
+@MODELS.register("lenet5")
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="c1")(x)
+        x = jnp.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="c3")(x)
+        x = jnp.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(120, (5, 5), padding="VALID", dtype=self.dtype, name="c5")(x)
+        x = jnp.tanh(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(84, dtype=self.dtype, name="f6")(x)
+        x = jnp.tanh(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="output")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,173 @@
+"""ResNet family (He et al. 2015, "Deep Residual Learning for Image Recognition";
+V2 from He et al. 2016, "Identity Mappings in Deep Residual Networks").
+
+Parity targets:
+- ResNet-34 basic-block (`ResNet/pytorch/models/resnet34.py:8-143`)
+- ResNet-50/152 bottleneck with projection shortcuts + He fan-out init
+  (`ResNet/pytorch/models/resnet50.py:8-165`, `resnet152.py`)
+- ResNet-50 V2 pre-activation (`ResNet/tensorflow/models/resnet50v2.py`)
+
+TPU-first choices: NHWC layout, bf16 compute / f32 BN+params, zero-init of the last
+BN gamma in each residual block (standard large-batch recipe, needed for the
+BASELINE.md 75.3% target; not in the reference).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import he_normal_fanout
+
+
+class _BN(nn.Module):
+    scale_init: Callable = nn.initializers.ones
+    relu: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32, scale_init=self.scale_init)(x)
+        if self.relu:
+            x = nn.relu(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut
+    (`ResNet/pytorch/models/resnet34.py:92-143`)."""
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
+                       dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (3, 3), strides=self.strides)(x)
+        y = _BN()(y, train).astype(self.dtype)
+        y = conv(self.features, (3, 3))(y)
+        y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1), strides=self.strides,
+                            name="proj")(residual)
+            residual = _BN(relu=False)(residual, train)
+        return nn.relu(y + residual).astype(self.dtype)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (×4) + projection shortcut
+    (`ResNet/pytorch/models/resnet50.py:96-165`). Stride on the 3x3 (torch style)."""
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    expansion: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
+                       dtype=self.dtype)
+        out_features = self.features * self.expansion
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = _BN()(y, train).astype(self.dtype)
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = _BN()(y, train).astype(self.dtype)
+        y = conv(out_features, (1, 1))(y)
+        y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
+        if residual.shape != y.shape:
+            residual = conv(out_features, (1, 1), strides=self.strides,
+                            name="proj")(residual)
+            residual = _BN(relu=False)(residual, train)
+        return nn.relu(y + residual).astype(self.dtype)
+
+
+class ResNet(nn.Module):
+    """V1 ResNet: 7x7/2 stem → maxpool → 4 stages → GAP → Dense."""
+    stage_sizes: Sequence[int]
+    block: type = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, kernel_init=he_normal_fanout, dtype=self.dtype,
+                    name="stem_conv")(x)
+        x = _BN()(x, train).astype(self.dtype)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.width * 2 ** i, strides=strides,
+                               dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.initializers.normal(0.01), name="head")(x)
+        return x.astype(jnp.float32)
+
+
+MODELS.register("resnet34", partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock))
+MODELS.register("resnet50", partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock))
+MODELS.register("resnet101", partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock))
+MODELS.register("resnet152", partial(ResNet, stage_sizes=(3, 8, 36, 3), block=BottleneckBlock))
+
+
+class PreActBottleneck(nn.Module):
+    """Pre-activation bottleneck (`ResNet/tensorflow/models/resnet50v2.py:18+`)."""
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    expansion: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
+                       dtype=self.dtype)
+        out_features = self.features * self.expansion
+        pre = _BN()(x, train).astype(self.dtype)
+        if x.shape[-1] != out_features or self.strides != (1, 1):
+            residual = conv(out_features, (1, 1), strides=self.strides, name="proj")(pre)
+        else:
+            residual = x
+        y = conv(self.features, (1, 1))(pre)
+        y = _BN()(y, train).astype(self.dtype)
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = _BN()(y, train).astype(self.dtype)
+        y = conv(out_features, (1, 1))(y)
+        return (y + residual).astype(self.dtype)
+
+
+class ResNetV2(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, kernel_init=he_normal_fanout, dtype=self.dtype,
+                    name="stem_conv")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = PreActBottleneck(self.width * 2 ** i, strides=strides,
+                                     dtype=self.dtype)(x, train=train)
+        x = _BN()(x, train).astype(self.dtype)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.initializers.normal(0.01), name="head")(x)
+        return x.astype(jnp.float32)
+
+
+MODELS.register("resnet50v2", partial(ResNetV2, stage_sizes=(3, 4, 6, 3)))

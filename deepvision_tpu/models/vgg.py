@@ -1,0 +1,49 @@
+"""VGG-16 / VGG-19 (Simonyan & Zisserman 2014, configurations D and E).
+
+Parity target: `VGG/pytorch/models/vgg16.py:8-127` / `vgg19.py:7-128` — plain 3x3 conv
+stacks with 2x2 max-pools and three FC layers, manual weight init
+(`vgg16.py:112-127` → normal(0, 0.01) dense, kaiming conv).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import he_normal_fanout
+
+# channels per stage; (vgg16, vgg19) differ only in convs per stage: (2,2,3,3,3) vs
+# (2,2,4,4,4)
+_STAGES: Tuple[int, ...] = (64, 128, 256, 512, 512)
+_DEPTHS = {"vgg16": (2, 2, 3, 3, 3), "vgg19": (2, 2, 4, 4, 4)}
+
+
+class VGG(nn.Module):
+    depths: Sequence[int]
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for stage, (features, depth) in enumerate(zip(_STAGES, self.depths)):
+            for _ in range(depth):
+                x = nn.Conv(features, (3, 3), padding="SAME", dtype=self.dtype,
+                            kernel_init=he_normal_fanout)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        dense_init = nn.initializers.normal(0.01)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, kernel_init=dense_init)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, kernel_init=dense_init)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, kernel_init=dense_init)(x)
+        return x.astype(jnp.float32)
+
+
+MODELS.register("vgg16", lambda **kw: VGG(depths=_DEPTHS["vgg16"], **kw))
+MODELS.register("vgg19", lambda **kw: VGG(depths=_DEPTHS["vgg19"], **kw))

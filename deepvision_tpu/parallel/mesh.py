@@ -1,0 +1,87 @@
+"""Device mesh construction and sharding helpers.
+
+TPU-native replacement for the reference's data-parallel wrappers
+(`nn.DataParallel`, `ResNet/pytorch/train.py:352-355`; `tf.distribute.MirroredStrategy`,
+`YOLO/tensorflow/train.py:281-294`). Instead of replicate/scatter/gather wrappers we
+build a `jax.sharding.Mesh` and let GSPMD insert the collectives: the batch is sharded
+over the 'data' axis (gradients all-reduce over ICI automatically), and large params
+may be sharded over the 'model' axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: int = 1,
+    axis_names: tuple[str, str] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Build a (data, model) 2-D mesh over the given devices.
+
+    With ``model_parallel=1`` this is pure data parallelism — the idiomatic
+    equivalent of the reference's MirroredStrategy NCCL all-reduce, but over ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, axis_names)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Shard the leading (batch) dim over 'data'; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_pytree(mesh: Mesh, batch):
+    """Device-put a host pytree of arrays with the batch dim sharded over 'data'."""
+    def _put(x):
+        x = np.asarray(x)
+        return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1)))))
+    return jax.tree_util.tree_map(_put, batch)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return int(math.ceil(n / k) * k)
+
+
+def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
+    """Sharding pytree for params: shard the largest axis of big tensors over 'model',
+    replicate everything else.
+
+    When the mesh's model axis is 1 (pure DP) this degenerates to full replication,
+    matching the reference's replicated-weights semantics. For wide final projections
+    (e.g. the 2048x1000 ResNet-50 head) a model axis > 1 shards the weight so the
+    matmul runs as a partial-K/N matmul with an all-reduce inserted by GSPMD.
+    """
+    model_size = mesh.shape[MODEL_AXIS]
+
+    def rule(x):
+        if model_size == 1 or x.ndim == 0 or x.size < min_size_to_shard:
+            return NamedSharding(mesh, P())
+        # shard the largest divisible axis over 'model'
+        axes = sorted(range(x.ndim), key=lambda a: -x.shape[a])
+        for a in axes:
+            if x.shape[a] % model_size == 0:
+                spec = [None] * x.ndim
+                spec[a] = MODEL_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, params)

@@ -1,0 +1,51 @@
+"""Tiny name → object registries.
+
+Replaces the reference's per-file ``training_config`` dicts keyed by model name
+(`ResNet/pytorch/train.py:26-215`) with one shared registry so configs/models are
+declared once and selected via the same ``-m <name>`` CLI surface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, object] = {}
+
+    def register(self, name: str, obj: object = None):
+        if obj is not None:
+            self._add(name, obj)
+            return obj
+
+        def deco(o):
+            self._add(name, o)
+            return o
+
+        return deco
+
+    def _add(self, name: str, obj: object):
+        if name in self._entries:
+            raise KeyError(f"duplicate {self.kind} registration: {name!r}")
+        self._entries[name] = obj
+
+    def get(self, name: str):
+        try:
+            return self._entries[name]
+        except KeyError:
+            known = ", ".join(sorted(self._entries))
+            raise KeyError(f"unknown {self.kind} {name!r}; known: {known}") from None
+
+    def names(self):
+        return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+
+MODELS = Registry("model")
+CONFIGS = Registry("training config")

@@ -1,0 +1,28 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax initializes, so all
+data/model-parallel sharding logic is exercised without TPU hardware (the portable
+trick recommended in SURVEY.md §4)."""
+
+import os
+
+# Force CPU: the session env presets JAX_PLATFORMS=axon (TPU-via-tunnel), which is
+# wrong for unit tests — override, don't setdefault.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh_4x2():
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh(model_parallel=2)

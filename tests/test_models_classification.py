@@ -1,0 +1,56 @@
+"""Shape/param sanity for the classification zoo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu import models
+from deepvision_tpu.core.train_state import init_model, param_count
+from deepvision_tpu.utils.registry import MODELS
+
+
+def _build(name, **kw):
+    return MODELS.get(name)(**kw)
+
+
+def _init_and_apply(model, shape, train=False):
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((2, *shape), jnp.float32)
+    params, batch_stats = init_model(model, rng, x)
+    out = model.apply({"params": params, "batch_stats": batch_stats}, x,
+                      train=train, mutable=["batch_stats"] if train else False,
+                      rngs={"dropout": rng} if train else None)
+    return params, out
+
+
+def test_lenet5_shapes():
+    model = _build("lenet5", num_classes=10)
+    params, out = _init_and_apply(model, (32, 32, 1))
+    assert out.shape == (2, 10)
+    # ~61k params in the classic LeNet-5
+    assert 40_000 < param_count(params) < 80_000
+
+
+@pytest.mark.parametrize("name,expected_m", [
+    ("resnet34", (20, 23)),
+    ("resnet50", (24, 27)),
+    ("resnet152", (58, 62)),
+    ("resnet50v2", (24, 27)),
+])
+def test_resnet_param_counts(name, expected_m):
+    model = _build(name, num_classes=1000, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((1, 64, 64, 3), jnp.float32)  # small spatial for test speed
+    params, _ = init_model(model, rng, x)
+    n = param_count(params) / 1e6
+    lo, hi = expected_m
+    assert lo < n < hi, f"{name}: {n:.1f}M params"
+
+
+def test_resnet50_forward_and_train_mode():
+    model = _build("resnet50", num_classes=17, dtype=jnp.float32)
+    params, (out, mutated) = _init_and_apply(model, (64, 64, 3), train=True)
+    assert out.shape == (2, 17)
+    assert out.dtype == jnp.float32
+    assert "batch_stats" in mutated
