@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Train MobileNet models on TPU — `python train.py -m <model> [-c latest] [--synthetic]`.
+
+Per-family entrypoint matching the reference's UX (MobileNet/pytorch|tensorflow/train.py),
+backed by the shared deepvision_tpu Trainer instead of a copy-pasted loop.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_classification
+
+MODELS = ["mobilenet_v1"]
+
+if __name__ == "__main__":
+    run_classification("MobileNet", MODELS)

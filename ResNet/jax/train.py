@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Train ResNet models on TPU — `python train.py -m <model> [-c latest] [--synthetic]`.
+
+Per-family entrypoint matching the reference's UX (ResNet/pytorch|tensorflow/train.py),
+backed by the shared deepvision_tpu Trainer instead of a copy-pasted loop.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_classification
+
+MODELS = ["resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2"]
+
+if __name__ == "__main__":
+    run_classification("ResNet", MODELS)

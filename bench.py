@@ -1,0 +1,78 @@
+"""Benchmark: ResNet-50 ImageNet-shape training-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's ResNet-50 was trained on 1x P100 at batch 256
+(`ResNet/pytorch/README.md:24,67`). A P100 sustains ~230 images/sec on ResNet-50
+fp32 training (MLPerf-era public number); vs_baseline = ours / 230.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P100_BASELINE_IMG_PER_SEC = 230.0
+
+
+def main():
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_mesh()
+    platform = jax.devices()[0].platform
+    batch = 256 if platform == "tpu" else 32  # per-chip ImageNet batch
+    image_size = 224 if platform == "tpu" else 64
+
+    model = MODELS.get("resnet50")(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, image_size, image_size, 3)))
+    tx = build_optimizer(OptimizerConfig(name="momentum", learning_rate=0.1,
+                                         weight_decay=1e-4),
+                         ScheduleConfig(name="cosine", warmup_epochs=1),
+                         steps_per_epoch=1000, total_epochs=90)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+    repl = mesh_lib.replicated(mesh)
+    state = jax.device_put(state, repl)
+
+    train_step = steps.make_classification_train_step(
+        label_smoothing=0.1, compute_dtype=jnp.bfloat16, mesh=mesh)
+
+    rs = np.random.RandomState(0)
+    images = rs.randn(batch, image_size, image_size, 3).astype(np.float32)
+    labels = rs.randint(0, 1000, size=(batch,)).astype(np.int32)
+    sharded = mesh_lib.shard_batch_pytree(mesh, (images, labels))
+
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = train_step(state, *sharded, rng)
+    jax.block_until_ready(state.params)
+
+    n_steps = 20 if platform == "tpu" else 5
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = train_step(state, *sharded, rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = n_steps * batch / dt
+    img_per_sec_per_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": f"resnet50_train_images_per_sec_per_chip(b{batch},{image_size}px,{platform})",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / P100_BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

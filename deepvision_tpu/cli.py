@@ -1,0 +1,115 @@
+"""Shared CLI for classification training.
+
+Preserves the reference's documented UX (`python train.py -m <model> [-c <ckpt>]`,
+`ResNet/pytorch/train.py:541-562`; `ResNet/pytorch/README.md:33`) while backing every
+family's `train.py` with the one shared Trainer. Extras the reference lacked:
+`--synthetic` smoke mode, `--data-dir`, epoch/batch overrides, auto-resume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+from .configs import CONFIGS, get_config
+
+
+def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=f"Train {family} models (TPU-native JAX). Models: {', '.join(models)}")
+    p.add_argument("-m", "--model", required=True, choices=list(models))
+    p.add_argument("-c", "--checkpoint", default=None,
+                   help="resume from this epoch number, or 'latest'")
+    p.add_argument("--data-dir", default=None,
+                   help="dataset root (TFRecords for ImageNet, idx files for MNIST)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="train on synthetic data (smoke test, no dataset needed)")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="override steps per epoch (synthetic/smoke)")
+    return p
+
+
+def run_classification(family: str, models: Sequence[str],
+                       argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_parser(family, models).parse_args(argv)
+    cfg = get_config(args.model)
+    if args.epochs:
+        cfg = cfg.replace(total_epochs=args.epochs)
+    if args.batch_size:
+        cfg = cfg.replace(batch_size=args.batch_size)
+    if args.synthetic:
+        n_batches = args.steps_per_epoch or 8
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, dataset="synthetic", train_examples=cfg.batch_size * n_batches))
+    workdir = args.workdir or os.path.join("runs", cfg.name)
+
+    from .core.trainer import Trainer
+    trainer = Trainer(cfg, workdir=workdir)
+
+    data = cfg.data
+    image_size = data.image_size
+    if args.synthetic or data.dataset == "synthetic":
+        from .data.synthetic import SyntheticClassification
+        n_batches = max(1, data.train_examples // cfg.batch_size)
+
+        def train_fn(epoch):
+            return SyntheticClassification(cfg.batch_size, image_size, 3,
+                                           data.num_classes, n_batches, seed=epoch)
+
+        def val_fn(epoch):
+            return SyntheticClassification(cfg.batch_size, image_size, 3,
+                                           data.num_classes, 2, seed=10**6)
+
+        sample_shape = (image_size, image_size, 3)
+    elif data.dataset == "mnist":
+        from .data.mnist import MnistBatches, load_split
+        data_dir = args.data_dir or data.data_dir or "dataset/mnist"
+        train_x, train_y = load_split(data_dir, "train")
+        test_x, test_y = load_split(data_dir, "test")
+
+        def train_fn(epoch):
+            return MnistBatches(train_x, train_y, cfg.batch_size, shuffle=True,
+                                seed=epoch)
+
+        def val_fn(epoch):
+            return MnistBatches(test_x, test_y, cfg.batch_size, shuffle=False,
+                                drop_remainder=False)
+
+        sample_shape = (32, 32, 1)
+    elif data.dataset == "imagenet":
+        import jax
+        from .data import imagenet as inet
+        data_dir = args.data_dir or data.data_dir or "dataset/tfrecord"
+        per_host = cfg.batch_size // jax.process_count()
+        steps = args.steps_per_epoch or data.train_examples // cfg.batch_size
+        train_ds = inet.build_dataset(
+            os.path.join(data_dir, "train*"), batch_size=per_host,
+            image_size=image_size, training=True,
+            num_process=jax.process_count(), process_index=jax.process_index())
+        val_ds = inet.build_dataset(
+            os.path.join(data_dir, "val*"), batch_size=per_host,
+            image_size=image_size, training=False,
+            num_process=jax.process_count(), process_index=jax.process_index())
+
+        def train_fn(epoch, _ds=train_ds, _steps=steps):
+            return inet.epoch_iterator(_ds, _steps)
+
+        def val_fn(epoch, _ds=val_ds):
+            return inet.epoch_iterator(_ds)
+
+        sample_shape = (image_size, image_size, 3)
+    else:
+        raise ValueError(f"unknown dataset {data.dataset!r}")
+
+    trainer.init_state(sample_shape)
+    if args.checkpoint:
+        trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
+    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape)
+    trainer.close()
+    print(f"done: best={result.get('best_metric')}")
+    return result

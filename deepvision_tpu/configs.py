@@ -1,0 +1,116 @@
+"""Per-model training configs — the `training_config` registry surface of the
+reference (`ResNet/pytorch/train.py:26-215`, selected by `-m <name>`), as typed
+dataclasses. Hyperparameters are paper-cited; where the reference's single-GPU recipe
+conflicts with the large-batch TPU recipe (BASELINE.md: ResNet-50 must reach 75.3%),
+the TPU recipe wins and the difference is noted.
+"""
+
+from __future__ import annotations
+
+from .core.config import (DataConfig, OptimizerConfig, ScheduleConfig, TrainConfig)
+from .utils.registry import CONFIGS
+
+
+def _imagenet(image_size=224, **kw):
+    return DataConfig(dataset="imagenet", image_size=image_size, num_classes=1000,
+                      train_examples=1281167, val_examples=50000, **kw)
+
+
+# -- LeNet (reference: LeNet/pytorch/train.py:15-32 — Adam, MNIST) -------------
+CONFIGS.register("lenet5", TrainConfig(
+    name="lenet5", model="lenet5", batch_size=256, total_epochs=20,
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+    schedule=ScheduleConfig(name="plateau", plateau_patience=2, plateau_mode="max"),
+    data=DataConfig(dataset="mnist", image_size=32, num_classes=10,
+                    train_examples=60000, val_examples=10000),
+    dtype="float32",
+))
+
+# -- AlexNet (Krizhevsky 2012 §5: SGD momentum .9, wd 5e-4, lr .01 /10 on plateau;
+#    reference alexnet configs mirror this) ------------------------------------
+for _name in ("alexnet1", "alexnet2"):
+    CONFIGS.register(_name, TrainConfig(
+        name=_name, model=_name, batch_size=128, total_epochs=90,
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.01, momentum=0.9,
+                                  weight_decay=5e-4),
+        schedule=ScheduleConfig(name="plateau", plateau_patience=2,
+                                plateau_factor=0.1, plateau_mode="max"),
+        data=_imagenet(227 if _name == "alexnet1" else 224),
+    ))
+
+# -- VGG (Simonyan 2014 §3.1: batch 256, momentum .9, wd 5e-4, lr .01 /10 on
+#    plateau, dropout .5) -------------------------------------------------------
+for _name in ("vgg16", "vgg19"):
+    CONFIGS.register(_name, TrainConfig(
+        name=_name, model=_name, batch_size=256, total_epochs=74,
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.01, momentum=0.9,
+                                  weight_decay=5e-4),
+        schedule=ScheduleConfig(name="plateau", plateau_patience=2,
+                                plateau_factor=0.1, plateau_mode="max"),
+        data=_imagenet(),
+    ))
+
+# -- Inception V1 (Szegedy 2014 §5: momentum .9, lr decreased 4% every 8 epochs;
+#    aux heads weighted 0.3 — the reference never wired the aux losses, fixed
+#    here via aux_loss_weight) --------------------------------------------------
+CONFIGS.register("inception_v1", TrainConfig(
+    name="inception_v1", model="inception_v1", batch_size=256, total_epochs=90,
+    optimizer=OptimizerConfig(name="momentum", learning_rate=0.05, momentum=0.9,
+                              weight_decay=1e-4),
+    schedule=ScheduleConfig(name="step", warmup_epochs=2,
+                            boundaries_epochs=tuple(range(8, 90, 8)),
+                            decay_factor=0.96 ** 8),
+    aux_loss_weight=0.3,
+    data=_imagenet(),
+))
+
+# -- Inception V3 (Szegedy 2015 §8: RMSprop decay .9 eps 1.0, lr .045 ×0.94 every
+#    2 epochs, grad clip 2.0, label smoothing .1, 299px) ------------------------
+CONFIGS.register("inception_v3", TrainConfig(
+    name="inception_v3", model="inception_v3", batch_size=256, total_epochs=100,
+    optimizer=OptimizerConfig(name="rmsprop", learning_rate=0.045, rmsprop_decay=0.9,
+                              eps=1.0, grad_clip_norm=2.0),
+    schedule=ScheduleConfig(name="step", boundaries_epochs=tuple(range(2, 100, 2)),
+                            decay_factor=0.94),
+    label_smoothing=0.1, aux_loss_weight=0.3,
+    data=_imagenet(299),
+))
+
+# -- ResNet (He 2015 §3.4: batch 256, lr .1, /10 at plateau, momentum .9, wd 1e-4.
+#    TPU recipe: warmup 5 epochs + cosine to 90, label smoothing .1 — needed for
+#    the 75.3% BASELINE.md bar; plateau kept available via schedule.name) -------
+for _name in ("resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2"):
+    CONFIGS.register(_name, TrainConfig(
+        name=_name, model=_name, batch_size=256, total_epochs=90,
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.1, momentum=0.9,
+                                  weight_decay=1e-4),
+        schedule=ScheduleConfig(name="cosine", warmup_epochs=5),
+        label_smoothing=0.1,
+        data=_imagenet(),
+    ))
+
+# -- MobileNet V1 (Howard 2017 §4: RMSprop, less wd on depthwise; simplified to
+#    the common cosine recipe; reference config `MobileNet/pytorch/train.py`) ---
+CONFIGS.register("mobilenet_v1", TrainConfig(
+    name="mobilenet_v1", model="mobilenet_v1", batch_size=256, total_epochs=90,
+    optimizer=OptimizerConfig(name="rmsprop", learning_rate=0.045, rmsprop_decay=0.9,
+                              weight_decay=4e-5),
+    schedule=ScheduleConfig(name="step", boundaries_epochs=tuple(range(2, 90, 2)),
+                            decay_factor=0.94),
+    data=_imagenet(),
+))
+
+# -- ShuffleNet V1 (Zhang 2017 §4: BN no-decay, linear-decay LR over 3e5 steps;
+#    reference left the model an empty stub — completed here) -------------------
+CONFIGS.register("shufflenet_v1", TrainConfig(
+    name="shufflenet_v1", model="shufflenet_v1", batch_size=512, total_epochs=90,
+    optimizer=OptimizerConfig(name="momentum", learning_rate=0.25, momentum=0.9,
+                              weight_decay=4e-5),
+    schedule=ScheduleConfig(name="linear_decay", decay_start_epoch=0),
+    label_smoothing=0.1,
+    data=_imagenet(),
+))
+
+
+def get_config(name: str) -> TrainConfig:
+    return CONFIGS.get(name)

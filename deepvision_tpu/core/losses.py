@@ -15,13 +15,19 @@ import jax.numpy as jnp
 import optax
 
 
-def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray,
-                 label_smoothing: float = 0.0) -> jnp.ndarray:
-    """Mean softmax cross-entropy over integer labels."""
+def per_example_xent(logits: jnp.ndarray, labels: jnp.ndarray,
+                     label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Per-example softmax cross-entropy over integer labels, shape (batch,)."""
     num_classes = logits.shape[-1]
     onehot = optax.smooth_labels(
         jnp.eye(num_classes, dtype=jnp.float32)[labels], label_smoothing)
-    return optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
+    return optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot)
+
+
+def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray,
+                 label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer labels."""
+    return per_example_xent(logits, labels, label_smoothing).mean()
 
 
 def classification_loss(outputs, labels, label_smoothing: float = 0.0,
@@ -56,3 +62,15 @@ def topk_accuracies(logits: jnp.ndarray, labels: jnp.ndarray,
         kk = min(k, logits.shape[-1])
         out[f"top{k}"] = correct[..., :kk].any(axis=-1).mean()
     return out
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray,
+                 ks: Sequence[int] = (1, 5)) -> dict:
+    """Per-example top-k correctness indicators (batch,) — for masked eval sums."""
+    if isinstance(logits, (tuple, list)):
+        logits = logits[0]
+    k_max = min(max(ks), logits.shape[-1])
+    top = jnp.argsort(logits, axis=-1)[..., ::-1][..., :k_max]
+    correct = top == labels[..., None]
+    return {f"top{k}": correct[..., :min(k, logits.shape[-1])].any(axis=-1)
+            .astype(jnp.float32) for k in ks}

@@ -29,17 +29,17 @@ def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: int,
 
     if cfg.name == "constant" or cfg.name == "plateau":
         # plateau: base schedule is constant; the host-side PlateauState scales it.
-        sched = optax.constant_schedule(base_lr)
+        base = optax.constant_schedule(base_lr)
     elif cfg.name == "step":
         boundaries = {int(e * steps_per_epoch): cfg.decay_factor for e in cfg.boundaries_epochs}
-        sched = optax.piecewise_constant_schedule(base_lr, boundaries)
+        base = optax.piecewise_constant_schedule(base_lr, boundaries)
     elif cfg.name == "cosine":
-        sched = optax.cosine_decay_schedule(base_lr, max(1, total_steps - warmup_steps),
-                                            alpha=cfg.min_lr / base_lr if base_lr else 0.0)
+        base = optax.cosine_decay_schedule(base_lr, total_steps,
+                                           alpha=cfg.min_lr / base_lr if base_lr else 0.0)
     elif cfg.name == "linear_decay":
         # constant until decay_start_epoch, then linear to ~0 (CycleGAN LinearDecay).
         decay_start = int(cfg.decay_start_epoch * steps_per_epoch)
-        sched = optax.join_schedules(
+        base = optax.join_schedules(
             [optax.constant_schedule(base_lr),
              optax.linear_schedule(base_lr, 0.0, max(1, total_steps - decay_start))],
             [decay_start],
@@ -47,12 +47,18 @@ def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: int,
     else:
         raise ValueError(f"unknown schedule {cfg.name!r}")
 
-    if warmup_steps > 0 and cfg.name != "linear_decay":
-        sched = optax.join_schedules(
-            [optax.linear_schedule(0.0, base_lr, warmup_steps), sched],
-            [warmup_steps],
-        )
-    return sched
+    if warmup_steps > 0:
+        # Multiplicative linear warmup: keeps the base schedule's boundaries at their
+        # ABSOLUTE steps (optax.join_schedules would shift the inner schedule by
+        # -warmup_steps, silently moving step-decay epochs late).
+        import jax.numpy as jnp
+
+        def sched(count):
+            warm = jnp.minimum(1.0, (count + 1) / warmup_steps)
+            return base(count) * warm
+
+        return sched
+    return base
 
 
 @dataclasses.dataclass

@@ -65,18 +65,25 @@ def make_classification_train_step(
 
 def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
                                   mesh: Optional[Mesh] = None) -> Callable:
-    """Build a jitted `(state, images, labels) -> metrics` step (no_grad validate loop,
-    reference `validate()` ResNet/pytorch/train.py:488-520)."""
+    """Build a jitted `(state, images, labels, mask) -> sums` step (no_grad validate
+    loop, reference `validate()` ResNet/pytorch/train.py:488-520).
 
-    def step(state: TrainState, images, labels):
+    `mask` is a (batch,) 0/1 float marking real examples: partial final batches are
+    padded up to a multiple of the data axis on the host, and padded rows contribute
+    nothing to the returned SUMS. The host divides by `count` to get means.
+    """
+
+    def step(state: TrainState, images, labels, mask):
         images = images.astype(compute_dtype)
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)
-        loss = losses.classification_loss(outputs, labels)
-        m = {"loss": loss, **losses.topk_accuracies(outputs, labels)}
-        # also return per-batch example count so the host can weight partial batches
-        m["count"] = jnp.asarray(labels.shape[0], jnp.float32)
+        xent = losses.per_example_xent(outputs if not isinstance(outputs, (tuple, list))
+                                       else outputs[0], labels)
+        correct = losses.topk_correct(outputs, labels)
+        m = {"loss": jnp.sum(xent * mask),
+             **{k: jnp.sum(v * mask) for k, v in correct.items()},
+             "count": jnp.sum(mask)}
         return m
 
     jit_kwargs = {}

@@ -45,10 +45,12 @@ class TrainState:
         )
 
 
-def init_model(model, rng: jax.Array, sample_input, train: bool = True):
+def init_model(model, rng: jax.Array, sample_input):
     """Initialize a Flax module, splitting out batch_stats if present."""
+    # init in train mode so every branch's params materialize (e.g. Inception aux
+    # heads exist only when train=True)
     variables = model.init({"params": rng, "dropout": jax.random.fold_in(rng, 1)},
-                           sample_input, train=False)
+                           sample_input, train=True)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", FrozenDict({}))
     return params, batch_stats

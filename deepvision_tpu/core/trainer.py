@@ -32,6 +32,21 @@ def _is_main_process() -> bool:
     return jax.process_index() == 0
 
 
+def _accepts_kwarg(ctor, name: str) -> bool:
+    import functools
+    import inspect
+    if isinstance(ctor, functools.partial):
+        if name in ctor.keywords:
+            return False  # already bound
+        ctor = ctor.func
+    try:
+        params = inspect.signature(ctor).parameters
+    except (TypeError, ValueError):
+        return False
+    return name in params or any(p.kind == inspect.Parameter.VAR_KEYWORD
+                                 for p in params.values())
+
+
 class Trainer:
     """Classification trainer: `fit(train_data, val_data)` where each dataset is an
     iterable of (images NHWC float32, labels int32) numpy batches per epoch."""
@@ -47,13 +62,9 @@ class Trainer:
             model_ctor = MODELS.get(config.model)
             kwargs = dict(config.model_kwargs)
             kwargs.setdefault("num_classes", config.data.num_classes)
-            if config.dtype and "dtype" not in kwargs:
-                try:
-                    model = model_ctor(dtype=jnp.dtype(config.dtype), **kwargs)
-                except TypeError:
-                    model = model_ctor(**kwargs)
-            else:
-                model = model_ctor(**kwargs)
+            if config.dtype and "dtype" not in kwargs and _accepts_kwarg(model_ctor, "dtype"):
+                kwargs["dtype"] = jnp.dtype(config.dtype)
+            model = model_ctor(**kwargs)
         self.model = model
 
         self.steps_per_epoch = max(
@@ -131,32 +142,53 @@ class Trainer:
 
     # -- loops ------------------------------------------------------------
     def train_epoch(self, epoch: int, data: Iterable) -> dict:
-        acc = MeanAccumulator()
         t0 = time.time()
         n_img = 0
         step_rng = jax.random.fold_in(self.rng, epoch)
+        device_metrics = []  # device arrays; fetched once at epoch end (no per-step sync)
         for i, (images, labels) in enumerate(data):
             batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels))
             self.state, metrics = self.train_step(self.state, *batch, step_rng)
+            device_metrics.append(metrics)
             n_img += len(labels)
             if (i + 1) % self.config.log_every_steps == 0:
-                m = jax.device_get(metrics)
-                self.logger.log(int(self.state.step), m, epoch=epoch, prefix="train_",
-                                echo=_is_main_process())
-                acc.update(m, weight=self.config.log_every_steps)
+                self.logger.log(int(self.state.step), jax.device_get(metrics),
+                                epoch=epoch, prefix="train_", echo=_is_main_process())
         jax.block_until_ready(self.state.params)
         dt = time.time() - t0
-        out = acc.result()
+        if device_metrics:
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs).mean(),
+                                             *device_metrics)
+            out = {k: float(v) for k, v in jax.device_get(stacked).items()}
+        else:
+            out = {}
         out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         return out
 
     def evaluate(self, data: Iterable) -> dict:
-        acc = MeanAccumulator()
+        """Masked eval: partial final batches are zero-padded up to a multiple of the
+        data axis; padded rows carry mask 0 and don't affect the metric sums."""
+        data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
+        sums: dict = {}
         for images, labels in data:
-            batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels))
+            n = len(labels)
+            padded = mesh_lib.pad_to_multiple(n, data_axis)
+            mask = np.zeros((padded,), np.float32)
+            mask[:n] = 1.0
+            if padded != n:
+                pad = [(0, padded - n)]
+                images = np.pad(np.asarray(images), pad + [(0, 0)] * (images.ndim - 1))
+                labels = np.pad(np.asarray(labels), pad)
+            batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels, mask))
             m = jax.device_get(self.eval_step(self.state, *batch))
-            acc.update(m, weight=float(m.get("count", len(labels))))
-        return acc.result()
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+        count = sums.pop("count", 0.0)
+        if count == 0:
+            return {}
+        out = {k: v / count for k, v in sums.items()}
+        out["count"] = count
+        return out
 
     def fit(self, train_data_fn: Callable[[int], Iterable],
             val_data_fn: Optional[Callable[[int], Iterable]] = None,
@@ -191,7 +223,10 @@ class Trainer:
                                     prefix="val_")
                 metric = last_val.get(watch_key, 0.0)
             else:
-                metric = train_metrics.get("top1", 0.0)
+                # no val set: watch the same key on train metrics so min-mode
+                # (loss-watching) plateau semantics stay correct
+                metric = train_metrics.get(
+                    watch_key, 0.0 if watch_key != "loss" else float("inf"))
 
             if self.best_metric is None or (
                     metric > self.best_metric if watch_key != "loss"
@@ -203,13 +238,14 @@ class Trainer:
                 self.state = self.state.replace(
                     opt_state=set_lr_scale(self.state.opt_state, scale))
 
-            if _is_main_process():
-                host = {"best_metric": self.best_metric}
-                if self.plateau:
-                    host["plateau"] = {"best": self.plateau.best,
-                                       "num_bad_epochs": self.plateau.num_bad_epochs,
-                                       "scale": self.plateau.scale}
-                self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+            # NOTE: Orbax save is a collective — every process must enter it
+            # (process 0 writes; the rest participate in the barrier).
+            host = {"best_metric": self.best_metric}
+            if self.plateau:
+                host["plateau"] = {"best": self.plateau.best,
+                                   "num_bad_epochs": self.plateau.num_bad_epochs,
+                                   "scale": self.plateau.scale}
+            self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
         return {"best_metric": self.best_metric, **last_val}
 
     def close(self):

@@ -1,5 +1,6 @@
 """Model zoo — importing this package registers all models in MODELS."""
 
-from . import lenet, resnet  # noqa: F401
+from . import (alexnet, inception, lenet, mobilenet, resnet, shufflenet,  # noqa: F401
+               vgg)
 
 from ..utils.registry import MODELS  # noqa: F401

@@ -1,0 +1,70 @@
+"""MobileNet V1 (Howard et al. 2017, "MobileNets: Efficient Convolutional Neural
+Networks for Mobile Vision Applications").
+
+Parity target: `MobileNet/pytorch/models/mobilenet_v1.py:10-155` — 13
+depthwise-separable blocks with width multiplier alpha; the reference implements the
+depthwise conv with `groups=in_channels` (`:120`), the Flax equivalent is
+`feature_group_count=in_channels` (XLA lowers this to a true depthwise conv on TPU).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import he_normal_fanout
+
+
+class DepthwiseSeparable(nn.Module):
+    """dw 3x3 + BN + relu → pw 1x1 + BN + relu
+    (`mobilenet_v1.py:95-134`, `MobileNet/tensorflow/models/mobilenet_v1.py:7-26`)."""
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=(self.strides, self.strides),
+                    feature_group_count=in_ch, use_bias=False,
+                    kernel_init=he_normal_fanout, dtype=self.dtype, name="dw")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x).astype(self.dtype)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=he_normal_fanout, dtype=self.dtype, name="pw")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32)(x)
+        return nn.relu(x).astype(self.dtype)
+
+
+# (features, stride) after the stem — paper Table 1.
+_V1_BODY = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+
+
+@MODELS.register("mobilenet_v1")
+class MobileNetV1(nn.Module):
+    num_classes: int = 1000
+    alpha: float = 1.0          # width multiplier (reference `MobileNetV1(alpha=1)`)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(ch):
+            return max(8, int(ch * self.alpha))
+        x = x.astype(self.dtype)
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2), use_bias=False,
+                    kernel_init=he_normal_fanout, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x).astype(self.dtype)
+        for i, (features, stride) in enumerate(_V1_BODY):
+            x = DepthwiseSeparable(c(features), stride, dtype=self.dtype,
+                                   name=f"block{i}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

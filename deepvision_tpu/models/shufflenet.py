@@ -1,0 +1,100 @@
+"""ShuffleNet V1 (Zhang et al. 2017, "ShuffleNet: An Extremely Efficient
+Convolutional Neural Network for Mobile Devices").
+
+The reference left this as an empty stub (`ShuffleNet/pytorch/models/shufflenet_v1.py`,
+0 lines; README says work-in-progress `ShuffleNet/pytorch/README.md:1`). Implemented in
+full here: grouped 1x1 convs + channel shuffle + depthwise 3x3, stages 2-4, the g=3
+configuration by default.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import he_normal_fanout
+
+
+def channel_shuffle(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    """Transpose the (groups, ch/groups) channel view — pure reshape/transpose,
+    free on TPU (layout change folded by XLA)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
+
+
+def _gconv(x, features, groups, dtype, name=None):
+    return nn.Conv(features, (1, 1), feature_group_count=groups, use_bias=False,
+                   kernel_init=he_normal_fanout, dtype=dtype, name=name)(x)
+
+
+class ShuffleUnit(nn.Module):
+    features: int
+    groups: int = 3
+    stride: int = 1
+    first_unit_no_gconv: bool = False  # stage2 first unit: plain 1x1 (paper §3.2)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn(y, relu=True):
+            y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=jnp.float32)(y)
+            return (nn.relu(y) if relu else y).astype(self.dtype)
+
+        in_ch = x.shape[-1]
+        bottleneck = self.features // 4
+        # stride-2 units concat with a 3x3 avg-pool shortcut, so the residual branch
+        # produces (features - in_ch) channels
+        out_ch = self.features - in_ch if self.stride == 2 else self.features
+        g1 = 1 if self.first_unit_no_gconv else self.groups
+
+        y = _gconv(x, bottleneck, g1, self.dtype, name="gconv1")
+        y = bn(y)
+        y = channel_shuffle(y, self.groups) if g1 > 1 else y
+        y = nn.Conv(bottleneck, (3, 3), strides=(self.stride, self.stride),
+                    feature_group_count=bottleneck, use_bias=False,
+                    kernel_init=he_normal_fanout, dtype=self.dtype, name="dw")(y)
+        y = bn(y, relu=False)
+        y = _gconv(y, out_ch, self.groups, self.dtype, name="gconv2")
+        y = bn(y, relu=False)
+
+        if self.stride == 2:
+            shortcut = nn.avg_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            return nn.relu(jnp.concatenate([shortcut, y], axis=-1)).astype(self.dtype)
+        return nn.relu(x + y).astype(self.dtype)
+
+
+# output channels per stage for each group count g — paper Table 1.
+_STAGE_CH = {1: (144, 288, 576), 2: (200, 400, 800), 3: (240, 480, 960),
+             4: (272, 544, 1088), 8: (384, 768, 1536)}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+@MODELS.register("shufflenet_v1")
+class ShuffleNetV1(nn.Module):
+    num_classes: int = 1000
+    groups: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(24, (3, 3), strides=(2, 2), use_bias=False,
+                    kernel_init=he_normal_fanout, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x).astype(self.dtype)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        channels = _STAGE_CH[self.groups]
+        for stage, (ch, reps) in enumerate(zip(channels, _STAGE_REPEATS)):
+            for unit in range(reps):
+                x = ShuffleUnit(
+                    ch, groups=self.groups, stride=2 if unit == 0 else 1,
+                    first_unit_no_gconv=(stage == 0 and unit == 0),
+                    dtype=self.dtype, name=f"stage{stage + 2}_unit{unit}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

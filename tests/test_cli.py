@@ -1,0 +1,29 @@
+"""CLI surface tests — the `-m`/`-c`/--synthetic UX of the per-family train.py."""
+
+import sys
+
+import pytest
+
+from deepvision_tpu.cli import build_parser, run_classification
+from deepvision_tpu.configs import CONFIGS, get_config
+
+
+def test_all_registered_configs_resolve_models():
+    from deepvision_tpu.models import MODELS
+    for name in CONFIGS.names():
+        cfg = get_config(name)
+        assert cfg.model in MODELS, f"config {name} references unknown model {cfg.model}"
+
+
+def test_parser_rejects_unknown_model():
+    p = build_parser("LeNet", ["lenet5"])
+    with pytest.raises(SystemExit):
+        p.parse_args(["-m", "resnet50"])
+
+
+def test_synthetic_end_to_end(tmp_path):
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size", "16",
+              "--steps-per-epoch", "2", "--workdir", str(tmp_path)])
+    assert "best_metric" in result

@@ -1,0 +1,80 @@
+"""Forward-shape + param-count tests for the full classification zoo, and
+aux-head behavior for Inception."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepvision_tpu.core.train_state import init_model, param_count
+from deepvision_tpu.models import MODELS
+
+
+def _run(name, input_shape, num_classes=21, train=False, **kw):
+    model = MODELS.get(name)(num_classes=num_classes, dtype=jnp.float32, **kw)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((2, *input_shape), jnp.float32)
+    params, batch_stats = init_model(model, rng, x)
+    out = model.apply({"params": params, "batch_stats": batch_stats}, x,
+                      train=train, mutable=["batch_stats"] if train else False,
+                      rngs={"dropout": rng} if train else None)
+    if train:
+        out = out[0]
+    return params, out
+
+
+@pytest.mark.parametrize("name,size,params_m", [
+    ("alexnet1", 224, (40, 80)),
+    ("alexnet2", 224, (40, 80)),
+    ("vgg16", 224, (130, 145)),
+    ("vgg19", 224, (135, 150)),
+    ("mobilenet_v1", 224, (3, 5)),
+    ("shufflenet_v1", 224, (1, 3)),
+])
+def test_zoo_forward_shapes(name, size, params_m):
+    params, out = _run(name, (size, size, 3), num_classes=1000)
+    assert out.shape == (2, 1000)
+    n = param_count(params) / 1e6
+    lo, hi = params_m
+    assert lo < n < hi, f"{name}: {n:.2f}M params"
+
+
+def test_mobilenet_alpha_scales_params():
+    p1, _ = _run("mobilenet_v1", (64, 64, 3), alpha=1.0)
+    p2, _ = _run("mobilenet_v1", (64, 64, 3), alpha=0.5)
+    assert param_count(p2) < 0.4 * param_count(p1)
+
+
+def test_inception_v1_aux_heads():
+    model = MODELS.get("inception_v1")(num_classes=13, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((2, 224, 224, 3), jnp.float32)
+    params, batch_stats = init_model(model, rng, x)
+    # train mode → (main, aux1, aux2)
+    out, _ = model.apply({"params": params, "batch_stats": batch_stats}, x,
+                         train=True, mutable=["batch_stats"], rngs={"dropout": rng})
+    assert isinstance(out, tuple) and len(out) == 3
+    assert all(o.shape == (2, 13) for o in out)
+    # eval mode → just logits
+    out_eval = model.apply({"params": params, "batch_stats": batch_stats}, x,
+                           train=False)
+    assert out_eval.shape == (2, 13)
+    n = param_count(params) / 1e6
+    assert 5 < n < 15, f"{n:.2f}M"
+
+
+def test_inception_v3_shapes():
+    params, out = _run("inception_v3", (299, 299, 3), num_classes=7)
+    assert out.shape == (2, 7)
+    n = param_count(params) / 1e6
+    assert 20 < n < 30, f"{n:.2f}M"
+
+
+def test_channel_shuffle_roundtrip():
+    from deepvision_tpu.models.shufflenet import channel_shuffle
+    x = jnp.arange(2 * 1 * 1 * 12, dtype=jnp.float32).reshape(2, 1, 1, 12)
+    y = channel_shuffle(x, 3)
+    # shuffling with groups then ch//groups is the inverse permutation
+    z = channel_shuffle(y, 4)
+    assert (z == x).all()
+    # channels actually move
+    assert not (y == x).all()
