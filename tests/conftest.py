@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The image's sitecustomize imports jax at interpreter start (before this file),
+# latching jax_platforms=axon from the env — and initializing the axon backend
+# can stall for minutes when the TPU tunnel is slow. Backends initialize lazily,
+# so overriding the already-imported config here still wins.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def mesh8():
