@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""CycleGAN inference: restore generators, translate images, save input/output
+pairs side by side (`CycleGAN/tensorflow/inference.py:34-63`).
+
+Usage: python inference.py --workdir runs/cyclegan-x --direction a2b img1.jpg ...
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="runs/cyclegan")
+    p.add_argument("--direction", default="a2b", choices=["a2b", "b2a"])
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--out-dir", default="translated")
+    p.add_argument("images", nargs="+")
+    args = p.parse_args()
+
+    import numpy as np
+    from PIL import Image
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import CycleGANTrainer
+
+    trainer = CycleGANTrainer(get_config("cyclegan"), workdir=args.workdir,
+                              image_size=args.image_size)
+    if trainer.resume() is None:
+        print("WARNING: no checkpoint found — using random weights")
+
+    size = args.image_size
+    os.makedirs(args.out_dir, exist_ok=True)
+    batch = np.stack([
+        np.asarray(Image.open(f).convert("RGB").resize((size, size)),
+                   np.float32) / 127.5 - 1.0 for f in args.images])
+    out = trainer.translate(batch, args.direction)
+    trainer.close()
+
+    for path, src, dst in zip(args.images, batch, out):
+        pair = np.concatenate([src, dst], axis=1)  # input | output
+        pair = ((pair + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+        name = os.path.join(args.out_dir,
+                            f"{os.path.splitext(os.path.basename(path))[0]}"
+                            f"_{args.direction}.png")
+        Image.fromarray(pair).save(name)
+        print(f"saved {name}")
+
+
+if __name__ == "__main__":
+    main()

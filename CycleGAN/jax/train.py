@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Train CycleGAN on TPU — `python train.py --dataset <name> [--batch_size 4]`.
+
+Per-family entrypoint matching the reference's UX
+(`CycleGAN/tensorflow/train.py:24-31`: `--dataset` names the
+`tfrecords/<dataset>/{trainA,trainB}.tfrecord` pair), backed by the shared
+deepvision_tpu CycleGANTrainer (jitted generator phase → host ImagePool → jitted
+discriminator phase).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Train CycleGAN (TPU-native JAX).")
+    p.add_argument("--dataset", help="dataset name under tfrecords/")
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--synthetic", action="store_true",
+                   help="random two-domain data smoke run (the reference's "
+                        "commented-out local test, train.py:338-342)")
+    p.add_argument("--steps-per-epoch", type=int, default=2)
+    args = p.parse_args()
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import CycleGANTrainer
+    from deepvision_tpu.data import gan as gan_data
+
+    cfg = get_config("cyclegan")
+    if args.epochs:
+        cfg = cfg.replace(total_epochs=args.epochs)
+    if args.batch_size:
+        cfg = cfg.replace(batch_size=args.batch_size)
+
+    image_size = 64 if args.synthetic else args.image_size
+    workdir = args.workdir or (
+        f"runs/cyclegan-{args.dataset}" if args.dataset else "runs/cyclegan")
+
+    if args.synthetic:
+        steps_per_epoch = args.steps_per_epoch
+
+        def train_fn(epoch):
+            return gan_data.synthetic_two_domain_batches(
+                cfg.batch_size, image_size=image_size,
+                steps=steps_per_epoch, seed=epoch)
+    else:
+        if not args.dataset:
+            p.error("--dataset is required without --synthetic")
+        ds = gan_data.build_two_domain_dataset(
+            f"tfrecords/{args.dataset}/trainA.tfrecord",
+            f"tfrecords/{args.dataset}/trainB.tfrecord",
+            batch_size=cfg.batch_size, image_size=image_size)
+        # count batches up front so LinearDecay is anchored to the true epoch
+        # length (the reference counts too, train.py:108-120)
+        steps_per_epoch = sum(1 for _ in ds)
+        print(f"Batch size: {cfg.batch_size}, "
+              f"Total batches per epoch: {steps_per_epoch}")
+
+        def train_fn(epoch, _ds=ds):
+            return _ds.as_numpy_iterator()
+
+    trainer = CycleGANTrainer(cfg, workdir=workdir, image_size=image_size,
+                              steps_per_epoch=steps_per_epoch)
+    if args.resume:
+        got = trainer.resume()
+        print(f"resumed from epoch {got}" if got else "no checkpoint found")
+
+    metrics = trainer.fit(train_fn)
+    trainer.close()
+    print(f"done: {metrics}")
+
+
+if __name__ == "__main__":
+    main()

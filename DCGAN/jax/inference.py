@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""DCGAN inference: restore checkpoint, sample generated digits, save a PNG grid
+(`DCGAN/tensorflow/inference.py:7-29` — matplotlib display swapped for a file,
+this runs headless on TPU VMs).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="runs/dcgan")
+    p.add_argument("--num", type=int, default=16)
+    p.add_argument("--out", default="generated.png")
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import DCGANTrainer
+
+    trainer = DCGANTrainer(get_config("dcgan"), workdir=args.workdir)
+    if trainer.resume() is None:
+        print("WARNING: no checkpoint found — sampling from random weights")
+    images = trainer.generate(args.num, jax.random.PRNGKey(args.seed))
+    trainer.close()
+
+    # tile into a roughly-square grid, [-1,1] → [0,255]
+    n = int(np.ceil(np.sqrt(args.num)))
+    grid = np.zeros((n * 28, n * 28), np.uint8)
+    for i, img in enumerate(images):
+        r, c = divmod(i, n)
+        grid[r * 28:(r + 1) * 28, c * 28:(c + 1) * 28] = (
+            (img[..., 0] * 127.5 + 127.5).clip(0, 255).astype(np.uint8))
+    Image.fromarray(grid).save(args.out)
+    print(f"saved {args.num} samples to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Train DCGAN on MNIST (TPU) — `python main.py [--synthetic] [--resume]`.
+
+Per-family entrypoint matching the reference's UX (`DCGAN/tensorflow/main.py`),
+backed by the shared deepvision_tpu DCGANTrainer: one jitted step with two
+optimizers instead of two GradientTapes.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default="dataset/mnist")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--workdir", default="runs/dcgan")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--synthetic", action="store_true",
+                   help="random data smoke run, no dataset needed")
+    p.add_argument("--steps-per-epoch", type=int, default=4,
+                   help="steps per epoch in --synthetic mode")
+    args = p.parse_args()
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import DCGANTrainer
+    from deepvision_tpu.data import gan as gan_data
+
+    cfg = get_config("dcgan")
+    if args.epochs:
+        cfg = cfg.replace(total_epochs=args.epochs)
+    if args.batch_size:
+        cfg = cfg.replace(batch_size=args.batch_size)
+
+    trainer = DCGANTrainer(cfg, workdir=args.workdir)
+    if args.resume:
+        got = trainer.resume()
+        print(f"resumed from epoch {got}" if got else "no checkpoint found")
+
+    if args.synthetic:
+        def train_fn(epoch):
+            return gan_data.synthetic_mnist_batches(
+                cfg.batch_size, steps=args.steps_per_epoch, seed=epoch)
+    else:
+        def train_fn(epoch):
+            return gan_data.mnist_gan_batches(args.data_dir, cfg.batch_size,
+                                              seed=epoch)
+
+    metrics = trainer.fit(train_fn)
+    trainer.close()
+    print(f"done: {metrics}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Train Stacked Hourglass on TPU — `python train.py -m hourglass104 [-c latest]`.
+
+Per-family entrypoint matching the reference's UX
+(`Hourglass/tensorflow/main.py:21-41` click CLI), backed by the shared
+deepvision_tpu PoseTrainer instead of the MirroredStrategy loop.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_pose
+
+MODELS = ["hourglass104"]
+
+if __name__ == "__main__":
+    run_pose("Hourglass", MODELS)

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""YOLO V3 inference: restore a checkpoint, detect objects in images, print/save
+boxes — the role of the reference's demo notebook + `Postprocessor`
+(`YOLO/tensorflow/demo_mscoco.ipynb`, `postprocess.py:6-36`).
+
+Usage: python detect.py -m yolov3 --workdir runs/yolov3 image1.jpg ...
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", default="yolov3",
+                   choices=["yolov3", "yolov3_voc"])
+    p.add_argument("--workdir", default=None,
+                   help="training workdir holding ckpt/ (default runs/<model>)")
+    p.add_argument("--iou-thresh", type=float, default=0.5)
+    p.add_argument("--score-thresh", type=float, default=0.5)
+    p.add_argument("--image-size", type=int, default=416)
+    p.add_argument("images", nargs="+")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer
+    from deepvision_tpu.ops.boxes import xywh_to_x1y1x2y2
+    from deepvision_tpu.ops.nms import batched_nms
+
+    cfg = get_config(args.model)
+    trainer = DetectionTrainer(
+        cfg, workdir=args.workdir or os.path.join("runs", cfg.name))
+    trainer.init_state((args.image_size, args.image_size, 3))
+    if trainer.resume() is None:
+        print("WARNING: no checkpoint found — using random weights")
+
+    size = args.image_size
+    batch = []
+    for path in args.images:
+        img = Image.open(path).convert("RGB").resize((size, size))
+        batch.append(np.asarray(img, np.float32) / 127.5 - 1.0)
+    images = jnp.asarray(np.stack(batch))
+
+    state = trainer.state
+    # decoded per-scale outputs → flatten → NMS (`postprocess.py:12-36`)
+    outputs = state.apply_fn(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        images, train=False, decode=True)
+    b = images.shape[0]
+    boxes = jnp.concatenate([o[0].reshape(b, -1, 4) for o in outputs], axis=1)
+    scores = jnp.concatenate([o[1].reshape(b, -1) for o in outputs], axis=1)
+    classes = jnp.concatenate(
+        [o[2].reshape(b, -1, o[2].shape[-1]) for o in outputs], axis=1)
+    nms_boxes, nms_scores, nms_classes, counts = batched_nms(
+        xywh_to_x1y1x2y2(boxes), scores, classes,
+        iou_thresh=args.iou_thresh, score_thresh=args.score_thresh)
+
+    for i, path in enumerate(args.images):
+        n = int(counts[i])
+        print(f"{path}: {n} detections")
+        for d in range(n):
+            x1, y1, x2, y2 = np.asarray(nms_boxes[i, d])
+            cls = int(jnp.argmax(nms_classes[i, d]))
+            print(f"  class={cls} score={float(nms_scores[i, d]):.3f} "
+                  f"box=({x1:.3f},{y1:.3f},{x2:.3f},{y2:.3f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Train YOLO V3 on TPU — `python train.py -m yolov3|yolov3_voc [-c latest]`.
+
+Per-family entrypoint matching the reference's UX (`YOLO/tensorflow/train.py:276-313`:
+`python3 train.py --checkpoint <ckpt>`), backed by the shared deepvision_tpu
+DetectionTrainer instead of the MirroredStrategy loop.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_detection
+
+MODELS = ["yolov3", "yolov3_voc"]
+
+if __name__ == "__main__":
+    run_detection("YOLO", MODELS)

@@ -1,9 +1,13 @@
-"""Shared CLI for classification training.
+"""Shared CLI for the per-family training entrypoints.
 
 Preserves the reference's documented UX (`python train.py -m <model> [-c <ckpt>]`,
 `ResNet/pytorch/train.py:541-562`; `ResNet/pytorch/README.md:33`) while backing every
-family's `train.py` with the one shared Trainer. Extras the reference lacked:
+family's `train.py` with the shared trainers. Extras the reference lacked:
 `--synthetic` smoke mode, `--data-dir`, epoch/batch overrides, auto-resume.
+
+One `_run` driver covers all task types; each task contributes only its trainer
+class and a `make_data(cfg, args)` hook returning `(train_fn, val_fn)` epoch-data
+factories.
 """
 
 from __future__ import annotations
@@ -11,9 +15,11 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
 
 from .configs import CONFIGS, get_config
+
+SYNTH_STEPS_DEFAULT = 8
 
 
 def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
@@ -23,7 +29,8 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("-c", "--checkpoint", default=None,
                    help="resume from this epoch number, or 'latest'")
     p.add_argument("--data-dir", default=None,
-                   help="dataset root (TFRecords for ImageNet, idx files for MNIST)")
+                   help="dataset root (TFRecords for ImageNet/VOC/COCO/MPII, "
+                        "idx files for MNIST)")
     p.add_argument("--synthetic", action="store_true",
                    help="train on synthetic data (smoke test, no dataset needed)")
     p.add_argument("--epochs", type=int, default=None)
@@ -34,8 +41,42 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     return p
 
 
-def run_classification(family: str, models: Sequence[str],
-                       argv: Optional[Sequence[str]] = None) -> dict:
+def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,
+                   bounded_train_steps: bool = False):
+    """Per-host train*/val* TFRecord pipelines shared by the tf.data tasks."""
+    import jax
+
+    from .data.imagenet import epoch_iterator
+    data = cfg.data
+    data_dir = args.data_dir or data.data_dir or default_dir
+    per_host = cfg.batch_size // jax.process_count()
+    common = dict(batch_size=per_host, image_size=data.image_size,
+                  num_process=jax.process_count(),
+                  process_index=jax.process_index())
+    train_ds = build_dataset(os.path.join(data_dir, "train*"), training=True,
+                             **common)
+    val_ds = build_dataset(os.path.join(data_dir, "val*"), training=False,
+                           **common)
+    # imagenet repeats its dataset → always bound each epoch; detection/pose
+    # datasets are single-pass per epoch (reference semantics) → iterate fully
+    # unless --steps-per-epoch explicitly bounds them
+    steps = args.steps_per_epoch
+    if steps is None and bounded_train_steps:
+        steps = data.train_examples // cfg.batch_size
+
+    def train_fn(epoch, _ds=train_ds, _steps=steps):
+        return epoch_iterator(_ds, _steps)
+
+    def val_fn(epoch, _ds=val_ds):
+        return epoch_iterator(_ds)
+
+    return train_fn, val_fn
+
+
+def _run(family: str, models: Sequence[str], trainer_factory: Callable,
+         make_data: Callable, argv: Optional[Sequence[str]] = None,
+         synthetic_image_size: Optional[int] = None) -> dict:
+    """Shared driver: parse → config overrides → trainer → data → fit."""
     args = build_parser(family, models).parse_args(argv)
     cfg = get_config(args.model)
     if args.epochs:
@@ -43,29 +84,46 @@ def run_classification(family: str, models: Sequence[str],
     if args.batch_size:
         cfg = cfg.replace(batch_size=args.batch_size)
     if args.synthetic:
-        n_batches = args.steps_per_epoch or 8
-        cfg = cfg.replace(data=dataclasses.replace(
-            cfg.data, dataset="synthetic", train_examples=cfg.batch_size * n_batches))
+        n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
+        synth = dict(dataset="synthetic",
+                     train_examples=cfg.batch_size * n_batches)
+        if synthetic_image_size:
+            synth["image_size"] = synthetic_image_size
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, **synth))
     workdir = args.workdir or os.path.join("runs", cfg.name)
 
-    from .core.trainer import Trainer
-    trainer = Trainer(cfg, workdir=workdir)
+    trainer = trainer_factory(cfg, workdir)
+    train_fn, val_fn = make_data(cfg, args)
 
+    if cfg.data.dataset == "mnist":
+        sample_shape = (32, 32, 1)  # mnist pipeline pads 28→32
+    else:
+        sample_shape = (cfg.data.image_size, cfg.data.image_size, 3)
+    trainer.init_state(sample_shape)
+    if args.checkpoint:
+        trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
+    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape)
+    trainer.close()
+    print(f"done: best={result.get('best_metric')}")
+    return result
+
+
+def _synthetic_data(cfg, make_batches: Callable):
+    """Shared synthetic train/val factories: `make_batches(steps, seed)`."""
+    n_batches = max(1, cfg.data.train_examples // cfg.batch_size)
+    return (lambda epoch: make_batches(n_batches, epoch),
+            lambda epoch: make_batches(2, 10**6))
+
+
+# -- classification ------------------------------------------------------------
+
+def _classification_data(cfg, args):
     data = cfg.data
-    image_size = data.image_size
     if args.synthetic or data.dataset == "synthetic":
         from .data.synthetic import SyntheticClassification
-        n_batches = max(1, data.train_examples // cfg.batch_size)
-
-        def train_fn(epoch):
-            return SyntheticClassification(cfg.batch_size, image_size, 3,
-                                           data.num_classes, n_batches, seed=epoch)
-
-        def val_fn(epoch):
-            return SyntheticClassification(cfg.batch_size, image_size, 3,
-                                           data.num_classes, 2, seed=10**6)
-
-        sample_shape = (image_size, image_size, 3)
+        return _synthetic_data(cfg, lambda steps, seed: SyntheticClassification(
+            cfg.batch_size, data.image_size, 3, data.num_classes, steps,
+            seed=seed))
     elif data.dataset == "mnist":
         from .data.mnist import MnistBatches, load_split
         data_dir = args.data_dir or data.data_dir or "dataset/mnist"
@@ -79,37 +137,61 @@ def run_classification(family: str, models: Sequence[str],
         def val_fn(epoch):
             return MnistBatches(test_x, test_y, cfg.batch_size, shuffle=False,
                                 drop_remainder=False)
-
-        sample_shape = (32, 32, 1)
     elif data.dataset == "imagenet":
-        import jax
         from .data import imagenet as inet
-        data_dir = args.data_dir or data.data_dir or "dataset/tfrecord"
-        per_host = cfg.batch_size // jax.process_count()
-        steps = args.steps_per_epoch or data.train_examples // cfg.batch_size
-        train_ds = inet.build_dataset(
-            os.path.join(data_dir, "train*"), batch_size=per_host,
-            image_size=image_size, training=True,
-            num_process=jax.process_count(), process_index=jax.process_index())
-        val_ds = inet.build_dataset(
-            os.path.join(data_dir, "val*"), batch_size=per_host,
-            image_size=image_size, training=False,
-            num_process=jax.process_count(), process_index=jax.process_index())
-
-        def train_fn(epoch, _ds=train_ds, _steps=steps):
-            return inet.epoch_iterator(_ds, _steps)
-
-        def val_fn(epoch, _ds=val_ds):
-            return inet.epoch_iterator(_ds)
-
-        sample_shape = (image_size, image_size, 3)
+        return _tfrecord_data(inet.build_dataset, cfg, args, "dataset/tfrecord",
+                              bounded_train_steps=True)
     else:
         raise ValueError(f"unknown dataset {data.dataset!r}")
+    return train_fn, val_fn
 
-    trainer.init_state(sample_shape)
-    if args.checkpoint:
-        trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
-    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape)
-    trainer.close()
-    print(f"done: best={result.get('best_metric')}")
-    return result
+
+def run_classification(family: str, models: Sequence[str],
+                       argv: Optional[Sequence[str]] = None) -> dict:
+    from .core.trainer import Trainer
+    return _run(family, models, lambda c, w: Trainer(c, workdir=w),
+                _classification_data, argv)
+
+
+# -- detection -----------------------------------------------------------------
+
+def _detection_data(cfg, args):
+    from .data import detection as det
+    data = cfg.data
+    if args.synthetic or data.dataset == "synthetic":
+        return _synthetic_data(cfg, lambda steps, seed: det.synthetic_batches(
+            batch_size=cfg.batch_size, image_size=data.image_size,
+            num_classes=data.num_classes, steps=steps, seed=seed))
+    return _tfrecord_data(det.build_dataset, cfg, args, "dataset/tfrecords")
+
+
+def run_detection(family: str, models: Sequence[str],
+                  argv: Optional[Sequence[str]] = None) -> dict:
+    """Detection (YOLO) entrypoint — `python train.py -m yolov3 [-c latest]`,
+    mirroring `YOLO/tensorflow/train.py:276-313`'s `--checkpoint` resume UX."""
+    from .core.detection import DetectionTrainer
+    return _run(family, models, lambda c, w: DetectionTrainer(c, workdir=w),
+                _detection_data, argv, synthetic_image_size=64)
+
+
+# -- pose ----------------------------------------------------------------------
+
+def _pose_data(cfg, args):
+    from .data import pose as pose_data
+    data = cfg.data
+    if args.synthetic or data.dataset == "synthetic":
+        return _synthetic_data(
+            cfg, lambda steps, seed: pose_data.synthetic_batches(
+                batch_size=cfg.batch_size, image_size=data.image_size,
+                steps=steps, seed=seed))
+    return _tfrecord_data(pose_data.build_dataset, cfg, args,
+                          "dataset/tfrecords_mpii")
+
+
+def run_pose(family: str, models: Sequence[str],
+             argv: Optional[Sequence[str]] = None) -> dict:
+    """Pose (Hourglass) entrypoint — mirrors the reference's click CLI
+    (`Hourglass/tensorflow/main.py:21-41`) with the shared `-m/-c` surface."""
+    from .core.pose import PoseTrainer
+    return _run(family, models, lambda c, w: PoseTrainer(c, workdir=w),
+                _pose_data, argv, synthetic_image_size=64)

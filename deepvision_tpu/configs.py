@@ -112,5 +112,66 @@ CONFIGS.register("shufflenet_v1", TrainConfig(
 ))
 
 
+# -- DCGAN (DCGAN/tensorflow/main.py:13-16,31-32: MNIST, batch 256, 50 epochs,
+#    two Adam(1e-4) optimizers, checkpoint every 2 epochs keep 3) ---------------
+CONFIGS.register("dcgan", TrainConfig(
+    name="dcgan", model="dcgan", batch_size=256, total_epochs=50,
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-4),
+    schedule=ScheduleConfig(name="constant"),
+    data=DataConfig(dataset="mnist", image_size=28, num_classes=10,
+                    train_examples=60000, val_examples=10000),
+    dtype="float32", keep_checkpoints=3, keep_best=False,
+))
+
+# -- CycleGAN (CycleGAN/tensorflow/train.py:14-21: 200 epochs, Adam lr 2e-4
+#    β1 .5, linear LR decay to 0 after epoch 100, λ_cycle 10 λ_id 5. The
+#    reference default batch is 4 on one GPU; the global batch must divide the
+#    data axis, so the default is 1/chip on a v3-8) -----------------------------
+CONFIGS.register("cyclegan", TrainConfig(
+    name="cyclegan", model="cyclegan", batch_size=8, total_epochs=200,
+    optimizer=OptimizerConfig(name="adam", learning_rate=2e-4, beta1=0.5),
+    schedule=ScheduleConfig(name="linear_decay", decay_start_epoch=100),
+    data=DataConfig(dataset="cyclegan", image_size=256, num_classes=0,
+                    train_examples=1000, val_examples=100),
+    dtype="float32", keep_checkpoints=3, keep_best=False,
+))
+
+# -- Stacked Hourglass (Hourglass/tensorflow/main.py:26 lr 1e-3 default,
+#    train.py:233-236 batch 16/replica, Adam; MPII 16 joints at 256px → 64px
+#    heatmaps; plateau /10 after 10 bad epochs watching val loss) ---------------
+CONFIGS.register("hourglass104", TrainConfig(
+    name="hourglass104", model="hourglass104", batch_size=128, total_epochs=100,
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+    schedule=ScheduleConfig(name="plateau", plateau_patience=10,
+                            plateau_factor=0.1, plateau_mode="min"),
+    data=DataConfig(dataset="pose", image_size=256, num_classes=16,
+                    train_examples=22246, val_examples=2958),
+))
+
+# -- YOLO V3 (reference module constants YOLO/tensorflow/train.py:13-17: 416px,
+#    batch 16/replica, 300 epochs, COCO 80 classes; Adam lr .01 with hand-rolled
+#    plateau /10 after 10 bad epochs watching val loss, train.py:46-68) ---------
+CONFIGS.register("yolov3", TrainConfig(
+    name="yolov3", model="yolov3", batch_size=128, total_epochs=300,
+    optimizer=OptimizerConfig(name="adam", learning_rate=0.01),
+    schedule=ScheduleConfig(name="plateau", plateau_patience=10,
+                            plateau_factor=0.1, plateau_mode="min"),
+    data=DataConfig(dataset="detection", image_size=416, num_classes=80,
+                    train_examples=118287, val_examples=5000),
+))
+
+# -- YOLO V3 on VOC2007 (the reference's 1×K80 recipe, YOLO/tensorflow/README.md:10;
+#    20 classes, 2501 trainval images) ------------------------------------------
+CONFIGS.register("yolov3_voc", TrainConfig(
+    name="yolov3_voc", model="yolov3", batch_size=32, total_epochs=300,
+    model_kwargs={"num_classes": 20},
+    optimizer=OptimizerConfig(name="adam", learning_rate=0.01),
+    schedule=ScheduleConfig(name="plateau", plateau_patience=10,
+                            plateau_factor=0.1, plateau_mode="min"),
+    data=DataConfig(dataset="detection", image_size=416, num_classes=20,
+                    train_examples=2501, val_examples=2510),
+))
+
+
 def get_config(name: str) -> TrainConfig:
     return CONFIGS.get(name)

@@ -43,16 +43,24 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, epoch: int, state: TrainState, host_state: Optional[Dict[str, Any]] = None,
+    @staticmethod
+    def _payload(state):
+        """TrainState → dict payload; any other pytree (e.g. the GAN trainers'
+        {gen, disc} dicts) is saved as-is."""
+        if isinstance(state, TrainState):
+            return {
+                "step": state.step,
+                "params": state.params,
+                "batch_stats": state.batch_stats,
+                "opt_state": state.opt_state,
+            }
+        return state
+
+    def save(self, epoch: int, state, host_state: Optional[Dict[str, Any]] = None,
              metric: Optional[float] = None):
         """Save at `epoch` (reference saves per-epoch with epoch in the payload,
         ResNet/pytorch/train.py:417-428)."""
-        payload = {
-            "step": state.step,
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-        }
+        payload = self._payload(state)
         metrics = {"best_metric": float(metric)} if metric is not None else None
         self._mgr.save(
             epoch,
@@ -70,19 +78,15 @@ class CheckpointManager:
     def best_epoch(self) -> Optional[int]:
         return self._mgr.best_step()
 
-    def restore(self, state: TrainState, epoch: Optional[int] = None):
-        """Restore into an abstract/concrete TrainState template; returns
-        (state, host_state, epoch). `epoch=None` → latest (auto-resume-from-latest)."""
+    def restore(self, state, epoch: Optional[int] = None):
+        """Restore into an abstract/concrete template (TrainState or pytree);
+        returns (state, host_state, epoch). `epoch=None` → latest
+        (auto-resume-from-latest)."""
         if epoch is None:
             epoch = self._mgr.latest_step()
         if epoch is None:
             return state, {}, None
-        template = {
-            "step": state.step,
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-        }
+        template = self._payload(state)
         restored = self._mgr.restore(
             epoch,
             args=ocp.args.Composite(
@@ -91,9 +95,12 @@ class CheckpointManager:
             ),
         )
         payload = restored["state"]
-        new_state = state.replace(
-            step=payload["step"], params=payload["params"],
-            batch_stats=payload["batch_stats"], opt_state=payload["opt_state"])
+        if isinstance(state, TrainState):
+            new_state = state.replace(
+                step=payload["step"], params=payload["params"],
+                batch_stats=payload["batch_stats"], opt_state=payload["opt_state"])
+        else:
+            new_state = payload
         return new_state, dict(restored["host"] or {}), epoch
 
     def close(self):

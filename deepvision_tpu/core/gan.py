@@ -1,0 +1,413 @@
+"""Adversarial (DCGAN / CycleGAN) SPMD steps + trainers.
+
+Parity targets:
+- DCGAN trainer (`DCGAN/tensorflow/main.py:20-88`): one step with TWO GradientTapes
+  and two Adam(1e-4) optimizers — generator and discriminator gradients both taken
+  against the pre-update parameters, then both applied; `tf.train.Checkpoint` +
+  manager saving every 2 epochs, keep 3.
+- CycleGAN trainer (`CycleGAN/tensorflow/train.py:150-344`): two-phase step —
+  jitted generator phase (one loss over BOTH generators: GAN + 10·cycle +
+  5·identity, one Adam(2e-4, β1=.5) over the concatenated generator variables),
+  host-side ImagePool query on the fakes, jitted discriminator phase (second Adam
+  over both discriminators, each (real+fake)/2 LSGAN-MSE) — with LinearDecay LR
+  after epoch 100 and checkpoints every 2 epochs.
+
+TPU-native shape: each phase is one jitted SPMD function over the mesh; the two
+optimizers are two optax states over the param pytrees {"a2b": …, "b2a": …} /
+{"a": …, "b": …} (the concatenated-variables trick, `train.py:183-185`). The
+ImagePool stays on the host BETWEEN the two jitted calls — the same structure the
+reference uses and the reason its outer step is eager (`utils.py:31`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+from ..utils.image_pool import ImagePool
+from .checkpoint import CheckpointManager
+from .config import TrainConfig
+from .metrics import MetricsLogger
+from .optim import build_optimizer
+from .train_state import TrainState, init_model
+
+
+def _bce_logits(logits, target: float) -> jnp.ndarray:
+    """BinaryCrossentropy(from_logits=True) vs all-ones/zeros
+    (`DCGAN/tensorflow/main.py:42-53`)."""
+    t = jnp.full_like(logits, target)
+    return optax.sigmoid_binary_cross_entropy(logits, t).mean()
+
+
+def _mse(pred, target: float) -> jnp.ndarray:
+    """LSGAN loss (`CycleGAN/tensorflow/train.py:58-63`)."""
+    return jnp.mean(jnp.square(pred - target))
+
+
+def _mae(a, b) -> jnp.ndarray:
+    """Cycle/identity loss (`train.py:65-72`)."""
+    return jnp.mean(jnp.abs(a - b))
+
+
+class AdversarialTrainer:
+    """Shared machinery for the two-network trainers: epoch loop with mean
+    metric accumulation, checkpoint-every-N-epochs ({gen, disc} payloads), and
+    resume — the common shape of `DCGAN/tensorflow/main.py:73-87` and
+    `CycleGAN/tensorflow/train.py:314-336`. Subclasses set gen_state/disc_state
+    and implement `train_batch(*batch) -> metrics dict`."""
+
+    gen_state: TrainState
+    disc_state: TrainState
+
+    def _init_logging(self, config: TrainConfig, workdir: str):
+        self.config = config
+        self.logger = MetricsLogger(workdir, name=config.name)
+        self.ckpt = CheckpointManager(workdir + "/ckpt",
+                                      keep=config.keep_checkpoints,
+                                      keep_best=False)
+        self.start_epoch = 1
+
+    def _payload(self):
+        return {"gen": CheckpointManager._payload(self.gen_state),
+                "disc": CheckpointManager._payload(self.disc_state)}
+
+    def resume(self) -> Optional[int]:
+        payload, _, epoch = self.ckpt.restore(self._payload())
+        if epoch is None:
+            return None
+        self.gen_state = self.gen_state.replace(**payload["gen"])
+        self.disc_state = self.disc_state.replace(**payload["disc"])
+        self.start_epoch = epoch + 1
+        return epoch
+
+    def train_batch(self, *batch) -> dict:
+        raise NotImplementedError
+
+    def fit(self, train_data_fn: Callable[[int], Iterable],
+            total_epochs: Optional[int] = None, save_every: int = 2) -> dict:
+        """Epoch loop + save every 2 epochs (`DCGAN/tensorflow/main.py:81-83`,
+        `CycleGAN/tensorflow/train.py:330-333`)."""
+        total_epochs = total_epochs or self.config.total_epochs
+        metrics = {}
+        for epoch in range(self.start_epoch, total_epochs + 1):
+            t0 = time.time()
+            step_metrics = []  # device arrays; fetched once at epoch end so a
+            for batch in train_data_fn(epoch):  # pool-free step stays async
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+                step_metrics.append(self.train_batch(*batch))
+            if step_metrics:
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: float(np.mean(jax.device_get(jnp.stack(
+                        [jnp.asarray(x) for x in xs])))), *step_metrics)
+                metrics = dict(stacked)
+            else:
+                metrics = {}
+            metrics["epoch_seconds"] = time.time() - t0
+            self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
+                            echo=jax.process_index() == 0)
+            if epoch % save_every == 0 or epoch == total_epochs:
+                self.ckpt.save(epoch, self._payload())
+        return metrics
+
+    def close(self):
+        self.logger.close()
+        self.ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# DCGAN
+# ---------------------------------------------------------------------------
+
+def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
+                          noise_dim: int, mesh=None,
+                          donate: bool = True) -> Callable:
+    """(gen_state, disc_state, images, rng) -> (gen_state, disc_state, metrics).
+
+    Both gradient sets are computed against the pre-update parameters (the
+    two-tape semantics of `DCGAN/tensorflow/main.py:59-71`); XLA CSEs the shared
+    generator forward.
+    """
+
+    def step(gen_state: TrainState, disc_state: TrainState, images, rng):
+        rng = jax.random.fold_in(rng, gen_state.step)
+        rng_z, rng_d1, rng_d2, rng_d3 = jax.random.split(rng, 4)
+        noise = jax.random.normal(rng_z, (images.shape[0], noise_dim))
+
+        def gen_loss_fn(gp):
+            fake, mut = gen_apply(
+                {"params": gp, "batch_stats": gen_state.batch_stats},
+                noise, train=True, mutable=["batch_stats"])
+            fake_logits = disc_apply(
+                {"params": disc_state.params}, fake, train=True,
+                rngs={"dropout": rng_d1})
+            return _bce_logits(fake_logits, 1.0), (fake, mut)
+
+        (g_loss, (fake, g_mut)), g_grads = jax.value_and_grad(
+            gen_loss_fn, has_aux=True)(gen_state.params)
+
+        def disc_loss_fn(dp):
+            real_logits = disc_apply({"params": dp}, images, train=True,
+                                     rngs={"dropout": rng_d2})
+            fake_logits = disc_apply({"params": dp},
+                                     jax.lax.stop_gradient(fake), train=True,
+                                     rngs={"dropout": rng_d3})
+            return _bce_logits(real_logits, 1.0) + _bce_logits(fake_logits, 0.0)
+
+        d_loss, d_grads = jax.value_and_grad(disc_loss_fn)(disc_state.params)
+
+        new_gen = gen_state.apply_gradients(g_grads).replace(
+            batch_stats=g_mut.get("batch_stats", gen_state.batch_stats))
+        new_disc = disc_state.apply_gradients(d_grads)
+        return new_gen, new_disc, {"gen_loss": g_loss, "disc_loss": d_loss}
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0, 1)
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
+class DCGANTrainer(AdversarialTrainer):
+    """Epoch loop + checkpointing for DCGAN (`DCGAN/tensorflow/main.py:73-87`)."""
+
+    def __init__(self, config: TrainConfig, workdir: str = "runs/dcgan",
+                 mesh=None, noise_dim: int = 100):
+        from ..models.gan import DCGANDiscriminator, DCGANGenerator
+        self.noise_dim = noise_dim
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.generator = DCGANGenerator(noise_dim=noise_dim)
+        self.discriminator = DCGANDiscriminator()
+
+        steps_per_epoch = max(1, config.data.train_examples // config.batch_size)
+        tx_g = build_optimizer(config.optimizer, config.schedule,
+                               steps_per_epoch, config.total_epochs)
+        tx_d = build_optimizer(config.optimizer, config.schedule,
+                               steps_per_epoch, config.total_epochs)
+
+        rng = jax.random.PRNGKey(config.seed)
+        g_rng, d_rng, self.rng = jax.random.split(rng, 3)
+        g_params, g_bs = init_model(self.generator, g_rng,
+                                    jnp.zeros((2, noise_dim)))
+        d_params, d_bs = init_model(self.discriminator, d_rng,
+                                    jnp.zeros((2, 28, 28, 1)))
+        repl = mesh_lib.replicated(self.mesh)
+        self.gen_state = jax.device_put(
+            TrainState.create(self.generator.apply, g_params, tx_g, g_bs), repl)
+        self.disc_state = jax.device_put(
+            TrainState.create(self.discriminator.apply, d_params, tx_d, d_bs),
+            repl)
+
+        self.train_step = make_dcgan_train_step(
+            self.generator.apply, self.discriminator.apply, noise_dim,
+            mesh=self.mesh)
+        self._init_logging(config, workdir)
+
+    def train_batch(self, images) -> dict:
+        batch = mesh_lib.shard_batch_pytree(self.mesh, np.asarray(images))
+        self.gen_state, self.disc_state, m = self.train_step(
+            self.gen_state, self.disc_state, batch, self.rng)
+        return m  # device arrays — no per-step host sync (DCGAN has no pool)
+
+    def generate(self, num: int, rng: Optional[jax.Array] = None) -> np.ndarray:
+        """Sample images (`DCGAN/tensorflow/inference.py:7-29`)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(42)
+        noise = jax.random.normal(rng, (num, self.noise_dim))
+        images = self.generator.apply(
+            {"params": self.gen_state.params,
+             "batch_stats": self.gen_state.batch_stats}, noise, train=False)
+        return np.asarray(images)
+
+
+# ---------------------------------------------------------------------------
+# CycleGAN
+# ---------------------------------------------------------------------------
+
+LAMBDA_CYCLE = 10.0  # `CycleGAN/tensorflow/train.py:16-17`
+LAMBDA_ID = 5.0
+
+
+def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
+                                 mesh=None) -> Callable:
+    """Generator phase (`train.py:150-205`): one loss over both generators.
+
+    gen_state.params = {"a2b": …, "b2a": …}; disc_state.params = {"a": …, "b": …}.
+    Returns (gen_state, disc_batch_stats, fake_a2b, fake_b2a, metrics) — the
+    discriminator forward passes run train=True (keras side-effect parity), so
+    their mutated batch_stats are threaded back to the caller.
+    """
+
+    def step(gen_state: TrainState, disc_state: TrainState, real_a, real_b):
+
+        def loss_fn(gparams):
+            bs = dict(gen_state.batch_stats)
+
+            def g(name, x):
+                y, mut = gen_apply(
+                    {"params": gparams[name], "batch_stats": bs[name]},
+                    x, train=True, mutable=["batch_stats"])
+                bs[name] = mut["batch_stats"]
+                return y
+
+            fake_a2b = g("a2b", real_a)          # cycle A→B→A
+            recon_b2a = g("b2a", fake_a2b)
+            fake_b2a = g("b2a", real_b)          # cycle B→A→B
+            recon_a2b = g("a2b", fake_b2a)
+            identity_a2b = g("a2b", real_b)      # identity terms
+            identity_b2a = g("b2a", real_a)
+
+            dbs = dict(disc_state.batch_stats)
+
+            def d(name, x):
+                y, mut = disc_apply(
+                    {"params": disc_state.params[name], "batch_stats": dbs[name]},
+                    x, train=True, mutable=["batch_stats"])
+                dbs[name] = mut["batch_stats"]
+                return y
+
+            loss_gan_a2b = _mse(d("b", fake_a2b), 1.0)
+            loss_gan_b2a = _mse(d("a", fake_b2a), 1.0)
+            loss_cycle_a2b2a = _mae(recon_b2a, real_a)
+            loss_cycle_b2a2b = _mae(recon_a2b, real_b)
+            loss_id_a2b = _mae(identity_a2b, real_b)
+            loss_id_b2a = _mae(identity_b2a, real_a)
+            total = (loss_gan_a2b + loss_gan_b2a
+                     + (loss_cycle_a2b2a + loss_cycle_b2a2b) * LAMBDA_CYCLE
+                     + (loss_id_a2b + loss_id_b2a) * LAMBDA_ID)
+            aux = (bs, dbs, fake_a2b, fake_b2a,
+                   {"loss_gen_a2b": loss_gan_a2b, "loss_gen_b2a": loss_gan_b2a,
+                    "loss_cycle_a2b2a": loss_cycle_a2b2a,
+                    "loss_cycle_b2a2b": loss_cycle_b2a2b,
+                    "loss_id_a2b": loss_id_a2b, "loss_id_b2a": loss_id_b2a,
+                    "loss_gen_total": total})
+            return total, aux
+
+        (_, (bs, dbs, fake_a2b, fake_b2a, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(gen_state.params)
+        new_gen = gen_state.apply_gradients(grads).replace(batch_stats=bs)
+        return new_gen, dbs, fake_a2b, fake_b2a, metrics
+
+    jit_kwargs = {"donate_argnums": (0,)}
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        jit_kwargs["out_shardings"] = (None, repl, data, data, repl)
+    return jax.jit(step, **jit_kwargs)
+
+
+def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None) -> Callable:
+    """Discriminator phase (`train.py:207-246`): (real+fake)/2 LSGAN per domain,
+    one optimizer over both discriminators. Fakes come from the host ImagePool."""
+
+    def step(disc_state: TrainState, real_a, real_b, fake_a2b, fake_b2a):
+
+        def loss_fn(dparams):
+            bs = dict(disc_state.batch_stats)
+
+            def d(name, x):
+                y, mut = disc_apply(
+                    {"params": dparams[name], "batch_stats": bs[name]},
+                    x, train=True, mutable=["batch_stats"])
+                bs[name] = mut["batch_stats"]
+                return y
+
+            loss_dis_a = (_mse(d("a", real_a), 1.0) +
+                          _mse(d("a", fake_b2a), 0.0)) * 0.5
+            loss_dis_b = (_mse(d("b", real_b), 1.0) +
+                          _mse(d("b", fake_a2b), 0.0)) * 0.5
+            total = loss_dis_a + loss_dis_b
+            return total, (bs, {"loss_dis_a": loss_dis_a,
+                                "loss_dis_b": loss_dis_b,
+                                "loss_dis_total": total})
+
+        (_, (bs, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(disc_state.params)
+        new_disc = disc_state.apply_gradients(grads).replace(batch_stats=bs)
+        return new_disc, metrics
+
+    jit_kwargs = {"donate_argnums": (0,)}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
+class CycleGANTrainer(AdversarialTrainer):
+    """Two-phase adversarial trainer (`CycleGAN/tensorflow/train.py:248-344`)."""
+
+    def __init__(self, config: TrainConfig, workdir: str = "runs/cyclegan",
+                 mesh=None, image_size: int = 256, n_blocks: int = 9,
+                 pool_size: int = 50, steps_per_epoch: Optional[int] = None):
+        """`steps_per_epoch` anchors the LinearDecay schedule to the real epoch
+        length — pass the counted dataset size like the reference does
+        (`CycleGAN/tensorflow/train.py:108-129` counts total_batches before
+        building LinearDecay); defaults to config.data.train_examples / batch."""
+        from ..models.gan import CycleGANGenerator, PatchGANDiscriminator
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        self.generator = CycleGANGenerator(n_blocks=n_blocks)
+        self.discriminator = PatchGANDiscriminator()
+
+        steps_per_epoch = steps_per_epoch or max(
+            1, config.data.train_examples // config.batch_size)
+        tx_g = build_optimizer(config.optimizer, config.schedule,
+                               steps_per_epoch, config.total_epochs)
+        tx_d = build_optimizer(config.optimizer, config.schedule,
+                               steps_per_epoch, config.total_epochs)
+
+        rng = jax.random.PRNGKey(config.seed)
+        rngs = jax.random.split(rng, 4)
+        sample = jnp.zeros((2, image_size, image_size, 3))
+        g_params, g_bs, d_params, d_bs = {}, {}, {}, {}
+        for i, name in enumerate(("a2b", "b2a")):
+            g_params[name], g_bs[name] = init_model(self.generator, rngs[i],
+                                                    sample)
+        for i, name in enumerate(("a", "b")):
+            d_params[name], d_bs[name] = init_model(self.discriminator,
+                                                    rngs[2 + i], sample)
+        repl = mesh_lib.replicated(self.mesh)
+        self.gen_state = jax.device_put(
+            TrainState.create(self.generator.apply, g_params, tx_g, g_bs), repl)
+        self.disc_state = jax.device_put(
+            TrainState.create(self.discriminator.apply, d_params, tx_d, d_bs),
+            repl)
+
+        self.gen_step = make_cyclegan_generator_step(
+            self.generator.apply, self.discriminator.apply, mesh=self.mesh)
+        self.disc_step = make_cyclegan_discriminator_step(
+            self.discriminator.apply, mesh=self.mesh)
+        # one pool per fake stream (`train.py:55-56`)
+        self.pool_a2b = ImagePool(pool_size, seed=config.seed)
+        self.pool_b2a = ImagePool(pool_size, seed=config.seed + 1)
+        self._init_logging(config, workdir)
+
+    def train_batch(self, images_a: np.ndarray, images_b: np.ndarray) -> dict:
+        """One eager-outer step: jitted gen phase → host pools → jitted disc
+        phase (`train.py:248-255`)."""
+        real_a, real_b = mesh_lib.shard_batch_pytree(
+            self.mesh, (np.asarray(images_a), np.asarray(images_b)))
+        self.gen_state, disc_bs, fake_a2b, fake_b2a, gm = self.gen_step(
+            self.gen_state, self.disc_state, real_a, real_b)
+        self.disc_state = self.disc_state.replace(batch_stats=disc_bs)
+
+        fake_a2b_pool = self.pool_a2b.query(jax.device_get(fake_a2b))
+        fake_b2a_pool = self.pool_b2a.query(jax.device_get(fake_b2a))
+        fa, fb = mesh_lib.shard_batch_pytree(self.mesh,
+                                             (fake_a2b_pool, fake_b2a_pool))
+        self.disc_state, dm = self.disc_step(self.disc_state, real_a, real_b,
+                                             fa, fb)
+        return {**jax.device_get(gm), **jax.device_get(dm)}
+
+    def translate(self, images: np.ndarray, direction: str = "a2b") -> np.ndarray:
+        """Run one generator (`CycleGAN/tensorflow/inference.py:34-63`)."""
+        out = self.generator.apply(
+            {"params": self.gen_state.params[direction],
+             "batch_stats": self.gen_state.batch_stats[direction]},
+            jnp.asarray(images), train=False)
+        return np.asarray(out)

@@ -1,0 +1,132 @@
+"""Pose (Stacked Hourglass) SPMD steps + trainer.
+
+Parity target: `Hourglass/tensorflow/train.py:15-226` — MirroredStrategy trainer
+with foreground-weighted MSE summed over stacks (`compute_loss`, `:65-76`: weights
+= 81×[label>0] + 1, i.e. 82 on gaussian pixels), Adam, hand-rolled plateau LR /10
+after 10 bad epochs watching val loss (`:46-58`), NaN-val-batch skip (`:126-130`),
+and save-best checkpoints (`:160-163`).
+
+TPU-native shape: heatmap rendering happens ON DEVICE inside the jitted step from
+the raw (keypoints, visibility) batch (ops/heatmap.py) — the reference renders on
+the host with per-keypoint autograph loops. Loss is the plain global-batch mean of
+the weighted squared error per stack (the reference additionally multiplies by
+1/global_batch after an already-mean reduction, `:73-75` — a pure LR rescale we
+don't replicate).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import MODELS
+from ..ops.heatmap import render_gaussian_heatmaps
+from ..parallel import mesh as mesh_lib
+from .config import TrainConfig
+from .trainer import Trainer
+
+FOREGROUND_WEIGHT = 81.0  # `Hourglass/tensorflow/train.py:69`
+
+
+def weighted_mse_loss(labels: jnp.ndarray, outputs) -> jnp.ndarray:
+    """Σ_stacks mean((pred - label)² · (81·[label>0] + 1)) (`train.py:65-76`)."""
+    labels = labels.astype(jnp.float32)
+    weights = (labels > 0).astype(jnp.float32) * FOREGROUND_WEIGHT + 1.0
+    loss = 0.0
+    for out in outputs:
+        loss = loss + jnp.mean(jnp.square(labels - out.astype(jnp.float32))
+                               * weights)
+    return loss
+
+
+def make_pose_train_step(*, heatmap_size: Tuple[int, int],
+                         compute_dtype=jnp.bfloat16, donate: bool = True,
+                         mesh=None) -> Callable:
+    """(state, images, kp_x, kp_y, visibility, rng) -> (state, metrics).
+
+    kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K).
+    """
+    h, w = heatmap_size
+
+    def step(state, images, kp_x, kp_y, visibility, rng):
+        del rng
+        images = images.astype(compute_dtype)
+        labels = jax.vmap(
+            lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
+                kp_x, kp_y, visibility)
+
+        def loss_fn(params):
+            outputs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            return weighted_mse_loss(labels, outputs), mutated
+
+        (loss, mutated), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads).replace(
+            batch_stats=mutated.get("batch_stats", state.batch_stats))
+        return new_state, {"loss": loss}
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
+def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
+                        compute_dtype=jnp.bfloat16, mesh=None) -> Callable:
+    h, w = heatmap_size
+
+    def step(state, images, kp_x, kp_y, visibility):
+        images = images.astype(compute_dtype)
+        labels = jax.vmap(
+            lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
+                kp_x, kp_y, visibility)
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        return {"loss": weighted_mse_loss(labels, outputs)}
+
+    jit_kwargs = {}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
+    return jax.jit(step, **jit_kwargs)
+
+
+class PoseTrainer(Trainer):
+    """Hourglass trainer: shared epoch/checkpoint/plateau machinery with pose
+    steps, loss-watched validation, and the reference's NaN-batch skip."""
+
+    def __init__(self, config: TrainConfig, model=None, mesh=None,
+                 workdir: Optional[str] = None):
+        if model is None:
+            kwargs = dict(config.model_kwargs)
+            kwargs.setdefault("num_heatmap", config.data.num_classes)
+            if config.dtype:
+                kwargs.setdefault("dtype", jnp.dtype(config.dtype))
+            model = MODELS.get(config.model)(**kwargs)
+        super().__init__(config, model=model, mesh=mesh, workdir=workdir)
+        hm = (config.data.image_size // 4, config.data.image_size // 4)
+        compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        self.train_step = make_pose_train_step(
+            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
+        self.eval_step = make_pose_eval_step(
+            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
+
+    def evaluate(self, data: Iterable) -> dict:
+        """Mean val loss, skipping non-finite batches (`train.py:126-130`)."""
+        total, n = 0.0, 0
+        for batch in data:
+            sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
+            m = jax.device_get(self.eval_step(self.state, *sharded))
+            loss = float(m["loss"])
+            if np.isfinite(loss):
+                total += loss
+                n += 1
+        return {"loss": total / n, "count": float(n)} if n else {}

@@ -146,11 +146,14 @@ class Trainer:
         n_img = 0
         step_rng = jax.random.fold_in(self.rng, epoch)
         device_metrics = []  # device arrays; fetched once at epoch end (no per-step sync)
-        for i, (images, labels) in enumerate(data):
-            batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels))
+        for i, batch in enumerate(data):
+            # batch is any tuple of arrays with a leading batch dim — (images,
+            # labels) for classification, (images, boxes, classes, valid) for
+            # detection — forwarded positionally to the task's train step.
+            batch = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
             self.state, metrics = self.train_step(self.state, *batch, step_rng)
             device_metrics.append(metrics)
-            n_img += len(labels)
+            n_img += len(jax.tree_util.tree_leaves(batch)[0])
             if (i + 1) % self.config.log_every_steps == 0:
                 self.logger.log(int(self.state.step), jax.device_get(metrics),
                                 epoch=epoch, prefix="train_", echo=_is_main_process())
@@ -221,7 +224,10 @@ class Trainer:
                 if _is_main_process():
                     self.logger.log(int(self.state.step), last_val, epoch=epoch,
                                     prefix="val_")
-                metric = last_val.get(watch_key, 0.0)
+                # empty eval (e.g. all val batches dropped/skipped) must not
+                # register as a perfect 0.0 loss in min-mode
+                metric = last_val.get(
+                    watch_key, 0.0 if watch_key != "loss" else float("inf"))
             else:
                 # no val set: watch the same key on train metrics so min-mode
                 # (loss-watching) plateau semantics stay correct

@@ -1,0 +1,154 @@
+"""Detection input pipeline: VOC/COCO TFRecords → padded ground-truth batches.
+
+Parity targets: the TFRecord feature schema written by the reference's converters
+(`Datasets/VOC2007/tfrecords.py:70-93`, `Datasets/MSCOCO/tfrecords.py:37-101`) and
+read by `YOLO/tensorflow/preprocess.py:271-285`; the augmentations of
+`Preprocessor.__call__` (`preprocess.py:13-35`): 50% horizontal flip with bbox
+mirroring (`:37-50`), 50% bbox-preserving random crop (`:52-119`), resize to the
+output shape, and `/127.5 - 1` normalization.
+
+TPU-first split of responsibilities: the host does decode/augment/resize and pads
+ground truth to a STATIC `MAX_BOXES`; the per-scale dense label encoding the
+reference does here with an autograph loop (`preprocess.py:137-224`) happens on
+device inside the jitted train step (ops/yolo.py) — static shapes end to end.
+
+Batches are (images (B,H,W,3) f32 in [-1,1], boxes (B,100,4) corner-normalized,
+classes (B,100) int32, valid (B,100) f32).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..ops.yolo import MAX_BOXES
+from .imagenet import _tf
+
+
+def parse_example(serialized, tf):
+    """Reference schema (`YOLO/tensorflow/preprocess.py:271-285`)."""
+    features = {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/object/class/label": tf.io.VarLenFeature(tf.int64),
+        "image/object/bbox/xmin": tf.io.VarLenFeature(tf.float32),
+        "image/object/bbox/ymin": tf.io.VarLenFeature(tf.float32),
+        "image/object/bbox/xmax": tf.io.VarLenFeature(tf.float32),
+        "image/object/bbox/ymax": tf.io.VarLenFeature(tf.float32),
+    }
+    parsed = tf.io.parse_single_example(serialized, features)
+    classes = tf.cast(tf.sparse.to_dense(parsed["image/object/class/label"]),
+                      tf.int32)
+    boxes = tf.stack([
+        tf.sparse.to_dense(parsed["image/object/bbox/xmin"]),
+        tf.sparse.to_dense(parsed["image/object/bbox/ymin"]),
+        tf.sparse.to_dense(parsed["image/object/bbox/xmax"]),
+        tf.sparse.to_dense(parsed["image/object/bbox/ymax"]),
+    ], axis=1)  # (n, 4) normalized corners
+    return parsed["image/encoded"], boxes, classes
+
+
+def random_flip(image, boxes, tf):
+    """50% horizontal flip, mirroring xmin/xmax (`preprocess.py:37-50`)."""
+    def flip():
+        xmin, ymin, xmax, ymax = tf.unstack(boxes, axis=-1)
+        return (tf.image.flip_left_right(image),
+                tf.stack([1.0 - xmax, ymin, 1.0 - xmin, ymax], axis=-1))
+    return tf.cond(tf.random.uniform([]) < 0.5, flip, lambda: (image, boxes))
+
+
+def random_crop_keep_boxes(image, boxes, tf):
+    """50% random crop guaranteed to contain every box (`preprocess.py:52-119`):
+    crop bounds drawn uniformly between the union of boxes and the image edge,
+    then boxes re-normalized to the crop."""
+    def crop():
+        min_xmin = tf.reduce_min(boxes[:, 0])
+        min_ymin = tf.reduce_min(boxes[:, 1])
+        max_xmax = tf.reduce_max(boxes[:, 2])
+        max_ymax = tf.reduce_max(boxes[:, 3])
+        xmin_d = tf.random.uniform([], 0.0, tf.maximum(min_xmin, 1e-6))
+        ymin_d = tf.random.uniform([], 0.0, tf.maximum(min_ymin, 1e-6))
+        xmax_d = tf.random.uniform([], 0.0, tf.maximum(1.0 - max_xmax, 1e-6))
+        ymax_d = tf.random.uniform([], 0.0, tf.maximum(1.0 - max_ymax, 1e-6))
+
+        w_scale = 1.0 - xmin_d - xmax_d
+        h_scale = 1.0 - ymin_d - ymax_d
+        xmin, ymin, xmax, ymax = tf.unstack(boxes, axis=-1)
+        new_boxes = tf.stack([(xmin - xmin_d) / w_scale,
+                              (ymin - ymin_d) / h_scale,
+                              (xmax - xmin_d) / w_scale,
+                              (ymax - ymin_d) / h_scale], axis=-1)
+
+        h = tf.cast(tf.shape(image)[0], tf.float32)
+        w = tf.cast(tf.shape(image)[1], tf.float32)
+        off_h = tf.cast(ymin_d * h, tf.int32)
+        off_w = tf.cast(xmin_d * w, tf.int32)
+        tgt_h = tf.cast(tf.math.ceil(h_scale * h), tf.int32)
+        tgt_w = tf.cast(tf.math.ceil(w_scale * w), tf.int32)
+        tgt_h = tf.minimum(tgt_h, tf.shape(image)[0] - off_h)
+        tgt_w = tf.minimum(tgt_w, tf.shape(image)[1] - off_w)
+        return image[off_h:off_h + tgt_h, off_w:off_w + tgt_w, :], new_boxes
+
+    has_boxes = tf.shape(boxes)[0] > 0
+    do_crop = tf.logical_and(tf.random.uniform([]) < 0.5, has_boxes)
+    return tf.cond(do_crop, crop, lambda: (image, boxes))
+
+
+def preprocess(serialized, image_size: int, training: bool, tf):
+    encoded, boxes, classes = parse_example(serialized, tf)
+    image = tf.cast(tf.io.decode_jpeg(encoded, channels=3), tf.float32)
+    if training:
+        image, boxes = random_flip(image, boxes, tf)
+        image, boxes = random_crop_keep_boxes(image, boxes, tf)
+    image = tf.image.resize(image, [image_size, image_size])
+    image = image / 127.5 - 1.0  # `preprocess.py:25`
+
+    n = tf.minimum(tf.shape(boxes)[0], MAX_BOXES)
+    boxes = tf.pad(boxes[:n], [[0, MAX_BOXES - n], [0, 0]])
+    classes = tf.pad(classes[:n], [[0, MAX_BOXES - n]])
+    valid = tf.pad(tf.ones([n], tf.float32), [[0, MAX_BOXES - n]])
+    image.set_shape([image_size, image_size, 3])
+    boxes.set_shape([MAX_BOXES, 4])
+    classes.set_shape([MAX_BOXES])
+    valid.set_shape([MAX_BOXES])
+    return image, boxes, classes, valid
+
+
+def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 416,
+                  training: bool = True, shuffle_buffer: int = 512,
+                  num_process: int = 1, process_index: int = 0, seed: int = 0):
+    """Per-host tf.data detection pipeline (cf. `create_dataset`,
+    `YOLO/tensorflow/train.py:260-273`, plus per-host sharding for pods)."""
+    tf = _tf()
+    AUTOTUNE = tf.data.AUTOTUNE
+    files = tf.data.Dataset.list_files(file_pattern, shuffle=training, seed=seed)
+    if num_process > 1:
+        files = files.shard(num_process, process_index)
+    ds = tf.data.TFRecordDataset(files, num_parallel_reads=AUTOTUNE)
+    if training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    ds = ds.map(lambda s: preprocess(s, image_size, training, tf),
+                num_parallel_calls=AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=True)
+    return ds.prefetch(AUTOTUNE)
+
+
+def synthetic_batches(*, batch_size: int, image_size: int = 64,
+                      num_classes: int = 4, steps: int = 2, num_boxes: int = 3,
+                      seed: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Random but well-formed detection batches for tests/benchmarks (the
+    fake-data idea the reference left commented out,
+    `CycleGAN/tensorflow/train.py:338-342`)."""
+    rs = np.random.RandomState(seed)
+    for _ in range(steps):
+        images = rs.rand(batch_size, image_size, image_size, 3).astype(
+            np.float32) * 2.0 - 1.0
+        xy1 = rs.uniform(0.0, 0.6, (batch_size, MAX_BOXES, 2))
+        wh = rs.uniform(0.05, 0.4, (batch_size, MAX_BOXES, 2))
+        boxes = np.concatenate([xy1, np.minimum(xy1 + wh, 1.0)],
+                               axis=-1).astype(np.float32)
+        classes = rs.randint(0, num_classes,
+                             (batch_size, MAX_BOXES)).astype(np.int32)
+        valid = np.zeros((batch_size, MAX_BOXES), np.float32)
+        valid[:, :num_boxes] = 1.0
+        yield images, boxes, classes, valid

@@ -1,0 +1,90 @@
+"""GAN input pipelines: MNIST for DCGAN, two-domain TFRecords for CycleGAN.
+
+Parity targets: DCGAN's keras-datasets MNIST normalized to [-1, 1]
+(`DCGAN/tensorflow/main.py:21-26`), and CycleGAN's zipped two-domain TFRecord
+pipeline with flip → resize 286 → random-crop 256 → [-1, 1]
+(`CycleGAN/tensorflow/train.py:74-117`), reading the single-feature TFRecords of
+`CycleGAN/tensorflow/tfrecords.py:9-73`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .imagenet import _tf
+from .mnist import read_idx_images
+
+
+def mnist_gan_batches(data_dir: str, batch_size: int, *, seed: int = 0,
+                      drop_remainder: bool = True) -> Iterator[np.ndarray]:
+    """(B, 28, 28, 1) float32 in [-1, 1] (`DCGAN/tensorflow/main.py:21-26`)."""
+    import os
+    for name in ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"):
+        path = os.path.join(data_dir, name)
+        if os.path.exists(path):
+            break
+    images = read_idx_images(path).astype(np.float32)
+    images = (images - 127.5) / 127.5
+    images = images[..., None]
+    rs = np.random.RandomState(seed)
+    order = rs.permutation(len(images))
+    for i in range(0, len(order) - (batch_size - 1 if drop_remainder else 0),
+                   batch_size):
+        yield images[order[i:i + batch_size]]
+
+
+def synthetic_mnist_batches(batch_size: int, steps: int = 2,
+                            seed: int = 0) -> Iterator[np.ndarray]:
+    rs = np.random.RandomState(seed)
+    for _ in range(steps):
+        yield rs.uniform(-1, 1, (batch_size, 28, 28, 1)).astype(np.float32)
+
+
+def _parse_cyclegan(serialized, image_size, training, tf):
+    features = {"image/encoded": tf.io.FixedLenFeature([], tf.string)}
+    parsed = tf.io.parse_single_example(serialized, features)
+    image = tf.image.decode_jpeg(parsed["image/encoded"], channels=3)
+    if training:
+        image = tf.image.random_flip_left_right(image)
+        resize = int(image_size * 286 / 256)  # 286 at 256 (`train.py:89-92`)
+        image = tf.image.resize(image, [resize, resize])
+        image = tf.image.random_crop(image, [image_size, image_size, 3])
+    else:
+        image = tf.image.resize(image, [image_size, image_size])
+    image = tf.cast(image, tf.float32) / 127.5 - 1.0
+    image.set_shape([image_size, image_size, 3])
+    return image
+
+
+def build_two_domain_dataset(tfrecord_a: str, tfrecord_b: str, *,
+                             batch_size: int, image_size: int = 256,
+                             training: bool = True, shuffle_buffer: int = 10000,
+                             seed: int = 0):
+    """Zipped (image_a, image_b) dataset (`CycleGAN/tensorflow/train.py:114-117`)."""
+    tf = _tf()
+    AUTOTUNE = tf.data.AUTOTUNE
+
+    def one(path):
+        ds = tf.data.TFRecordDataset(path)
+        return ds.map(lambda s: _parse_cyclegan(s, image_size, training, tf),
+                      num_parallel_calls=AUTOTUNE)
+
+    ds = tf.data.Dataset.zip((one(tfrecord_a), one(tfrecord_b)))
+    if training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    ds = ds.batch(batch_size, drop_remainder=True)
+    return ds.prefetch(AUTOTUNE)
+
+
+def synthetic_two_domain_batches(batch_size: int, image_size: int = 64,
+                                 steps: int = 2, seed: int = 0
+                                 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """The reference's commented-out local-testing harness, made real
+    (`CycleGAN/tensorflow/train.py:338-342`)."""
+    rs = np.random.RandomState(seed)
+    for _ in range(steps):
+        a = rs.uniform(-1, 1, (batch_size, image_size, image_size, 3))
+        b = rs.uniform(-1, 1, (batch_size, image_size, image_size, 3))
+        yield a.astype(np.float32), b.astype(np.float32)

@@ -1,0 +1,138 @@
+"""Pose (MPII) input pipeline: TFRecords → cropped images + normalized keypoints.
+
+Parity targets: the MPII TFRecord schema written by the reference converter
+(`Datasets/MPII/tfrecords_mpii.py:38-84`: parts/x,y as floats normalized by image
+size with <0 marking missing joints, parts/v ∈ {0, 2}) and the ROI-crop semantics
+of `Hourglass/tensorflow/preprocess.py:43-88` (crop to the keypoint bounding box
+plus a margin — randomized 0.1-0.3 at train time, `:17-23` — then shift/rescale
+keypoints into crop coordinates).
+
+NOTE: the reference preprocessor declares `parts/x` as int64 pixels and reads
+`center/scale` keys its own converter never writes (`preprocess.py:180-185` vs
+`tfrecords_mpii.py:65-77`) — its two halves disagree. We follow the converter's
+schema (it defines the on-disk format) and express the crop margin as a fraction
+of the keypoint extent instead of the absent `scale` field.
+
+The per-keypoint gaussian rendering the reference does here on the host moves to
+the device step (ops/heatmap.py). Batches are (images (B,S,S,3) f32 in [-1,1],
+kp_x (B,16), kp_y (B,16), visibility (B,16)).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from .imagenet import _tf
+
+NUM_JOINTS = 16  # MPII
+
+
+def parse_example(serialized, tf):
+    features = {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/object/parts/x": tf.io.VarLenFeature(tf.float32),
+        "image/object/parts/y": tf.io.VarLenFeature(tf.float32),
+        "image/object/parts/v": tf.io.VarLenFeature(tf.int64),
+    }
+    parsed = tf.io.parse_single_example(serialized, features)
+    kp_x = tf.sparse.to_dense(parsed["image/object/parts/x"])
+    kp_y = tf.sparse.to_dense(parsed["image/object/parts/y"])
+    vis = tf.cast(tf.sparse.to_dense(parsed["image/object/parts/v"]), tf.float32)
+    return parsed["image/encoded"], kp_x, kp_y, vis
+
+
+def crop_roi(image, kp_x, kp_y, vis, margin, tf):
+    """Crop to the visible-keypoint bounding box + margin (fraction of the
+    keypoint extent), re-normalizing keypoints to the crop
+    (`preprocess.py:43-88`)."""
+    h = tf.cast(tf.shape(image)[0], tf.float32)
+    w = tf.cast(tf.shape(image)[1], tf.float32)
+    ok = (kp_x >= 0.0) & (kp_y >= 0.0)
+    big = tf.where(ok, kp_x, tf.ones_like(kp_x) * 2.0)
+    sml = tf.where(ok, kp_x, tf.ones_like(kp_x) * -1.0)
+    xmin = tf.reduce_min(big)
+    xmax = tf.reduce_max(sml)
+    big_y = tf.where(ok, kp_y, tf.ones_like(kp_y) * 2.0)
+    sml_y = tf.where(ok, kp_y, tf.ones_like(kp_y) * -1.0)
+    ymin = tf.reduce_min(big_y)
+    ymax = tf.reduce_max(sml_y)
+
+    extent = tf.maximum(xmax - xmin, ymax - ymin)
+    pad = margin * tf.maximum(extent, 1e-3)
+    exmin = tf.clip_by_value(xmin - pad, 0.0, 1.0)
+    eymin = tf.clip_by_value(ymin - pad, 0.0, 1.0)
+    exmax = tf.clip_by_value(xmax + pad, 0.0, 1.0)
+    eymax = tf.clip_by_value(ymax + pad, 0.0, 1.0)
+
+    off_y = tf.cast(eymin * h, tf.int32)
+    off_x = tf.cast(exmin * w, tf.int32)
+    tgt_h = tf.maximum(tf.cast((eymax - eymin) * h, tf.int32), 1)
+    tgt_w = tf.maximum(tf.cast((exmax - exmin) * w, tf.int32), 1)
+    image = image[off_y:off_y + tgt_h, off_x:off_x + tgt_w, :]
+
+    new_w = exmax - exmin
+    new_h = eymax - eymin
+    kp_x = tf.where(ok, (kp_x - exmin) / tf.maximum(new_w, 1e-6),
+                    tf.ones_like(kp_x) * -1.0)
+    kp_y = tf.where(ok, (kp_y - eymin) / tf.maximum(new_h, 1e-6),
+                    tf.ones_like(kp_y) * -1.0)
+    return image, kp_x, kp_y
+
+
+def preprocess(serialized, image_size: int, training: bool, tf):
+    encoded, kp_x, kp_y, vis = parse_example(serialized, tf)
+    image = tf.cast(tf.io.decode_jpeg(encoded, channels=3), tf.float32)
+    margin = (tf.random.uniform([], 0.1, 0.3) if training
+              else tf.constant(0.2))  # `preprocess.py:17-23`
+    # all-missing annotations (every joint < 0) would collapse the crop to a
+    # zero-size slice — skip the crop for those records
+    has_kp = tf.reduce_any((kp_x >= 0.0) & (kp_y >= 0.0))
+    image, kp_x, kp_y = tf.cond(
+        has_kp,
+        lambda: crop_roi(image, kp_x, kp_y, vis, margin, tf),
+        lambda: (image, kp_x, kp_y))
+    image = tf.image.resize(image, [image_size, image_size])
+    image = image / 127.5 - 1.0
+
+    def fix(t):
+        t = t[:NUM_JOINTS]
+        t = tf.pad(t, [[0, NUM_JOINTS - tf.shape(t)[0]]], constant_values=-1.0)
+        t.set_shape([NUM_JOINTS])
+        return t
+
+    image.set_shape([image_size, image_size, 3])
+    return image, fix(kp_x), fix(kp_y), fix(vis)
+
+
+def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 256,
+                  training: bool = True, shuffle_buffer: int = 512,
+                  num_process: int = 1, process_index: int = 0, seed: int = 0):
+    """Per-host tf.data pose pipeline (cf. `create_dataset`,
+    `Hourglass/tensorflow/train.py:175-190`)."""
+    tf = _tf()
+    AUTOTUNE = tf.data.AUTOTUNE
+    files = tf.data.Dataset.list_files(file_pattern, shuffle=training, seed=seed)
+    if num_process > 1:
+        files = files.shard(num_process, process_index)
+    ds = tf.data.TFRecordDataset(files, num_parallel_reads=AUTOTUNE)
+    if training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    ds = ds.map(lambda s: preprocess(s, image_size, training, tf),
+                num_parallel_calls=AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=True)
+    return ds.prefetch(AUTOTUNE)
+
+
+def synthetic_batches(*, batch_size: int, image_size: int = 64,
+                      num_joints: int = NUM_JOINTS, steps: int = 2,
+                      seed: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
+    rs = np.random.RandomState(seed)
+    for _ in range(steps):
+        images = rs.rand(batch_size, image_size, image_size, 3).astype(
+            np.float32) * 2.0 - 1.0
+        kp_x = rs.uniform(0.1, 0.9, (batch_size, num_joints)).astype(np.float32)
+        kp_y = rs.uniform(0.1, 0.9, (batch_size, num_joints)).astype(np.float32)
+        vis = (rs.rand(batch_size, num_joints) > 0.2).astype(np.float32) * 2.0
+        yield images, kp_x, kp_y, vis

@@ -1,0 +1,149 @@
+"""GAN model zoo: DCGAN (MNIST) and CycleGAN generators/discriminators in Flax.
+
+Parity targets:
+- DCGAN (`DCGAN/tensorflow/models.py:8-65`): 28×28 conv discriminator
+  (conv64/conv128 stride 2 + LeakyReLU + dropout 0.3 → dense 1 logit) and the
+  transposed-conv generator (dense 7·7·256 → CT128 s1 → CT64 s2 → CT1 s2 tanh,
+  BN + LeakyReLU between, no biases) with its shape contract asserted.
+- CycleGAN (`CycleGAN/tensorflow/models.py:8-104`): 9-ResNet-block generator with
+  reflection padding (c7s1-64, d128, d256, R256×9, u128, u64, c7s1-3) and the
+  70×70 PatchGAN discriminator (C64-C128-C256-C512 → 1-channel patch logits).
+
+Keras defaults preserved: LeakyReLU α=0.3 for DCGAN, α=0.2 for the PatchGAN;
+BatchNorm everywhere the reference has it (the CycleGAN paper uses instance norm —
+the reference chose BN, and we match the reference).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+
+
+class DCGANGenerator(nn.Module):
+    """`make_generator_model` (`DCGAN/tensorflow/models.py:30-65`): 100-d noise →
+    (28, 28, 1) tanh image."""
+    noise_dim: int = 100
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.99,
+                     epsilon=1e-3, dtype=jnp.float32)
+        ct = partial(nn.ConvTranspose, padding="SAME", use_bias=False,
+                     dtype=self.dtype)
+        x = nn.Dense(7 * 7 * 256, use_bias=False, dtype=self.dtype)(z)
+        x = nn.leaky_relu(bn()(x), 0.3).astype(self.dtype)
+        x = x.reshape(x.shape[0], 7, 7, 256)
+        x = ct(128, (5, 5), strides=(1, 1))(x)
+        assert x.shape[1:] == (7, 7, 128), x.shape
+        x = nn.leaky_relu(bn()(x), 0.3).astype(self.dtype)
+        x = ct(64, (5, 5), strides=(2, 2))(x)
+        assert x.shape[1:] == (14, 14, 64), x.shape
+        x = nn.leaky_relu(bn()(x), 0.3).astype(self.dtype)
+        x = ct(1, (5, 5), strides=(2, 2))(x)
+        assert x.shape[1:] == (28, 28, 1), x.shape
+        return jnp.tanh(x.astype(jnp.float32))
+
+
+class DCGANDiscriminator(nn.Module):
+    """`make_discriminator_model` (`DCGAN/tensorflow/models.py:8-27`): image →
+    single real/fake logit."""
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, padding="SAME", dtype=self.dtype)
+        x = conv(64, (5, 5), strides=(2, 2))(x.astype(self.dtype))
+        x = nn.leaky_relu(x, 0.3)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = conv(128, (5, 5), strides=(2, 2))(x)
+        x = nn.leaky_relu(x, 0.3)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1, dtype=jnp.float32)(x)
+
+
+def _reflect_pad(x, pad: int):
+    """`ReflectionPad2d` (`CycleGAN/tensorflow/models.py:8-14`)."""
+    return jnp.pad(x, [(0, 0), (pad, pad), (pad, pad), (0, 0)], mode="reflect")
+
+
+class CycleGANResBlock(nn.Module):
+    """Reflect-padded 3x3 residual block (`CycleGAN/tensorflow/models.py:17-38`)."""
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.99,
+                     epsilon=1e-3, dtype=jnp.float32)
+        conv = partial(nn.Conv, padding="VALID", use_bias=False, dtype=self.dtype)
+        y = _reflect_pad(x, 1)
+        y = conv(self.features, (3, 3))(y)
+        y = nn.relu(bn()(y)).astype(self.dtype)
+        y = _reflect_pad(y, 1)
+        y = conv(self.features, (3, 3))(y)
+        y = bn()(y).astype(self.dtype)
+        return x + y
+
+
+class CycleGANGenerator(nn.Module):
+    """c7s1-64, d128, d256, R256×n, u128, u64, c7s1-3 with reflection pads
+    (`CycleGAN/tensorflow/models.py:41-78`)."""
+    n_blocks: int = 9
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.99,
+                     epsilon=1e-3, dtype=jnp.float32)
+        x = _reflect_pad(x.astype(self.dtype), 3)
+        x = nn.Conv(64, (7, 7), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(bn()(x)).astype(self.dtype)
+        for f in (128, 256):  # encode
+            x = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
+                        use_bias=False, dtype=self.dtype)(x)
+            x = nn.relu(bn()(x)).astype(self.dtype)
+        for _ in range(self.n_blocks):  # transform
+            x = CycleGANResBlock(256, self.dtype)(x, train)
+        for f in (128, 64):  # decode
+            x = nn.ConvTranspose(f, (3, 3), strides=(2, 2), padding="SAME",
+                                 use_bias=False, dtype=self.dtype)(x)
+            x = nn.relu(bn()(x)).astype(self.dtype)
+        x = _reflect_pad(x, 3)
+        x = nn.Conv(3, (7, 7), padding="VALID", dtype=jnp.float32)(x)
+        return jnp.tanh(x)
+
+
+class PatchGANDiscriminator(nn.Module):
+    """70×70 PatchGAN (`CycleGAN/tensorflow/models.py:81-104`): (H, W, 3) →
+    (H/8, W/8, 1) patch logits."""
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.99,
+                     epsilon=1e-3, dtype=jnp.float32)
+        conv = partial(nn.Conv, padding="SAME", dtype=self.dtype)
+        x = conv(64, (4, 4), strides=(2, 2))(x.astype(self.dtype))
+        x = nn.leaky_relu(x, 0.2)
+        for f, s in ((128, 2), (256, 2), (512, 1)):
+            x = conv(f, (4, 4), strides=(s, s), use_bias=False)(x)
+            x = nn.leaky_relu(bn()(x), 0.2).astype(self.dtype)
+        return conv(1, (4, 4), strides=(1, 1), dtype=jnp.float32)(x)
+
+
+MODELS.register("dcgan_generator", DCGANGenerator)
+MODELS.register("dcgan_discriminator", DCGANDiscriminator)
+MODELS.register("cyclegan_generator", CycleGANGenerator)
+MODELS.register("patchgan_discriminator", PatchGANDiscriminator)
+# family aliases so the dcgan/cyclegan configs resolve; the GAN trainers build
+# the full generator+discriminator pairs themselves (core/gan.py)
+MODELS.register("dcgan", DCGANGenerator)
+MODELS.register("cyclegan", CycleGANGenerator)

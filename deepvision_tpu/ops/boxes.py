@@ -1,0 +1,69 @@
+"""Box geometry ops shared by the detection families.
+
+Parity targets: `YOLO/tensorflow/utils.py:4-84` (xywh→corners converters, broadcast
+IoU, clipped binary cross-entropy). Implemented as pure jnp so they run inside jitted
+train steps on TPU; the BCE variant used in losses works on logits
+(`optax.sigmoid_binary_cross_entropy`) rather than clipped probabilities for
+numerical stability, with identical semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def xywh_to_x1y1x2y2(box: jnp.ndarray) -> jnp.ndarray:
+    """(cx, cy, w, h) → (xmin, ymin, xmax, ymax). Reference
+    `YOLO/tensorflow/utils.py:4-12` (its name says x1x2y1y2 but the layout it
+    produces is xmin,ymin,xmax,ymax — we name it honestly)."""
+    xy = box[..., 0:2]
+    wh = box[..., 2:4]
+    return jnp.concatenate([xy - wh / 2.0, xy + wh / 2.0], axis=-1)
+
+
+def xywh_to_y1x1y2x2(box: jnp.ndarray) -> jnp.ndarray:
+    """(cx, cy, w, h) → (ymin, xmin, ymax, xmax) — the tf.image convention
+    (`YOLO/tensorflow/utils.py:15-28`)."""
+    x = box[..., 0:1]
+    y = box[..., 1:2]
+    w = box[..., 2:3]
+    h = box[..., 3:4]
+    yx = jnp.concatenate([y, x], axis=-1)
+    hw = jnp.concatenate([h, w], axis=-1)
+    return jnp.concatenate([yx - hw / 2.0, yx + hw / 2.0], axis=-1)
+
+
+def x1y1x2y2_to_xywh(box: jnp.ndarray) -> jnp.ndarray:
+    """(xmin, ymin, xmax, ymax) → (cx, cy, w, h)."""
+    xy = (box[..., 0:2] + box[..., 2:4]) / 2.0
+    wh = box[..., 2:4] - box[..., 0:2]
+    return jnp.concatenate([xy, wh], axis=-1)
+
+
+def broadcast_iou(box_a: jnp.ndarray, box_b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU between (..., N, 4) and (..., M, 4) corner boxes → (..., N, M).
+
+    Reference `YOLO/tensorflow/utils.py:31-77`: normalized coordinates, overlap
+    widths clipped to [0, 1], epsilon-guarded union.
+    """
+    a = box_a[..., :, None, :]  # (..., N, 1, 4)
+    b = box_b[..., None, :, :]  # (..., 1, M, 4)
+    left = jnp.maximum(a[..., 0], b[..., 0])
+    top = jnp.maximum(a[..., 1], b[..., 1])
+    right = jnp.minimum(a[..., 2], b[..., 2])
+    bot = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.clip(right - left, 0.0, 1.0)
+    ih = jnp.clip(bot - top, 0.0, 1.0)
+    inter = iw * ih
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    union = area_a + area_b - inter
+    return inter / (union + 1e-7)
+
+
+def binary_cross_entropy(probs: jnp.ndarray, labels: jnp.ndarray,
+                         epsilon: float = 1e-7) -> jnp.ndarray:
+    """Elementwise BCE on probabilities with clipping — exact semantics of
+    `YOLO/tensorflow/utils.py:80-84`. Prefer the logits form in losses."""
+    p = jnp.clip(probs, epsilon, 1.0 - epsilon)
+    return -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))

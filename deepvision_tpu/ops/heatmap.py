@@ -1,0 +1,49 @@
+"""Keypoint → 2D-gaussian heatmap rendering, vectorized jnp.
+
+Parity target: `Hourglass/tensorflow/preprocess.py:91-173` — a σ=1 gaussian patch
+of amplitude `scale`=12 centered on each (rounded) keypoint, truncated at 3σ,
+all-zero when the keypoint is invisible (v==0) or its patch falls fully outside
+the heatmap ("a ground truth heatmap of all zeros is provided", Newell §3).
+
+The reference renders each patch with a nested autograph loop + TensorArray
+scatter per keypoint (`preprocess.py:143-149`); here the whole (H, W, K) tensor is
+one broadcasted expression, so it runs inside the jitted train step on device.
+(The reference's patch loop also drops the right-most row/column of each 7×7 patch
+— `range(patch_min, patch_max)` with an exclusive bound, `:143-144`; we render the
+full symmetric patch.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def render_gaussian_heatmaps(kp_x: jnp.ndarray, kp_y: jnp.ndarray,
+                             visibility: jnp.ndarray, height: int, width: int,
+                             sigma: float = 1.0,
+                             scale: float = 12.0) -> jnp.ndarray:
+    """Render K keypoints into an (height, width, K) heatmap tensor.
+
+    kp_x, kp_y: (K,) keypoint coordinates normalized to [0, 1] (values < 0 mark
+    missing joints, as written by the MPII converter,
+    `Datasets/MPII/tfrecords_mpii.py:54-60`); visibility: (K,) 0 = invisible.
+    """
+    x0 = jnp.round(kp_x * width).astype(jnp.int32)    # (K,)
+    y0 = jnp.round(kp_y * height).astype(jnp.int32)
+
+    xs = jnp.arange(width, dtype=jnp.int32)[None, :, None]    # (1, W, 1)
+    ys = jnp.arange(height, dtype=jnp.int32)[:, None, None]   # (H, 1, 1)
+    dx = xs - x0[None, None, :]                               # (H→1, W, K) bcast
+    dy = ys - y0[None, None, :]
+
+    r = int(3 * sigma)
+    in_patch = (jnp.abs(dx) <= r) & (jnp.abs(dy) <= r)
+    gauss = jnp.exp(-(dx.astype(jnp.float32) ** 2 + dy.astype(jnp.float32) ** 2)
+                    / (2.0 * sigma * sigma)) * scale
+
+    visible = (visibility > 0) & (kp_x >= 0) & (kp_y >= 0)
+    # fully-out-of-bounds patch → all zeros (`preprocess.py:109-110`)
+    on_map = ((x0 - r < width) & (y0 - r < height) &
+              (x0 + r >= 0) & (y0 + r >= 0))
+    keep = (visible & on_map)[None, None, :]
+    return jnp.where(in_patch & keep, gauss, 0.0)

@@ -1,0 +1,231 @@
+"""YOLO V3 box coding, on-device label encoding, and loss — pure jnp.
+
+Parity targets (all under `/root/reference/YOLO/tensorflow/`):
+- `get_absolute_yolo_box` / `get_relative_yolo_box` (`yolov3.py:238-349`): the
+  (tx,ty,tw,th) ↔ (bx,by,bw,bh) transforms with meshgrid cell offsets.
+- `Preprocessor.preprocess_label_for_one_scale` + `find_best_anchor`
+  (`preprocess.py:137-269`): ground-truth assignment to grid cells.
+- `YoloLoss` (`yolov3.py:352-563`): xy/wh/class/obj losses with small-box weighting
+  and the IoU ignore mask.
+
+TPU-first design notes:
+- Label encoding runs ON DEVICE inside the jitted train step, vectorized over a
+  fixed `MAX_BOXES` ground-truth pad. The reference encodes labels on the host with
+  an autograph `tf.range` loop + TensorArray per example (`preprocess.py:169-223`);
+  here the same assignment is one masked scatter (`.at[...].set(mode='drop')`) —
+  static shapes, no per-example Python, nothing for the host to bottleneck on.
+- The ignore mask takes IoU against the padded ground-truth list directly (the
+  reference reconstructs at most 100 boxes from the dense label by sorting,
+  `yolov3.py:448-454` — same cap, same semantics, minus the reconstruction).
+- BCE terms are computed from logits (`optax.sigmoid_binary_cross_entropy`) instead
+  of clipped probabilities (`utils.py:80-84`) for numerical stability.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .boxes import broadcast_iou, xywh_to_x1y1x2y2
+
+# The 9 COCO anchors, normalized by the 416 training resolution
+# (`yolov3.py:18-20`). Groups of 3 per scale: [0:3]→stride 8, [3:6]→16, [6:9]→32.
+ANCHORS_WH = np.array([[10, 13], [16, 30], [33, 23], [30, 61], [62, 45],
+                       [59, 119], [116, 90], [156, 198], [373, 326]],
+                      np.float32) / 416.0
+
+MAX_BOXES = 100  # ground-truth pad; the reference caps its ignore mask at 100 too
+
+LAMBDA_COORD = 5.0   # YoloV1 eq. 3 weights (`yolov3.py:357-358`)
+LAMBDA_NOOBJ = 0.5
+IGNORE_THRESH = 0.5  # `yolov3.py:355`
+
+
+def _cell_offsets(grid_size: int) -> jnp.ndarray:
+    """(g, g, 1, 2) tensor of (Cx, Cy) cell offsets — row y, column x, so
+    offsets[y, x] == (x, y). Matches the meshgrid walkthrough `yolov3.py:261-292`."""
+    cx, cy = jnp.meshgrid(jnp.arange(grid_size), jnp.arange(grid_size))
+    return jnp.stack([cx, cy], axis=-1)[:, :, None, :].astype(jnp.float32)
+
+
+def decode_boxes(y_pred: jnp.ndarray, anchors_wh, num_classes: int):
+    """Raw head output → absolute normalized boxes (`get_absolute_yolo_box`,
+    `yolov3.py:238-326`).
+
+    y_pred: (..., g, g, 3, 5 + C) raw logits.
+    Returns (box_xywh (...,g,g,3,4), objectness (...,g,g,3,1), classes (...,g,g,3,C)),
+    with objectness/classes sigmoided.
+    """
+    t_xy = y_pred[..., 0:2]
+    t_wh = y_pred[..., 2:4]
+    objectness = jax.nn.sigmoid(y_pred[..., 4:5])
+    classes = jax.nn.sigmoid(y_pred[..., 5:5 + num_classes])
+
+    grid_size = y_pred.shape[-4]
+    c_xy = _cell_offsets(grid_size)
+    # bx = sigmoid(tx) + Cx, normalized by grid size; bw = exp(tw) * pw
+    b_xy = (jax.nn.sigmoid(t_xy) + c_xy) / float(grid_size)
+    b_wh = jnp.exp(t_wh) * jnp.asarray(anchors_wh, y_pred.dtype)
+    return jnp.concatenate([b_xy, b_wh], axis=-1), objectness, classes
+
+
+def encode_boxes(y_true_xywh: jnp.ndarray, anchors_wh) -> jnp.ndarray:
+    """Absolute normalized (bx,by,bw,bh) → cell-relative (tx,ty,tw,th) — the inverse
+    transform (`get_relative_yolo_box`, `yolov3.py:329-349`), with the same
+    zero-guard for empty cells (log of 0/anchor → 0)."""
+    grid_size = y_true_xywh.shape[-4]
+    c_xy = _cell_offsets(grid_size)
+    b_xy = y_true_xywh[..., 0:2]
+    b_wh = y_true_xywh[..., 2:4]
+    t_xy = b_xy * float(grid_size) - c_xy
+    raw = b_wh / jnp.asarray(anchors_wh, y_true_xywh.dtype)
+    t_wh = jnp.where(raw > 0, jnp.log(jnp.maximum(raw, 1e-12)), 0.0)
+    return jnp.concatenate([t_xy, t_wh], axis=-1)
+
+
+def find_best_anchor(boxes_x1y1x2y2: jnp.ndarray,
+                     anchors_wh=None) -> jnp.ndarray:
+    """Best of the 9 anchors per ground-truth box by centered-IoU
+    (`Preprocessor.find_best_anchor`, `preprocess.py:226-269`).
+
+    boxes: (N, 4) corner boxes → (N,) int32 anchor indices in [0, 9).
+    """
+    anchors = jnp.asarray(ANCHORS_WH if anchors_wh is None else anchors_wh)
+    box_wh = boxes_x1y1x2y2[..., 2:4] - boxes_x1y1x2y2[..., 0:2]  # (N, 2)
+    inter = (jnp.minimum(box_wh[..., None, 0], anchors[..., 0]) *
+             jnp.minimum(box_wh[..., None, 1], anchors[..., 1]))  # (N, 9)
+    box_area = box_wh[..., 0] * box_wh[..., 1]
+    anchor_area = anchors[..., 0] * anchors[..., 1]
+    iou = inter / (box_area[..., None] + anchor_area - inter + 1e-12)
+    return jnp.argmax(iou, axis=-1).astype(jnp.int32)
+
+
+def encode_labels_one_scale(classes_onehot: jnp.ndarray, boxes: jnp.ndarray,
+                            valid: jnp.ndarray, grid_size: int,
+                            scale_index: int, anchors_wh=None) -> jnp.ndarray:
+    """Dense (g, g, 3, 5+C) target for one scale from padded ground truth —
+    the vectorized equivalent of `preprocess_label_for_one_scale`
+    (`preprocess.py:137-224`).
+
+    classes_onehot: (N, C); boxes: (N, 4) corner boxes; valid: (N,) bool/0-1 mask.
+    A box contributes iff it is valid AND its best anchor belongs to this scale
+    (anchors 3*scale_index .. 3*scale_index+2). grid[y][x][anchor] layout.
+    """
+    num_classes = classes_onehot.shape[-1]
+    anchor_idx = find_best_anchor(boxes, anchors_wh)        # (N,)
+    in_scale = (anchor_idx // 3) == scale_index
+    ok = valid.astype(bool) & in_scale
+    adjusted_anchor = anchor_idx % 3
+
+    box_xy = (boxes[..., 0:2] + boxes[..., 2:4]) / 2.0
+    box_wh = boxes[..., 2:4] - boxes[..., 0:2]
+    cell = jnp.floor(box_xy * grid_size).astype(jnp.int32)  # (N, 2) = (gx, gy)
+
+    updates = jnp.concatenate(
+        [box_xy, box_wh, jnp.ones_like(box_xy[..., :1]),
+         classes_onehot.astype(jnp.float32)], axis=-1)      # (N, 5+C)
+
+    # Scatter with dropped-out-of-range indices: boxes not in this scale get index
+    # `grid_size` (out of bounds → dropped by mode='drop').
+    oob = jnp.int32(grid_size)
+    gy = jnp.where(ok, cell[..., 1], oob)
+    gx = jnp.where(ok, cell[..., 0], oob)
+    y = jnp.zeros((grid_size, grid_size, 3, 5 + num_classes), jnp.float32)
+    return y.at[gy, gx, adjusted_anchor].set(updates, mode="drop")
+
+
+def encode_labels(classes_onehot, boxes, valid, grid_sizes: Sequence[int],
+                  anchors_wh=None) -> Tuple[jnp.ndarray, ...]:
+    """Per-scale dense labels for a BATCH of padded ground truth (vmapped scatter).
+
+    classes_onehot: (B, N, C); boxes: (B, N, 4); valid: (B, N).
+    grid_sizes ordered like the model outputs: finest (stride 8) first
+    (reference label tuple, `preprocess.py:27-34`).
+    """
+    out = []
+    for scale_index, g in enumerate(grid_sizes):
+        fn = lambda c, b, v: encode_labels_one_scale(  # noqa: E731
+            c, b, v, g, scale_index, anchors_wh)
+        out.append(jax.vmap(fn)(classes_onehot, boxes, valid))
+    return tuple(out)
+
+
+def yolo_loss_one_scale(y_true: jnp.ndarray, y_pred: jnp.ndarray,
+                        gt_boxes: jnp.ndarray, gt_valid: jnp.ndarray,
+                        scale_anchors_wh, num_classes: int) -> dict:
+    """Per-example YOLO loss for one scale (`YoloLoss.__call__`, `yolov3.py:360-434`).
+
+    y_true: (B, g, g, 3, 5+C) dense targets (absolute xywh + obj + one-hot).
+    y_pred: (B, g, g, 3, 5+C) raw head logits.
+    gt_boxes: (B, N, 4) corner ground truth (for the ignore mask); gt_valid: (B, N).
+    Returns dict of (B,) loss components: xy, wh, class, obj, total.
+    """
+    anchors = jnp.asarray(scale_anchors_wh, jnp.float32)
+    y_pred = y_pred.astype(jnp.float32)
+    y_true = y_true.astype(jnp.float32)
+
+    pred_xy_rel = jax.nn.sigmoid(y_pred[..., 0:2])
+    pred_wh_rel = y_pred[..., 2:4]
+
+    pred_box_abs, pred_obj, _ = decode_boxes(y_pred, anchors, num_classes)
+    pred_box_corners = xywh_to_x1y1x2y2(pred_box_abs)
+
+    true_obj = y_true[..., 4:5]
+    true_class = y_true[..., 5:]
+    true_box_rel = encode_boxes(y_true[..., 0:4], anchors)
+    true_xy_rel = true_box_rel[..., 0:2]
+    true_wh_rel = true_box_rel[..., 2:4]
+
+    # small-box weighting: 2 - w*h (`yolov3.py:405-407`)
+    weight = 2.0 - y_true[..., 2] * y_true[..., 3]
+    obj = true_obj[..., 0]
+
+    # xy / wh coordinate losses (`yolov3.py:515-563`)
+    xy_loss = jnp.sum(jnp.square(true_xy_rel - pred_xy_rel), axis=-1)
+    xy_loss = jnp.sum(obj * weight * xy_loss, axis=(1, 2, 3)) * LAMBDA_COORD
+    wh_loss = jnp.sum(jnp.square(true_wh_rel - pred_wh_rel), axis=-1)
+    wh_loss = jnp.sum(obj * weight * wh_loss, axis=(1, 2, 3)) * LAMBDA_COORD
+
+    # class loss, only where an object is present (`yolov3.py:494-513`)
+    class_bce = optax.sigmoid_binary_cross_entropy(
+        y_pred[..., 5:], true_class)
+    class_loss = jnp.sum(true_obj * class_bce, axis=(1, 2, 3, 4))
+
+    # ignore mask: predictions overlapping ANY ground truth > 0.5 IoU are not
+    # penalized for objectness (`yolov3.py:436-470`); padded GT rows have zero
+    # area → IoU 0 → never mask anything.
+    b, g = y_pred.shape[0], y_pred.shape[1]
+    flat_pred = pred_box_corners.reshape(b, -1, 4)
+    masked_gt = gt_boxes * gt_valid[..., None].astype(gt_boxes.dtype)
+    iou = broadcast_iou(flat_pred, masked_gt)            # (B, g*g*3, N)
+    best_iou = jnp.max(iou, axis=-1).reshape(b, g, g, 3)
+    ignore_mask = (best_iou < IGNORE_THRESH).astype(jnp.float32)[..., None]
+
+    # objectness loss (`yolov3.py:472-492`)
+    obj_bce = optax.sigmoid_binary_cross_entropy(y_pred[..., 4:5], true_obj)
+    obj_term = jnp.sum(true_obj * obj_bce, axis=(1, 2, 3, 4))
+    noobj_term = jnp.sum((1.0 - true_obj) * obj_bce * ignore_mask,
+                         axis=(1, 2, 3, 4)) * LAMBDA_NOOBJ
+    obj_loss = obj_term + noobj_term
+
+    total = xy_loss + wh_loss + class_loss + obj_loss
+    return {"xy": xy_loss, "wh": wh_loss, "class": class_loss, "obj": obj_loss,
+            "total": total}
+
+
+def yolo_loss(y_trues, y_preds, gt_boxes, gt_valid, num_classes: int,
+              anchors_wh=None) -> dict:
+    """Sum the per-scale losses over the 3 scales (`YOLO/tensorflow/train.py:80-95`).
+    Scale order = model output order: stride 8 (anchors 0-2) first.
+    Returns dict of (B,) per-example components."""
+    anchors = np.asarray(ANCHORS_WH if anchors_wh is None else anchors_wh)
+    out = None
+    for i, (y_true, y_pred) in enumerate(zip(y_trues, y_preds)):
+        part = yolo_loss_one_scale(y_true, y_pred, gt_boxes, gt_valid,
+                                   anchors[3 * i:3 * i + 3], num_classes)
+        out = part if out is None else {k: out[k] + part[k] for k in out}
+    return out

@@ -1,0 +1,38 @@
+"""Host-side fake-image history buffer for CycleGAN discriminator updates.
+
+Parity target: `CycleGAN/tensorflow/utils.py:31-61` — a 50-image pool; while
+filling, images pass through; once full, each incoming image is 50% swapped with a
+random stored one (Shrivastava et al. 2017). The reference notes it "only works in
+TF eager mode" — this is inherently stateful host code, which is exactly why the
+TPU-native CycleGAN step is split into jitted generator step → host pool query →
+jitted discriminator step, mirroring the reference's eager outer step
+(`CycleGAN/tensorflow/train.py:248-255`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ImagePool:
+    def __init__(self, pool_size: int = 50, seed: int = 0):
+        self.pool_size = pool_size
+        self.pool: list = []
+        self.rng = np.random.RandomState(seed)
+
+    def query(self, images: np.ndarray) -> np.ndarray:
+        """images: (B, H, W, C) host array → same-shape array mixing history."""
+        if self.pool_size == 0:
+            return images
+        out = []
+        for image in np.asarray(images):
+            if len(self.pool) < self.pool_size:
+                self.pool.append(image)
+                out.append(image)
+            elif self.rng.uniform() > 0.5:
+                idx = self.rng.randint(0, self.pool_size)
+                out.append(self.pool[idx])
+                self.pool[idx] = image
+            else:
+                out.append(image)
+        return np.stack(out, axis=0)

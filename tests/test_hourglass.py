@@ -1,0 +1,131 @@
+"""Hourglass family tests: gaussian heatmap rendering fixtures, weighted-MSE
+loss semantics, model shapes (abstract), and a tiny train-step smoke on the mesh.
+
+Fixtures follow the reference's documented semantics
+(`Hourglass/tensorflow/preprocess.py:91-173` gaussian rendering,
+`Hourglass/tensorflow/train.py:65-76` foreground-weighted loss).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepvision_tpu.core.pose import weighted_mse_loss
+from deepvision_tpu.ops.heatmap import render_gaussian_heatmaps
+
+_jit_render = jax.jit(render_gaussian_heatmaps, static_argnums=(3, 4))
+
+
+# -- heatmap rendering ---------------------------------------------------------
+
+def test_gaussian_peak_and_decay():
+    """σ=1 scale=12 gaussian centered on the rounded keypoint: peak 12, 1-px
+    neighbors 12·e^(-1/2), zero beyond 3σ."""
+    hm = _jit_render(jnp.array([0.5]), jnp.array([0.25]), jnp.array([2.0]),
+                     16, 16)
+    assert hm.shape == (16, 16, 1)
+    # x0 = round(.5*16) = 8, y0 = round(.25*16) = 4
+    assert float(hm[4, 8, 0]) == 12.0
+    np.testing.assert_allclose(hm[4, 9, 0], 12.0 * np.exp(-0.5), rtol=1e-5)
+    np.testing.assert_allclose(hm[5, 9, 0], 12.0 * np.exp(-1.0), rtol=1e-5)
+    # truncated at 3σ
+    assert float(hm[4, 12, 0]) == 0.0
+    assert float(hm[4, 11, 0]) > 0.0
+    # symmetric full patch (the reference's loop drops the last row/col —
+    # deviation documented in ops/heatmap.py)
+    np.testing.assert_allclose(hm[4, 8 - 3, 0], hm[4, 8 + 3, 0], rtol=1e-6)
+
+
+def test_gaussian_invisible_and_oob():
+    # v=0 → all zeros ("ground truth heatmap of all zeros", preprocess.py:106-110)
+    hm = _jit_render(jnp.array([0.5]), jnp.array([0.5]), jnp.array([0.0]), 8, 8)
+    assert float(jnp.abs(hm).sum()) == 0.0
+    # missing joint (-1 coords) → zeros
+    hm = _jit_render(jnp.array([-1.0]), jnp.array([-1.0]), jnp.array([2.0]), 8, 8)
+    assert float(jnp.abs(hm).sum()) == 0.0
+    # far out of bounds → zeros
+    hm = _jit_render(jnp.array([3.0]), jnp.array([0.5]), jnp.array([2.0]), 8, 8)
+    assert float(jnp.abs(hm).sum()) == 0.0
+    # partially out of bounds: clipped but present
+    hm = _jit_render(jnp.array([0.0]), jnp.array([0.0]), jnp.array([2.0]), 8, 8)
+    assert float(hm[0, 0, 0]) == 12.0
+    assert float(jnp.abs(hm).sum()) > 0.0
+
+
+def test_gaussian_multiple_joints_independent():
+    hm = _jit_render(jnp.array([0.25, 0.75]), jnp.array([0.25, 0.75]),
+                     jnp.array([2.0, 2.0]), 32, 32)
+    assert hm.shape == (32, 32, 2)
+    # each channel has exactly one peak at its own joint
+    assert float(hm[8, 8, 0]) == 12.0
+    assert float(hm[24, 24, 1]) == 12.0
+    assert float(hm[24, 24, 0]) == 0.0
+    assert float(hm[8, 8, 1]) == 0.0
+
+
+# -- loss ----------------------------------------------------------------------
+
+def test_weighted_mse_foreground_weighting():
+    """A unit error on a gaussian (label>0) pixel costs 82× a background one
+    (`train.py:69`), and stacks sum."""
+    label = jnp.zeros((1, 4, 4, 1)).at[0, 1, 1, 0].set(1.0)
+    pred_bg_err = label.at[0, 3, 3, 0].add(1.0)   # error on background pixel
+    pred_fg_err = label.at[0, 1, 1, 0].add(1.0)   # same error on foreground
+    l_bg = float(weighted_mse_loss(label, [pred_bg_err]))
+    l_fg = float(weighted_mse_loss(label, [pred_fg_err]))
+    np.testing.assert_allclose(l_fg / l_bg, 82.0, rtol=1e-5)
+    # two identical stacks → double
+    l2 = float(weighted_mse_loss(label, [pred_fg_err, pred_fg_err]))
+    np.testing.assert_allclose(l2, 2 * l_fg, rtol=1e-6)
+    # perfect prediction → zero
+    assert float(weighted_mse_loss(label, [label])) == 0.0
+
+
+# -- model ---------------------------------------------------------------------
+
+def test_hourglass_shapes_abstract():
+    """Full-size 4-stack hourglass via eval_shape: 4 heads at (64,64,16),
+    param count in the published ~6-9M range for hg104."""
+    from deepvision_tpu.models.hourglass import StackedHourglass
+    model = StackedHourglass(num_heatmap=16, num_stack=4, dtype=jnp.float32)
+    x = jnp.zeros((1, 256, 256, 3))
+    variables = jax.eval_shape(
+        lambda xx: model.init(jax.random.PRNGKey(0), xx, train=True), x)
+    outs = jax.eval_shape(
+        lambda v, xx: model.apply(v, xx, train=True, mutable=["batch_stats"]),
+        variables, x)[0]
+    assert len(outs) == 4
+    assert all(o.shape == (1, 64, 64, 16) for o in outs)
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(variables["params"])) / 1e6
+    assert 10 < n < 20, f"{n:.1f}M"  # 16.3M at 4 stacks / 1 residual
+
+
+def test_pose_train_step_decreases_loss(mesh8):
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.pose import make_pose_train_step
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.data.pose import synthetic_batches
+    from deepvision_tpu.models.hourglass import StackedHourglass
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    model = StackedHourglass(num_heatmap=16, num_stack=2, order=2,
+                             width_mult=0.125, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 64, 64, 3)))
+    tx = build_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3),
+                         ScheduleConfig(name="constant"), 10, 10)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+    state = jax.device_put(state, mesh_lib.replicated(mesh8))
+
+    step = make_pose_train_step(heatmap_size=(16, 16),
+                                compute_dtype=jnp.float32, mesh=mesh8)
+    batch = next(iter(synthetic_batches(batch_size=8, image_size=64, steps=1)))
+    sharded = mesh_lib.shard_batch_pytree(mesh8, batch)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, *sharded, rng)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
