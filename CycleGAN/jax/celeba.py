@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Split CelebA into male (trainA) / female (trainB) domains by the gender
+attribute — parity with `CycleGAN/tensorflow/celeba.py` (hard-coded paths
+replaced by flags; attribute parsed by column name instead of fixed offsets).
+
+Usage: python celeba.py --attrs list_attr_celeba.txt --images img_align_celeba \
+           --out datasets/celeba
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from shutil import copyfile
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--attrs", default="./list_attr_celeba.txt")
+    p.add_argument("--images", default="./img_align_celeba")
+    p.add_argument("--out", default="./datasets/celeba")
+    args = p.parse_args()
+
+    os.makedirs(os.path.join(args.out, "trainA"), exist_ok=True)  # male
+    os.makedirs(os.path.join(args.out, "trainB"), exist_ok=True)  # female
+
+    with open(args.attrs) as fp:
+        fp.readline()                      # count line
+        header = fp.readline().split()
+        male_col = header.index("Male")
+        n = {"trainA": 0, "trainB": 0}
+        for line in fp:
+            parts = line.split()
+            if not parts:
+                continue
+            filename = parts[0]
+            gender = int(parts[1 + male_col])
+            split = "trainA" if gender == 1 else "trainB"
+            copyfile(os.path.join(args.images, filename),
+                     os.path.join(args.out, split, filename))
+            n[split] += 1
+    print(f"male (trainA): {n['trainA']}, female (trainB): {n['trainB']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CycleGAN two-domain image dirs → per-domain TFRecords.
+
+Parity target: `CycleGAN/tensorflow/tfrecords.py` — one TFRecord per
+{trainA, trainB, testA, testB} split from `datasets/<name>/` image dirs, JPEG
+images only (non-JPEG re-encoded rather than crashed on — the reference
+swallows them with a print, `:30-32`).
+
+Usage: python tfrecords.py --dataset monet2photo
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import os
+
+
+def convert_to_tfexample(img_path: str):
+    import tensorflow as tf
+    from PIL import Image
+    try:
+        with open(img_path, "rb") as f:
+            content = f.read()
+        with Image.open(img_path) as im:
+            im.load()
+            if im.format != "JPEG" or im.mode != "RGB":
+                with io.BytesIO() as out:
+                    im.convert("RGB").save(out, format="JPEG", quality=95)
+                    content = out.getvalue()
+            feature = {
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[content])),
+                "image/format": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"JPEG"])),
+                "image/width": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[im.width])),
+                "image/height": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[im.height])),
+                "image/filename": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(
+                        value=[os.path.basename(img_path).encode()])),
+            }
+            return tf.train.Example(features=tf.train.Features(feature=feature))
+    except Exception as e:  # bad image → skip with a warning (`:30-32`)
+        print(f"WARNING: skipping {img_path}: {e}")
+        return None
+
+
+def main():
+    import tensorflow as tf
+    p = argparse.ArgumentParser(
+        description="Convert TFRecords for a CycleGAN dataset.")
+    p.add_argument("--dataset", required=True,
+                   help="name under ./datasets/ with trainA/trainB[/testA/testB]")
+    p.add_argument("--data-root", default="./datasets")
+    p.add_argument("--out-root", default="./tfrecords")
+    args = p.parse_args()
+
+    out_dir = os.path.join(args.out_root, args.dataset)
+    os.makedirs(out_dir, exist_ok=True)
+    for split in ("trainA", "trainB", "testA", "testB"):
+        files = sorted(glob.glob(
+            os.path.join(args.data_root, args.dataset, split, "*")))
+        if not files:
+            continue
+        out_path = os.path.join(out_dir, f"{split}.tfrecord")
+        n = 0
+        with tf.io.TFRecordWriter(out_path) as writer:
+            for path in files:
+                example = convert_to_tfexample(path)
+                if example is not None:
+                    writer.write(example.SerializeToString())
+                    n += 1
+        print(f"Finished converting {n} images for {split}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""ImageNet (ILSVRC2012) → TFRecords.
+
+Parity target: `Datasets/ILSVRC2012/build_imagenet_tfrecord.py` (the 710-line
+TF-official derivative): 1024 train / 128 validation shards (`:111-118`),
+`train_directory/<synset>/<file>.JPEG` layout, labels as 1-based indices into
+the sorted synset list with 0 reserved for background (`:364-376`), human-
+readable class text from the metadata file, PNG- and CMYK-encoded oddball
+images re-encoded to RGB JPEG (`:238-335` ImageCoder), shard files named
+`train-00000-of-01024` (`:399-418`), and a worker pool per shard range
+(`:420-448` threads → processes here, bypassing the GIL for JPEG re-encode).
+
+The TF-official bounding-box features are omitted: nothing in the reference
+ever consumes them (its classification pipelines read only encoded+label).
+
+Output feature schema matches what deepvision_tpu.data.imagenet.parse_example
+reads: image/encoded + image/class/label (1-based).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+from concurrent.futures import ProcessPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+NUM_TRAIN_SHARDS = 1024  # reference `:111-118`
+NUM_VAL_SHARDS = 128
+
+
+def _load_synsets(labels_file: str) -> list:
+    with open(labels_file) as fp:
+        return [line.strip() for line in fp if line.strip()]
+
+
+def _load_human_map(metadata_file: str) -> dict:
+    """`n01440764\ttench, Tinca tinca` lines → dict (`:364-383`)."""
+    out = {}
+    with open(metadata_file) as fp:
+        for line in fp:
+            parts = line.strip().split("\t")
+            if len(parts) == 2:
+                out[parts[0]] = parts[1]
+    return out
+
+
+def _example(path: str, label: int, synset: str, human: str):
+    import tensorflow as tf
+    from PIL import Image
+
+    from Datasets.common import bytes_feature, int64_feature
+
+    with open(path, "rb") as f:
+        content = f.read()
+    image = Image.open(io.BytesIO(content))
+    # PNG-masquerading-as-JPEG and CMYK fixups (`:268-335`)
+    if image.format != "JPEG" or image.mode != "RGB":
+        with io.BytesIO() as out:
+            image.convert("RGB").save(out, format="JPEG", quality=95)
+            content = out.getvalue()
+        image = Image.open(io.BytesIO(content))
+    width, height = image.size
+
+    feature = {
+        "image/height": int64_feature(height),
+        "image/width": int64_feature(width),
+        "image/colorspace": bytes_feature("RGB"),
+        "image/channels": int64_feature(3),
+        "image/class/label": int64_feature(label),
+        "image/class/synset": bytes_feature(synset),
+        "image/class/text": bytes_feature(human),
+        "image/format": bytes_feature("JPEG"),
+        "image/filename": bytes_feature(os.path.basename(path)),
+        "image/encoded": bytes_feature(content),
+    }
+    return tf.train.Example(features=tf.train.Features(feature=feature))
+
+
+def _write_shard(args):
+    import tensorflow as tf
+    items, out_path = args
+    with tf.io.TFRecordWriter(out_path) as writer:
+        for path, label, synset, human in items:
+            writer.write(_example(path, label, synset, human)
+                         .SerializeToString())
+    print(f"wrote {out_path} ({len(items)} images)", flush=True)
+    return out_path
+
+
+def _build(items: list, split: str, num_shards: int, output_dir: str,
+           num_workers: int):
+    os.makedirs(output_dir, exist_ok=True)
+    shards = []
+    per = (len(items) + num_shards - 1) // num_shards
+    for i in range(num_shards):
+        chunk = items[i * per:(i + 1) * per]
+        name = f"{split}-{str(i).zfill(5)}-of-{str(num_shards).zfill(5)}"
+        shards.append((chunk, os.path.join(output_dir, name)))
+    with ProcessPoolExecutor(max_workers=num_workers) as pool:
+        list(pool.map(_write_shard, shards))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train_directory", default="./train",
+                   help="dir of <synset>/<image>.JPEG subdirs")
+    p.add_argument("--validation_directory", default="./validation",
+                   help="flat dir of validation images (sorted order matches "
+                        "the validation labels file)")
+    p.add_argument("--output_directory", default="./tfrecord")
+    p.add_argument("--labels_file", default="./synsets.txt",
+                   help="one synset per line; label = 1-based line index")
+    p.add_argument("--imagenet_metadata_file",
+                   default="./imagenet_2012_metadata.txt")
+    p.add_argument("--validation_labels_file",
+                   default="./imagenet_2012_validation_synset_labels.txt",
+                   help="one synset per line, aligned to sorted val images")
+    p.add_argument("--train_shards", type=int, default=NUM_TRAIN_SHARDS)
+    p.add_argument("--validation_shards", type=int, default=NUM_VAL_SHARDS)
+    p.add_argument("--num_workers", type=int, default=os.cpu_count())
+    args = p.parse_args()
+
+    synsets = _load_synsets(args.labels_file)
+    label_of = {s: i + 1 for i, s in enumerate(synsets)}  # 0 = background
+    humans = _load_human_map(args.imagenet_metadata_file)
+
+    train_items = []
+    for synset in synsets:
+        syn_dir = os.path.join(args.train_directory, synset)
+        if not os.path.isdir(syn_dir):
+            continue
+        for name in sorted(os.listdir(syn_dir)):
+            train_items.append((os.path.join(syn_dir, name), label_of[synset],
+                                synset, humans.get(synset, synset)))
+    # shuffle deterministically so shards are class-mixed (`:561-576`)
+    import random
+    random.Random(12345).shuffle(train_items)
+    print(f"train: {len(train_items)} images")
+
+    val_items = []
+    if os.path.isdir(args.validation_directory):
+        with open(args.validation_labels_file) as fp:
+            val_synsets = [line.strip() for line in fp if line.strip()]
+        val_files = sorted(os.listdir(args.validation_directory))
+        assert len(val_files) == len(val_synsets), \
+            (len(val_files), len(val_synsets))
+        for name, synset in zip(val_files, val_synsets):
+            val_items.append((os.path.join(args.validation_directory, name),
+                              label_of[synset], synset,
+                              humans.get(synset, synset)))
+    print(f"validation: {len(val_items)} images")
+
+    _build(train_items, "train", args.train_shards, args.output_directory,
+           args.num_workers)
+    if val_items:
+        _build(val_items, "validation", args.validation_shards,
+               args.output_directory, args.num_workers)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

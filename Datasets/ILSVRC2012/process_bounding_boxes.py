@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""ImageNet bounding-box XML → CSV.
+
+Parity target: `Datasets/ILSVRC2012/process_bounding_boxes.py` — walks the
+ILSVRC2012 bbox annotation tree and emits one CSV line per box,
+`filename,xmin,ymin,xmax,ymax` with coordinates normalized by image size and
+clamped to [0, 1] (the reference also guards min<max). Kept for tooling parity;
+the classification pipeline itself doesn't consume boxes.
+
+Usage: python process_bounding_boxes.py <xml_dir> [synsets.txt] > boxes.csv
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from xml.etree import ElementTree as ET
+
+
+def process_xml(path: str):
+    root = ET.parse(path).getroot()
+    filename = root.find("filename").text
+    size = root.find("size")
+    width = float(size.find("width").text)
+    height = float(size.find("height").text)
+    rows = []
+    for obj in root.findall("object"):
+        box = obj.find("bndbox")
+        xmin = min(max(float(box.find("xmin").text) / width, 0.0), 1.0)
+        ymin = min(max(float(box.find("ymin").text) / height, 0.0), 1.0)
+        xmax = min(max(float(box.find("xmax").text) / width, 0.0), 1.0)
+        ymax = min(max(float(box.find("ymax").text) / height, 0.0), 1.0)
+        if xmin >= xmax or ymin >= ymax:
+            continue
+        rows.append(f"{filename},{xmin:.6f},{ymin:.6f},{xmax:.6f},{ymax:.6f}")
+    return rows
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        sys.exit(1)
+    xml_dir = sys.argv[1]
+    allowed = None
+    if len(sys.argv) > 2:
+        with open(sys.argv[2]) as fp:
+            allowed = {line.strip() for line in fp if line.strip()}
+    count = 0
+    for dirpath, _, files in os.walk(xml_dir):
+        synset = os.path.basename(dirpath)
+        if allowed is not None and synset not in allowed:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".xml"):
+                continue
+            for row in process_xml(os.path.join(dirpath, name)):
+                print(row)
+                count += 1
+    print(f"wrote {count} boxes", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

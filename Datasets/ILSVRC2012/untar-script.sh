@@ -1,0 +1,8 @@
+#!/bin/bash
+# Unpack the per-synset inner tars of ILSVRC2012_img_train.tar into one
+# directory per synset (reference: Datasets/ILSVRC2012/untar-script.sh).
+for a in *.tar; do
+    b="${a%.tar}"
+    mkdir -p "./$b"
+    tar xf "$a" -C "./$b" && rm "$a"
+done

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""MPII human pose → TFRecords.
+
+Parity target: `Datasets/MPII/tfrecords_mpii.py` — the train/validation JSON
+annotation files → keypoint TFExamples: joints normalized by image size with
+negative values preserved for missing joints (`:54-60`), visibility collapsed
+to {0, 2} (`:62`), non-JPEG/non-RGB re-encode (`:44-49`), 64 train / 8 val
+shards (`:14-15`), Ray workers → process pool. The reference's loguru logging
+is plain prints here.
+
+Run from a directory containing ./mpii_human_pose_v1_u12_2/{train,validation}.json
+and ./mpii/images/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from Datasets.common import (build_tfrecords, bytes_feature,  # noqa: E402
+                             float_feature, int64_feature)
+
+NUM_TRAIN_SHARDS = 64  # reference `MPII/tfrecords_mpii.py:14-15`
+NUM_VAL_SHARDS = 8
+
+
+def parse_one_annotation(anno: dict, image_dir: str) -> dict:
+    """(`tfrecords_mpii.py:113-123`)."""
+    return {
+        "filename": anno["image"],
+        "filepath": os.path.join(image_dir, anno["image"]),
+        "joints": anno["joints"],
+        "joints_visibility": anno["joints_vis"],
+    }
+
+
+def generate_tfexample(anno: dict):
+    """(`tfrecords_mpii.py:38-84`): joints normalized by image dims, negatives
+    kept as missing-joint markers; visibility 0 stays 0, else 2."""
+    import tensorflow as tf
+    from PIL import Image
+
+    with open(anno["filepath"], "rb") as f:
+        content = f.read()
+    image = Image.open(anno["filepath"])
+    if image.format != "JPEG" or image.mode != "RGB":
+        with io.BytesIO() as out:
+            image.convert("RGB").save(out, format="JPEG", quality=95)
+            content = out.getvalue()
+    width, height = image.size
+
+    xs = [j[0] / width if j[0] >= 0 else float(j[0]) for j in anno["joints"]]
+    ys = [j[1] / height if j[1] >= 0 else float(j[1]) for j in anno["joints"]]
+    vs = [0 if v == 0 else 2 for v in anno["joints_visibility"]]
+
+    feature = {
+        "image/height": int64_feature(height),
+        "image/width": int64_feature(width),
+        "image/depth": int64_feature(3),
+        "image/object/parts/x": float_feature(xs),
+        "image/object/parts/y": float_feature(ys),
+        "image/object/parts/v": int64_feature(vs),
+        "image/encoded": bytes_feature(content),
+        "image/filename": bytes_feature(anno["filename"]),
+    }
+    return tf.train.Example(features=tf.train.Features(feature=feature))
+
+
+def convert(annotations_dir: str, image_dir: str, out_dir: str):
+    total = 0
+    for split, json_name, shards in (
+            ("train", "train.json", NUM_TRAIN_SHARDS),
+            ("val", "validation.json", NUM_VAL_SHARDS)):
+        with open(os.path.join(annotations_dir, json_name)) as fp:
+            annos = [parse_one_annotation(a, image_dir) for a in json.load(fp)]
+        print(f"{split}: {len(annos)} annotations")
+        build_tfrecords(annos, shards, split, out_dir, generate_tfexample)
+        total += len(annos)
+    print(f"Successfully wrote {total} annotations to TF Records.")
+    return total
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--annotations", default="./mpii_human_pose_v1_u12_2")
+    p.add_argument("--images", default="./mpii/images")
+    p.add_argument("--out", default="./tfrecords_mpii")
+    a = p.parse_args()
+    convert(a.annotations, a.images, a.out)

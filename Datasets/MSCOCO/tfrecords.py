@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""MSCOCO → detection TFRecords.
+
+Parity target: `Datasets/MSCOCO/tfrecords.py` — COCO instances JSON →
+per-image grouped TFExamples with normalized boxes, non-JPEG/non-RGB images
+re-encoded to JPEG quality 95 (`:42-48`), contiguous 0-based class ids
+(`:135-143`), 64 train / 8 val shards (`:13-14`), Ray workers → process pool.
+
+Run from a directory containing ./annotations/instances_{train,val}2017.json
+and ./{train,val}2017/ image dirs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from Datasets.common import (build_tfrecords, bytes_feature,  # noqa: E402
+                             bytes_list_feature, float_feature, int64_feature)
+
+NUM_TRAIN_SHARDS = 64  # reference `MSCOCO/tfrecords.py:13-14`
+NUM_VAL_SHARDS = 8
+
+
+def load_categories(coco_json: dict) -> dict:
+    """COCO category_id (1-based, sparse) → (contiguous 0-based id, name)
+    (`MSCOCO/tfrecords.py:135-143` wants ids starting at 0)."""
+    cats = sorted(coco_json["categories"], key=lambda c: c["id"])
+    return {c["id"]: (i, c["name"]) for i, c in enumerate(cats)}
+
+
+def parse_annotations(coco_json: dict, image_dir: str) -> list:
+    """Group instance annotations by image → list of per-image dicts."""
+    categories = load_categories(coco_json)
+    by_image = defaultdict(list)
+    for anno in coco_json["annotations"]:
+        class_id, class_text = categories[int(anno["category_id"])]
+        x, y, w, h = anno["bbox"]  # COCO (x, y, width, height)
+        by_image[anno["image_id"]].append({
+            "class_id": class_id,
+            "class_text": class_text,
+            "xmin": float(x), "ymin": float(y),
+            "xmax": float(x) + float(w), "ymax": float(y) + float(h),
+        })
+    return [{"filename": os.path.join(image_dir, f"{str(iid).rjust(12, '0')}.jpg"),
+             "bboxes": bboxes} for iid, bboxes in by_image.items()]
+
+
+def generate_tfexample(anno: dict):
+    """(`MSCOCO/tfrecords.py:37-101`) — JPEG/RGB re-encode + normalized boxes
+    clipped to [0, 1] (COCO boxes can overhang the image edge by a pixel)."""
+    import tensorflow as tf
+    from PIL import Image
+
+    filename = anno["filename"]
+    with open(filename, "rb") as f:
+        content = f.read()
+    image = Image.open(filename)
+    if image.format != "JPEG" or image.mode != "RGB":
+        with io.BytesIO() as out:
+            image.convert("RGB").save(out, format="JPEG", quality=95)
+            content = out.getvalue()
+    width, height = image.size
+
+    ids, texts, xmins, ymins, xmaxs, ymaxs = [], [], [], [], [], []
+    for bbox in anno["bboxes"]:
+        norm = [min(max(bbox["xmin"] / width, 0.0), 1.0),
+                min(max(bbox["ymin"] / height, 0.0), 1.0),
+                min(max(bbox["xmax"] / width, 0.0), 1.0),
+                min(max(bbox["ymax"] / height, 0.0), 1.0)]
+        ids.append(bbox["class_id"])
+        texts.append(bbox["class_text"])
+        xmins.append(norm[0])
+        ymins.append(norm[1])
+        xmaxs.append(norm[2])
+        ymaxs.append(norm[3])
+
+    feature = {
+        "image/height": int64_feature(height),
+        "image/width": int64_feature(width),
+        "image/depth": int64_feature(3),
+        "image/object/bbox/xmin": float_feature(xmins),
+        "image/object/bbox/ymin": float_feature(ymins),
+        "image/object/bbox/xmax": float_feature(xmaxs),
+        "image/object/bbox/ymax": float_feature(ymaxs),
+        "image/object/class/label": int64_feature(ids),
+        "image/object/class/text": bytes_list_feature(texts),
+        "image/encoded": bytes_feature(content),
+        "image/filename": bytes_feature(os.path.basename(filename)),
+    }
+    return tf.train.Example(features=tf.train.Features(feature=feature))
+
+
+def convert(annotations_dir: str, out_dir: str, year: str = "2017"):
+    total = 0
+    for split, shards in (("train", NUM_TRAIN_SHARDS), ("val", NUM_VAL_SHARDS)):
+        path = os.path.join(annotations_dir, f"instances_{split}{year}.json")
+        with open(path) as fp:
+            coco_json = json.load(fp)
+        annos = parse_annotations(coco_json, f"./{split}{year}")
+        build_tfrecords(annos, shards, split, out_dir, generate_tfexample)
+        total += len(annos)
+    print(f"Successfully wrote {total} images to TF Records.")
+    return total
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--annotations", default="./annotations")
+    p.add_argument("--out", default="./tfrecords")
+    p.add_argument("--year", default="2017")
+    a = p.parse_args()
+    convert(a.annotations, a.out, a.year)

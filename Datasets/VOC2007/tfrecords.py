@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""VOC2007 → TFRecords (reference: `Datasets/VOC2007/tfrecords.py`, 2 shards
+per split, Ray workers → process pool). Run from a directory containing
+./VOCdevkit/VOC2007."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from Datasets.voc import convert
+
+NUM_SHARDS = 2  # reference `VOC2007/tfrecords.py:13-15`
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devkit", default="./VOCdevkit/VOC2007")
+    p.add_argument("--out", default="./tfrecords_voc")
+    p.add_argument("--shards", type=int, default=NUM_SHARDS)
+    a = p.parse_args()
+    convert(a.devkit, a.out, a.shards)

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""VOC2012 → TFRecords (reference: `Datasets/VOC2012/tfrecords.py`, 4 shards
+per split; VOC2012 has no public test annotations → train/val only). Run from a
+directory containing ./VOCdevkit/VOC2012."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from Datasets.voc import convert
+
+NUM_SHARDS = 4  # reference `VOC2012/tfrecords.py:13-15`
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devkit", default="./VOCdevkit/VOC2012")
+    p.add_argument("--out", default="./tfrecords_voc2012")
+    p.add_argument("--shards", type=int, default=NUM_SHARDS)
+    a = p.parse_args()
+    convert(a.devkit, a.out, a.shards, splits=("train", "val"))

@@ -1,0 +1,130 @@
+"""Pascal VOC → detection TFRecords (shared by VOC2007 and VOC2012).
+
+Parity target: `Datasets/VOC2007/tfrecords.py` and the near-identical
+`Datasets/VOC2012/tfrecords.py` (they differ only in paths and shard counts —
+the md5-copy pattern this package replaces with one parameterized module).
+Behavior preserved: XML annotation parse (`VOC2007/tfrecords.py:124-155`),
+train/val/test split from ImageSets/Main (`:163-176`), class ids from the
+names file order (`:178-181`), normalized-bbox range asserts (`:61-64`), and
+`<split>_NNNN_of_MMMM.tfrecords` shard naming. Output feature schema matches
+what the YOLO pipeline reads (`YOLO/tensorflow/preprocess.py:271-285`).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from xml.etree import ElementTree as ET
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from Datasets.common import (build_tfrecords, bytes_feature,  # noqa: E402
+                             bytes_list_feature, float_feature, int64_feature)
+
+VOC_CLASS_NAMES = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+
+
+def parse_one_xml(xml_path: str, image_dir: str, names_map: dict) -> dict:
+    root = ET.parse(xml_path).getroot()
+    filename = root.find(".//filename").text
+    size_el = root.find("size")
+    bboxes = []
+    for obj in root.findall(".//object"):
+        name = obj.find("name").text
+        bb = obj.find("bndbox")
+        bboxes.append({
+            "class_text": name,
+            "class_id": names_map[name],
+            "xmin": int(float(bb.find("xmin").text)),
+            "ymin": int(float(bb.find("ymin").text)),
+            "xmax": int(float(bb.find("xmax").text)),
+            "ymax": int(float(bb.find("ymax").text)),
+        })
+    return {
+        "filepath": os.path.join(image_dir, filename),
+        "filename": filename,
+        "width": int(size_el.find("width").text),
+        "height": int(size_el.find("height").text),
+        "depth": int(size_el.find("depth").text),
+        "bboxes": bboxes,
+    }
+
+
+def generate_tfexample(anno: dict):
+    """One image + normalized boxes → tf.train.Example
+    (`VOC2007/tfrecords.py:38-97`, including the [0,1] asserts)."""
+    import tensorflow as tf
+    with open(anno["filepath"], "rb") as f:
+        content = f.read()
+    width, height, depth = anno["width"], anno["height"], anno["depth"]
+    if depth != 3:
+        print(f"WARNING: image {anno['filename']} has depth {depth}")
+    ids, texts, xmins, ymins, xmaxs, ymaxs = [], [], [], [], [], []
+    for bbox in anno["bboxes"]:
+        norm = [bbox["xmin"] / width, bbox["ymin"] / height,
+                bbox["xmax"] / width, bbox["ymax"] / height]
+        for v in norm:
+            assert 0.0 <= v <= 1.0, (anno["filename"], norm)
+        ids.append(bbox["class_id"])
+        texts.append(bbox["class_text"])
+        xmins.append(norm[0])
+        ymins.append(norm[1])
+        xmaxs.append(norm[2])
+        ymaxs.append(norm[3])
+    feature = {
+        "image/height": int64_feature(height),
+        "image/width": int64_feature(width),
+        "image/depth": int64_feature(depth),
+        "image/object/bbox/xmin": float_feature(xmins),
+        "image/object/bbox/ymin": float_feature(ymins),
+        "image/object/bbox/xmax": float_feature(xmaxs),
+        "image/object/bbox/ymax": float_feature(ymaxs),
+        "image/object/class/label": int64_feature(ids),
+        "image/object/class/text": bytes_list_feature(texts),
+        "image/encoded": bytes_feature(content),
+        "image/filename": bytes_feature(anno["filename"]),
+    }
+    return tf.train.Example(features=tf.train.Features(feature=feature))
+
+
+def convert(devkit_dir: str, out_dir: str, shards_per_split: int,
+            splits=("train", "val", "test"), names=None):
+    """Full conversion for one VOC year rooted at `devkit_dir`
+    (e.g. ./VOCdevkit/VOC2007)."""
+    names = names or VOC_CLASS_NAMES
+    names_map = {n: i for i, n in enumerate(names)}
+    anno_dir = os.path.join(devkit_dir, "Annotations")
+    image_dir = os.path.join(devkit_dir, "JPEGImages")
+
+    split_of = {}
+    for split in splits:
+        path = os.path.join(devkit_dir, "ImageSets", "Main", f"{split}.txt")
+        if not os.path.exists(path):
+            continue
+        with open(path) as fp:
+            for line in fp.read().splitlines():
+                split_of[line.strip()] = split
+
+    annotations = {s: [] for s in splits}
+    for xml_file in sorted(os.listdir(anno_dir)):
+        image_id = xml_file[:-4]
+        split = split_of.get(image_id)
+        if split is None:
+            print(f"WARNING: unwanted image id {image_id}")
+            continue
+        annotations[split].append(
+            parse_one_xml(os.path.join(anno_dir, xml_file), image_dir,
+                          names_map))
+
+    total = 0
+    for split in splits:
+        if annotations[split]:
+            build_tfrecords(annotations[split], shards_per_split, split,
+                            out_dir, generate_tfexample)
+            total += len(annotations[split])
+    print(f"Successfully wrote {total} annotations to TF Records.")
+    return total

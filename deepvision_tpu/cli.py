@@ -188,6 +188,16 @@ def _pose_data(cfg, args):
                           "dataset/tfrecords_mpii")
 
 
+def run_centernet(family: str, models: Sequence[str],
+                  argv: Optional[Sequence[str]] = None) -> dict:
+    """CenterNet entrypoint — same padded-GT detection data as YOLO; the
+    reference never enabled its runner (`ObjectsAsPoints/tensorflow/train.py:248`)."""
+    from .core.centernet import CenterNetTrainer
+    # 128px minimum: stride-4 stem + order-5 hourglass needs 2^5 on the 1/4 grid
+    return _run(family, models, lambda c, w: CenterNetTrainer(c, workdir=w),
+                _detection_data, argv, synthetic_image_size=128)
+
+
 def run_pose(family: str, models: Sequence[str],
              argv: Optional[Sequence[str]] = None) -> dict:
     """Pose (Hourglass) entrypoint — mirrors the reference's click CLI

@@ -1,0 +1,87 @@
+"""CenterNet SPMD steps + trainer — completing the reference's disabled family
+(`ObjectsAsPoints/tensorflow/train.py`: a copy of the YOLO trainer with
+`self.loss_objects = []` at `:35` and `trainer.run` commented out at `:248`).
+
+Same shape as core/detection.py: one jitted step over the mesh, label encoding
+on device from the shared padded ground-truth batches, loss-watched validation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import centernet as cn_ops
+from .config import TrainConfig
+from .trainer import LossWatchedTrainer
+
+
+def make_centernet_train_step(*, num_classes: int, grid: int,
+                              compute_dtype=jnp.bfloat16, donate: bool = True,
+                              mesh=None) -> Callable:
+    """(state, images, boxes, classes, valid, rng) -> (state, metrics)."""
+
+    def step(state, images, boxes, classes, valid, rng):
+        del rng
+        images = images.astype(compute_dtype)
+        targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
+
+        def loss_fn(params):
+            outputs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            comp = cn_ops.centernet_loss(outputs, targets)
+            return jnp.mean(comp["total"]), (comp, mutated)
+
+        (loss, (comp, mutated)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads).replace(
+            batch_stats=mutated.get("batch_stats", state.batch_stats))
+        metrics = {"loss": loss,
+                   **{f"{k}_loss": jnp.mean(v) for k, v in comp.items()
+                      if k != "total"}}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
+def make_centernet_eval_step(*, num_classes: int, grid: int,
+                             compute_dtype=jnp.bfloat16, mesh=None) -> Callable:
+    def step(state, images, boxes, classes, valid):
+        images = images.astype(compute_dtype)
+        targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        comp = cn_ops.centernet_loss(outputs, targets)
+        return {"loss": jnp.mean(comp["total"])}
+
+    jit_kwargs = {}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
+    return jax.jit(step, **jit_kwargs)
+
+
+class CenterNetTrainer(LossWatchedTrainer):
+    """Uses the same padded-GT detection batches as DetectionTrainer; model
+    construction and loss-watched eval come from the base."""
+
+    def __init__(self, config: TrainConfig, model=None, mesh=None,
+                 workdir: Optional[str] = None):
+        super().__init__(config, model=model, mesh=mesh, workdir=workdir)
+        grid = config.data.image_size // 4  # output stride 4
+        compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        self.train_step = make_centernet_train_step(
+            num_classes=config.data.num_classes, grid=grid,
+            compute_dtype=compute_dtype, mesh=self.mesh)
+        self.eval_step = make_centernet_eval_step(
+            num_classes=config.data.num_classes, grid=grid,
+            compute_dtype=compute_dtype, mesh=self.mesh)

@@ -14,17 +14,15 @@ the step on device (see ops/yolo.py); `jnp.mean` over the data-sharded batch IS 
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models import MODELS
 from ..ops import yolo as yolo_ops
-from ..parallel import mesh as mesh_lib
 from .config import TrainConfig
-from .trainer import Trainer
+from .trainer import LossWatchedTrainer
 
 
 def yolo_grid_sizes(image_size: int) -> Sequence[int]:
@@ -94,19 +92,14 @@ def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
     return jax.jit(step, **jit_kwargs)
 
 
-class DetectionTrainer(Trainer):
+class DetectionTrainer(LossWatchedTrainer):
     """YOLO trainer: same epoch/checkpoint/plateau machinery as the shared Trainer,
     with detection steps and loss-watched validation (the reference watches val loss
-    for both LR decay and save-best, `YOLO/tensorflow/train.py:244-247`)."""
+    for both LR decay and save-best, `YOLO/tensorflow/train.py:244-247`). Model
+    construction (num_classes/dtype kwargs) is inherited from the base."""
 
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
-        if model is None:
-            kwargs = dict(config.model_kwargs)
-            kwargs.setdefault("num_classes", config.data.num_classes)
-            if config.dtype:
-                kwargs.setdefault("dtype", jnp.dtype(config.dtype))
-            model = MODELS.get(config.model)(**kwargs)
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
         grids = yolo_grid_sizes(config.data.image_size)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
@@ -116,14 +109,3 @@ class DetectionTrainer(Trainer):
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
             compute_dtype=compute_dtype, mesh=self.mesh)
-
-    def evaluate(self, data: Iterable) -> dict:
-        """Mean of per-batch val losses (`distributed_val_epoch`,
-        `YOLO/tensorflow/train.py:182-193,228-233`)."""
-        total, n = 0.0, 0
-        for batch in data:
-            sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
-            m = jax.device_get(self.eval_step(self.state, *sharded))
-            total += float(m["loss"])
-            n += 1
-        return {"loss": total / n, "count": float(n)} if n else {}

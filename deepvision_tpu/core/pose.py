@@ -16,18 +16,16 @@ don't replicate).
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import MODELS
 from ..ops.heatmap import render_gaussian_heatmaps
-from ..parallel import mesh as mesh_lib
 from .config import TrainConfig
-from .trainer import Trainer
+from .trainer import LossWatchedTrainer
 
 FOREGROUND_WEIGHT = 81.0  # `Hourglass/tensorflow/train.py:69`
 
@@ -99,14 +97,15 @@ def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
     return jax.jit(step, **jit_kwargs)
 
 
-class PoseTrainer(Trainer):
+class PoseTrainer(LossWatchedTrainer):
     """Hourglass trainer: shared epoch/checkpoint/plateau machinery with pose
-    steps, loss-watched validation, and the reference's NaN-batch skip."""
+    steps; loss-watched validation with NaN-batch skip comes from the base."""
 
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         if model is None:
             kwargs = dict(config.model_kwargs)
+            # pose models take num_heatmap, not num_classes
             kwargs.setdefault("num_heatmap", config.data.num_classes)
             if config.dtype:
                 kwargs.setdefault("dtype", jnp.dtype(config.dtype))
@@ -118,15 +117,3 @@ class PoseTrainer(Trainer):
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
-
-    def evaluate(self, data: Iterable) -> dict:
-        """Mean val loss, skipping non-finite batches (`train.py:126-130`)."""
-        total, n = 0.0, 0
-        for batch in data:
-            sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
-            m = jax.device_get(self.eval_step(self.state, *sharded))
-            loss = float(m["loss"])
-            if np.isfinite(loss):
-                total += loss
-                n += 1
-        return {"loss": total / n, "count": float(n)} if n else {}

@@ -51,6 +51,10 @@ class Trainer:
     """Classification trainer: `fit(train_data, val_data)` where each dataset is an
     iterable of (images NHWC float32, labels int32) numpy batches per epoch."""
 
+    # subclass override for the watched metric, e.g. ("loss", "min");
+    # None → derived from the plateau config (top-1 max by default)
+    default_watch = None
+
     def __init__(self, config: TrainConfig, model=None,
                  mesh: Optional[Any] = None, workdir: Optional[str] = None):
         self.config = config
@@ -86,15 +90,29 @@ class Trainer:
         ) if config.schedule.name == "plateau" else None
 
         self.logger = MetricsLogger(self.workdir, name=config.name)
-        self.ckpt = CheckpointManager(
-            self.workdir + "/ckpt", keep=config.keep_checkpoints,
-            keep_best=config.keep_best,
-            best_mode=config.schedule.plateau_mode if self.plateau else "max")
 
         self.rng = jax.random.PRNGKey(config.seed)
         self.state: Optional[TrainState] = None
         self.start_epoch = 1
         self.best_metric: Optional[float] = None
+        # what fit() watches for best-model tracking and plateau decisions;
+        # loss-watching subclasses declare `default_watch = ("loss", "min")`
+        if self.default_watch is not None:
+            self._set_watch(*self.default_watch)
+        elif self.plateau and config.schedule.plateau_mode == "min":
+            self._set_watch("loss", "min")
+        else:
+            self._set_watch("top1", "max")
+
+    def _set_watch(self, key: str, mode: str):
+        """Set the watched metric + direction and (re)build the checkpoint
+        manager's keep-best policy to match."""
+        self.watch_key, self.watch_mode = key, mode
+        if getattr(self, "ckpt", None) is not None:
+            self.ckpt.close()
+        self.ckpt = CheckpointManager(
+            self.workdir + "/ckpt", keep=self.config.keep_checkpoints,
+            keep_best=self.config.keep_best, best_mode=mode)
 
     # -- state ------------------------------------------------------------
     def init_state(self, sample_shape) -> TrainState:
@@ -212,7 +230,7 @@ class Trainer:
         if resume:
             self.resume()
 
-        watch_key = "top1" if (not self.plateau or self.plateau.mode == "max") else "loss"
+        watch_key, watch_mode = self.watch_key, self.watch_mode
         last_val = {}
         for epoch in range(self.start_epoch, total_epochs + 1):
             train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
@@ -227,15 +245,15 @@ class Trainer:
                 # empty eval (e.g. all val batches dropped/skipped) must not
                 # register as a perfect 0.0 loss in min-mode
                 metric = last_val.get(
-                    watch_key, 0.0 if watch_key != "loss" else float("inf"))
+                    watch_key, 0.0 if watch_mode == "max" else float("inf"))
             else:
                 # no val set: watch the same key on train metrics so min-mode
                 # (loss-watching) plateau semantics stay correct
                 metric = train_metrics.get(
-                    watch_key, 0.0 if watch_key != "loss" else float("inf"))
+                    watch_key, 0.0 if watch_mode == "max" else float("inf"))
 
             if self.best_metric is None or (
-                    metric > self.best_metric if watch_key != "loss"
+                    metric > self.best_metric if watch_mode == "max"
                     else metric < self.best_metric):
                 self.best_metric = metric
 
@@ -257,3 +275,25 @@ class Trainer:
     def close(self):
         self.logger.close()
         self.ckpt.close()
+
+
+class LossWatchedTrainer(Trainer):
+    """Base for tasks that validate on loss only (detection / pose / centernet):
+    watches ("loss", "min") for best-model + plateau decisions and averages
+    per-batch val losses, skipping non-finite batches — the NaN-batch guard of
+    `Hourglass/tensorflow/train.py:126-130`, applied uniformly."""
+
+    default_watch = ("loss", "min")
+
+    def evaluate(self, data: Iterable) -> dict:
+        """Mean of per-batch val losses (`distributed_val_epoch`,
+        `YOLO/tensorflow/train.py:182-193,228-233`)."""
+        total, n = 0.0, 0
+        for batch in data:
+            sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
+            m = jax.device_get(self.eval_step(self.state, *sharded))
+            loss = float(m["loss"])
+            if np.isfinite(loss):
+                total += loss
+                n += 1
+        return {"loss": total / n, "count": float(n)} if n else {}
