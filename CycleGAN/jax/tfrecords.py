@@ -23,7 +23,7 @@ def convert_to_tfexample(img_path: str):
     try:
         with open(img_path, "rb") as f:
             content = f.read()
-        with Image.open(img_path) as im:
+        with Image.open(io.BytesIO(content)) as im:
             im.load()
             if im.format != "JPEG" or im.mode != "RGB":
                 with io.BytesIO() as out:

@@ -26,6 +26,8 @@ def main():
                    help="random two-domain data smoke run (the reference's "
                         "commented-out local test, train.py:338-342)")
     p.add_argument("--steps-per-epoch", type=int, default=2)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first epoch here")
     args = p.parse_args()
 
     from deepvision_tpu.configs import get_config
@@ -71,7 +73,7 @@ def main():
         got = trainer.resume()
         print(f"resumed from epoch {got}" if got else "no checkpoint found")
 
-    metrics = trainer.fit(train_fn)
+    metrics = trainer.fit(train_fn, profile_dir=args.profile_dir)
     trainer.close()
     print(f"done: {metrics}")
 

@@ -23,6 +23,8 @@ def main():
                    help="random data smoke run, no dataset needed")
     p.add_argument("--steps-per-epoch", type=int, default=4,
                    help="steps per epoch in --synthetic mode")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first epoch here")
     args = p.parse_args()
 
     from deepvision_tpu.configs import get_config
@@ -49,7 +51,7 @@ def main():
             return gan_data.mnist_gan_batches(args.data_dir, cfg.batch_size,
                                               seed=epoch)
 
-    metrics = trainer.fit(train_fn)
+    metrics = trainer.fit(train_fn, profile_dir=args.profile_dir)
     trainer.close()
     print(f"done: {metrics}")
 

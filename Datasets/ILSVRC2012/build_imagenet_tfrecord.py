@@ -23,7 +23,6 @@ import argparse
 import io
 import os
 import sys
-from concurrent.futures import ProcessPoolExecutor
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
@@ -80,28 +79,23 @@ def _example(path: str, label: int, synset: str, human: str):
     return tf.train.Example(features=tf.train.Features(feature=feature))
 
 
-def _write_shard(args):
-    import tensorflow as tf
-    items, out_path = args
-    with tf.io.TFRecordWriter(out_path) as writer:
-        for path, label, synset, human in items:
-            writer.write(_example(path, label, synset, human)
-                         .SerializeToString())
-    print(f"wrote {out_path} ({len(items)} images)", flush=True)
-    return out_path
+def _tf_official_shard_path(out_dir: str, split: str, i: int, total: int) -> str:
+    """`train-00000-of-01024` naming (`:399-418`)."""
+    return os.path.join(out_dir,
+                        f"{split}-{str(i).zfill(5)}-of-{str(total).zfill(5)}")
+
+
+def _example_from_item(item):
+    # module-level so ProcessPoolExecutor can pickle it
+    return _example(*item)
 
 
 def _build(items: list, split: str, num_shards: int, output_dir: str,
            num_workers: int):
-    os.makedirs(output_dir, exist_ok=True)
-    shards = []
-    per = (len(items) + num_shards - 1) // num_shards
-    for i in range(num_shards):
-        chunk = items[i * per:(i + 1) * per]
-        name = f"{split}-{str(i).zfill(5)}-of-{str(num_shards).zfill(5)}"
-        shards.append((chunk, os.path.join(output_dir, name)))
-    with ProcessPoolExecutor(max_workers=num_workers) as pool:
-        list(pool.map(_write_shard, shards))
+    from Datasets.common import build_tfrecords
+    build_tfrecords(items, num_shards, split, output_dir, _example_from_item,
+                    num_workers=num_workers,
+                    shard_path_fn=_tf_official_shard_path)
 
 
 def main():

@@ -47,7 +47,7 @@ def generate_tfexample(anno: dict):
 
     with open(anno["filepath"], "rb") as f:
         content = f.read()
-    image = Image.open(anno["filepath"])
+    image = Image.open(io.BytesIO(content))  # decode from the bytes just read
     if image.format != "JPEG" or image.mode != "RGB":
         with io.BytesIO() as out:
             image.convert("RGB").save(out, format="JPEG", quality=95)

@@ -61,7 +61,7 @@ def generate_tfexample(anno: dict):
     filename = anno["filename"]
     with open(filename, "rb") as f:
         content = f.read()
-    image = Image.open(filename)
+    image = Image.open(io.BytesIO(content))  # decode from the bytes just read
     if image.format != "JPEG" or image.mode != "RGB":
         with io.BytesIO() as out:
             image.convert("RGB").save(out, format="JPEG", quality=95)
@@ -97,13 +97,15 @@ def generate_tfexample(anno: dict):
     return tf.train.Example(features=tf.train.Features(feature=feature))
 
 
-def convert(annotations_dir: str, out_dir: str, year: str = "2017"):
+def convert(annotations_dir: str, out_dir: str, year: str = "2017",
+            image_root: str = "."):
     total = 0
     for split, shards in (("train", NUM_TRAIN_SHARDS), ("val", NUM_VAL_SHARDS)):
         path = os.path.join(annotations_dir, f"instances_{split}{year}.json")
         with open(path) as fp:
             coco_json = json.load(fp)
-        annos = parse_annotations(coco_json, f"./{split}{year}")
+        annos = parse_annotations(coco_json,
+                                  os.path.join(image_root, f"{split}{year}"))
         build_tfrecords(annos, shards, split, out_dir, generate_tfexample)
         total += len(annos)
     print(f"Successfully wrote {total} images to TF Records.")
@@ -113,7 +115,9 @@ def convert(annotations_dir: str, out_dir: str, year: str = "2017"):
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--annotations", default="./annotations")
+    p.add_argument("--image-root", default=".",
+                   help="directory containing the train2017/ val2017 image dirs")
     p.add_argument("--out", default="./tfrecords")
     p.add_argument("--year", default="2017")
     a = p.parse_args()
-    convert(a.annotations, a.out, a.year)
+    convert(a.annotations, a.out, a.year, a.image_root)

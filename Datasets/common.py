@@ -73,12 +73,16 @@ def write_shard(chunk: list, path: str, example_fn: Callable) -> str:
 
 def build_tfrecords(annotations: Sequence, total_shards: int, split: str,
                     out_dir: str, example_fn: Callable,
-                    num_workers: int = 0) -> List[str]:
+                    num_workers: int = 0,
+                    shard_path_fn: Callable = None) -> List[str]:
     """Parallel shard writer — the `build_tf_records` + Ray pattern
-    (`VOC2007/tfrecords.py:109-121`) on a process pool."""
+    (`VOC2007/tfrecords.py:109-121`) on a process pool. `shard_path_fn`
+    overrides the file-naming convention (the ILSVRC builder uses the
+    TF-official `train-00000-of-01024` style)."""
     os.makedirs(out_dir, exist_ok=True)
     chunks = chunkify(annotations, total_shards)
-    paths = [shard_path(out_dir, split, i, total_shards)
+    shard_path_fn = shard_path_fn or shard_path
+    paths = [shard_path_fn(out_dir, split, i, total_shards)
              for i in range(total_shards)]
     num_workers = num_workers or min(total_shards, os.cpu_count() or 1)
     if num_workers <= 1 or total_shards == 1:

@@ -1,0 +1,19 @@
+# TPU training image — the TPU-VM counterpart of the reference's CUDA image
+# (`Hourglass/tensorflow/Dockerfile:1-21`: nvidia/cuda base + reqs + ENTRYPOINT).
+# Run on a Cloud TPU VM (the TPU runtime is provided by the host libtpu).
+FROM python:3.12-slim
+
+WORKDIR /app
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax orbax-checkpoint chex einops numpy pillow \
+    tensorflow-cpu  # host-side tf.data input pipelines only
+
+COPY . /app
+
+ENV PYTHONPATH=/app
+
+# Override with e.g.:
+#   docker run <img> python ResNet/jax/train.py -m resnet50 --data-dir gs://...
+ENTRYPOINT ["python", "Hourglass/jax/main.py"]

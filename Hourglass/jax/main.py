@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Cloud-style Hourglass entrypoint: train, then export the best checkpoint.
+
+Parity target: `Hourglass/tensorflow/main.py:21-66` — the click CLI that trains
+and uploads the best model to a GCS bucket, writing the artifact path to
+/tmp/output.txt. This container has no GCS credentials baked in, so the export
+target is a directory: pass `--export-dir gs://bucket/dir` on a GCP VM (copied
+via gsutil if available) or any local/NFS path otherwise.
+"""
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--num_heatmap", type=int, default=16)
+    p.add_argument("--checkpoint", default=None, help="'latest' or epoch number")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--workdir", default="runs/hourglass104")
+    p.add_argument("--export-dir", default=None,
+                   help="copy the best checkpoint here after training "
+                        "(gs:// paths use gsutil)")
+    p.add_argument("--version", default="0.0.1")
+    args = p.parse_args()
+
+    from deepvision_tpu.cli import run_pose
+
+    argv = ["-m", "hourglass104", "--epochs", str(args.epochs),
+            "--workdir", args.workdir]
+    if args.batch_size:
+        argv += ["--batch-size", str(args.batch_size)]
+    if args.checkpoint:
+        argv += ["-c", args.checkpoint]
+    if args.data_dir:
+        argv += ["--data-dir", args.data_dir]
+    if args.synthetic:
+        argv += ["--synthetic"]
+    run_pose("Hourglass", ["hourglass104"], argv)
+
+    if not args.export_dir:
+        return
+    # export the best epoch's checkpoint tree (`main.py:53-66` GCS upload role)
+    from deepvision_tpu.core.checkpoint import CheckpointManager
+    ckpt = CheckpointManager(os.path.join(args.workdir, "ckpt"))
+    best = ckpt.best_epoch() or ckpt.latest_epoch()
+    ckpt.close()
+    if best is None:
+        print("no checkpoint to export")
+        return
+    src = os.path.join(args.workdir, "ckpt", str(best))
+    name = f"hourglass-v{args.version}-epoch-{best}"
+    if args.export_dir.startswith("gs://"):
+        dst = f"{args.export_dir.rstrip('/')}/{name}"
+        subprocess.run(["gsutil", "-m", "cp", "-r", src, dst], check=True)
+    else:
+        dst = os.path.join(args.export_dir, name)
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+    print(f"Exported best model (epoch {best}) to {dst}")
+    with open("/tmp/output.txt", "w") as fp:  # `main.py:64-66` parity
+        fp.write(dst + "\n")
+
+
+if __name__ == "__main__":
+    main()

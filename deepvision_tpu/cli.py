@@ -38,6 +38,8 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--workdir", default=None)
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="override steps per epoch (synthetic/smoke)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first epoch here")
     return p
 
 
@@ -102,7 +104,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     trainer.init_state(sample_shape)
     if args.checkpoint:
         trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
-    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape)
+    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
+                         profile_dir=args.profile_dir)
     trainer.close()
     print(f"done: best={result.get('best_metric')}")
     return result

@@ -91,12 +91,17 @@ class AdversarialTrainer:
         raise NotImplementedError
 
     def fit(self, train_data_fn: Callable[[int], Iterable],
-            total_epochs: Optional[int] = None, save_every: int = 2) -> dict:
+            total_epochs: Optional[int] = None, save_every: int = 2,
+            profile_dir: Optional[str] = None) -> dict:
         """Epoch loop + save every 2 epochs (`DCGAN/tensorflow/main.py:81-83`,
-        `CycleGAN/tensorflow/train.py:330-333`)."""
+        `CycleGAN/tensorflow/train.py:330-333`). `profile_dir` captures a
+        jax.profiler trace of the first trained epoch."""
         total_epochs = total_epochs or self.config.total_epochs
         metrics = {}
         for epoch in range(self.start_epoch, total_epochs + 1):
+            profiling = profile_dir and epoch == self.start_epoch
+            if profiling:
+                jax.profiler.start_trace(profile_dir)
             t0 = time.time()
             step_metrics = []  # device arrays; fetched once at epoch end so a
             for batch in train_data_fn(epoch):  # pool-free step stays async
@@ -110,6 +115,8 @@ class AdversarialTrainer:
                 metrics = dict(stacked)
             else:
                 metrics = {}
+            if profiling:  # the metric fetch above synced the device
+                jax.profiler.stop_trace()
             metrics["epoch_seconds"] = time.time() - t0
             self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
                             echo=jax.process_index() == 0)

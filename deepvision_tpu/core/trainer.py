@@ -214,11 +214,15 @@ class Trainer:
     def fit(self, train_data_fn: Callable[[int], Iterable],
             val_data_fn: Optional[Callable[[int], Iterable]] = None,
             sample_shape=None, resume: bool = False,
-            total_epochs: Optional[int] = None) -> dict:
+            total_epochs: Optional[int] = None,
+            profile_dir: Optional[str] = None) -> dict:
         """`train_data_fn(epoch)` returns that epoch's batch iterable (re-shuffled).
 
         Mirrors run_epochs (`ResNet/pytorch/train.py:310-428`): optional sanity
         validate at epoch 0, then train/validate/schedule/checkpoint per epoch.
+        `profile_dir` captures a jax.profiler trace of the first trained epoch
+        (viewable in TensorBoard/XProf) — the first-class profiling hook the
+        reference lacked (SURVEY.md §5.1).
         """
         cfg = self.config
         total_epochs = total_epochs or cfg.total_epochs
@@ -233,7 +237,12 @@ class Trainer:
         watch_key, watch_mode = self.watch_key, self.watch_mode
         last_val = {}
         for epoch in range(self.start_epoch, total_epochs + 1):
+            profiling = profile_dir and epoch == self.start_epoch
+            if profiling:
+                jax.profiler.start_trace(profile_dir)
             train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
+            if profiling:  # train_epoch blocks on params → trace is complete
+                jax.profiler.stop_trace()
             if _is_main_process():
                 self.logger.log(int(self.state.step), train_metrics, epoch=epoch,
                                 prefix="epoch_train_")
