@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Convert a trained CycleGAN generator to TFLite — the role of the reference's
+`CycleGAN/tensorflow/convert.py:8-14`, via jax2tf since our models are Flax.
+
+Usage: python convert.py --workdir runs/cyclegan --direction a2b \
+           --output photo2monet.tflite
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="runs/cyclegan")
+    p.add_argument("--direction", default="a2b", choices=["a2b", "b2a"])
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--output", default=None,
+                   help="output .tflite path (default <direction>.tflite)")
+    p.add_argument("--saved-model-dir", default=None,
+                   help="also keep the intermediate SavedModel here")
+    p.add_argument("--no-optimize", action="store_true")
+    args = p.parse_args(argv)
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.export import export_tflite
+    from deepvision_tpu.core.gan import CycleGANTrainer
+
+    trainer = CycleGANTrainer(get_config("cyclegan"), workdir=args.workdir,
+                              image_size=args.image_size)
+    if trainer.resume() is None:
+        print("WARNING: no checkpoint found — exporting random weights")
+
+    variables = {"params": trainer.gen_state.params[args.direction],
+                 "batch_stats": trainer.gen_state.batch_stats[args.direction]}
+    apply_fn = lambda v, x: trainer.generator.apply(v, x, train=False)  # noqa: E731
+    out = args.output or f"{args.direction}.tflite"
+    export_tflite(apply_fn, variables,
+                  (args.image_size, args.image_size, 3), out,
+                  optimize=not args.no_optimize,
+                  saved_model_dir=args.saved_model_dir)
+    trainer.close()
+    print(f"wrote {out} ({os.path.getsize(out)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,9 +36,11 @@ def parse_one_xml(xml_path: str, image_dir: str, names_map: dict) -> dict:
     for obj in root.findall(".//object"):
         name = obj.find("name").text
         bb = obj.find("bndbox")
+        diff_el = obj.find("difficult")
         bboxes.append({
             "class_text": name,
             "class_id": names_map[name],
+            "difficult": int(diff_el.text) if diff_el is not None else 0,
             "xmin": int(float(bb.find("xmin").text)),
             "ymin": int(float(bb.find("ymin").text)),
             "xmax": int(float(bb.find("xmax").text)),
@@ -63,7 +65,7 @@ def generate_tfexample(anno: dict):
     width, height, depth = anno["width"], anno["height"], anno["depth"]
     if depth != 3:
         print(f"WARNING: image {anno['filename']} has depth {depth}")
-    ids, texts, xmins, ymins, xmaxs, ymaxs = [], [], [], [], [], []
+    ids, texts, xmins, ymins, xmaxs, ymaxs, diffs = [], [], [], [], [], [], []
     for bbox in anno["bboxes"]:
         norm = [bbox["xmin"] / width, bbox["ymin"] / height,
                 bbox["xmax"] / width, bbox["ymax"] / height]
@@ -75,6 +77,7 @@ def generate_tfexample(anno: dict):
         ymins.append(norm[1])
         xmaxs.append(norm[2])
         ymaxs.append(norm[3])
+        diffs.append(bbox.get("difficult", 0))
     feature = {
         "image/height": int64_feature(height),
         "image/width": int64_feature(width),
@@ -84,6 +87,7 @@ def generate_tfexample(anno: dict):
         "image/object/bbox/xmax": float_feature(xmaxs),
         "image/object/bbox/ymax": float_feature(ymaxs),
         "image/object/class/label": int64_feature(ids),
+        "image/object/difficult": int64_feature(diffs),
         "image/object/class/text": bytes_list_feature(texts),
         "image/encoded": bytes_feature(content),
         "image/filename": bytes_feature(anno["filename"]),

@@ -35,6 +35,8 @@ def main():
     from deepvision_tpu.cli import run_pose
 
     argv = ["-m", "hourglass104", "--epochs", str(args.epochs),
+            "--learning-rate", str(args.learning_rate),
+            "--num-classes", str(args.num_heatmap),
             "--workdir", args.workdir]
     if args.batch_size:
         argv += ["--batch-size", str(args.batch_size)]

@@ -24,15 +24,12 @@ def main():
     p.add_argument("images", nargs="+")
     args = p.parse_args()
 
-    import jax
     import jax.numpy as jnp
     import numpy as np
     from PIL import Image
 
     from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.detection import DetectionTrainer
-    from deepvision_tpu.ops.boxes import xywh_to_x1y1x2y2
-    from deepvision_tpu.ops.nms import batched_nms
+    from deepvision_tpu.core.detection import DetectionTrainer, make_predict_step
 
     cfg = get_config(args.model)
     trainer = DetectionTrainer(
@@ -48,19 +45,11 @@ def main():
         batch.append(np.asarray(img, np.float32) / 127.5 - 1.0)
     images = jnp.asarray(np.stack(batch))
 
-    state = trainer.state
     # decoded per-scale outputs → flatten → NMS (`postprocess.py:12-36`)
-    outputs = state.apply_fn(
-        {"params": state.params, "batch_stats": state.batch_stats},
-        images, train=False, decode=True)
-    b = images.shape[0]
-    boxes = jnp.concatenate([o[0].reshape(b, -1, 4) for o in outputs], axis=1)
-    scores = jnp.concatenate([o[1].reshape(b, -1) for o in outputs], axis=1)
-    classes = jnp.concatenate(
-        [o[2].reshape(b, -1, o[2].shape[-1]) for o in outputs], axis=1)
-    nms_boxes, nms_scores, nms_classes, counts = batched_nms(
-        xywh_to_x1y1x2y2(boxes), scores, classes,
-        iou_thresh=args.iou_thresh, score_thresh=args.score_thresh)
+    predict = make_predict_step(iou_thresh=args.iou_thresh,
+                                score_thresh=args.score_thresh)
+    nms_boxes, nms_scores, nms_classes, counts = predict(trainer.state, images)
+    trainer.close()
 
     for i, path in enumerate(args.images):
         n = int(counts[i])

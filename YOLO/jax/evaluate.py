@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""YOLO V3 accuracy evaluation: COCO mAP@[.5:.95] / VOC mAP@0.5 on the val split.
+
+The reference never shipped this — its README lists mAP as "work in progress"
+(`YOLO/tensorflow/README.md:29`). Usage:
+
+    python evaluate.py -m yolov3_voc --data-dir dataset/tfrecords --metric voc
+    python evaluate.py -m yolov3 --synthetic            # smoke, random weights
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", default="yolov3",
+                   choices=["yolov3", "yolov3_voc"])
+    p.add_argument("-c", "--checkpoint", default="latest",
+                   help="epoch number or 'latest'")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--metric", default="coco", choices=["coco", "voc", "voc07"])
+    p.add_argument("--score-thresh", type=float, default=0.05)
+    p.add_argument("--iou-thresh", type=float, default=0.5,
+                   help="NMS IoU threshold (not the matching threshold)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="evaluate on synthetic batches (smoke test)")
+    p.add_argument("--max-batches", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import itertools
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer, evaluate_map
+
+    cfg = get_config(args.model)
+    trainer = DetectionTrainer(
+        cfg, workdir=args.workdir or os.path.join("runs", cfg.name))
+    size = 64 if args.synthetic else cfg.data.image_size
+    trainer.init_state((size, size, 3))
+    if not args.synthetic and trainer.resume(
+            None if args.checkpoint == "latest" else int(args.checkpoint)) is None:
+        print("WARNING: no checkpoint found — evaluating random weights")
+
+    if args.synthetic:
+        from deepvision_tpu.data.detection import synthetic_batches
+        batches = synthetic_batches(batch_size=4, image_size=size,
+                                    num_classes=cfg.data.num_classes, steps=2)
+    else:
+        from deepvision_tpu.data.detection import build_dataset
+        data_dir = args.data_dir or cfg.data.data_dir or "dataset/tfrecords"
+        # keep the val tail (drop_remainder=False) and carry difficult flags —
+        # both required for protocol-faithful numbers
+        ds = build_dataset(os.path.join(data_dir, "val*"),
+                           batch_size=cfg.batch_size, image_size=size,
+                           training=False, with_difficult=True,
+                           drop_remainder=False)
+        batches = (tuple(t.numpy() for t in b) for b in ds)
+    if args.max_batches:
+        batches = itertools.islice(batches, args.max_batches)
+
+    metrics = evaluate_map(trainer.state, batches,
+                           num_classes=cfg.data.num_classes, metric=args.metric,
+                           iou_thresh=args.iou_thresh,
+                           score_thresh=args.score_thresh)
+    trainer.close()
+    for k in sorted(metrics):
+        if k.startswith("mAP"):
+            print(f"{k}: {metrics[k]:.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,11 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="train on synthetic data (smoke test, no dataset needed)")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--learning-rate", type=float, default=None,
+                   help="override the config's base learning rate")
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="override output classes/keypoints (e.g. MPII=16 "
+                        "heatmaps, custom VOC subsets)")
     p.add_argument("--workdir", default=None)
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="override steps per epoch (synthetic/smoke)")
@@ -85,6 +90,12 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(total_epochs=args.epochs)
     if args.batch_size:
         cfg = cfg.replace(batch_size=args.batch_size)
+    if args.learning_rate:
+        cfg = cfg.replace(optimizer=dataclasses.replace(
+            cfg.optimizer, learning_rate=args.learning_rate))
+    if args.num_classes:
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, num_classes=args.num_classes))
     if args.synthetic:
         n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
         synth = dict(dataset="synthetic",

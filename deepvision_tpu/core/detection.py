@@ -92,6 +92,71 @@ def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
     return jax.jit(step, **jit_kwargs)
 
 
+def make_predict_step(*, compute_dtype=jnp.bfloat16, iou_thresh: float = 0.5,
+                      score_thresh: float = 0.5, max_detection: int = 100) -> Callable:
+    """(state, images) -> (nms_boxes, nms_scores, nms_class_probs, counts).
+
+    Full device-side inference: decoded multi-scale heads → flatten → fixed-shape
+    NMS (ops/nms.py) — the role of the reference's `Postprocessor`
+    (`YOLO/tensorflow/postprocess.py:6-36`), but jitted and batched.
+    """
+    from ..ops.boxes import xywh_to_x1y1x2y2
+    from ..ops.nms import batched_nms
+
+    def step(state, images):
+        images = images.astype(compute_dtype)
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False, decode=True)
+        b = images.shape[0]
+        boxes = jnp.concatenate([o[0].reshape(b, -1, 4) for o in outputs], axis=1)
+        obj = jnp.concatenate([o[1].reshape(b, -1) for o in outputs], axis=1)
+        cls_probs = jnp.concatenate(
+            [o[2].reshape(b, -1, o[2].shape[-1]) for o in outputs], axis=1)
+        # detection confidence = objectness × class probability (the standard
+        # score both COCO and VOC evaluators rank by); rank/suppress on the best
+        # class's confidence, report per-class confidences for the evaluator.
+        conf = obj[..., None].astype(jnp.float32) * cls_probs.astype(jnp.float32)
+        return batched_nms(xywh_to_x1y1x2y2(boxes.astype(jnp.float32)),
+                           jnp.max(conf, axis=-1), conf,
+                           iou_thresh=iou_thresh, score_thresh=score_thresh,
+                           max_detection=max_detection)
+
+    return jax.jit(step)
+
+
+def evaluate_map(state, batches, *, num_classes: int, metric: str = "coco",
+                 iou_thresh: float = 0.5, score_thresh: float = 0.05,
+                 compute_dtype=jnp.bfloat16) -> dict:
+    """Run detection inference over `batches` of (images, boxes, classes, valid)
+    and return mAP summary metrics.
+
+    metric="coco" → mAP@[.5:.95]; "voc" → all-point mAP@0.5; "voc07" → 11-point.
+    The low default score threshold keeps the PR curve's low-confidence tail, as
+    standard evaluators do. This is the evaluator the reference never shipped
+    (`YOLO/tensorflow/README.md:29`).
+    """
+    from .eval_detection import DetectionEvaluator, coco_evaluator, voc_evaluator
+
+    if metric == "coco":
+        ev = coco_evaluator(num_classes)
+    elif metric in ("voc", "voc07"):
+        ev = voc_evaluator(num_classes, use_07_metric=(metric == "voc07"))
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+
+    predict = make_predict_step(compute_dtype=compute_dtype,
+                                iou_thresh=iou_thresh, score_thresh=score_thresh)
+    for batch in batches:
+        images, boxes, classes, valid = batch[:4]
+        difficult = batch[4] if len(batch) > 4 else None  # VOC devkit flags
+        nms_boxes, nms_scores, nms_classes, counts = predict(
+            state, jnp.asarray(images))
+        ev.add_batch(nms_boxes, nms_scores, nms_classes, counts,
+                     boxes, classes, valid, gt_difficult=difficult)
+    return ev.summarize()
+
+
 class DetectionTrainer(LossWatchedTrainer):
     """YOLO trainer: same epoch/checkpoint/plateau machinery as the shared Trainer,
     with detection steps and loss-watched validation (the reference watches val loss

@@ -18,7 +18,7 @@ classes (B,100) int32, valid (B,100) f32).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 import numpy as np
 
@@ -27,7 +27,9 @@ from .imagenet import _tf
 
 
 def parse_example(serialized, tf):
-    """Reference schema (`YOLO/tensorflow/preprocess.py:271-285`)."""
+    """Reference schema (`YOLO/tensorflow/preprocess.py:271-285`) plus the
+    `image/object/difficult` flags our VOC converter adds (absent in older
+    records → zeros) for devkit-faithful evaluation."""
     features = {
         "image/encoded": tf.io.FixedLenFeature([], tf.string),
         "image/object/class/label": tf.io.VarLenFeature(tf.int64),
@@ -35,6 +37,7 @@ def parse_example(serialized, tf):
         "image/object/bbox/ymin": tf.io.VarLenFeature(tf.float32),
         "image/object/bbox/xmax": tf.io.VarLenFeature(tf.float32),
         "image/object/bbox/ymax": tf.io.VarLenFeature(tf.float32),
+        "image/object/difficult": tf.io.VarLenFeature(tf.int64),
     }
     parsed = tf.io.parse_single_example(serialized, features)
     classes = tf.cast(tf.sparse.to_dense(parsed["image/object/class/label"]),
@@ -45,7 +48,12 @@ def parse_example(serialized, tf):
         tf.sparse.to_dense(parsed["image/object/bbox/xmax"]),
         tf.sparse.to_dense(parsed["image/object/bbox/ymax"]),
     ], axis=1)  # (n, 4) normalized corners
-    return parsed["image/encoded"], boxes, classes
+    difficult = tf.cast(tf.sparse.to_dense(parsed["image/object/difficult"]),
+                        tf.float32)
+    # records written without the field parse to an empty list → all-easy
+    difficult = tf.cond(tf.shape(difficult)[0] > 0, lambda: difficult,
+                        lambda: tf.zeros_like(tf.cast(classes, tf.float32)))
+    return parsed["image/encoded"], boxes, classes, difficult
 
 
 def random_flip(image, boxes, tf):
@@ -94,8 +102,9 @@ def random_crop_keep_boxes(image, boxes, tf):
     return tf.cond(do_crop, crop, lambda: (image, boxes))
 
 
-def preprocess(serialized, image_size: int, training: bool, tf):
-    encoded, boxes, classes = parse_example(serialized, tf)
+def preprocess(serialized, image_size: int, training: bool, tf,
+               with_difficult: bool = False):
+    encoded, boxes, classes, difficult = parse_example(serialized, tf)
     image = tf.cast(tf.io.decode_jpeg(encoded, channels=3), tf.float32)
     if training:
         image, boxes = random_flip(image, boxes, tf)
@@ -111,14 +120,24 @@ def preprocess(serialized, image_size: int, training: bool, tf):
     boxes.set_shape([MAX_BOXES, 4])
     classes.set_shape([MAX_BOXES])
     valid.set_shape([MAX_BOXES])
+    if with_difficult:
+        difficult = tf.pad(difficult[:n], [[0, MAX_BOXES - n]])
+        difficult.set_shape([MAX_BOXES])
+        return image, boxes, classes, valid, difficult
     return image, boxes, classes, valid
 
 
 def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 416,
                   training: bool = True, shuffle_buffer: int = 512,
-                  num_process: int = 1, process_index: int = 0, seed: int = 0):
+                  num_process: int = 1, process_index: int = 0, seed: int = 0,
+                  with_difficult: bool = False, drop_remainder: bool = True):
     """Per-host tf.data detection pipeline (cf. `create_dataset`,
-    `YOLO/tensorflow/train.py:260-273`, plus per-host sharding for pods)."""
+    `YOLO/tensorflow/train.py:260-273`, plus per-host sharding for pods).
+
+    `drop_remainder` defaults to True (static shapes for the jitted train/val
+    steps); mAP evaluation passes False so the val tail isn't silently dropped
+    (costs one extra compile for the final ragged batch).
+    """
     tf = _tf()
     AUTOTUNE = tf.data.AUTOTUNE
     files = tf.data.Dataset.list_files(file_pattern, shuffle=training, seed=seed)
@@ -127,9 +146,10 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 416,
     ds = tf.data.TFRecordDataset(files, num_parallel_reads=AUTOTUNE)
     if training:
         ds = ds.shuffle(shuffle_buffer, seed=seed)
-    ds = ds.map(lambda s: preprocess(s, image_size, training, tf),
+    ds = ds.map(lambda s: preprocess(s, image_size, training, tf,
+                                     with_difficult=with_difficult),
                 num_parallel_calls=AUTOTUNE)
-    ds = ds.batch(batch_size, drop_remainder=True)
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
     return ds.prefetch(AUTOTUNE)
 
 

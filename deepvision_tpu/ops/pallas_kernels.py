@@ -1,0 +1,108 @@
+"""Pallas TPU kernels for the detection hot spots.
+
+The YOLO ignore mask is the reference's memory hot spot: a broadcast IoU between
+every prediction and every (padded) ground-truth box, then a max over GT
+(`YOLO/tensorflow/yolov3.py:436-470` — a (507·B, 100) intermediate per scale at
+13×13 and a (8112·B, 100) one at 52×52). XLA materializes the (B, N, M) IoU
+tensor in HBM before reducing it; the kernel below fuses compute + reduction so
+only (BLOCK_N, M) tiles ever exist, in VMEM.
+
+Layout choices (see /opt/skills/guides/pallas_guide.md):
+- predictions tile the sublane axis in BLOCK_N rows; each coordinate column
+  broadcast as (BLOCK_N, 1);
+- ground truth is passed TRANSPOSED as (B, 4, M) so each coordinate row is a
+  natural (1, M) lane vector, M padded to a multiple of 128 lanes;
+- the (BLOCK_N, M) IoU tile lives only in registers/VMEM; the max over lanes
+  writes a (BLOCK_N, 1) sublane vector straight to the output block.
+
+CPU fallback: `interpret=True` runs the same kernel under the Pallas interpreter
+(used by tests); callers can also use the pure-jnp path in `ops/boxes.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+
+
+def _best_iou_kernel(pred_ref, gt_ref, out_ref):
+    """One (BLOCK_N, M) tile: IoU of BLOCK_N pred boxes vs all M GT, max over M.
+
+    pred_ref: (1, BLOCK_N, 4) corner boxes; gt_ref: (1, 4, M) transposed corner
+    boxes (padded GT rows are all-zero → zero area → IoU 0); out_ref:
+    (1, BLOCK_N, 1).
+    """
+    pred = pred_ref[0]  # (BLOCK_N, 4)
+    gt = gt_ref[0]      # (4, M)
+
+    px1, py1 = pred[:, 0:1], pred[:, 1:2]          # (BLOCK_N, 1)
+    px2, py2 = pred[:, 2:3], pred[:, 3:4]
+    gx1, gy1 = gt[0:1, :], gt[1:2, :]              # (1, M)
+    gx2, gy2 = gt[2:3, :], gt[3:4, :]
+
+    left = jnp.maximum(px1, gx1)                   # (BLOCK_N, M)
+    top = jnp.maximum(py1, gy1)
+    right = jnp.minimum(px2, gx2)
+    bot = jnp.minimum(py2, gy2)
+    # overlap clipped to [0, 1] — normalized coords (`utils.py:31-77`)
+    iw = jnp.clip(right - left, 0.0, 1.0)
+    ih = jnp.clip(bot - top, 0.0, 1.0)
+    inter = iw * ih
+    area_p = (px2 - px1) * (py2 - py1)
+    area_g = (gx2 - gx1) * (gy2 - gy1)
+    iou = inter / (area_p + area_g - inter + 1e-7)
+    out_ref[0] = jnp.max(iou, axis=1, keepdims=True)  # (BLOCK_N, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def best_iou(pred_boxes: jnp.ndarray, gt_boxes: jnp.ndarray, *,
+             block_n: int = 512, interpret: bool = False) -> jnp.ndarray:
+    """max_m IoU(pred_n, gt_m): (B, N, 4) x (B, M, 4) corner boxes → (B, N).
+
+    Fused replacement for `jnp.max(broadcast_iou(pred, gt), -1)` — numerically
+    identical (same clipping and epsilon), without the (B, N, M) HBM
+    intermediate. Invalid/padded GT rows must be zeroed by the caller (zero area
+    → IoU 0, exactly like the jnp path).
+    """
+    b, n, _ = pred_boxes.shape
+    m = gt_boxes.shape[1]
+    block_n = min(block_n, n)
+
+    # pad N to the block size and M to full lanes; padded GT columns are zeros
+    n_pad = -n % block_n
+    m_pad = -m % LANE
+    pred = jnp.pad(pred_boxes.astype(jnp.float32), ((0, 0), (0, n_pad), (0, 0)))
+    gt_t = jnp.pad(gt_boxes.astype(jnp.float32).transpose(0, 2, 1),
+                   ((0, 0), (0, 0), (0, m_pad)))
+
+    grid = (b, (n + n_pad) // block_n)
+    out = pl.pallas_call(
+        _best_iou_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n + n_pad, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_n, 4), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 4, m + m_pad), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_n, 1), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(pred, gt_t)
+    return out[:, :n, 0]
+
+
+def best_iou_auto(pred_boxes: jnp.ndarray, gt_boxes: jnp.ndarray) -> jnp.ndarray:
+    """Dispatch: Pallas kernel on TPU, pure-jnp elsewhere (CPU tests/bench).
+
+    The jnp fallback keeps the op differentiable-by-XLA and portable; the TPU
+    path is wrapped in stop_gradient by its caller (the ignore mask is consumed
+    through a comparison, so its gradient is identically zero either way).
+    """
+    if jax.default_backend() == "tpu":
+        return best_iou(pred_boxes, gt_boxes)
+    from .boxes import broadcast_iou
+    return jnp.max(broadcast_iou(pred_boxes, gt_boxes), axis=-1)

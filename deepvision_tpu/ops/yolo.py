@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from .boxes import broadcast_iou, xywh_to_x1y1x2y2
+from .boxes import xywh_to_x1y1x2y2
 
 # The 9 COCO anchors, normalized by the 416 training resolution
 # (`yolov3.py:18-20`). Groups of 3 per scale: [0:3]→stride 8, [3:6]→16, [6:9]→32.
@@ -201,8 +201,12 @@ def yolo_loss_one_scale(y_true: jnp.ndarray, y_pred: jnp.ndarray,
     b, g = y_pred.shape[0], y_pred.shape[1]
     flat_pred = pred_box_corners.reshape(b, -1, 4)
     masked_gt = gt_boxes * gt_valid[..., None].astype(gt_boxes.dtype)
-    iou = broadcast_iou(flat_pred, masked_gt)            # (B, g*g*3, N)
-    best_iou = jnp.max(iou, axis=-1).reshape(b, g, g, 3)
+    # fused pallas kernel on TPU (no (B, N, M) HBM intermediate), jnp elsewhere;
+    # the mask is consumed through a `<` so its gradient is identically zero —
+    # stop_gradient makes that explicit and keeps the kernel out of the VJP.
+    from .pallas_kernels import best_iou_auto
+    best_iou = jax.lax.stop_gradient(
+        best_iou_auto(flat_pred, masked_gt)).reshape(b, g, g, 3)
     ignore_mask = (best_iou < IGNORE_THRESH).astype(jnp.float32)[..., None]
 
     # objectness loss (`yolov3.py:472-492`)

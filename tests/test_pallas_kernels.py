@@ -1,0 +1,75 @@
+"""Pallas kernel parity tests (interpret mode on CPU).
+
+The kernel must be numerically identical to the jnp reference path
+(`ops/boxes.py` broadcast_iou + max): same clipping, same epsilon.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu.ops.boxes import broadcast_iou
+from deepvision_tpu.ops.pallas_kernels import best_iou
+
+
+def _reference(pred, gt):
+    return np.asarray(jnp.max(broadcast_iou(pred, gt), axis=-1))
+
+
+def _random_boxes(rs, b, n):
+    xy1 = rs.uniform(0, 0.7, (b, n, 2))
+    wh = rs.uniform(0.01, 0.3, (b, n, 2))
+    return np.concatenate([xy1, np.minimum(xy1 + wh, 1.0)], -1).astype(np.float32)
+
+
+@pytest.mark.parametrize("n,m,block_n", [
+    (507, 100, 128),   # 13x13x3 YOLO scale, real GT pad count
+    (64, 100, 512),    # n smaller than block
+    (130, 3, 64),      # n not divisible by block, tiny m
+])
+def test_best_iou_matches_jnp(n, m, block_n):
+    rs = np.random.RandomState(0)
+    pred = _random_boxes(rs, 2, n)
+    gt = _random_boxes(rs, 2, m)
+    got = np.asarray(best_iou(jnp.asarray(pred), jnp.asarray(gt),
+                              block_n=block_n, interpret=True))
+    np.testing.assert_allclose(got, _reference(pred, gt), rtol=1e-6, atol=1e-6)
+
+
+def test_best_iou_padded_gt_rows_are_zero_iou():
+    """All-zero GT rows (the padding convention) must never win the max."""
+    rs = np.random.RandomState(1)
+    pred = _random_boxes(rs, 1, 32)
+    gt = np.zeros((1, 100, 4), np.float32)
+    gt[0, 0] = [0.1, 0.1, 0.4, 0.4]
+    got = np.asarray(best_iou(jnp.asarray(pred), jnp.asarray(gt),
+                              block_n=32, interpret=True))
+    np.testing.assert_allclose(got, _reference(pred, gt), rtol=1e-6, atol=1e-6)
+
+
+def test_best_iou_exact_match_is_one():
+    gt = np.array([[[0.2, 0.2, 0.5, 0.6]]], np.float32)
+    got = best_iou(jnp.asarray(gt), jnp.asarray(gt), interpret=True)
+    assert float(got[0, 0]) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_yolo_loss_uses_kernel_and_grads_flow():
+    """yolo_loss still differentiates (kernel is behind stop_gradient)."""
+    from deepvision_tpu.ops.yolo import yolo_loss_one_scale, ANCHORS_WH
+
+    rs = np.random.RandomState(2)
+    b, g, c = 2, 4, 3
+    y_true = jnp.asarray(rs.rand(b, g, g, 3, 5 + c).astype(np.float32))
+    y_pred = jnp.asarray(rs.randn(b, g, g, 3, 5 + c).astype(np.float32))
+    gt_boxes = jnp.asarray(_random_boxes(rs, b, 10))
+    gt_valid = jnp.ones((b, 10), jnp.float32)
+
+    def scalar_loss(yp):
+        comp = yolo_loss_one_scale(y_true, yp, gt_boxes, gt_valid,
+                                   np.asarray(ANCHORS_WH[:3]), c)
+        return jnp.sum(comp["total"])
+
+    grads = jax.grad(scalar_loss)(y_pred)
+    assert np.all(np.isfinite(np.asarray(grads)))
+    assert float(jnp.sum(jnp.abs(grads))) > 0.0
