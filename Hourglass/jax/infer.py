@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Hourglass pose inference: restore a checkpoint, predict MPII keypoints for
+images, print them and (optionally) save skeleton overlays — the scripted
+equivalent of the reference's `demo_hourglass_pose.ipynb`.
+
+Usage: python infer.py --workdir runs/hourglass104 [--out-dir poses] img1.jpg ...
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# MPII joint order (`Datasets/MPII/tfrecords_mpii.py` annotation convention)
+MPII_JOINTS = ["r_ankle", "r_knee", "r_hip", "l_hip", "l_knee", "l_ankle",
+               "pelvis", "thorax", "upper_neck", "head_top", "r_wrist",
+               "r_elbow", "r_shoulder", "l_shoulder", "l_elbow", "l_wrist"]
+SKELETON = [(0, 1), (1, 2), (2, 6), (3, 6), (3, 4), (4, 5), (6, 7), (7, 8),
+            (8, 9), (10, 11), (11, 12), (12, 7), (13, 7), (13, 14), (14, 15)]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="runs/hourglass104")
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--conf-thresh", type=float, default=1.0,
+                   help="min peak amplitude (heatmaps train to 12 at joints)")
+    p.add_argument("--out-dir", default=None,
+                   help="save skeleton overlays here (needs PIL only)")
+    p.add_argument("images", nargs="+")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image, ImageDraw
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.pose import PoseTrainer
+    from deepvision_tpu.ops.heatmap import decode_keypoints
+
+    cfg = get_config("hourglass104")
+    trainer = PoseTrainer(cfg, workdir=args.workdir)
+    size = args.image_size
+    trainer.init_state((size, size, 3))
+    if trainer.resume() is None:
+        print("WARNING: no checkpoint found — using random weights")
+
+    batch = np.stack([
+        np.asarray(Image.open(f).convert("RGB").resize((size, size)),
+                   np.float32) / 127.5 - 1.0 for f in args.images])
+    state = trainer.state
+    outputs = state.apply_fn(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(batch), train=False)
+    # last stack's heatmaps are the prediction (intermediate supervision only
+    # trains the earlier heads)
+    kp_x, kp_y, conf = decode_keypoints(outputs[-1])
+    kp_x, kp_y, conf = map(np.asarray, (kp_x, kp_y, conf))
+    trainer.close()
+
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+    for i, path in enumerate(args.images):
+        print(f"{path}:")
+        vis = conf[i] >= args.conf_thresh
+        for j, name in enumerate(MPII_JOINTS[:kp_x.shape[1]]):
+            mark = "" if vis[j] else "  (low conf)"
+            print(f"  {name:12s} x={kp_x[i, j]:.3f} y={kp_y[i, j]:.3f} "
+                  f"conf={conf[i, j]:.2f}{mark}")
+        if args.out_dir:
+            img = Image.open(path).convert("RGB").resize((size, size))
+            draw = ImageDraw.Draw(img)
+            pts = [(float(kp_x[i, j]) * size, float(kp_y[i, j]) * size)
+                   for j in range(kp_x.shape[1])]
+            for a, b in SKELETON:
+                if a < len(pts) and b < len(pts) and vis[a] and vis[b]:
+                    draw.line([pts[a], pts[b]], width=3, fill=(0, 255, 0))
+            for j, (x, y) in enumerate(pts):
+                if vis[j]:
+                    draw.ellipse([x - 3, y - 3, x + 3, y + 3], fill=(255, 0, 0))
+            name = os.path.join(
+                args.out_dir,
+                f"{os.path.splitext(os.path.basename(path))[0]}_pose.png")
+            img.save(name)
+            print(f"  saved {name}")
+
+
+if __name__ == "__main__":
+    main()

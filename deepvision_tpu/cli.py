@@ -33,6 +33,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                         "idx files for MNIST)")
     p.add_argument("--synthetic", action="store_true",
                    help="train on synthetic data (smoke test, no dataset needed)")
+    p.add_argument("--dataset", default=None,
+                   help="override the config's dataset flavor (e.g. "
+                        "imagenet_flat for the reference's flattened-dir "
+                        "layout instead of TFRecords)")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--learning-rate", type=float, default=None,
@@ -96,6 +100,9 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))
+    if args.dataset:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data,
+                                                   dataset=args.dataset))
     if args.synthetic:
         n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
         synth = dict(dataset="synthetic",
@@ -155,6 +162,30 @@ def _classification_data(cfg, args):
         from .data import imagenet as inet
         return _tfrecord_data(inet.build_dataset, cfg, args, "dataset/tfrecord",
                               bounded_train_steps=True)
+    elif data.dataset == "imagenet_flat":
+        # the reference's flat-dir layout (`ResNet/pytorch/data_load.py:20-44`:
+        # dataset/{train_flatten,val_flatten}/ + synsets.txt)
+        import itertools
+
+        import jax
+
+        from .data.imagenet_flat import FlatImageNet
+        data_dir = args.data_dir or data.data_dir or "dataset"
+        synsets = os.path.join(data_dir, "synsets.txt")
+        common = dict(batch_size=cfg.batch_size // jax.process_count(),
+                      image_size=data.image_size,
+                      num_shards=jax.process_count(),
+                      shard_index=jax.process_index())
+        steps = args.steps_per_epoch
+
+        def train_fn(epoch):
+            ds = FlatImageNet(os.path.join(data_dir, "train_flatten"),
+                              synsets, training=True, seed=epoch, **common)
+            return itertools.islice(iter(ds), steps) if steps else ds
+
+        def val_fn(epoch):
+            return FlatImageNet(os.path.join(data_dir, "val_flatten"),
+                                synsets, training=False, **common)
     else:
         raise ValueError(f"unknown dataset {data.dataset!r}")
     return train_fn, val_fn

@@ -40,11 +40,14 @@ class MeanAccumulator:
 
 
 class MetricsLogger:
-    def __init__(self, log_dir: Optional[str] = None, name: str = "train"):
+    def __init__(self, log_dir: Optional[str] = None, name: str = "train",
+                 tensorboard: bool = True):
         self.log_dir = log_dir
         self.name = name
         self.history: Dict[str, Dict[str, list]] = {}
         self._jsonl = None
+        self._tb = None
+        self._tb_pending = bool(log_dir) and tensorboard  # created on first log
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             self._jsonl = open(os.path.join(log_dir, f"{name}.jsonl"), "a")
@@ -62,6 +65,15 @@ class MetricsLogger:
         if self._jsonl:
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+        if self._tb_pending:  # lazy: inference-only runs never pay the TF cost
+            self._tb_pending = False
+            self._tb = _make_tb_writer(os.path.join(self.log_dir, "tb",
+                                                    self.name))
+        if self._tb is not None:
+            with self._tb.as_default():
+                import tensorflow as tf
+                for k, v in metrics.items():
+                    tf.summary.scalar(prefix + k, v, step=step)
         if echo:
             body = " ".join(f"{prefix + k}={v:.4f}" for k, v in metrics.items())
             ep = f"epoch {epoch} " if epoch is not None else ""
@@ -70,6 +82,22 @@ class MetricsLogger:
     def close(self):
         if self._jsonl:
             self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def _make_tb_writer(path: str):
+    """TensorBoard scalar writer (`tf.summary.create_file_writer` role of
+    `YOLO/tensorflow/train.py:196-199`); None if tensorflow is unavailable."""
+    try:
+        import tensorflow as tf
+    except ImportError:  # TF genuinely optional; any other failure surfaces
+        return None
+    try:
+        tf.config.set_visible_devices([], "GPU")
+    except RuntimeError:  # devices already initialized elsewhere — benign
+        pass
+    return tf.summary.create_file_writer(path)
 
 
 def device_get_metrics(metrics) -> Dict[str, float]:

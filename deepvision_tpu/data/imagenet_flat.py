@@ -1,0 +1,120 @@
+"""Flat-directory ImageNet loader (the reference's PyTorch data flavor).
+
+Parity target: `ImageNet2012Dataset` (`ResNet/pytorch/data_load.py:14-69`) — a
+single flattened directory of JPEGs whose filenames start with their WordNet
+synset id (`n01440764_10026.JPEG`), labels resolved through the synset list
+(`Datasets/ILSVRC2012/synsets.txt`, flattening scripts
+`Datasets/ILSVRC2012/flatten-script.sh`). Redesigned for feeding TPU hosts:
+
+- PIL decode (no cv2 dependency) in a thread pool — JPEG decode releases the
+  GIL, so this parallels like the reference's `num_workers=16` loader procs
+  without fork overhead;
+- batches are NHWC float32 numpy arrays ready for `device_put` (the
+  `DataLoader` role of `ResNet/pytorch/train.py:229-234`);
+- per-epoch seeded shuffling (the reference never seeds, SURVEY.md §5.2).
+
+The TFRecord pipeline (`data/imagenet.py`) is the fast path for pods; this
+loader covers the reference's simpler disk layout and is handy for subsets.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .transforms import eval_transform, train_transform
+
+IMG_EXTS = (".jpeg", ".jpg", ".png")
+
+
+def load_synsets(path: str) -> dict:
+    """synset id → contiguous class index, in file order
+    (`Datasets/ILSVRC2012/synsets.txt` ordering)."""
+    with open(path) as fp:
+        return {line.strip(): i for i, line in enumerate(fp) if line.strip()}
+
+
+class FlatImageNet:
+    """Iterable over (images, labels) batches from one flat directory.
+
+    `synsets` may be a path to synsets.txt or a prebuilt {synset: index} dict.
+    Labels come from the filename prefix before the first underscore
+    (`data_load.py:36-44` semantics).
+    """
+
+    def __init__(self, root_dir: str, synsets, *, batch_size: int,
+                 transform: Optional[Callable] = None, training: bool = True,
+                 image_size: int = 224, seed: int = 0, workers: int = 16,
+                 drop_remainder: Optional[bool] = None,
+                 num_shards: int = 1, shard_index: int = 0):
+        """`batch_size` is the PER-HOST batch; on a pod pass
+        `num_shards=jax.process_count(), shard_index=jax.process_index()` so
+        each host reads a disjoint slice of the directory (the
+        `files.shard(...)` role of the TFRecord pipelines)."""
+        self.root_dir = root_dir
+        self.synset_to_idx = (load_synsets(synsets) if isinstance(synsets, str)
+                              else dict(synsets))
+        self.batch_size = batch_size
+        self.training = training
+        self.transform = transform or (train_transform(image_size) if training
+                                       else eval_transform(image_size))
+        self.seed = seed
+        self.workers = workers
+        self.drop_remainder = training if drop_remainder is None else drop_remainder
+
+        self.files = sorted(
+            f for f in os.listdir(root_dir)
+            if f.lower().endswith(IMG_EXTS) and "_" in f
+            and f.split("_", 1)[0] in self.synset_to_idx)[shard_index::num_shards]
+        if not self.files:
+            raise FileNotFoundError(
+                f"no labeled images (synset_*.JPEG) under {root_dir!r} "
+                f"(shard {shard_index}/{num_shards})")
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.files)
+        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+
+    def _load_one(self, args) -> Tuple[np.ndarray, int]:
+        fname, rng = args
+        from PIL import Image
+        with Image.open(os.path.join(self.root_dir, fname)) as im:
+            arr = np.asarray(im.convert("RGB"))
+        label = self.synset_to_idx[fname.split("_", 1)[0]]
+        return self.transform(arr, rng), label
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        order = np.arange(len(self.files))
+        root_rng = np.random.default_rng((self.seed, self.epoch))
+        if self.training:
+            root_rng.shuffle(order)
+        self.epoch += 1
+
+        starts = []
+        for start in range(0, len(order), self.batch_size):
+            if start + self.batch_size > len(order) and self.drop_remainder:
+                break
+            starts.append(start)
+
+        def submit(pool, start):
+            idx = order[start:start + self.batch_size]
+            rngs = root_rng.spawn(len(idx))
+            return [pool.submit(self._load_one, (self.files[i], r))
+                    for i, r in zip(idx, rngs)]
+
+        # one-batch lookahead: batch N+1 decodes while N trains (the prefetch
+        # the tf.data path gets from `.prefetch(AUTOTUNE)`)
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            pending = submit(pool, starts[0]) if starts else None
+            for n, start in enumerate(starts):
+                futures = pending
+                pending = (submit(pool, starts[n + 1])
+                           if n + 1 < len(starts) else None)
+                pairs = [f.result() for f in futures]
+                images = np.stack([p[0] for p in pairs]).astype(np.float32)
+                labels = np.asarray([p[1] for p in pairs], np.int32)
+                yield images, labels

@@ -1,0 +1,188 @@
+"""Composable host-side image transforms (numpy, NHWC).
+
+Parity target: the 7 transform classes every PyTorch classification dir copies
+(`ResNet/pytorch/data_load.py:72-296`): Rescale, RandomCrop, CenterCrop,
+RandomHorizontalFlip, ToTensor, Normalize, ColorJitter. Differences are
+deliberate TPU-first choices:
+
+- images stay **HWC float32** end to end (TPU convs are NHWC; the reference's
+  ToTensor transposes to CHW for torch) — the equivalent here is `ToFloat`,
+  which only scales uint8 → [0, 1];
+- random transforms take an explicit `numpy.random.Generator` instead of
+  mutating global RNG state, so input pipelines are seedable per epoch
+  (SURVEY.md §5.2: the reference never seeds its PyTorch pipelines).
+
+`Compose` threads the rng through; deterministic transforms ignore it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+Size = Union[int, Tuple[int, int]]
+
+
+def _resize(image: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize via PIL (cv2-free; PIL ships with the image).
+
+    uint8 goes through the fast RGB path; float inputs resize per-channel in
+    float32 ("F" mode) so any value range survives (e.g. Rescale composed after
+    ToFloat/Normalize).
+    """
+    from PIL import Image
+    if image.dtype == np.uint8:
+        return np.asarray(Image.fromarray(image).resize((w, h), Image.BILINEAR))
+    chans = [np.asarray(Image.fromarray(
+        np.ascontiguousarray(image[..., c], dtype=np.float32), mode="F")
+        .resize((w, h), Image.BILINEAR)) for c in range(image.shape[-1])]
+    return np.stack(chans, axis=-1)
+
+
+class Rescale:
+    """Resize: int = shorter side (aspect preserved), tuple = exact (h, w)
+    (`data_load.py:72-101`)."""
+
+    def __init__(self, output_size: Size):
+        self.output_size = output_size
+
+    def __call__(self, image: np.ndarray, rng=None) -> np.ndarray:
+        h, w = image.shape[:2]
+        if isinstance(self.output_size, int):
+            if h < w:
+                nh, nw = self.output_size, int(round(w * self.output_size / h))
+            else:
+                nh, nw = int(round(h * self.output_size / w)), self.output_size
+        else:
+            nh, nw = self.output_size
+        return _resize(image, nh, nw)
+
+
+class RandomCrop:
+    """Uniform random (h, w) crop (`data_load.py:104-113`)."""
+
+    def __init__(self, output_size: Size):
+        self.size = ((output_size, output_size)
+                     if isinstance(output_size, int) else output_size)
+
+    def __call__(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        h, w = image.shape[:2]
+        ch, cw = self.size
+        top = int(rng.integers(0, h - ch + 1))
+        left = int(rng.integers(0, w - cw + 1))
+        return image[top:top + ch, left:left + cw]
+
+
+class CenterCrop:
+    """Center (h, w) crop (`data_load.py:116-143`)."""
+
+    def __init__(self, output_size: Size):
+        self.size = ((output_size, output_size)
+                     if isinstance(output_size, int) else output_size)
+
+    def __call__(self, image: np.ndarray, rng=None) -> np.ndarray:
+        h, w = image.shape[:2]
+        ch, cw = self.size
+        top = (h - ch) // 2
+        left = (w - cw) // 2
+        return image[top:top + ch, left:left + cw]
+
+
+class RandomHorizontalFlip:
+    """50% (default) left-right flip (`data_load.py:146-173`)."""
+
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def __call__(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        if rng.random() < self.prob:
+            return image[:, ::-1]
+        return image
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation jitter (`data_load.py:213-296`).
+    Factors drawn uniformly from [max(0, 1-x), 1+x]; applied on [0, 255]."""
+
+    def __init__(self, brightness: float = 0.0, contrast: float = 0.0,
+                 saturation: float = 0.0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    @staticmethod
+    def _factor(rng, x: float) -> float:
+        return float(rng.uniform(max(0.0, 1.0 - x), 1.0 + x))
+
+    def __call__(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        img = image.astype(np.float32)
+        if self.brightness:
+            img = img * self._factor(rng, self.brightness)
+        if self.contrast:
+            mean = img.mean(axis=(0, 1), keepdims=True)
+            img = (img - mean) * self._factor(rng, self.contrast) + mean
+        if self.saturation:
+            gray = img.mean(axis=2, keepdims=True)
+            img = (img - gray) * self._factor(rng, self.saturation) + gray
+        return np.clip(img, 0.0, 255.0)
+
+
+class ToFloat:
+    """uint8 [0, 255] → float32 [0, 1]; stays HWC (the NHWC-native stand-in
+    for the reference's CHW `ToTensor`, `data_load.py:176-194`)."""
+
+    def __call__(self, image: np.ndarray, rng=None) -> np.ndarray:
+        return np.asarray(image, np.float32) / 255.0
+
+
+class Normalize:
+    """Channelwise (x - mean) / std on [0, 1] floats (`data_load.py:197-210`);
+    defaults are the ImageNet statistics the reference uses."""
+
+    def __init__(self, mean: Sequence[float] = (0.485, 0.456, 0.406),
+                 std: Sequence[float] = (0.229, 0.224, 0.225)):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, image: np.ndarray, rng=None) -> np.ndarray:
+        return (image.astype(np.float32) - self.mean) / self.std
+
+
+class Compose:
+    """Apply transforms in order, threading one rng through
+    (`transforms.Compose` role, `ResNet/pytorch/train.py:315-331`)."""
+
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, image: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        for t in self.transforms:
+            image = t(image, rng)
+        return image
+
+
+def train_transform(image_size: int = 224) -> Compose:
+    """The reference's training stack (`ResNet/pytorch/train.py:315-323`):
+    Rescale(256) → flip → RandomCrop(224) → jitter → float → normalize."""
+    return Compose([
+        Rescale(int(image_size * 256 / 224)),
+        RandomHorizontalFlip(),
+        RandomCrop(image_size),
+        ColorJitter(brightness=0.2, contrast=0.2, saturation=0.2),
+        ToFloat(),
+        Normalize(),
+    ])
+
+
+def eval_transform(image_size: int = 224) -> Compose:
+    """Validation stack (`ResNet/pytorch/train.py:325-331`):
+    Rescale(256) → CenterCrop(224) → float → normalize."""
+    return Compose([
+        Rescale(int(image_size * 256 / 224)),
+        CenterCrop(image_size),
+        ToFloat(),
+        Normalize(),
+    ])

@@ -47,3 +47,20 @@ def render_gaussian_heatmaps(kp_x: jnp.ndarray, kp_y: jnp.ndarray,
               (x0 + r >= 0) & (y0 + r >= 0))
     keep = (visible & on_map)[None, None, :]
     return jnp.where(in_patch & keep, gauss, 0.0)
+
+
+def decode_keypoints(heatmaps: jnp.ndarray):
+    """Per-joint argmax decode: (..., H, W, K) heatmaps → normalized keypoints.
+
+    Returns (kp_x, kp_y, confidence), each (..., K): the peak location scaled to
+    [0, 1] (cell centers) and the peak amplitude. This is the inference decode
+    the reference's demo notebook does with numpy argmax over model output
+    (`Hourglass/tensorflow/demo_hourglass_pose.ipynb` role).
+    """
+    h, w, k = heatmaps.shape[-3], heatmaps.shape[-2], heatmaps.shape[-1]
+    flat = heatmaps.reshape(*heatmaps.shape[:-3], h * w, k)
+    idx = jnp.argmax(flat, axis=-2)                      # (..., K)
+    conf = jnp.max(flat, axis=-2)
+    kp_y = (idx // w).astype(jnp.float32) / h
+    kp_x = (idx % w).astype(jnp.float32) / w
+    return kp_x, kp_y, conf

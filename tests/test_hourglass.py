@@ -129,3 +129,32 @@ def test_pose_train_step_decreases_loss(mesh8):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_decode_keypoints_roundtrip():
+    """Render keypoints → heatmaps → decode: peaks recover locations/amplitude."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.ops.heatmap import decode_keypoints, render_gaussian_heatmaps
+
+    kp_x = jnp.array([0.25, 0.75, 0.5])
+    kp_y = jnp.array([0.5, 0.25, 0.9])
+    vis = jnp.ones(3)
+    hm = render_gaussian_heatmaps(kp_x, kp_y, vis, 64, 64)
+    dx, dy, conf = decode_keypoints(hm)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(kp_x), atol=1.5 / 64)
+    np.testing.assert_allclose(np.asarray(dy), np.asarray(kp_y), atol=1.5 / 64)
+    assert np.all(np.asarray(conf) == 12.0)  # gaussian amplitude
+
+
+def test_decode_keypoints_batched():
+    import jax.numpy as jnp
+
+    from deepvision_tpu.ops.heatmap import decode_keypoints
+
+    hm = jnp.zeros((2, 8, 8, 4)).at[0, 2, 3, 1].set(5.0)
+    kp_x, kp_y, conf = decode_keypoints(hm)
+    assert kp_x.shape == (2, 4)
+    assert float(kp_x[0, 1]) == 3 / 8 and float(kp_y[0, 1]) == 2 / 8
+    assert float(conf[0, 1]) == 5.0

@@ -87,3 +87,21 @@ def test_plateau_trainer_integration(tmp_path):
     tr.fit(_data(), val_fn, sample_shape=(32, 32, 1))
     assert tr.plateau.scale < 1.0
     tr.close()
+
+
+def test_metrics_logger_tensorboard(tmp_path):
+    """TB event files are written alongside JSONL (`SURVEY.md §5.5` parity)."""
+    import os
+
+    import pytest
+    pytest.importorskip("tensorflow")  # TB is optional by contract
+
+    from deepvision_tpu.core.metrics import MetricsLogger
+
+    lg = MetricsLogger(str(tmp_path), name="t")
+    lg.log(1, {"loss": 1.5}, epoch=1, echo=False)
+    lg.close()
+    tb_dir = os.path.join(str(tmp_path), "tb", "t")
+    assert os.path.isdir(tb_dir) and any(
+        "tfevents" in f for f in os.listdir(tb_dir))
+    assert os.path.exists(os.path.join(str(tmp_path), "t.jsonl"))

@@ -1,0 +1,152 @@
+"""Composable transforms + flat-dir ImageNet loader
+(parity: `ResNet/pytorch/data_load.py:14-296`, redesigned NHWC/numpy-first)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.data.transforms import (
+    CenterCrop, ColorJitter, Compose, Normalize, RandomCrop,
+    RandomHorizontalFlip, Rescale, ToFloat, eval_transform, train_transform)
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def checker(h=10, w=12):
+    img = np.zeros((h, w, 3), np.uint8)
+    img[::2, ::2] = 255
+    return img
+
+
+class TestTransforms:
+    def test_rescale_short_side(self):
+        out = Rescale(8)(checker(10, 20))
+        assert out.shape == (8, 16, 3)  # shorter side → 8, aspect kept
+        out = Rescale(8)(checker(20, 10))
+        assert out.shape == (16, 8, 3)
+
+    def test_rescale_exact(self):
+        assert Rescale((5, 7))(checker()).shape == (5, 7, 3)
+
+    def test_random_crop_bounds_and_determinism(self):
+        img = np.arange(10 * 12 * 3, dtype=np.uint8).reshape(10, 12, 3)
+        a = RandomCrop(6)(img, rng(3))
+        b = RandomCrop(6)(img, rng(3))
+        assert a.shape == (6, 6, 3)
+        np.testing.assert_array_equal(a, b)  # seeded → reproducible
+
+    def test_center_crop(self):
+        img = np.zeros((10, 10, 3), np.uint8)
+        img[4:6, 4:6] = 1
+        out = CenterCrop(2)(img)
+        assert out.shape == (2, 2, 3) and out.min() == 1
+
+    def test_flip_always_and_never(self):
+        img = checker()
+        np.testing.assert_array_equal(
+            RandomHorizontalFlip(prob=1.0)(img, rng()), img[:, ::-1])
+        np.testing.assert_array_equal(
+            RandomHorizontalFlip(prob=0.0)(img, rng()), img)
+
+    def test_tofloat_and_normalize(self):
+        img = np.full((2, 2, 3), 255, np.uint8)
+        f = ToFloat()(img)
+        assert f.dtype == np.float32 and f.max() == pytest.approx(1.0)
+        n = Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))(f)
+        assert n.max() == pytest.approx(1.0)  # (1 - .5) / .5
+
+    def test_color_jitter_identity_and_range(self):
+        img = checker().astype(np.float32)
+        np.testing.assert_array_equal(ColorJitter()(img, rng()), img)
+        out = ColorJitter(0.4, 0.4, 0.4)(img, rng(1))
+        assert out.min() >= 0.0 and out.max() <= 255.0
+
+    def test_compose_pipeline_shapes(self):
+        img = (rng(0).random((40, 60, 3)) * 255).astype(np.uint8)
+        out = train_transform(16)(img, rng(1))
+        assert out.shape == (16, 16, 3) and out.dtype == np.float32
+        out = eval_transform(16)(img)
+        assert out.shape == (16, 16, 3)
+
+
+@pytest.fixture(scope="module")
+def flat_dir(tmp_path_factory):
+    """Tiny flat ImageNet dir: 2 synsets x 5 JPEGs + synsets.txt."""
+    from PIL import Image
+    root = tmp_path_factory.mktemp("flat")
+    d = root / "train_flatten"
+    d.mkdir()
+    g = np.random.default_rng(0)
+    for s, syn in enumerate(["n01440764", "n01443537"]):
+        for i in range(5):
+            arr = (g.random((36, 36, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{syn}_{i}.JPEG")
+    (root / "synsets.txt").write_text("n01440764\nn01443537\n")
+    return root
+
+
+class TestFlatImageNet:
+    def test_batches_and_labels(self, flat_dir):
+        from deepvision_tpu.data.imagenet_flat import FlatImageNet
+        ds = FlatImageNet(str(flat_dir / "train_flatten"),
+                          str(flat_dir / "synsets.txt"), batch_size=4,
+                          image_size=16, training=True, seed=0, workers=2)
+        batches = list(ds)
+        assert len(batches) == len(ds) == 2  # 10 imgs, drop remainder
+        images, labels = batches[0]
+        assert images.shape == (4, 16, 16, 3) and images.dtype == np.float32
+        assert labels.dtype == np.int32 and set(labels) <= {0, 1}
+
+    def test_eval_keeps_tail_and_is_ordered(self, flat_dir):
+        from deepvision_tpu.data.imagenet_flat import FlatImageNet
+        ds = FlatImageNet(str(flat_dir / "train_flatten"),
+                          str(flat_dir / "synsets.txt"), batch_size=4,
+                          image_size=16, training=False, workers=2)
+        batches = list(ds)
+        assert [len(b[1]) for b in batches] == [4, 4, 2]
+        all_labels = np.concatenate([b[1] for b in batches])
+        assert all_labels.tolist() == sorted(all_labels.tolist())  # file order
+
+    def test_epoch_reshuffle(self, flat_dir):
+        from deepvision_tpu.data.imagenet_flat import FlatImageNet
+        ds = FlatImageNet(str(flat_dir / "train_flatten"),
+                          str(flat_dir / "synsets.txt"), batch_size=10,
+                          image_size=8, training=True, seed=0, workers=2)
+        l1 = next(iter(ds))[1].tolist()
+        l2 = next(iter(ds))[1].tolist()
+        assert sorted(l1) == sorted(l2)
+        assert l1 != l2  # epoch bump reshuffles
+
+    def test_missing_dir_raises(self, flat_dir, tmp_path):
+        from deepvision_tpu.data.imagenet_flat import FlatImageNet
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(FileNotFoundError):
+            FlatImageNet(str(empty), str(flat_dir / "synsets.txt"),
+                         batch_size=2)
+
+
+def test_rescale_float_preserves_values():
+    """Float images (any range) survive Rescale — no uint8 truncation."""
+    img = np.full((8, 8, 3), -1.7, np.float32)
+    out = Rescale((4, 4))(img)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, -1.7, atol=1e-5)
+
+
+def test_flat_sharding_disjoint(flat_dir):
+    from deepvision_tpu.data.imagenet_flat import FlatImageNet
+    kw = dict(batch_size=2, image_size=8, training=False, workers=2)
+    a = FlatImageNet(str(flat_dir / "train_flatten"),
+                     str(flat_dir / "synsets.txt"), num_shards=2,
+                     shard_index=0, **kw)
+    b = FlatImageNet(str(flat_dir / "train_flatten"),
+                     str(flat_dir / "synsets.txt"), num_shards=2,
+                     shard_index=1, **kw)
+    assert set(a.files).isdisjoint(b.files)
+    assert sorted(a.files + b.files) == sorted(
+        FlatImageNet(str(flat_dir / "train_flatten"),
+                     str(flat_dir / "synsets.txt"), **kw).files)
