@@ -49,6 +49,14 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="override steps per epoch (synthetic/smoke)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the config's PRNG seed")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="resume from the latest checkpoint if one exists "
+                        "(preemption recovery; starts fresh otherwise)")
+    p.add_argument("--multihost", action="store_true",
+                   help="force jax.distributed.initialize() (auto-detected "
+                        "when a coordinator address env var is set)")
     return p
 
 
@@ -89,6 +97,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
          synthetic_image_size: Optional[int] = None) -> dict:
     """Shared driver: parse → config overrides → trainer → data → fit."""
     args = build_parser(family, models).parse_args(argv)
+
+    from .parallel.mesh import maybe_init_distributed
+    maybe_init_distributed(force=args.multihost)
+
     cfg = get_config(args.model)
     if args.epochs:
         cfg = cfg.replace(total_epochs=args.epochs)
@@ -103,6 +115,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.dataset:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data,
                                                    dataset=args.dataset))
+    if args.seed is not None:
+        cfg = cfg.replace(seed=args.seed)
     if args.synthetic:
         n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
         synth = dict(dataset="synthetic",
@@ -122,6 +136,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     trainer.init_state(sample_shape)
     if args.checkpoint:
         trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
+    elif args.auto_resume:
+        # preemption recovery (SURVEY.md §5.3): latest checkpoint if present,
+        # fresh start otherwise — resume() returns None when the dir is empty
+        trainer.resume()
     result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
                          profile_dir=args.profile_dir)
     trainer.close()
@@ -177,15 +195,18 @@ def _classification_data(cfg, args):
                       num_shards=jax.process_count(),
                       shard_index=jax.process_index())
         steps = args.steps_per_epoch
+        # one instance per split: the directory scan happens once, and
+        # FlatImageNet reshuffles internally on each __iter__ (epoch bump)
+        train_ds = FlatImageNet(os.path.join(data_dir, "train_flatten"),
+                                synsets, training=True, **common)
+        val_ds = FlatImageNet(os.path.join(data_dir, "val_flatten"),
+                              synsets, training=False, **common)
 
-        def train_fn(epoch):
-            ds = FlatImageNet(os.path.join(data_dir, "train_flatten"),
-                              synsets, training=True, seed=epoch, **common)
-            return itertools.islice(iter(ds), steps) if steps else ds
+        def train_fn(epoch, _ds=train_ds, _steps=steps):
+            return itertools.islice(iter(_ds), _steps) if _steps else _ds
 
-        def val_fn(epoch):
-            return FlatImageNet(os.path.join(data_dir, "val_flatten"),
-                                synsets, training=False, **common)
+        def val_fn(epoch, _ds=val_ds):
+            return _ds
     else:
         raise ValueError(f"unknown dataset {data.dataset!r}")
     return train_fn, val_fn
@@ -207,6 +228,9 @@ def _detection_data(cfg, args):
         return _synthetic_data(cfg, lambda steps, seed: det.synthetic_batches(
             batch_size=cfg.batch_size, image_size=data.image_size,
             num_classes=data.num_classes, steps=steps, seed=seed))
+    if data.dataset != "detection":
+        raise ValueError(f"detection families read 'detection' TFRecords, "
+                         f"not dataset={data.dataset!r}")
     return _tfrecord_data(det.build_dataset, cfg, args, "dataset/tfrecords")
 
 
@@ -229,6 +253,9 @@ def _pose_data(cfg, args):
             cfg, lambda steps, seed: pose_data.synthetic_batches(
                 batch_size=cfg.batch_size, image_size=data.image_size,
                 steps=steps, seed=seed))
+    if data.dataset != "pose":
+        raise ValueError(f"pose families read 'pose' TFRecords, "
+                         f"not dataset={data.dataset!r}")
     return _tfrecord_data(pose_data.build_dataset, cfg, args,
                           "dataset/tfrecords_mpii")
 

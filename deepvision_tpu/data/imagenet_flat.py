@@ -65,19 +65,30 @@ class FlatImageNet:
         self.workers = workers
         self.drop_remainder = training if drop_remainder is None else drop_remainder
 
-        self.files = sorted(
+        all_files = sorted(
             f for f in os.listdir(root_dir)
             if f.lower().endswith(IMG_EXTS) and "_" in f
-            and f.split("_", 1)[0] in self.synset_to_idx)[shard_index::num_shards]
+            and f.split("_", 1)[0] in self.synset_to_idx)
+        self.files = all_files[shard_index::num_shards]
         if not self.files:
             raise FileNotFoundError(
                 f"no labeled images (synset_*.JPEG) under {root_dir!r} "
                 f"(shard {shard_index}/{num_shards})")
         self.epoch = 0
+        # Every host must run the SAME number of jitted (collective) steps or
+        # the pod deadlocks; shard sizes differ by up to 1 file, so each host
+        # caps its batch count at the smallest shard's count (min over shards —
+        # computable locally since sharding is deterministic). Single-host
+        # (num_shards=1) is exact.
+        def shard_batches(n_files: int) -> int:
+            return (n_files // batch_size if self.drop_remainder
+                    else -(-n_files // batch_size))
+        self._num_batches = min(
+            shard_batches(len(all_files[s::num_shards]))
+            for s in range(num_shards))
 
     def __len__(self) -> int:
-        n = len(self.files)
-        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+        return self._num_batches
 
     def _load_one(self, args) -> Tuple[np.ndarray, int]:
         fname, rng = args
@@ -94,11 +105,7 @@ class FlatImageNet:
             root_rng.shuffle(order)
         self.epoch += 1
 
-        starts = []
-        for start in range(0, len(order), self.batch_size):
-            if start + self.batch_size > len(order) and self.drop_remainder:
-                break
-            starts.append(start)
+        starts = [i * self.batch_size for i in range(self._num_batches)]
 
         def submit(pool, start):
             idx = order[start:start + self.batch_size]

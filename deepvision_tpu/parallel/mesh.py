@@ -85,3 +85,29 @@ def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map(rule, params)
+
+
+_distributed_initialized = False
+
+
+def maybe_init_distributed(force: bool = False) -> bool:
+    """Multi-host SPMD bring-up (SURVEY.md §5.8): call
+    `jax.distributed.initialize()` once per process when a multi-host launch is
+    detected, so `jax.devices()` spans the pod and `process_index/count` drive
+    the per-host data sharding. DCN coordination is the JAX runtime's job — no
+    user-level transport code, unlike the reference's NCCL/MirroredStrategy.
+
+    Detection: explicit coordinator env (JAX_COORDINATOR_ADDRESS /
+    COORDINATOR_ADDRESS, as set by pod launchers) or `force=True` (Cloud TPU
+    pods auto-discover via metadata). Safe no-op on single-host runs.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return True
+    import os
+    if not (force or os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")):
+        return False
+    jax.distributed.initialize()
+    _distributed_initialized = True
+    return True

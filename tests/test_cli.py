@@ -27,3 +27,29 @@ def test_synthetic_end_to_end(tmp_path):
         argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size", "16",
               "--steps-per-epoch", "2", "--workdir", str(tmp_path)])
     assert "best_metric" in result
+
+
+def test_auto_resume_continues_and_fresh_start(tmp_path):
+    """--auto-resume: fresh start on empty workdir, resumes after a crash."""
+    base = ["-m", "lenet5", "--synthetic", "--batch-size", "16",
+            "--steps-per-epoch", "2", "--workdir", str(tmp_path),
+            "--auto-resume"]
+    run_classification("LeNet", ["lenet5"], argv=base + ["--epochs", "1"])
+    # second run with more epochs resumes from epoch 1 (not retrain from 0)
+    from deepvision_tpu.core.trainer import Trainer
+    result = run_classification("LeNet", ["lenet5"], argv=base + ["--epochs", "2"])
+    assert "best_metric" in result
+    tr = Trainer(get_config("lenet5").replace(batch_size=16),
+                 workdir=str(tmp_path))
+    tr.init_state((32, 32, 3))  # synthetic mode trains 3-channel
+    assert tr.resume() == 2  # both epochs checkpointed
+    tr.close()
+
+
+def test_seed_and_lr_overrides_parse(tmp_path):
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--seed", "7",
+              "--learning-rate", "0.01", "--workdir", str(tmp_path)])
+    assert "best_metric" in result

@@ -150,3 +150,22 @@ def test_flat_sharding_disjoint(flat_dir):
     assert sorted(a.files + b.files) == sorted(
         FlatImageNet(str(flat_dir / "train_flatten"),
                      str(flat_dir / "synsets.txt"), **kw).files)
+
+
+def test_flat_sharding_equal_batch_counts(flat_dir):
+    """Unequal shard sizes must still yield IDENTICAL batch counts per host
+    (collective steps deadlock otherwise). 10 files, 3 shards → sizes 4/3/3."""
+    from deepvision_tpu.data.imagenet_flat import FlatImageNet
+    kw = dict(batch_size=2, image_size=8, workers=2)
+    lens_train = []
+    lens_eval = []
+    for s in range(3):
+        common = dict(num_shards=3, shard_index=s, **kw)
+        tr = FlatImageNet(str(flat_dir / "train_flatten"),
+                          str(flat_dir / "synsets.txt"), training=True, **common)
+        ev = FlatImageNet(str(flat_dir / "train_flatten"),
+                          str(flat_dir / "synsets.txt"), training=False, **common)
+        lens_train.append((len(tr), len(list(tr))))
+        lens_eval.append((len(ev), len(list(ev))))
+    assert len(set(lens_train)) == 1 and lens_train[0][0] == lens_train[0][1]
+    assert len(set(lens_eval)) == 1 and lens_eval[0][0] == lens_eval[0][1]
