@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Hourglass PCKh evaluation on the MPII val split — the pose metric the
+reference never shipped (verification was visual, SURVEY.md §4).
+
+Usage:
+    python evaluate.py --data-dir dataset/tfrecords_mpii
+    python evaluate.py --synthetic          # smoke, random weights
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-c", "--checkpoint", default="latest")
+    p.add_argument("--workdir", default="runs/hourglass104")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--thresholds", default="0.5",
+                   help="comma-separated PCKh thresholds")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--max-batches", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import itertools
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.eval_pose import evaluate_pckh
+    from deepvision_tpu.core.pose import PoseTrainer
+
+    cfg = get_config("hourglass104")
+    trainer = PoseTrainer(cfg, workdir=args.workdir)
+    size = 64 if args.synthetic else cfg.data.image_size
+    trainer.init_state((size, size, 3))
+    if not args.synthetic and trainer.resume(
+            None if args.checkpoint == "latest" else int(args.checkpoint)) is None:
+        print("WARNING: no checkpoint found — evaluating random weights")
+
+    if args.synthetic:
+        from deepvision_tpu.data.pose import synthetic_batches
+        batches = synthetic_batches(batch_size=4, image_size=size, steps=2)
+    else:
+        from deepvision_tpu.data.pose import build_dataset
+        data_dir = args.data_dir or cfg.data.data_dir or "dataset/tfrecords_mpii"
+        ds = build_dataset(os.path.join(data_dir, "val*"),
+                           batch_size=cfg.batch_size, image_size=size,
+                           training=False)
+        batches = (tuple(t.numpy() for t in b) for b in ds)
+    if args.max_batches:
+        batches = itertools.islice(batches, args.max_batches)
+
+    thresholds = tuple(float(t) for t in args.thresholds.split(","))
+    metrics = evaluate_pckh(trainer.state, batches,
+                            num_joints=cfg.data.num_classes,
+                            thresholds=thresholds)
+    trainer.close()
+    for k in sorted(metrics):
+        print(f"{k}: {metrics[k]:.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

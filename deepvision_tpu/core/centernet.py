@@ -21,18 +21,27 @@ from .trainer import LossWatchedTrainer
 
 def make_centernet_train_step(*, num_classes: int, grid: int,
                               compute_dtype=jnp.bfloat16, donate: bool = True,
-                              mesh=None) -> Callable:
-    """(state, images, boxes, classes, valid, rng) -> (state, metrics)."""
+                              mesh=None, remat: bool = False) -> Callable:
+    """(state, images, boxes, classes, valid, rng) -> (state, metrics).
+    `remat=True` recomputes forward activations in backward (cf. steps.py)."""
 
     def step(state, images, boxes, classes, valid, rng):
         del rng
         images = images.astype(compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
 
-        def loss_fn(params):
-            outputs, mutated = state.apply_fn(
+        def forward(params, images):
+            return state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
+
+        if remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        def loss_fn(params):
+            outputs, mutated = forward(params, images)
             comp = cn_ops.centernet_loss(outputs, targets)
             return jnp.mean(comp["total"]), (comp, mutated)
 
@@ -81,7 +90,7 @@ class CenterNetTrainer(LossWatchedTrainer):
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         self.train_step = make_centernet_train_step(
             num_classes=config.data.num_classes, grid=grid,
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh)

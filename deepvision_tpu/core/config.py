@@ -79,6 +79,9 @@ class TrainConfig:
     keep_checkpoints: int = 3
     keep_best: bool = True          # save-best policy, YOLO/tensorflow/train.py:244-246
     model_parallel: int = 1
+    remat: bool = False             # jax.checkpoint the forward: recompute
+                                    # activations in backward, trading ~1/3 more
+                                    # FLOPs for HBM (big batches / deep stacks)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

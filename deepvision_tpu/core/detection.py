@@ -33,11 +33,12 @@ def yolo_grid_sizes(image_size: int) -> Sequence[int]:
 
 def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
-                         mesh=None) -> Callable:
+                         mesh=None, remat: bool = False) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
 
     boxes: (B, N, 4) normalized corner ground truth padded to N=MAX_BOXES;
-    classes: (B, N) int32; valid: (B, N) 0/1.
+    classes: (B, N) int32; valid: (B, N) 0/1. `remat=True` recomputes forward
+    activations in the backward pass (HBM-for-FLOPs, cf. steps.py).
     """
 
     def step(state, images, boxes, classes, valid, rng):
@@ -46,10 +47,18 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
 
-        def loss_fn(params):
-            outputs, mutated = state.apply_fn(
+        def forward(params, images):
+            return state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
+
+        if remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        def loss_fn(params):
+            outputs, mutated = forward(params, images)
             comp = yolo_ops.yolo_loss(y_trues, outputs, boxes, valid, num_classes)
             # mean over the global batch == reference's sum × 1/global_batch_size
             # (`YOLO/tensorflow/train.py:85-91,134-151`)
@@ -170,7 +179,7 @@ class DetectionTrainer(LossWatchedTrainer):
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         self.train_step = make_yolo_train_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat)
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
             compute_dtype=compute_dtype, mesh=self.mesh)

@@ -1,0 +1,96 @@
+"""Pose accuracy evaluation: PCKh (percentage of correct keypoints).
+
+The reference never shipped a pose metric — Hourglass verification was visual
+(`Hourglass/tensorflow/demo_hourglass_pose.ipynb`, SURVEY.md §4). This module
+adds the MPII standard: a predicted joint is correct when its distance to the
+ground truth is below `threshold` × a per-person reference length.
+
+MPII PCKh normalizes by the head-rectangle size; our TFRecords
+(`Datasets/MPII/tfrecords_mpii.py:59-70`) carry joints but no head box, so the
+reference length is the ground-truth head SEGMENT ‖head_top − upper_neck‖
+(MPII joints 9 and 8) — the standard derivable approximation. Persons whose
+head joints are missing are skipped. All coordinates normalized [0, 1]; pass
+`aspect` if width ≠ height so distances are isotropic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+MPII_UPPER_NECK = 8
+MPII_HEAD_TOP = 9
+
+MPII_JOINT_NAMES = ["r_ankle", "r_knee", "r_hip", "l_hip", "l_knee", "l_ankle",
+                    "pelvis", "thorax", "upper_neck", "head_top", "r_wrist",
+                    "r_elbow", "r_shoulder", "l_shoulder", "l_elbow", "l_wrist"]
+
+
+class PoseEvaluator:
+    """Streaming PCKh accumulator over (pred, gt, visibility) keypoint sets."""
+
+    def __init__(self, num_joints: int = 16,
+                 thresholds: Sequence[float] = (0.5,),
+                 head_joints: tuple = (MPII_UPPER_NECK, MPII_HEAD_TOP)):
+        self.num_joints = num_joints
+        self.thresholds = tuple(thresholds)
+        self.head_joints = head_joints
+        # per threshold: per-joint correct counts; shared per-joint totals
+        self._correct = {t: np.zeros(num_joints) for t in self.thresholds}
+        self._total = np.zeros(num_joints)
+
+    def add_batch(self, pred_x, pred_y, gt_x, gt_y, visibility,
+                  aspect: float = 1.0) -> None:
+        """All arrays (B, K), coordinates in [0, 1]; visibility > 0 marks
+        joints that exist (converter writes 0/2). `aspect` = width/height."""
+        pred_x, pred_y, gt_x, gt_y = (np.asarray(a, np.float64)
+                                      for a in (pred_x, pred_y, gt_x, gt_y))
+        vis = np.asarray(visibility) > 0
+        a, b = self.head_joints
+        head = np.sqrt(((gt_x[:, a] - gt_x[:, b]) * aspect) ** 2 +
+                       (gt_y[:, a] - gt_y[:, b]) ** 2)       # (B,)
+        ok_person = vis[:, a] & vis[:, b] & (head > 1e-6)
+        dist = np.sqrt(((pred_x - gt_x) * aspect) ** 2 + (pred_y - gt_y) ** 2)
+        # joints counted only when the joint AND the head reference exist
+        counted = vis & ok_person[:, None] & (gt_x >= 0) & (gt_y >= 0)
+        self._total += counted.sum(axis=0)
+        for t in self.thresholds:
+            hit = counted & (dist <= t * head[:, None])
+            self._correct[t] += hit.sum(axis=0)
+
+    def summarize(self, joint_names: Optional[Sequence[str]] = None
+                  ) -> Dict[str, float]:
+        """{"PCKh@<t>": mean over joints with data, "PCKh@<t>/<joint>": ...}."""
+        names = joint_names or MPII_JOINT_NAMES
+        out: Dict[str, float] = {}
+        for t in self.thresholds:
+            per_joint = []
+            for j in range(self.num_joints):
+                if self._total[j] == 0:
+                    continue
+                v = float(self._correct[t][j] / self._total[j])
+                label = names[j] if j < len(names) else f"joint{j}"
+                out[f"PCKh@{t:g}/{label}"] = v
+                per_joint.append(v)
+            out[f"PCKh@{t:g}"] = float(np.mean(per_joint)) if per_joint else 0.0
+        return out
+
+
+def evaluate_pckh(state, batches, *, num_joints: int = 16,
+                  thresholds: Sequence[float] = (0.5,)) -> Dict[str, float]:
+    """Run the pose model over (images, kp_x, kp_y, visibility) batches and
+    return PCKh metrics. Predictions come from the LAST stack's heatmaps
+    (intermediate heads are train-time supervision only)."""
+    import jax.numpy as jnp
+
+    from ..ops.heatmap import decode_keypoints
+
+    ev = PoseEvaluator(num_joints=num_joints, thresholds=thresholds)
+    for images, kp_x, kp_y, vis in batches:
+        outputs = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.asarray(images), train=False)
+        px, py, _ = decode_keypoints(outputs[-1])
+        ev.add_batch(np.asarray(px), np.asarray(py), kp_x, kp_y, vis)
+    return ev.summarize()

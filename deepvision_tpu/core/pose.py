@@ -43,10 +43,12 @@ def weighted_mse_loss(labels: jnp.ndarray, outputs) -> jnp.ndarray:
 
 def make_pose_train_step(*, heatmap_size: Tuple[int, int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
-                         mesh=None) -> Callable:
+                         mesh=None, remat: bool = False) -> Callable:
     """(state, images, kp_x, kp_y, visibility, rng) -> (state, metrics).
 
-    kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K).
+    kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K). `remat=True`
+    recomputes forward activations in the backward pass — hourglass stacks are
+    activation-heavy, so this is the main big-batch lever (cf. steps.py).
     """
     h, w = heatmap_size
 
@@ -57,10 +59,18 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
 
-        def loss_fn(params):
-            outputs, mutated = state.apply_fn(
+        def forward(params, images):
+            return state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
+
+        if remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        def loss_fn(params):
+            outputs, mutated = forward(params, images)
             return weighted_mse_loss(labels, outputs), mutated
 
         (loss, mutated), grads = jax.value_and_grad(
@@ -114,6 +124,7 @@ class PoseTrainer(LossWatchedTrainer):
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         self.train_step = make_pose_train_step(
-            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
+            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
+            remat=config.remat)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)

@@ -30,18 +30,33 @@ def make_classification_train_step(
     compute_dtype: jnp.dtype = jnp.bfloat16,
     donate: bool = True,
     mesh: Optional[Mesh] = None,
+    remat: bool = False,
 ) -> Callable:
-    """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step."""
+    """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step.
+
+    `remat=True` wraps the forward in `jax.checkpoint`: activations are
+    recomputed during the backward pass instead of living in HBM — the standard
+    TPU lever for batch sizes / model depths that don't otherwise fit
+    (dot-products still saved via the dots_with_no_batch_dims policy).
+    """
 
     def step(state: TrainState, images, labels, rng):
         images = images.astype(compute_dtype)
 
-        def loss_fn(params):
-            outputs, mutated = state.apply_fn(
+        def forward(params, images):
+            return state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"],
                 rngs={"dropout": jax.random.fold_in(rng, state.step)},
             )
+
+        if remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        def loss_fn(params):
+            outputs, mutated = forward(params, images)
             loss = losses.classification_loss(
                 outputs, labels, label_smoothing=label_smoothing, aux_weight=aux_weight)
             return loss, (outputs, mutated)

@@ -79,7 +79,8 @@ class Trainer:
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         self.train_step = steps.make_classification_train_step(
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh,
+            remat=config.remat)
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh)
 

@@ -64,3 +64,47 @@ def test_epoch_metrics_present_even_below_log_interval(tmp_path):
     assert "epoch_train_loss" in hist and "epoch_train_top1" in hist
     assert tr.best_metric is not None
     tr.close()
+
+
+def test_remat_step_matches_plain_step(mesh8):
+    """jax.checkpoint is semantically transparent: one remat step produces the
+    same params/metrics as the plain step (HBM-for-FLOPs trade only)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    model = MODELS.get("lenet5")(num_classes=10)
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 32, 32, 1)))
+    tx = build_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1),
+                         ScheduleConfig(name="constant"), 10, 10)
+
+    rs = np.random.RandomState(0)
+    images = rs.rand(8, 32, 32, 1).astype(np.float32)
+    labels = rs.randint(0, 10, 8).astype(np.int32)
+    batch = mesh_lib.shard_batch_pytree(mesh8, (images, labels))
+    rng = jax.random.PRNGKey(1)
+
+    results = {}
+    for remat in (False, True):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        state = jax.device_put(state, mesh_lib.replicated(mesh8))
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, mesh=mesh8, remat=remat,
+            donate=False)  # both iterations reuse the same param buffers
+        new_state, metrics = step(state, *batch, rng)
+        results[remat] = (jax.device_get(new_state.params),
+                          jax.device_get(metrics))
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        results[False][0], results[True][0])
+    np.testing.assert_allclose(results[False][1]["loss"],
+                               results[True][1]["loss"], rtol=1e-6)

@@ -1,0 +1,93 @@
+"""PCKh evaluator fixtures (core/eval_pose.py) — the pose metric the reference
+never shipped."""
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.core.eval_pose import (MPII_HEAD_TOP, MPII_UPPER_NECK,
+                                           PoseEvaluator, evaluate_pckh)
+
+
+def _gt(batch=1, k=16):
+    """GT with head segment of length 0.2 and all joints visible."""
+    gt_x = np.full((batch, k), 0.5)
+    gt_y = np.full((batch, k), 0.5)
+    gt_y[:, MPII_HEAD_TOP] = 0.3
+    gt_y[:, MPII_UPPER_NECK] = 0.5
+    vis = np.full((batch, k), 2)
+    return gt_x, gt_y, vis
+
+
+class TestPCKh:
+    def test_perfect_predictions(self):
+        gt_x, gt_y, vis = _gt()
+        ev = PoseEvaluator()
+        ev.add_batch(gt_x, gt_y, gt_x, gt_y, vis)
+        s = ev.summarize()
+        assert s["PCKh@0.5"] == pytest.approx(1.0)
+        assert s["PCKh@0.5/r_ankle"] == pytest.approx(1.0)
+
+    def test_threshold_boundary(self):
+        # head length 0.2 → PCKh@0.5 radius = 0.1; offset one joint by 0.15
+        gt_x, gt_y, vis = _gt()
+        pred_x, pred_y = gt_x.copy(), gt_y.copy()
+        pred_x[0, 0] += 0.15
+        ev = PoseEvaluator(thresholds=(0.5, 1.0))
+        ev.add_batch(pred_x, pred_y, gt_x, gt_y, vis)
+        s = ev.summarize()
+        assert s["PCKh@0.5/r_ankle"] == pytest.approx(0.0)   # 0.15 > 0.1
+        assert s["PCKh@1/r_ankle"] == pytest.approx(1.0)     # 0.15 < 0.2
+        assert s["PCKh@0.5"] == pytest.approx(15 / 16)
+
+    def test_invisible_joints_not_counted(self):
+        gt_x, gt_y, vis = _gt()
+        vis[0, 3] = 0
+        pred_x = gt_x.copy()
+        pred_x[0, 3] = 0.0  # grossly wrong but invisible → ignored
+        ev = PoseEvaluator()
+        ev.add_batch(pred_x, gt_y, gt_x, gt_y, vis)
+        s = ev.summarize()
+        assert "PCKh@0.5/l_hip" not in s  # no counted examples for joint 3
+        assert s["PCKh@0.5"] == pytest.approx(1.0)
+
+    def test_missing_head_skips_person(self):
+        gt_x, gt_y, vis = _gt(batch=2)
+        vis[1, MPII_HEAD_TOP] = 0  # person 2 has no head reference
+        ev = PoseEvaluator()
+        ev.add_batch(gt_x, gt_y, gt_x, gt_y, vis)
+        assert ev._total[0] == 1  # only person 1 counted
+
+    def test_aspect_scaling(self):
+        # x-offset of 0.06 at aspect 2.0 → isotropic distance 0.12 > 0.1
+        gt_x, gt_y, vis = _gt()
+        pred_x = gt_x.copy()
+        pred_x[0, 0] += 0.06
+        ev = PoseEvaluator()
+        ev.add_batch(pred_x, gt_y, gt_x, gt_y, vis, aspect=2.0)
+        assert ev.summarize()["PCKh@0.5/r_ankle"] == pytest.approx(0.0)
+
+
+def test_evaluate_pckh_end_to_end():
+    """Tiny hourglass + synthetic pose batches: the full device path runs and
+    returns well-formed PCKh metrics."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.data.pose import synthetic_batches
+    from deepvision_tpu.models.hourglass import StackedHourglass
+
+    model = StackedHourglass(num_heatmap=16, num_stack=1, order=2,
+                             width_mult=0.125, dtype=jnp.float32)
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 64, 64, 3)))
+    tx = build_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3),
+                         ScheduleConfig(name="constant"), 10, 10)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+
+    metrics = evaluate_pckh(state, synthetic_batches(batch_size=2,
+                                                     image_size=64, steps=1))
+    assert "PCKh@0.5" in metrics
+    assert 0.0 <= metrics["PCKh@0.5"] <= 1.0
