@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""CenterNet mAP evaluation on the val split — past where the reference's WIP
+family stopped (`ObjectsAsPoints/tensorflow/train.py:248` disabled runner).
+
+Usage:
+    python evaluate.py --data-dir dataset/tfrecords --metric coco
+    python evaluate.py --synthetic           # smoke, random weights
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-c", "--checkpoint", default="latest")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--metric", default="coco", choices=["coco", "voc", "voc07"])
+    p.add_argument("--score-thresh", type=float, default=0.05)
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--max-batches", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import itertools
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.centernet import CenterNetTrainer, evaluate_map
+
+    cfg = get_config("centernet")
+    trainer = CenterNetTrainer(
+        cfg, workdir=args.workdir or os.path.join("runs", cfg.name))
+    size = 128 if args.synthetic else cfg.data.image_size
+    trainer.init_state((size, size, 3))
+    if not args.synthetic and trainer.resume(
+            None if args.checkpoint == "latest" else int(args.checkpoint)) is None:
+        print("WARNING: no checkpoint found — evaluating random weights")
+
+    if args.synthetic:
+        from deepvision_tpu.data.detection import synthetic_batches
+        batches = synthetic_batches(batch_size=2, image_size=size,
+                                    num_classes=cfg.data.num_classes, steps=2)
+    else:
+        from deepvision_tpu.data.detection import build_dataset
+        data_dir = args.data_dir or cfg.data.data_dir or "dataset/tfrecords"
+        ds = build_dataset(os.path.join(data_dir, "val*"),
+                           batch_size=cfg.batch_size, image_size=size,
+                           training=False, with_difficult=True,
+                           drop_remainder=False)
+        batches = (tuple(t.numpy() for t in b) for b in ds)
+    if args.max_batches:
+        batches = itertools.islice(batches, args.max_batches)
+
+    metrics = evaluate_map(trainer.state, batches,
+                           num_classes=cfg.data.num_classes,
+                           metric=args.metric, score_thresh=args.score_thresh)
+    trainer.close()
+    for k in sorted(metrics):
+        if k.startswith("mAP"):
+            print(f"{k}: {metrics[k]:.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,20 @@ import numpy as np
 P100_BASELINE_IMG_PER_SEC = 230.0
 
 
+def _devices_with_cpu_fallback():
+    """jax.devices(), falling back to CPU if the TPU backend is unreachable
+    (tunnel flakes must yield a number, not a crash)."""
+    import sys
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        # stderr only — stdout is the one-JSON-line contract
+        print(f"TPU backend unavailable ({e}); falling back to CPU",
+              file=sys.stderr, flush=True)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def main():
     from deepvision_tpu.core import steps
     from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
@@ -27,7 +41,7 @@ def main():
     from deepvision_tpu.models import MODELS
     from deepvision_tpu.parallel import mesh as mesh_lib
 
-    n_dev = len(jax.devices())
+    n_dev = len(_devices_with_cpu_fallback())
     mesh = mesh_lib.make_mesh()
     platform = jax.devices()[0].platform
     batch = 256 if platform == "tpu" else 32  # per-chip ImageNet batch

@@ -145,15 +145,9 @@ def evaluate_map(state, batches, *, num_classes: int, metric: str = "coco",
     standard evaluators do. This is the evaluator the reference never shipped
     (`YOLO/tensorflow/README.md:29`).
     """
-    from .eval_detection import DetectionEvaluator, coco_evaluator, voc_evaluator
+    from .eval_detection import make_evaluator
 
-    if metric == "coco":
-        ev = coco_evaluator(num_classes)
-    elif metric in ("voc", "voc07"):
-        ev = voc_evaluator(num_classes, use_07_metric=(metric == "voc07"))
-    else:
-        raise ValueError(f"unknown metric {metric!r}")
-
+    ev = make_evaluator(metric, num_classes)
     predict = make_predict_step(compute_dtype=compute_dtype,
                                 iou_thresh=iou_thresh, score_thresh=score_thresh)
     for batch in batches:

@@ -239,6 +239,16 @@ class DetectionEvaluator:
         return out
 
 
+def make_evaluator(metric: str, num_classes: int) -> "DetectionEvaluator":
+    """Dispatch on the metric name shared by every detector family's eval CLI:
+    "coco" → mAP@[.5:.95], "voc" → all-point mAP@0.5, "voc07" → 11-point."""
+    if metric == "coco":
+        return coco_evaluator(num_classes)
+    if metric in ("voc", "voc07"):
+        return voc_evaluator(num_classes, use_07_metric=(metric == "voc07"))
+    raise ValueError(f"unknown metric {metric!r}")
+
+
 def coco_evaluator(num_classes: int) -> DetectionEvaluator:
     """mAP@[.5:.95] evaluator (COCO primary metric, pycocotools matching)."""
     return DetectionEvaluator(num_classes, COCO_IOU_THRESHOLDS, ap_mode="area",

@@ -160,3 +160,31 @@ def test_centernet_train_step_decreases_loss(mesh8):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_centernet_evaluate_map_end_to_end():
+    """Tiny CenterNet + synthetic batches through decode → evaluator."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.centernet import evaluate_map
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.data.detection import synthetic_batches
+    from deepvision_tpu.models.centernet import ObjectsAsPoints
+
+    num_classes = 4
+    model = ObjectsAsPoints(num_classes=num_classes, num_stack=1, order=2,
+                            width_mult=0.125, dtype=jnp.float32)
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 128, 128, 3)))
+    tx = build_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3),
+                         ScheduleConfig(name="constant"), 10, 10)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+
+    metrics = evaluate_map(
+        state, synthetic_batches(batch_size=2, image_size=128,
+                                 num_classes=num_classes, steps=1),
+        num_classes=num_classes, metric="voc", compute_dtype=jnp.float32)
+    assert "mAP@0.5" in metrics and 0.0 <= metrics["mAP@0.5"] <= 1.0

@@ -105,3 +105,17 @@ def test_metrics_logger_tensorboard(tmp_path):
     assert os.path.isdir(tb_dir) and any(
         "tfevents" in f for f in os.listdir(tb_dir))
     assert os.path.exists(os.path.join(str(tmp_path), "t.jsonl"))
+
+
+@pytest.mark.slow
+def test_golden_lenet_synthetic_accuracy(tmp_path):
+    """Golden integration run (SURVEY.md §4's LeNet/MNIST smoke role, on the
+    learnable synthetic backend): a few epochs must reach high val top-1."""
+    from deepvision_tpu.cli import run_classification
+
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "4", "--batch-size",
+              "32", "--steps-per-epoch", "16", "--learning-rate", "0.003",
+              "--workdir", str(tmp_path)])
+    assert result["best_metric"] > 0.9, result
