@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Fetch a CycleGAN pair dataset and build its TFRecords
+# (`CycleGAN/tensorflow/setup.sh` role). Usage: ./setup.sh [monet2photo]
+set -euo pipefail
+DATASET="${1:-monet2photo}"
+BASE_URL="https://people.eecs.berkeley.edu/~taesung_park/CycleGAN/datasets"
+
+mkdir -p datasets
+if [ ! -d "datasets/${DATASET}" ]; then
+  wget "${BASE_URL}/${DATASET}.zip"
+  # extract to a temp dir and move into place so an interrupted unzip can't
+  # leave a partial datasets/${DATASET}/ that later runs mistake for complete
+  TMP="$(mktemp -d datasets/.extract.XXXXXX)"
+  unzip -q "${DATASET}.zip" -d "${TMP}"
+  mv "${TMP}/${DATASET}" "datasets/${DATASET}"
+  rmdir "${TMP}"
+  rm "${DATASET}.zip"
+fi
+python tfrecords.py --dataset "${DATASET}"
+echo "done: tfrecords/${DATASET}"

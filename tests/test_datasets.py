@@ -133,3 +133,57 @@ def test_chunkify_covers_everything():
     chunks = chunkify(items, 3)
     assert len(chunks) == 3
     assert sorted(sum(chunks, [])) == items
+
+
+class TestFlattenScript:
+    def test_train_and_val_flatten(self, tmp_path):
+        """flatten.py: per-synset train dirs and labeled val files land as
+        <synset>_<name>.JPEG hard links the flat loader can read."""
+        import subprocess
+        import sys
+
+        train = tmp_path / "train"
+        for syn in ["n01440764", "n01443537"]:
+            d = train / syn
+            d.mkdir(parents=True)
+            (d / f"{syn}_1.JPEG").write_bytes(b"fake")
+            (d / "oddname_2.JPEG").write_bytes(b"fake")  # no synset prefix
+        val = tmp_path / "validation"
+        val.mkdir()
+        for i in (1, 2):
+            (val / f"ILSVRC2012_val_{i:08d}.JPEG").write_bytes(b"fake")
+        labels = tmp_path / "val_labels.txt"
+        labels.write_text("n01443537\nn01440764\n")
+
+        script = os.path.join(os.path.dirname(__file__), "..",
+                              "Datasets", "ILSVRC2012", "flatten.py")
+        subprocess.run([sys.executable, script, "--train-dir", str(train),
+                        "--out", str(tmp_path / "train_flatten")], check=True)
+        subprocess.run([sys.executable, script, "--val-dir", str(val),
+                        "--val-labels", str(labels),
+                        "--out", str(tmp_path / "val_flatten")], check=True)
+
+        train_out = sorted(os.listdir(tmp_path / "train_flatten"))
+        assert train_out == ["n01440764_1.JPEG", "n01440764_oddname_2.JPEG",
+                             "n01443537_1.JPEG", "n01443537_oddname_2.JPEG"]
+        val_out = sorted(os.listdir(tmp_path / "val_flatten"))
+        assert val_out == ["n01440764_val_00000002.JPEG",
+                           "n01443537_val_00000001.JPEG"]
+
+    def test_val_count_mismatch_exits(self, tmp_path):
+        import subprocess
+        import sys
+
+        val = tmp_path / "validation"
+        val.mkdir()
+        (val / "ILSVRC2012_val_00000001.JPEG").write_bytes(b"fake")
+        labels = tmp_path / "val_labels.txt"
+        labels.write_text("n01443537\nn01440764\n")  # 2 labels, 1 file
+        script = os.path.join(os.path.dirname(__file__), "..",
+                              "Datasets", "ILSVRC2012", "flatten.py")
+        r = subprocess.run(
+            [sys.executable, script, "--val-dir",
+             str(val), "--val-labels", str(labels),
+             "--out", str(tmp_path / "out")], capture_output=True)
+        assert r.returncode != 0
+        assert b"ERROR" in r.stderr  # the mismatch message, not a launch failure
