@@ -20,12 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from Datasets.common import (build_tfrecords, bytes_feature,  # noqa: E402
                              bytes_list_feature, float_feature, int64_feature)
-
-VOC_CLASS_NAMES = [
-    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
-    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
-    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
-]
+from deepvision_tpu.data.class_names import VOC_CLASS_NAMES  # noqa: E402
 
 
 def parse_one_xml(xml_path: str, image_dir: str, names_map: dict) -> dict:

@@ -51,13 +51,15 @@ def main():
     nms_boxes, nms_scores, nms_classes, counts = predict(trainer.state, images)
     trainer.close()
 
+    from deepvision_tpu.data.class_names import names_for
+    names = names_for(cfg.data.num_classes)
     for i, path in enumerate(args.images):
         n = int(counts[i])
         print(f"{path}: {n} detections")
         for d in range(n):
             x1, y1, x2, y2 = np.asarray(nms_boxes[i, d])
             cls = int(jnp.argmax(nms_classes[i, d]))
-            print(f"  class={cls} score={float(nms_scores[i, d]):.3f} "
+            print(f"  {names[cls]} score={float(nms_scores[i, d]):.3f} "
                   f"box=({x1:.3f},{y1:.3f},{x2:.3f},{y2:.3f})")
 
 

@@ -69,13 +69,14 @@ def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,
     data = cfg.data
     data_dir = args.data_dir or data.data_dir or default_dir
     per_host = cfg.batch_size // jax.process_count()
-    common = dict(batch_size=per_host, image_size=data.image_size,
+    eval_per_host = (cfg.eval_batch_size or cfg.batch_size) // jax.process_count()
+    common = dict(image_size=data.image_size,
                   num_process=jax.process_count(),
                   process_index=jax.process_index())
     train_ds = build_dataset(os.path.join(data_dir, "train*"), training=True,
-                             **common)
+                             batch_size=per_host, **common)
     val_ds = build_dataset(os.path.join(data_dir, "val*"), training=False,
-                           **common)
+                           batch_size=eval_per_host, **common)
     # imagenet repeats its dataset → always bound each epoch; detection/pose
     # datasets are single-pass per epoch (reference semantics) → iterate fully
     # unless --steps-per-epoch explicitly bounds them
@@ -174,8 +175,9 @@ def _classification_data(cfg, args):
                                 seed=epoch)
 
         def val_fn(epoch):
-            return MnistBatches(test_x, test_y, cfg.batch_size, shuffle=False,
-                                drop_remainder=False)
+            return MnistBatches(test_x, test_y,
+                                cfg.eval_batch_size or cfg.batch_size,
+                                shuffle=False, drop_remainder=False)
     elif data.dataset == "imagenet":
         from .data import imagenet as inet
         return _tfrecord_data(inet.build_dataset, cfg, args, "dataset/tfrecord",
@@ -190,17 +192,20 @@ def _classification_data(cfg, args):
         from .data.imagenet_flat import FlatImageNet
         data_dir = args.data_dir or data.data_dir or "dataset"
         synsets = os.path.join(data_dir, "synsets.txt")
-        common = dict(batch_size=cfg.batch_size // jax.process_count(),
-                      image_size=data.image_size,
+        common = dict(image_size=data.image_size,
                       num_shards=jax.process_count(),
                       shard_index=jax.process_index())
         steps = args.steps_per_epoch
         # one instance per split: the directory scan happens once, and
         # FlatImageNet reshuffles internally on each __iter__ (epoch bump)
         train_ds = FlatImageNet(os.path.join(data_dir, "train_flatten"),
-                                synsets, training=True, **common)
-        val_ds = FlatImageNet(os.path.join(data_dir, "val_flatten"),
-                              synsets, training=False, **common)
+                                synsets, training=True,
+                                batch_size=cfg.batch_size // jax.process_count(),
+                                **common)
+        val_ds = FlatImageNet(
+            os.path.join(data_dir, "val_flatten"), synsets, training=False,
+            batch_size=(cfg.eval_batch_size or cfg.batch_size)
+            // jax.process_count(), **common)
 
         def train_fn(epoch, _ds=train_ds, _steps=steps):
             return itertools.islice(iter(_ds), _steps) if _steps else _ds

@@ -1,0 +1,39 @@
+"""Class-name tables for display and evaluation output.
+
+The reference ships these as metadata files (`Datasets/MSCOCO/
+mscoco_2017_names.txt`, `Datasets/VOC200*/voc_*_names.txt`); here they are
+importable constants (the VOC list also drives the converter's label ids,
+`Datasets/voc.py`). Index == class id as written by the converters.
+"""
+
+VOC_CLASS_NAMES = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+
+# MSCOCO 2017, the 80 detection categories in annotation-id order
+COCO_CLASS_NAMES = [
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep", "cow",
+    "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella", "handbag",
+    "tie", "suitcase", "frisbee", "skis", "snowboard", "sports ball", "kite",
+    "baseball bat", "baseball glove", "skateboard", "surfboard",
+    "tennis racket", "bottle", "wine glass", "cup", "fork", "knife", "spoon",
+    "bowl", "banana", "apple", "sandwich", "orange", "broccoli", "carrot",
+    "hot dog", "pizza", "donut", "cake", "chair", "couch", "potted plant",
+    "bed", "dining table", "toilet", "tv", "laptop", "mouse", "remote",
+    "keyboard", "cell phone", "microwave", "oven", "toaster", "sink",
+    "refrigerator", "book", "clock", "vase", "scissors", "teddy bear",
+    "hair drier", "toothbrush",
+]
+
+
+def names_for(dataset_num_classes: int):
+    """Best-effort table by class count (80 → COCO, 20 → VOC, else ids)."""
+    if dataset_num_classes == len(COCO_CLASS_NAMES):
+        return COCO_CLASS_NAMES
+    if dataset_num_classes == len(VOC_CLASS_NAMES):
+        return VOC_CLASS_NAMES
+    return [str(i) for i in range(dataset_num_classes)]
