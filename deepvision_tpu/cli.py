@@ -39,6 +39,8 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                         "layout instead of TFRecords)")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--eval-batch-size", type=int, default=None,
+                   help="validation batch size (defaults to --batch-size)")
     p.add_argument("--learning-rate", type=float, default=None,
                    help="override the config's base learning rate")
     p.add_argument("--num-classes", type=int, default=None,
@@ -107,6 +109,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(total_epochs=args.epochs)
     if args.batch_size:
         cfg = cfg.replace(batch_size=args.batch_size)
+    if args.eval_batch_size:
+        cfg = cfg.replace(eval_batch_size=args.eval_batch_size)
     if args.learning_rate:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, learning_rate=args.learning_rate))

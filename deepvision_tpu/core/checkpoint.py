@@ -25,12 +25,16 @@ from .train_state import TrainState
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, keep_best: bool = True,
-                 best_mode: str = "max"):
+                 best_mode: str = "max", async_save: bool = True):
+        """`async_save=True` (SURVEY.md §5.4's async-save goal): `save()`
+        kicks off the write in a background thread and training continues on
+        device; `restore()`/`close()` barrier on any in-flight save."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
         self.keep_best = keep_best
         self.best_mode = best_mode
+        self.async_save = async_save
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -39,7 +43,7 @@ class CheckpointManager:
                 best_mode=best_mode if keep_best else "max",
                 keep_checkpoints_without_metrics=True,
                 create=True,
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_save,
             ),
         )
 
@@ -70,18 +74,22 @@ class CheckpointManager:
             ),
             metrics=metrics,
         )
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
 
     def latest_epoch(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def best_epoch(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.best_step()
 
     def restore(self, state, epoch: Optional[int] = None):
         """Restore into an abstract/concrete template (TrainState or pytree);
         returns (state, host_state, epoch). `epoch=None` → latest
         (auto-resume-from-latest)."""
+        self._mgr.wait_until_finished()  # barrier on any in-flight async save
         if epoch is None:
             epoch = self._mgr.latest_step()
         if epoch is None:
@@ -104,4 +112,5 @@ class CheckpointManager:
         return new_state, dict(restored["host"] or {}), epoch
 
     def close(self):
+        self._mgr.wait_until_finished()
         self._mgr.close()

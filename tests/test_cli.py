@@ -53,3 +53,14 @@ def test_seed_and_lr_overrides_parse(tmp_path):
               "16", "--steps-per-epoch", "2", "--seed", "7",
               "--learning-rate", "0.01", "--workdir", str(tmp_path)])
     assert "best_metric" in result
+
+
+def test_eval_batch_size_flag(tmp_path):
+    """--eval-batch-size reaches the val pipeline (synthetic path ignores it,
+    mnist/tfrecord/flat honor it) — here we just assert the config override."""
+    from deepvision_tpu.cli import build_parser
+    args = build_parser("LeNet", ["lenet5"]).parse_args(
+        ["-m", "lenet5", "--eval-batch-size", "64"])
+    assert args.eval_batch_size == 64
+    cfg = get_config("lenet5").replace(eval_batch_size=args.eval_batch_size)
+    assert (cfg.eval_batch_size or cfg.batch_size) == 64
