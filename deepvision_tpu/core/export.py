@@ -14,11 +14,100 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional, Sequence
 
+import jax
+import jax.numpy as jnp
+
 
 def _tf():
     import tensorflow as tf
     tf.config.set_visible_devices([], "GPU")
     return tf
+
+
+def _zero_stuff(x, dilation, lhs_spec):
+    """Insert `d-1` zeros between elements along each spatial dim — the
+    explicit form of `lhs_dilation` (expand→concat-zeros→reshape→slice, all
+    ops TFLite converts natively)."""
+    spatial_dims = lhs_spec[2:]
+    for dim, d in zip(spatial_dims, dilation):
+        if d <= 1:
+            continue
+        n = x.shape[dim]
+        xe = jnp.expand_dims(x, dim + 1)
+        zeros = jnp.zeros_like(xe)
+        y = jnp.concatenate([xe] + [zeros] * (d - 1), axis=dim + 1)
+        new_shape = list(x.shape)
+        new_shape[dim] = n * d
+        y = y.reshape(new_shape)
+        idx = [slice(None)] * y.ndim
+        idx[dim] = slice(0, n * d - (d - 1))
+        x = y[tuple(idx)]
+    return x
+
+
+def rewrite_transposed_convs(fn: Callable) -> Callable:
+    """Re-express lhs-dilated convolutions (ConvTranspose / fractional stride)
+    as explicit zero-insertion + plain convolution before export.
+
+    TFLite's converter mis-lowers lhs-dilated convs — it emits TRANSPOSE_CONV
+    without the SAME-padding crop, so outputs come back the wrong shape/values
+    (verified: (1,8,8,3)→(1,18,18,4) instead of (1,16,16,4)). Zero-stuffing is
+    the *definition* of lhs_dilation, and the conv's explicit padding numbers
+    carry over verbatim, so this rewrite is exact (float round-off only) and a
+    no-op for models without transposed convs.
+    """
+
+    def _eval(jaxpr, consts, *args):
+        from jax.extend.core import Literal
+        env = {}
+
+        def read(v):
+            return v.val if isinstance(v, Literal) else env[v]
+
+        for var, val in zip(jaxpr.invars, args):
+            env[var] = val
+        for cv, cval in zip(jaxpr.constvars, consts):
+            env[cv] = cval
+        for eqn in jaxpr.eqns:
+            vals = [read(v) for v in eqn.invars]
+            params = dict(eqn.params)
+            name = eqn.primitive.name
+            if (name == "conv_general_dilated"
+                    and any(d > 1 for d in params["lhs_dilation"])):
+                dn = params["dimension_numbers"]
+                x = _zero_stuff(vals[0], params["lhs_dilation"], dn.lhs_spec)
+                params["lhs_dilation"] = (1,) * len(params["lhs_dilation"])
+                outs = [eqn.primitive.bind(x, vals[1], **params)]
+            elif name in ("custom_jvp_call", "custom_vjp_call"):
+                # can't re-bind (expects live callables); recurse into the
+                # primal jaxpr — export is inference-only, no grads needed
+                sub = params["call_jaxpr"]
+                outs = _eval(sub.jaxpr, sub.consts, *vals)
+            elif name in ("jit", "pjit", "closed_call"):
+                sub = params["jaxpr"]  # ClosedJaxpr
+                outs = _eval(sub.jaxpr, sub.consts, *vals)
+            elif name in ("remat2", "remat", "checkpoint"):
+                # remat carries an OPEN Jaxpr (consts hoisted into invars)
+                outs = _eval(params["jaxpr"], [], *vals)
+            else:
+                out = eqn.primitive.bind(*vals, **params)
+                outs = out if eqn.primitive.multiple_results else [out]
+            for v, o in zip(eqn.outvars, outs):
+                env[v] = o
+        return [read(v) for v in jaxpr.outvars]
+
+    def wrapped(*args):
+        flat, in_tree = jax.tree_util.tree_flatten(args)
+
+        def flat_fn(*flat_args):
+            return fn(*jax.tree_util.tree_unflatten(in_tree, flat_args))
+
+        closed, out_shape = jax.make_jaxpr(flat_fn, return_shape=True)(*flat)
+        out_tree = jax.tree_util.tree_structure(out_shape)
+        outs = _eval(closed.jaxpr, closed.consts, *flat)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return wrapped
 
 
 def export_saved_model(apply_fn: Callable, variables, input_shape: Sequence[int],
@@ -32,8 +121,9 @@ def export_saved_model(apply_fn: Callable, variables, input_shape: Sequence[int]
     tf = _tf()
     from jax.experimental import jax2tf
 
-    tf_fn = jax2tf.convert(lambda x: apply_fn(variables, x),
-                           with_gradient=False)
+    tf_fn = jax2tf.convert(
+        rewrite_transposed_convs(lambda x: apply_fn(variables, x)),
+        with_gradient=False)
     module = tf.Module()
     module.serve = tf.function(
         tf_fn,

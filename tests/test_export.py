@@ -63,3 +63,82 @@ def test_tflite_roundtrip(tmp_path, lenet_fn_and_vars):
     interp.invoke()
     got = interp.get_tensor(interp.get_output_details()[0]["index"])
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_cyclegan_generator_tflite(tmp_path):
+    """The shipped convert.py path on a small generator: reflection pads,
+    transposed convs, and instance/batch norm all survive jax2tf → TFLite."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.export import export_tflite
+    from deepvision_tpu.core.train_state import init_model
+    from deepvision_tpu.models.gan import CycleGANGenerator
+
+    model = CycleGANGenerator(n_blocks=1)
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0),
+                                     jnp.zeros((1, 64, 64, 3)))
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+
+    def apply_fn(v, x):
+        return model.apply(v, x, train=False)
+
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype(np.float32) * 2 - 1
+    expected = np.asarray(apply_fn(variables, x))
+
+    out = str(tmp_path / "gen.tflite")
+    export_tflite(apply_fn, variables, (64, 64, 3), out, optimize=False)
+    interp = tf.lite.Interpreter(model_path=out)
+    interp.allocate_tensors()
+    interp.set_tensor(interp.get_input_details()[0]["index"], x)
+    interp.invoke()
+    got = interp.get_tensor(interp.get_output_details()[0]["index"])
+    assert got.shape == expected.shape == (1, 64, 64, 3)
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_rewrite_transposed_convs_exact_dcgan():
+    """Pure-JAX parity of the export rewrite on the DCGAN generator (k=5 s=2
+    transposed convs): zero-stuff + plain conv must match lhs-dilation exactly."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.export import rewrite_transposed_convs
+    from deepvision_tpu.core.train_state import init_model
+    from deepvision_tpu.models.gan import DCGANGenerator
+
+    model = DCGANGenerator()
+    noise = jnp.asarray(np.random.RandomState(0).randn(2, 100).astype(np.float32))
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0), noise)
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+
+    def fn(z):
+        return model.apply(variables, z, train=False)
+
+    expected = np.asarray(fn(noise))
+    got = np.asarray(rewrite_transposed_convs(fn)(noise))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_rewrite_reaches_through_jit_and_remat():
+    """jit- and remat-wrapped functions must still get the lhs-dilation
+    rewrite (the natural way callers pass an apply_fn)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.export import rewrite_transposed_convs
+
+    ct = nn.ConvTranspose(4, (3, 3), strides=(2, 2), padding="SAME")
+    v = ct.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 8, 3).astype(np.float32))
+    base = lambda xx: ct.apply(v, xx)  # noqa: E731
+    expected = np.asarray(base(x))
+
+    for wrap in (jax.jit(base), jax.checkpoint(base)):
+        rewritten = rewrite_transposed_convs(wrap)
+        jaxpr_str = str(jax.make_jaxpr(rewritten)(x))
+        assert "lhs_dilation=(2, 2)" not in jaxpr_str, "rewrite bypassed"
+        np.testing.assert_allclose(np.asarray(rewritten(x)), expected,
+                                   rtol=1e-5, atol=1e-6)

@@ -330,3 +330,48 @@ def test_yolo_train_step_decreases_loss(mesh8):
     assert losses[-1] < losses[0]
     for k in ("xy_loss", "wh_loss", "class_loss", "obj_loss"):
         assert np.isfinite(float(metrics[k]))
+
+
+def test_nms_matches_naive_numpy_reference():
+    """Property test: the fixed-shape lax NMS equals a plain-python greedy NMS
+    on random inputs (same pick order, suppression set, and survivor count)."""
+    import numpy as np
+
+    from deepvision_tpu.ops.nms import batched_nms
+
+    def naive_nms(boxes, scores, iou_thresh, score_thresh, max_det):
+        def iou(a, b):
+            x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+            x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+            inter = max(0.0, min(x2 - x1, 1.0)) * max(0.0, min(y2 - y1, 1.0))
+            ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1])
+            return inter / (ua - inter + 1e-7)
+
+        alive = [i for i in range(len(scores)) if scores[i] >= score_thresh]
+        picks = []
+        while alive and len(picks) < max_det:
+            best = max(alive, key=lambda i: scores[i])
+            picks.append(best)
+            alive = [i for i in alive
+                     if i != best and iou(boxes[best], boxes[i]) <= iou_thresh]
+        return picks
+
+    rs = np.random.RandomState(7)
+    for trial in range(5):
+        n = 40
+        xy1 = rs.uniform(0, 0.7, (n, 2))
+        wh = rs.uniform(0.05, 0.35, (n, 2))
+        boxes = np.concatenate([xy1, np.minimum(xy1 + wh, 1.0)], -1).astype(
+            np.float32)
+        scores = rs.uniform(0, 1, n).astype(np.float32)
+        classes = np.eye(3)[rs.randint(0, 3, n)].astype(np.float32)
+
+        picks = naive_nms(boxes, scores, 0.45, 0.3, 10)
+        out_boxes, out_scores, _, count = batched_nms(
+            boxes[None], scores[None], classes[None],
+            iou_thresh=0.45, score_thresh=0.3, max_detection=10)
+        assert int(count[0]) == len(picks), trial
+        np.testing.assert_allclose(np.asarray(out_boxes[0, :len(picks)]),
+                                   boxes[picks], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_scores[0, :len(picks)]),
+                                   scores[picks], atol=1e-6)
