@@ -1,0 +1,21 @@
+# Repo-level targets (per-family Makefiles live in <Family>/jax/).
+PY ?= python
+CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+          XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-all bench dryrun smoke
+
+test:        ## fast suite (slow-marked compiles excluded)
+	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q
+
+test-all:    ## everything, including slow XLA-CPU compiles
+	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q -m ""
+
+bench:       ## ResNet-50 step throughput (TPU if reachable, else CPU)
+	$(PY) bench.py
+
+dryrun:      ## 8-virtual-device multichip compile/exec check
+	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+smoke:       ## one synthetic epoch of the flagship trainer
+	env $(CPU_ENV) $(PY) LeNet/jax/train.py -m lenet5 --synthetic --epochs 1

@@ -10,6 +10,8 @@ thin wrappers that build a TrainConfig and call `Trainer.fit()`.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Any, Callable, Iterable, Optional
 
@@ -61,6 +63,19 @@ class Trainer:
         self.workdir = workdir or config.checkpoint_dir
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             model_parallel=config.model_parallel)
+
+        # a workdir can pin model kwargs (e.g. stride_on_first for imported
+        # torch checkpoints, tools/import_torch_checkpoint.py) so every later
+        # train/evaluate run builds the architecture the weights expect
+        pinned = os.path.join(self.workdir, "model_kwargs.json")
+        if model is None and os.path.exists(pinned):
+            with open(pinned) as fp:
+                extra = json.load(fp)
+            if extra:
+                print(f"[{config.name}] applying pinned model kwargs {extra}",
+                      flush=True)
+                config = self.config = config.replace(
+                    model_kwargs={**config.model_kwargs, **extra})
 
         if model is None:
             model_ctor = MODELS.get(config.model)

@@ -43,6 +43,9 @@ class BasicBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
     dtype: jnp.dtype = jnp.bfloat16
+    # BasicBlock always strides its first conv (both here and in the
+    # reference), so the flag is accepted for API uniformity and is a no-op
+    stride_on_first: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -62,21 +65,27 @@ class BasicBlock(nn.Module):
 
 class BottleneckBlock(nn.Module):
     """1x1 reduce → 3x3 → 1x1 expand (×4) + projection shortcut
-    (`ResNet/pytorch/models/resnet50.py:96-165`). Stride on the 3x3 (torch style)."""
+    (`ResNet/pytorch/models/resnet50.py:96-165`). Stride on the 3x3 (torch-B
+    style, the modern-recipe default); `stride_on_first=True` reproduces the
+    reference's stride-on-conv1 placement (`resnet50.py:101-106`) so its
+    checkpoints import exactly (utils/torch_convert.py)."""
     features: int
     strides: Tuple[int, int] = (1, 1)
     expansion: int = 4
     dtype: jnp.dtype = jnp.bfloat16
+    stride_on_first: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
                        dtype=self.dtype)
         out_features = self.features * self.expansion
+        s1 = self.strides if self.stride_on_first else (1, 1)
+        s2 = (1, 1) if self.stride_on_first else self.strides
         residual = x
-        y = conv(self.features, (1, 1))(x)
+        y = conv(self.features, (1, 1), strides=s1)(x)
         y = _BN()(y, train).astype(self.dtype)
-        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = conv(self.features, (3, 3), strides=s2)(y)
         y = _BN()(y, train).astype(self.dtype)
         y = conv(out_features, (1, 1))(y)
         y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
@@ -94,6 +103,8 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    stride_on_first: bool = False  # reference stride placement, for imported
+                                   # torch checkpoints (utils/torch_convert.py)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -103,11 +114,12 @@ class ResNet(nn.Module):
                     name="stem_conv")(x)
         x = _BN()(x, train).astype(self.dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        block_kwargs = {"stride_on_first": True} if self.stride_on_first else {}
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block(self.width * 2 ** i, strides=strides,
-                               dtype=self.dtype)(x, train=train)
+                               dtype=self.dtype, **block_kwargs)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      kernel_init=nn.initializers.normal(0.01), name="head")(x)

@@ -101,8 +101,12 @@ def best_iou_auto(pred_boxes: jnp.ndarray, gt_boxes: jnp.ndarray) -> jnp.ndarray
     The jnp fallback keeps the op differentiable-by-XLA and portable; the TPU
     path is wrapped in stop_gradient by its caller (the ignore mask is consumed
     through a comparison, so its gradient is identically zero either way).
+    `DEEPVISION_NO_PALLAS=1` forces the jnp path (escape hatch if a Mosaic
+    lowering regression ever hits a TPU runtime we haven't tested).
     """
-    if jax.default_backend() == "tpu":
+    import os
+    if (jax.default_backend() == "tpu"
+            and os.environ.get("DEEPVISION_NO_PALLAS") != "1"):
         return best_iou(pred_boxes, gt_boxes)
     from .boxes import broadcast_iou
     return jnp.max(broadcast_iou(pred_boxes, gt_boxes), axis=-1)

@@ -1,0 +1,117 @@
+"""Import the reference's PyTorch checkpoints into Flax parameter trees.
+
+The reference publishes trained `.pt` checkpoints per model README
+(`ResNet/pytorch/README.md:71`: `{epoch, model, optimizer, scheduler,
+loggers}` dicts saved by `ResNet/pytorch/train.py:417-428`). This module maps
+the `model` state_dict onto our Flax trees so users can switch frameworks
+without retraining:
+
+- conv weights OIHW → HWIO;
+- linear weights (out, in) → (in, out);
+- BatchNorm weight/bias/running_mean/running_var → scale/bias + mean/var
+  batch_stats;
+- `module.`-prefixed keys (their `nn.DataParallel` wrap,
+  `ResNet/pytorch/train.py:352-355`) are stripped.
+
+Architectural caveat, handled: the reference strides bottlenecks on conv1
+(`resnet50.py:101-106`), ours default to the 3x3 — build the model with
+`model_kwargs={"stride_on_first": True}` (what `tools/import_torch_checkpoint.py`
+does) so imported weights compute the same function.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+RESNET_TORCH_STAGES = ("conv2x", "conv3x", "conv4x", "conv5x")
+RESNET_STAGE_SIZES = {
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _conv_w(sd, key):
+    """torch OIHW → flax HWIO."""
+    return _np(sd[key]).transpose(2, 3, 1, 0)
+
+
+def strip_data_parallel(sd: Dict) -> Dict:
+    return {(k[7:] if k.startswith("module.") else k): v for k, v in sd.items()}
+
+
+def _bn(sd, prefix) -> Tuple[Dict, Dict]:
+    p = {"BatchNorm_0": {"scale": _np(sd[prefix + ".weight"]),
+                         "bias": _np(sd[prefix + ".bias"])}}
+    s = {"BatchNorm_0": {"mean": _np(sd[prefix + ".running_mean"]),
+                         "var": _np(sd[prefix + ".running_var"])}}
+    return p, s
+
+
+class _RecordingDict(dict):
+    """Records key reads so leftover-weight detection can catch a checkpoint
+    whose depth doesn't match the requested model (e.g. a resnet152 .pt fed to
+    -m resnet101 — every indexed key exists, widths match, output is garbage)."""
+
+    def __init__(self, base):
+        super().__init__(base)
+        self.used = set()
+
+    def __getitem__(self, k):
+        self.used.add(k)
+        return super().__getitem__(k)
+
+
+def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict]:
+    """Reference bottleneck-ResNet state_dict → (params, batch_stats) matching
+    `models/resnet.py` naming (stem_conv/_BN_0/BottleneckBlock_i/head)."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+    params: Dict = {"stem_conv": {"kernel": _conv_w(sd, "conv1.weight")}}
+    stats: Dict = {}
+    params["_BN_0"], stats["_BN_0"] = _bn(sd, "bn1")
+    params["head"] = {"kernel": _np(sd["linear.weight"]).T,
+                      "bias": _np(sd["linear.bias"])}
+
+    b = 0
+    for stage, n in zip(RESNET_TORCH_STAGES, stage_sizes):
+        for i in range(n):
+            t = f"{stage}.{i}"
+            blk_p: Dict = {}
+            blk_s: Dict = {}
+            for j in range(3):
+                blk_p[f"Conv_{j}"] = {"kernel": _conv_w(sd, f"{t}.conv{j + 1}.weight")}
+                blk_p[f"_BN_{j}"], blk_s[f"_BN_{j}"] = _bn(sd, f"{t}.bn{j + 1}")
+            if f"{t}.projection.0.weight" in sd:
+                blk_p["proj"] = {"kernel": _conv_w(sd, f"{t}.projection.0.weight")}
+                blk_p["_BN_3"], blk_s["_BN_3"] = _bn(sd, f"{t}.projection.1")
+            params[f"BottleneckBlock_{b}"] = blk_p
+            stats[f"BottleneckBlock_{b}"] = blk_s
+            b += 1
+
+    leftover = {k for k in sd if k not in sd.used
+                and not k.endswith("num_batches_tracked")}
+    if leftover:
+        raise ValueError(
+            f"{len(leftover)} unconsumed weights (e.g. {sorted(leftover)[:3]}) "
+            f"— checkpoint depth doesn't match stage_sizes={tuple(stage_sizes)}; "
+            f"wrong -m model for this .pt?")
+    return params, stats
+
+
+def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
+    """Dispatch by registry model name. Raises KeyError for models without a
+    converter yet (extend RESNET_STAGE_SIZES / add a mapper)."""
+    if model_name in RESNET_STAGE_SIZES:
+        return convert_resnet_bottleneck(state_dict,
+                                         RESNET_STAGE_SIZES[model_name])
+    raise KeyError(
+        f"no torch-checkpoint converter for {model_name!r} "
+        f"(available: {sorted(RESNET_STAGE_SIZES)})")

@@ -1,0 +1,146 @@
+"""Torch-checkpoint import (utils/torch_convert.py).
+
+The numerical test builds an independent torch mini-ResNet whose state_dict
+uses the REFERENCE's key naming (`conv1/bn1/conv2x.{i}.conv{j}/projection`,
+stride on conv1 — the checkpoint format documented at
+`ResNet/pytorch/models/resnet50.py:20-44,99-165`), then checks that converted
+weights make our Flax model produce the same logits.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deepvision_tpu.models.resnet import BottleneckBlock, ResNet  # noqa: E402
+from deepvision_tpu.utils.torch_convert import (  # noqa: E402
+    convert, convert_resnet_bottleneck, strip_data_parallel)
+
+
+class _TorchBottleneck(tnn.Module):
+    """Independent re-statement of the checkpoint's block layout: stride on
+    conv1, projection = Sequential(conv 1x1, bn)."""
+
+    def __init__(self, cin, mid, cout, stride, project):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, mid, 1, stride=stride, bias=False)
+        self.bn1 = tnn.BatchNorm2d(mid)
+        self.conv2 = tnn.Conv2d(mid, mid, 3, stride=1, padding=1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(mid)
+        self.conv3 = tnn.Conv2d(mid, cout, 1, stride=1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.projection = (tnn.Sequential(
+            tnn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+            tnn.BatchNorm2d(cout)) if project else None)
+
+    def forward(self, x):
+        identity = self.projection(x) if self.projection is not None else x
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = torch.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return torch.relu(y + identity)
+
+
+class _TorchMiniResNet(tnn.Module):
+    """Stem + 4 one-block stages + head, reference naming (conv2x..conv5x)."""
+
+    def __init__(self, width=8, num_classes=5):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 7, stride=2, padding=3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.maxpool = tnn.MaxPool2d(3, stride=2, padding=1)
+        w = width
+        self.conv2x = tnn.Sequential(_TorchBottleneck(w, w, 4 * w, 1, True))
+        self.conv3x = tnn.Sequential(_TorchBottleneck(4 * w, 2 * w, 8 * w, 2, True))
+        self.conv4x = tnn.Sequential(_TorchBottleneck(8 * w, 4 * w, 16 * w, 2, True))
+        self.conv5x = tnn.Sequential(_TorchBottleneck(16 * w, 8 * w, 32 * w, 2, True))
+        self.linear = tnn.Linear(32 * w, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        for stage in (self.conv2x, self.conv3x, self.conv4x, self.conv5x):
+            x = stage(x)
+        x = x.mean(dim=(2, 3))
+        return self.linear(x)
+
+
+def test_mini_resnet_numerical_parity():
+    torch.manual_seed(0)
+    tm = _TorchMiniResNet(width=8, num_classes=5).eval()
+    # randomize BN stats so running_mean/var conversion is actually exercised
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 2.0)
+
+    params, batch_stats = convert_resnet_bottleneck(tm.state_dict(),
+                                                    stage_sizes=(1, 1, 1, 1))
+
+    fm = ResNet(stage_sizes=(1, 1, 1, 1), block=BottleneckBlock, width=8,
+                num_classes=5, dtype=jnp.float32, stride_on_first=True)
+    # structure must match a fresh init exactly
+    ref_p, ref_s = (jax.tree_util.tree_structure(t) for t in (
+        fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))["params"],
+        fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))["batch_stats"]))
+    assert jax.tree_util.tree_structure(params) == ref_p
+    assert jax.tree_util.tree_structure(batch_stats) == ref_s
+
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(fm.apply({"params": params, "batch_stats": batch_stats},
+                              jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_data_parallel_prefix_stripped():
+    sd = {"module.conv1.weight": 1, "conv1.bias": 2}
+    out = strip_data_parallel(sd)
+    assert set(out) == {"conv1.weight", "conv1.bias"}
+
+
+def test_convert_dispatch():
+    with pytest.raises(KeyError):
+        convert("lenet5", {})
+
+
+def test_depth_mismatch_raises():
+    """A deeper checkpoint fed to a shallower stage spec must raise, not
+    silently convert a truncated network."""
+    torch.manual_seed(0)
+    tm = _TorchMiniResNet(width=8, num_classes=5)
+    sd = dict(tm.state_dict())
+    # clone block conv2x.0 as a phantom extra block conv2x.1 (deeper ckpt)
+    for k in list(sd):
+        if k.startswith("conv2x.0."):
+            sd[k.replace("conv2x.0.", "conv2x.1.")] = sd[k]
+    with pytest.raises(ValueError, match="unconsumed"):
+        convert_resnet_bottleneck(sd, stage_sizes=(1, 1, 1, 1))
+
+
+def test_pinned_model_kwargs_applied(tmp_path):
+    """model_kwargs.json in the workdir reaches model construction, so
+    imported-checkpoint workdirs keep their architecture on later runs."""
+    import json
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "model_kwargs.json").write_text(json.dumps({"stride_on_first": True}))
+    tr = Trainer(get_config("resnet50").replace(batch_size=8),
+                 workdir=str(wd))
+    assert tr.model.stride_on_first is True
+    tr.close()
+
+
+def test_basic_block_accepts_flag():
+    from deepvision_tpu.models.resnet import BasicBlock
+    BasicBlock(8, stride_on_first=True)  # no-op, must not raise

@@ -119,3 +119,18 @@ def test_golden_lenet_synthetic_accuracy(tmp_path):
               "32", "--steps-per-epoch", "16", "--learning-rate", "0.003",
               "--workdir", str(tmp_path)])
     assert result["best_metric"] > 0.9, result
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    """--profile-dir captures a jax.profiler trace of the first epoch
+    (SURVEY.md §5.1 — the hook the reference lacked)."""
+    from deepvision_tpu.cli import run_classification
+
+    prof = tmp_path / "prof"
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--workdir", str(tmp_path / "wd"),
+              "--profile-dir", str(prof)])
+    found = list(prof.rglob("*.trace.json.gz")) + list(prof.rglob("*.xplane.pb"))
+    assert found, f"no trace artifacts under {prof}"

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Import a reference PyTorch checkpoint into this framework's Orbax format.
+
+Usage:
+    python tools/import_torch_checkpoint.py -m resnet50 \
+        --torch-ckpt resnet50-yanjiali-012320.pt --workdir runs/resnet50
+
+Loads the `.pt` dict (`ResNet/pytorch/train.py:417-428` format or a bare
+state_dict), maps weights via `deepvision_tpu/utils/torch_convert.py`, and
+saves them as epoch N so `train.py -c latest` / `evaluate` pick them up.
+The model is built with the reference's stride-on-conv1 bottlenecks so the
+imported network computes the same function.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True,
+                   choices=["resnet50", "resnet101", "resnet152"])
+    p.add_argument("--torch-ckpt", required=True)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--image-size", type=int, default=224)
+    args = p.parse_args(argv)
+
+    import torch
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.utils.torch_convert import convert
+
+    payload = torch.load(args.torch_ckpt, map_location="cpu",
+                         weights_only=False)
+    state_dict = payload.get("model", payload) if isinstance(payload, dict) else payload
+    epoch = int(payload.get("epoch", 0)) if isinstance(payload, dict) else 0
+    params, batch_stats = convert(args.model, state_dict)
+
+    cfg = get_config(args.model)
+    cfg = cfg.replace(model_kwargs={**cfg.model_kwargs,
+                                    "stride_on_first": True})
+    # pin the stride placement in the workdir so later `train.py -c latest` /
+    # evaluate runs rebuild the SAME architecture (Trainer reads this file)
+    workdir = args.workdir or os.path.join("runs", cfg.name)
+    os.makedirs(workdir, exist_ok=True)
+    import json
+    with open(os.path.join(workdir, "model_kwargs.json"), "w") as fp:
+        json.dump({"stride_on_first": True}, fp)
+    trainer = Trainer(cfg, workdir=workdir)
+    trainer.init_state((args.image_size, args.image_size, 3))
+    import jax
+    trainer.state = trainer.state.replace(
+        params=jax.device_put(params), batch_stats=jax.device_put(batch_stats))
+    trainer.ckpt.save(epoch, trainer.state, host_state={"imported_from":
+                                                        args.torch_ckpt})
+    trainer.close()
+    print(f"imported epoch {epoch} from {args.torch_ckpt} into "
+          f"{trainer.workdir if hasattr(trainer, 'workdir') else args.workdir}")
+
+
+if __name__ == "__main__":
+    main()
