@@ -118,8 +118,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))
     if args.dataset:
-        cfg = cfg.replace(data=dataclasses.replace(cfg.data,
-                                                   dataset=args.dataset))
+        over = {"dataset": args.dataset}
+        if args.dataset == "mnist":
+            over.update(image_size=32, channels=1)  # pipeline pads 28→32, grayscale
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, **over))
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
     if args.synthetic:
@@ -134,10 +136,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     trainer = trainer_factory(cfg, workdir)
     train_fn, val_fn = make_data(cfg, args)
 
-    if cfg.data.dataset == "mnist":
-        sample_shape = (32, 32, 1)  # mnist pipeline pads 28→32
-    else:
-        sample_shape = (cfg.data.image_size, cfg.data.image_size, 3)
+    # mnist pipeline pads 28→32, matching the configured image_size
+    sample_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     trainer.init_state(sample_shape)
     if args.checkpoint:
         trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
@@ -166,8 +166,8 @@ def _classification_data(cfg, args):
     if args.synthetic or data.dataset == "synthetic":
         from .data.synthetic import SyntheticClassification
         return _synthetic_data(cfg, lambda steps, seed: SyntheticClassification(
-            cfg.batch_size, data.image_size, 3, data.num_classes, steps,
-            seed=seed))
+            cfg.batch_size, data.image_size, data.channels, data.num_classes,
+            steps, seed=seed))
     elif data.dataset == "mnist":
         from .data.mnist import MnistBatches, load_split
         data_dir = args.data_dir or data.data_dir or "dataset/mnist"

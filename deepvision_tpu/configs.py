@@ -21,7 +21,7 @@ CONFIGS.register("lenet5", TrainConfig(
     name="lenet5", model="lenet5", batch_size=256, total_epochs=20,
     optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
     schedule=ScheduleConfig(name="plateau", plateau_patience=2, plateau_mode="max"),
-    data=DataConfig(dataset="mnist", image_size=32, num_classes=10,
+    data=DataConfig(dataset="mnist", image_size=32, channels=1, num_classes=10,
                     train_examples=60000, val_examples=10000),
     dtype="float32",
 ))
@@ -118,7 +118,7 @@ CONFIGS.register("dcgan", TrainConfig(
     name="dcgan", model="dcgan", batch_size=256, total_epochs=50,
     optimizer=OptimizerConfig(name="adam", learning_rate=1e-4),
     schedule=ScheduleConfig(name="constant"),
-    data=DataConfig(dataset="mnist", image_size=28, num_classes=10,
+    data=DataConfig(dataset="mnist", image_size=28, channels=1, num_classes=10,
                     train_examples=60000, val_examples=10000),
     dtype="float32", keep_checkpoints=3, keep_best=False,
 ))

@@ -1,0 +1,138 @@
+"""Checkpoint-restore + single-image prediction helpers for the classification
+zoo — the programmatic core of what the reference does inside its per-model
+visualization notebooks (`ResNet/pytorch/notebooks/ResNet50.ipynb`: load
+checkpoint, plot the saved loggers, `predict()` top-5 on test images).
+
+Used by the per-family `<Family>/jax/notebooks/*.ipynb` demos and usable from
+scripts:
+
+    from deepvision_tpu.core.classify import Classifier
+    clf = Classifier("resnet50", workdir="runs/resnet50")
+    for name, prob in clf.predict("cat.jpg"):
+        print(f"{prob:6.2%}  {name}")
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def load_class_names(path: Optional[str] = None,
+                     num_classes: int = 1000) -> List[str]:
+    """Human-readable class names.
+
+    `path` may be a JSON mapping of index → name (the reference's
+    `Datasets/ILSVRC2012/indices.json` format, values like ["n01440764",
+    "tench"]) or a text file with one name per line. Falls back to
+    "class <i>" placeholders when no file is given.
+    """
+    if path is None:
+        return [f"class {i}" for i in range(num_classes)]
+    if path.endswith(".json"):
+        with open(path) as fp:
+            raw = json.load(fp)
+        names = [f"class {i}" for i in range(num_classes)]
+        for k, v in raw.items():
+            names[int(k)] = v[-1] if isinstance(v, (list, tuple)) else str(v)
+        return names
+    with open(path) as fp:
+        return [line.strip() for line in fp if line.strip()]
+
+
+def load_metrics(workdir: str) -> dict:
+    """Read the trainer's JSONL metric logs into {metric: {"epochs": [...],
+    "value": [...]}} — same shape as the reference's pickled `loggers` dicts
+    (`ResNet/pytorch/train.py:260-285`), so notebook plotting code is 1:1."""
+    out: dict = {}
+    if not os.path.isdir(workdir):
+        return out
+    for fname in sorted(os.listdir(workdir)):
+        if not fname.endswith(".jsonl"):
+            continue
+        with open(os.path.join(workdir, fname)) as fp:
+            for line in fp:
+                rec = json.loads(line)
+                step = rec.get("epoch", rec.get("step", 0))
+                for key, val in rec.items():
+                    if key in ("epoch", "step", "t") or not isinstance(
+                            val, (int, float)):
+                        continue
+                    slot = out.setdefault(key, {"epochs": [], "value": []})
+                    slot["epochs"].append(step)
+                    slot["value"].append(val)
+    return out
+
+
+class Classifier:
+    """Restore a trained classification checkpoint and predict top-k classes."""
+
+    def __init__(self, model_name: str, workdir: Optional[str] = None,
+                 checkpoint: Optional[int] = None,
+                 image_size: Optional[int] = None,
+                 class_names: Optional[Sequence[str]] = None,
+                 class_names_file: Optional[str] = None):
+        from ..configs import get_config
+        from .trainer import Trainer
+
+        cfg = get_config(model_name)
+        self.image_size = image_size or cfg.data.image_size
+        self.grayscale = cfg.data.dataset == "mnist"
+        self.trainer = Trainer(cfg, workdir=workdir or os.path.join(
+            "runs", cfg.name))
+        self.trainer.init_state(
+            (self.image_size, self.image_size, cfg.data.channels))
+        restored = self.trainer.resume(epoch=checkpoint)
+        if restored is None:
+            print("WARNING: no checkpoint found — predictions use random "
+                  "weights")
+        self.epoch = restored
+        self.class_names = list(class_names) if class_names else \
+            load_class_names(class_names_file, cfg.data.num_classes)
+
+        state = self.trainer.state
+        apply_fn = state.apply_fn
+
+        @jax.jit
+        def _logits(params, batch_stats, images):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            return apply_fn(variables, images, train=False)
+
+        self._logits = _logits
+
+    def preprocess(self, image) -> np.ndarray:
+        """PIL image / path / HWC uint8 array → normalized NHWC float32 [1,...]."""
+        if isinstance(image, str):
+            from PIL import Image
+            image = Image.open(image)
+            image = np.asarray(image.convert("L" if self.grayscale else "RGB"))
+        image = np.asarray(image)
+        if self.grayscale:
+            from ..data import mnist
+            if image.shape[:2] != (28, 28):
+                from PIL import Image
+                image = np.asarray(
+                    Image.fromarray(image.astype(np.uint8)).resize((28, 28)))
+            return mnist.preprocess(image[None])
+        from ..data import transforms as T
+        tf = T.eval_transform(self.image_size)
+        return tf(image.astype(np.float32))[None]
+
+    def predict(self, image, top: int = 5) -> List[Tuple[str, float]]:
+        """Top-k (class name, probability), like the reference notebooks'
+        `predict()` (softmax → topk over `indices.json` names)."""
+        state = self.trainer.state
+        logits = self._logits(state.params, state.batch_stats,
+                              jnp.asarray(self.preprocess(image)))
+        if isinstance(logits, (tuple, list)):  # inception aux heads
+            logits = logits[0]
+        probs = np.asarray(jax.nn.softmax(logits[0]))
+        idx = np.argsort(probs)[::-1][:top]
+        return [(self.class_names[i], float(probs[i])) for i in idx]

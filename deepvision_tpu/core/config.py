@@ -49,6 +49,7 @@ class DataConfig:
     dataset: str = "synthetic"
     data_dir: str = ""
     image_size: int = 224
+    channels: int = 3               # input channels (1 for MNIST-family)
     num_classes: int = 1000
     train_examples: int = 1281167   # hard-coded in the reference: ResNet/tensorflow/train.py:223
     val_examples: int = 50000

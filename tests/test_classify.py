@@ -1,0 +1,51 @@
+"""Classifier restore + predict helpers (`deepvision_tpu/core/classify.py`) —
+the programmatic core of the per-family demo notebooks, mirroring the
+reference's notebook flow (load checkpoint → plot loggers → predict top-5,
+`ResNet/pytorch/notebooks/ResNet50.ipynb`)."""
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.cli import run_classification
+from deepvision_tpu.core.classify import Classifier, load_class_names, load_metrics
+
+
+@pytest.fixture(scope="module")
+def lenet_workdir(tmp_path_factory):
+    wd = tmp_path_factory.mktemp("clf")
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--workdir", str(wd)])
+    return str(wd)
+
+
+def test_classifier_restores_and_predicts(lenet_workdir):
+    clf = Classifier("lenet5", workdir=lenet_workdir)
+    assert clf.epoch == 1
+    img = (np.random.RandomState(0).rand(28, 28) * 255).astype(np.uint8)
+    top = clf.predict(img, top=3)
+    assert len(top) == 3
+    names, probs = zip(*top)
+    assert all(0.0 <= p <= 1.0 for p in probs)
+    assert list(probs) == sorted(probs, reverse=True)
+    # grayscale preprocess: 28x28 → padded 32x32x1 batch of one
+    assert clf.preprocess(img).shape == (1, 32, 32, 1)
+
+
+def test_load_metrics_matches_logger_shape(lenet_workdir):
+    loggers = load_metrics(lenet_workdir)
+    assert "epoch_train_loss" in loggers and "val_top1" in loggers
+    slot = loggers["epoch_train_loss"]
+    assert set(slot) == {"epochs", "value"}
+    assert len(slot["epochs"]) == len(slot["value"]) >= 1
+
+
+def test_load_class_names_fallback_and_json(tmp_path):
+    names = load_class_names(None, 10)
+    assert names[3] == "class 3"
+    p = tmp_path / "indices.json"
+    p.write_text('{"0": ["n01440764", "tench"], "2": "goldfish"}')
+    names = load_class_names(str(p), 4)
+    assert names[0] == "tench" and names[2] == "goldfish"
+    assert names[1] == "class 1"

@@ -41,7 +41,7 @@ def test_auto_resume_continues_and_fresh_start(tmp_path):
     assert "best_metric" in result
     tr = Trainer(get_config("lenet5").replace(batch_size=16),
                  workdir=str(tmp_path))
-    tr.init_state((32, 32, 3))  # synthetic mode trains 3-channel
+    tr.init_state((32, 32, 1))  # synthetic mode matches mnist channels
     assert tr.resume() == 2  # both epochs checkpointed
     tr.close()
 

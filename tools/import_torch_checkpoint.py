@@ -25,6 +25,9 @@ def main(argv=None):
     p.add_argument("--torch-ckpt", required=True)
     p.add_argument("--workdir", default=None)
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--allow-pickle", action="store_true",
+                   help="permit full unpickling of non-weights-only "
+                        "checkpoints (runs arbitrary code; trusted files only)")
     args = p.parse_args(argv)
 
     import torch
@@ -33,8 +36,17 @@ def main(argv=None):
     from deepvision_tpu.core.trainer import Trainer
     from deepvision_tpu.utils.torch_convert import convert
 
-    payload = torch.load(args.torch_ckpt, map_location="cpu",
-                         weights_only=False)
+    try:
+        payload = torch.load(args.torch_ckpt, map_location="cpu",
+                             weights_only=True)
+    except Exception:
+        if not args.allow_pickle:
+            raise SystemExit(
+                f"{args.torch_ckpt} needs full (unsafe) unpickling — pickle "
+                "can execute arbitrary code. Re-run with --allow-pickle only "
+                "if you trust the file's origin.")
+        payload = torch.load(args.torch_ckpt, map_location="cpu",
+                             weights_only=False)
     state_dict = payload.get("model", payload) if isinstance(payload, dict) else payload
     epoch = int(payload.get("epoch", 0)) if isinstance(payload, dict) else 0
     params, batch_stats = convert(args.model, state_dict)
@@ -57,8 +69,7 @@ def main(argv=None):
     trainer.ckpt.save(epoch, trainer.state, host_state={"imported_from":
                                                         args.torch_ckpt})
     trainer.close()
-    print(f"imported epoch {epoch} from {args.torch_ckpt} into "
-          f"{trainer.workdir if hasattr(trainer, 'workdir') else args.workdir}")
+    print(f"imported epoch {epoch} from {args.torch_ckpt} into {workdir}")
 
 
 if __name__ == "__main__":
