@@ -56,6 +56,12 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--auto-resume", action="store_true",
                    help="resume from the latest checkpoint if one exists "
                         "(preemption recovery; starts fresh otherwise)")
+    p.add_argument("--model-parallel", type=int, default=None,
+                   help="mesh 'model' axis size (shard big params / matmuls)")
+    p.add_argument("--spatial-parallel", type=int, default=None,
+                   help="mesh 'spatial' axis size: shard activations along "
+                        "image height (context parallelism; GSPMD "
+                        "halo-exchanges the convs)")
     p.add_argument("--multihost", action="store_true",
                    help="force jax.distributed.initialize() (auto-detected "
                         "when a coordinator address env var is set)")
@@ -112,8 +118,11 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.eval_batch_size:
         cfg = cfg.replace(eval_batch_size=args.eval_batch_size)
     if args.learning_rate:
+        # an explicit LR is honored verbatim: clear base_batch_size so the
+        # linear-scaling rule doesn't silently rescale it
         cfg = cfg.replace(optimizer=dataclasses.replace(
-            cfg.optimizer, learning_rate=args.learning_rate))
+            cfg.optimizer, learning_rate=args.learning_rate,
+            base_batch_size=None))
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))
@@ -124,6 +133,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **over))
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
+    if args.model_parallel:
+        cfg = cfg.replace(model_parallel=args.model_parallel)
+    if args.spatial_parallel:
+        cfg = cfg.replace(spatial_parallel=args.spatial_parallel)
     if args.synthetic:
         n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
         synth = dict(dataset="synthetic",

@@ -82,8 +82,10 @@ CONFIGS.register("inception_v3", TrainConfig(
 for _name in ("resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2"):
     CONFIGS.register(_name, TrainConfig(
         name=_name, model=_name, batch_size=256, total_epochs=90,
+        # base_batch_size → linear LR scaling when --batch-size is raised for
+        # pod runs (lr 0.1 @ 256 scales to 3.2 @ 8192, Goyal et al. recipe)
         optimizer=OptimizerConfig(name="momentum", learning_rate=0.1, momentum=0.9,
-                                  weight_decay=1e-4),
+                                  weight_decay=1e-4, base_batch_size=256),
         schedule=ScheduleConfig(name="cosine", warmup_epochs=5),
         label_smoothing=0.1,
         data=_imagenet(),

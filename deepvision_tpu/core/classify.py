@@ -116,6 +116,8 @@ class Classifier:
         image = np.asarray(image)
         if self.grayscale:
             from ..data import mnist
+            if image.ndim == 3:  # HWC with a trailing channel axis
+                image = image[..., 0]
             if image.shape[:2] != (28, 28):
                 from PIL import Image
                 image = np.asarray(

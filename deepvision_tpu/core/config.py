@@ -25,6 +25,11 @@ class OptimizerConfig:
     eps: float = 1e-8
     rmsprop_decay: float = 0.9
     grad_clip_norm: Optional[float] = None
+    # Linear LR scaling (Goyal et al. 2017): when set, the effective LR is
+    # learning_rate * global_batch / base_batch_size — the large-batch recipe
+    # the 75.3% north star needs at pod batch sizes (BASELINE.md). None keeps
+    # the configured LR verbatim (reference semantics at batch 256).
+    base_batch_size: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -80,6 +85,9 @@ class TrainConfig:
     keep_checkpoints: int = 3
     keep_best: bool = True          # save-best policy, YOLO/tensorflow/train.py:244-246
     model_parallel: int = 1
+    spatial_parallel: int = 1       # shard activations along H over a 'spatial'
+                                    # mesh axis (context parallelism for big
+                                    # resolutions; GSPMD halo-exchanges convs)
     remat: bool = False             # jax.checkpoint the forward: recompute
                                     # activations in backward, trading ~1/3 more
                                     # FLOPs for HBM (big batches / deep stacks)

@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import losses
 from .train_state import TrainState
+from ..parallel import mesh as mesh_lib
 from ..parallel.mesh import DATA_AXIS
 
 
@@ -42,6 +43,13 @@ def make_classification_train_step(
 
     def step(state: TrainState, images, labels, rng):
         images = images.astype(compute_dtype)
+        if mesh is not None:
+            # batch over 'data'; on a spatial mesh also H over 'spatial' —
+            # GSPMD partitions every conv with halo exchange (context
+            # parallelism for activations, SURVEY.md §5.7)
+            images = jax.lax.with_sharding_constraint(
+                images, mesh_lib.batch_sharding(mesh, images.ndim,
+                                                dim1=images.shape[1]))
 
         def forward(params, images):
             return state.apply_fn(
@@ -90,6 +98,10 @@ def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
 
     def step(state: TrainState, images, labels, mask):
         images = images.astype(compute_dtype)
+        if mesh is not None:
+            images = jax.lax.with_sharding_constraint(
+                images, mesh_lib.batch_sharding(mesh, images.ndim,
+                                                dim1=images.shape[1]))
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)

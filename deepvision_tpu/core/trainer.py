@@ -10,6 +10,7 @@ thin wrappers that build a TrainConfig and call `Trainer.fit()`.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
@@ -62,7 +63,8 @@ class Trainer:
         self.config = config
         self.workdir = workdir or config.checkpoint_dir
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
-            model_parallel=config.model_parallel)
+            model_parallel=config.model_parallel,
+            spatial_parallel=config.spatial_parallel)
 
         # a workdir can pin model kwargs (e.g. stride_on_first for imported
         # torch checkpoints, tools/import_torch_checkpoint.py) so every later
@@ -88,7 +90,16 @@ class Trainer:
 
         self.steps_per_epoch = max(
             1, config.data.train_examples // config.batch_size)
-        self.tx = build_optimizer(config.optimizer, config.schedule,
+        opt_cfg = config.optimizer
+        if opt_cfg.base_batch_size and config.batch_size != opt_cfg.base_batch_size:
+            scaled = opt_cfg.learning_rate * config.batch_size / opt_cfg.base_batch_size
+            if _is_main_process():
+                print(f"[{config.name}] linear LR scaling: "
+                      f"{opt_cfg.learning_rate} -> {scaled:g} "
+                      f"(batch {config.batch_size}/{opt_cfg.base_batch_size})",
+                      flush=True)
+            opt_cfg = dataclasses.replace(opt_cfg, learning_rate=scaled)
+        self.tx = build_optimizer(opt_cfg, config.schedule,
                                   self.steps_per_epoch, config.total_epochs)
 
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16

@@ -18,31 +18,74 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
 MODEL_AXIS = "model"
 
 
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     model_parallel: int = 1,
-    axis_names: tuple[str, str] = (DATA_AXIS, MODEL_AXIS),
+    spatial_parallel: int = 1,
+    axis_names: Optional[tuple[str, ...]] = None,
 ) -> Mesh:
-    """Build a (data, model) 2-D mesh over the given devices.
+    """Build a (data[, spatial], model) mesh over the given devices.
 
-    With ``model_parallel=1`` this is pure data parallelism — the idiomatic
-    equivalent of the reference's MirroredStrategy NCCL all-reduce, but over ICI.
+    With ``model_parallel=spatial_parallel=1`` this is pure data parallelism —
+    the idiomatic equivalent of the reference's MirroredStrategy NCCL
+    all-reduce, but over ICI.
+
+    ``spatial_parallel>1`` adds a 'spatial' axis: activations are sharded along
+    image height and GSPMD spatially partitions the convolutions, exchanging
+    kernel-halo rows between neighbors over ICI. This is the vision analog of
+    sequence/context parallelism — the lever for resolutions whose activations
+    exceed one chip's HBM (SURVEY.md §5.7's "big activation" axis).
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if n % model_parallel != 0:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(grid, axis_names)
+    if spatial_parallel > 1 and model_parallel > 1:
+        # XLA (jax 0.9.0) over-reduces replicated conv-kernel gradients by the
+        # model-axis size when activations are sharded on BOTH batch and a
+        # spatial dim of a mesh that also carries a model axis (verified: grads
+        # come back exactly model_parallel x too large; tests/test_spatial.py).
+        # Until that is fixed upstream, the combination is rejected rather than
+        # silently training at the wrong learning rate.
+        raise ValueError(
+            "spatial_parallel and model_parallel cannot both be >1 "
+            "(XLA GSPMD mis-reduces conv kernel grads on such meshes)")
+    if n % (model_parallel * spatial_parallel) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel={model_parallel} "
+            f"x spatial_parallel={spatial_parallel}")
+    if spatial_parallel > 1:
+        shape = (n // (model_parallel * spatial_parallel), spatial_parallel,
+                 model_parallel)
+        names = axis_names or (DATA_AXIS, SPATIAL_AXIS, MODEL_AXIS)
+    else:
+        shape = (n // model_parallel, model_parallel)
+        names = axis_names or (DATA_AXIS, MODEL_AXIS)
+    return Mesh(np.asarray(devices).reshape(shape), names)
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
-    """Shard the leading (batch) dim over 'data'; replicate the rest."""
-    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+def has_spatial(mesh: Mesh) -> bool:
+    return SPATIAL_AXIS in mesh.axis_names and mesh.shape[SPATIAL_AXIS] > 1
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4,
+                   dim1: Optional[int] = None) -> NamedSharding:
+    """Shard the leading (batch) dim over 'data'; on a spatial mesh, 4-D
+    arrays (NHWC images/heatmaps) also get H sharded over 'spatial';
+    replicate the rest.
+
+    Only rank-4 arrays are treated as spatial: lower-rank batch tensors
+    (labels, padded box lists (B,100,4)) have no height dim. `dim1` (the
+    actual H extent, when known) gates on divisibility so odd heights fall
+    back to replicated-H rather than failing at device_put."""
+    spec = [DATA_AXIS] + [None] * (ndim - 1)
+    if ndim == 4 and has_spatial(mesh) and (
+            dim1 is None or dim1 % mesh.shape[SPATIAL_AXIS] == 0):
+        spec[1] = SPATIAL_AXIS
+    return NamedSharding(mesh, P(*spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -50,10 +93,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch_pytree(mesh: Mesh, batch):
-    """Device-put a host pytree of arrays with the batch dim sharded over 'data'."""
+    """Device-put a host pytree of arrays with the batch dim sharded over 'data'
+    (and H over 'spatial' for NHWC arrays on a spatial mesh)."""
     def _put(x):
         x = np.asarray(x)
-        return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1)))))
+        dim1 = x.shape[1] if x.ndim > 1 else None
+        return jax.device_put(x, batch_sharding(mesh, x.ndim, dim1=dim1))
     return jax.tree_util.tree_map(_put, batch)
 
 

@@ -31,6 +31,8 @@ def test_classifier_restores_and_predicts(lenet_workdir):
     assert list(probs) == sorted(probs, reverse=True)
     # grayscale preprocess: 28x28 → padded 32x32x1 batch of one
     assert clf.preprocess(img).shape == (1, 32, 32, 1)
+    # HWC grayscale with trailing channel axis works too
+    assert clf.preprocess(img[..., None]).shape == (1, 32, 32, 1)
 
 
 def test_load_metrics_matches_logger_shape(lenet_workdir):

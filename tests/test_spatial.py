@@ -1,0 +1,132 @@
+"""Spatial (context) parallelism: activations sharded along image height over a
+'spatial' mesh axis, convs partitioned by GSPMD with halo exchange — the vision
+analog of sequence parallelism (SURVEY.md §5.7's "big activation" lever).
+Absent from the reference (its scale-out is data-parallel only, §2.8); here it
+is a first-class mesh axis."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu.core import steps
+from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+from deepvision_tpu.core.optim import build_optimizer
+from deepvision_tpu.core.train_state import TrainState, init_model
+from deepvision_tpu.parallel import mesh as mesh_lib
+
+
+class TinyConvNet(nn.Module):
+    """3x3 convs + BN: enough structure to need halo exchange and cross-shard
+    BN reductions under spatial partitioning."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        for feat in (8, 16):
+            x = nn.Conv(feat, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _mesh_spatial():
+    return mesh_lib.make_mesh(spatial_parallel=2)
+
+
+def test_make_mesh_spatial_axes():
+    mesh = _mesh_spatial()
+    assert dict(mesh.shape) == {"data": 4, "spatial": 2, "model": 1}
+    assert mesh_lib.has_spatial(mesh)
+    assert not mesh_lib.has_spatial(mesh_lib.make_mesh())
+
+
+def test_make_mesh_rejects_spatial_plus_model():
+    """jax 0.9.0 GSPMD over-reduces replicated conv-kernel grads by exactly
+    model_parallel when activations are sharded on batch+H of a mesh that also
+    has a model axis (grads come back 2x on a (2,2,2) mesh) — the combination
+    is rejected until fixed upstream."""
+    with pytest.raises(ValueError, match="spatial_parallel and model_parallel"):
+        mesh_lib.make_mesh(spatial_parallel=2, model_parallel=2)
+
+
+def test_batch_sharding_shards_height_on_spatial_mesh():
+    mesh = _mesh_spatial()
+    spec = mesh_lib.batch_sharding(mesh, ndim=4).spec
+    assert spec == jax.sharding.PartitionSpec("data", "spatial", None, None)
+    # labels stay batch-sharded only
+    assert mesh_lib.batch_sharding(mesh, ndim=1).spec == \
+        jax.sharding.PartitionSpec("data")
+    # rank-3 batch tensors (e.g. padded GT boxes (B,100,4)) have no height
+    # dim — never spatial-sharded
+    assert mesh_lib.batch_sharding(mesh, ndim=3).spec == \
+        jax.sharding.PartitionSpec("data", None, None)
+    # 4-D arrays whose H doesn't divide the spatial axis fall back cleanly
+    assert mesh_lib.batch_sharding(mesh, ndim=4, dim1=7).spec == \
+        jax.sharding.PartitionSpec("data", None, None, None)
+    boxes = np.zeros((8, 100, 4), np.float32)
+    sharded = mesh_lib.shard_batch_pytree(mesh, {"boxes": boxes})
+    assert sharded["boxes"].sharding.spec == \
+        jax.sharding.PartitionSpec("data", None, None)
+
+
+def test_spatial_forward_matches_replicated():
+    """Sharding H must not change the math: GSPMD inserts halo exchanges so
+    conv outputs are identical (up to float assoc) to the unsharded run."""
+    model = TinyConvNet()
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(0).randn(8, 16, 16, 3).astype(np.float32)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 16, 16, 3)))
+
+    def fwd(params, batch_stats, x):
+        return model.apply({"params": params, "batch_stats": batch_stats},
+                           x, train=False)
+
+    ref = jax.jit(fwd)(params, batch_stats, x)
+
+    mesh = _mesh_spatial()
+    xs = jax.device_put(x, mesh_lib.batch_sharding(mesh, 4))
+    out = jax.jit(fwd)(params, batch_stats, xs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_train_step_runs_and_loss_matches_dp():
+    """One full train step on a (2,2,2) mesh == same step on the pure-DP mesh
+    (same params, same batch → same loss/grads up to float reassociation)."""
+    model = TinyConvNet()
+    rng = jax.random.PRNGKey(0)
+    batch = 8
+    x = np.random.RandomState(1).randn(batch, 16, 16, 3).astype(np.float32)
+    y = (np.arange(batch) % 10).astype(np.int32)
+
+    def one_step(mesh):
+        params, batch_stats = init_model(model, rng, jnp.zeros((2, 16, 16, 3)))
+        tx = build_optimizer(
+            OptimizerConfig(name="momentum", learning_rate=0.1),
+            ScheduleConfig(name="constant"), steps_per_epoch=10, total_epochs=1)
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, mesh=mesh, donate=False)
+        sharded = mesh_lib.shard_batch_pytree(mesh, (x, y))
+        state, metrics = step(state, *sharded, rng)
+        return float(metrics["loss"]), state
+
+    loss_dp, state_dp = one_step(mesh_lib.make_mesh())
+    loss_sp, state_sp = one_step(_mesh_spatial())
+    assert np.isfinite(loss_sp)
+    np.testing.assert_allclose(loss_dp, loss_sp, rtol=1e-5)
+    # updated params agree too (gradient collectives were correct)
+    flat_dp = jax.tree_util.tree_leaves(state_dp.params)
+    flat_sp = jax.tree_util.tree_leaves(state_sp.params)
+    for a, b in zip(flat_dp, flat_sp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_make_mesh_rejects_bad_factorization():
+    with pytest.raises(ValueError):
+        mesh_lib.make_mesh(spatial_parallel=3)

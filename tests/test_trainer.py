@@ -134,3 +134,21 @@ def test_profile_dir_writes_trace(tmp_path):
               "--profile-dir", str(prof)])
     found = list(prof.rglob("*.trace.json.gz")) + list(prof.rglob("*.xplane.pb"))
     assert found, f"no trace artifacts under {prof}"
+
+
+def test_linear_lr_scaling_with_base_batch(tmp_path, capsys):
+    """base_batch_size → effective LR = lr * batch/base (Goyal et al. recipe);
+    unset → LR untouched (reference semantics at the configured batch)."""
+    cfg = _config(tmp_path, batch_size=64,
+                  optimizer=OptimizerConfig(name="momentum", learning_rate=0.1,
+                                            base_batch_size=32))
+    tr = Trainer(cfg, workdir=str(tmp_path))
+    out = capsys.readouterr().out
+    assert "linear LR scaling: 0.1 -> 0.2" in out
+    tr.close()
+
+    cfg2 = _config(tmp_path, batch_size=64,
+                   optimizer=OptimizerConfig(name="momentum", learning_rate=0.1))
+    tr2 = Trainer(cfg2, workdir=str(tmp_path))
+    assert "linear LR scaling" not in capsys.readouterr().out
+    tr2.close()

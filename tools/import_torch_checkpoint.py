@@ -36,10 +36,13 @@ def main(argv=None):
     from deepvision_tpu.core.trainer import Trainer
     from deepvision_tpu.utils.torch_convert import convert
 
+    import pickle
     try:
         payload = torch.load(args.torch_ckpt, map_location="cpu",
                              weights_only=True)
-    except Exception:
+    except (pickle.UnpicklingError, RuntimeError):
+        # weights-only refusal (non-tensor payloads like schedulers); other
+        # errors (missing/corrupt file) propagate untouched
         if not args.allow_pickle:
             raise SystemExit(
                 f"{args.torch_ckpt} needs full (unsafe) unpickling — pickle "
