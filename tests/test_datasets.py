@@ -187,3 +187,40 @@ class TestFlattenScript:
              "--out", str(tmp_path / "out")], capture_output=True)
         assert r.returncode != 0
         assert b"ERROR" in r.stderr  # the mismatch message, not a launch failure
+
+
+def test_per_host_sharding_partitions_files(tmp_path):
+    """Multi-host semantics (SURVEY.md §5.8): each process reads a disjoint
+    subset of TFRecord shards via files.shard(num_process, process_index) —
+    the per-host replacement for `experimental_distribute_dataset`'s global
+    batch splitting. Together the hosts must cover every example exactly once."""
+    import tensorflow as tf
+
+    from deepvision_tpu.data import imagenet as inet
+
+    # 4 shard files, one distinctly-labeled example each
+    for shard in range(4):
+        path = str(tmp_path / f"train-{shard:05d}-of-00004")
+        with tf.io.TFRecordWriter(path) as w:
+            img = tf.io.encode_jpeg(
+                tf.zeros((8, 8, 3), tf.uint8) + shard).numpy()
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[img])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[shard + 1])),
+            }))
+            w.write(ex.SerializeToString())
+
+    def labels_for(process_index, num_process):
+        ds = inet.build_dataset(str(tmp_path / "train-*"), batch_size=1,
+                                image_size=8, training=False,
+                                num_process=num_process,
+                                process_index=process_index)
+        return sorted(int(l) for _, ls in ds.as_numpy_iterator() for l in ls)
+
+    host0, host1 = labels_for(0, 2), labels_for(1, 2)
+    assert len(host0) == len(host1) == 2
+    assert not set(host0) & set(host1), "hosts must read disjoint shards"
+    # pipeline maps the schema's 1-based labels to 0-based class ids
+    assert sorted(host0 + host1) == [0, 1, 2, 3], "union must cover all examples"

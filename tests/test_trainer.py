@@ -152,3 +152,24 @@ def test_linear_lr_scaling_with_base_batch(tmp_path, capsys):
     tr2 = Trainer(cfg2, workdir=str(tmp_path))
     assert "linear LR scaling" not in capsys.readouterr().out
     tr2.close()
+
+
+def test_seeded_runs_are_bitwise_identical(tmp_path):
+    """Determinism harness (SURVEY.md §5.2 — the reference only gestures at
+    reproducibility with one tf seed): same config + seed → bitwise-identical
+    params after training. Catches nondeterministic reductions, unseeded
+    dropout, and host-side rng leaks across the whole stack."""
+    import jax
+
+    def run(subdir):
+        cfg = _config(tmp_path, seed=7,
+                      checkpoint_dir=str(tmp_path / subdir))
+        tr = Trainer(cfg, workdir=str(tmp_path / subdir))
+        tr.fit(_data(), _data(), sample_shape=(32, 32, 1))
+        params = jax.tree_util.tree_map(np.asarray, tr.state.params)
+        tr.close()
+        return params
+
+    a, b = run("a"), run("b")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(x, y)
