@@ -105,13 +105,34 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     stride_on_first: bool = False  # reference stride placement, for imported
                                    # torch checkpoints (utils/torch_convert.py)
+    stem_space_to_depth: bool = False  # MLPerf-style TPU stem: 2x2
+    # space-to-depth then a 4x4/1 conv on (H/2, W/2, 4C). The C=3 7x7/2 stem
+    # conv tiles poorly onto the MXU (channel dim far below the 128 lane
+    # width); the blocked form feeds 12 channels and strides 1. The function
+    # class contains the original exactly: an 8x8/2 conv whose first row/col
+    # of taps is zero equals the 7x7/2 conv, and the 4x4x(4C) kernel is that
+    # 8x8 kernel's phase decomposition (tests/test_models_classification.py).
+    # The 4x4 kernel / (2,1) padding geometry is derived for block size 2,
+    # which is the only block the 7x7/2 stem decomposes into — not a knob.
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, kernel_init=he_normal_fanout, dtype=self.dtype,
-                    name="stem_conv")(x)
+        if self.stem_space_to_depth:
+            b = 2
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // b, b, w // b, b, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                      b * b * c)
+            x = nn.Conv(self.width, (4, 4), strides=(1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        kernel_init=he_normal_fanout, dtype=self.dtype,
+                        name="stem_conv_s2d")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, kernel_init=he_normal_fanout,
+                        dtype=self.dtype, name="stem_conv")(x)
         x = _BN()(x, train).astype(self.dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         block_kwargs = {"stride_on_first": True} if self.stride_on_first else {}

@@ -54,3 +54,49 @@ def test_resnet50_forward_and_train_mode():
     assert out.shape == (2, 17)
     assert out.dtype == jnp.float32
     assert "batch_stats" in mutated
+
+
+def test_space_to_depth_stem_exactly_reproduces_7x7_stem():
+    """The s2d stem's function class contains the 7x7/2 conv exactly: embed
+    the 7x7 kernel in an 8x8 kernel with a zero first row/col, phase-decompose
+    it into the (4,4,4C) blocked kernel, and the two models agree to float
+    tolerance on the SAME input."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepvision_tpu.models.resnet import BasicBlock, ResNet
+
+    kw = dict(stage_sizes=(1,), block=BasicBlock, width=8, num_classes=5,
+              dtype=jnp.float32)
+    ref = ResNet(**kw)
+    s2d = ResNet(**kw, stem_space_to_depth=True)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    v_ref = ref.init(jax.random.PRNGKey(0), x, train=False)
+    v_s2d = s2d.init(jax.random.PRNGKey(1), x, train=False)
+
+    # copy everything but the stem, then map the stem kernel
+    p_ref = v_ref["params"]
+    p_s2d = jax.tree_util.tree_map(lambda a: a, v_s2d["params"])
+    for k in p_ref:
+        if k != "stem_conv":
+            p_s2d[k] = p_ref[k]
+    k7 = np.asarray(p_ref["stem_conv"]["kernel"])          # (7,7,3,8)
+    k_ext = np.zeros((8, 8) + k7.shape[2:], k7.dtype)
+    k_ext[1:, 1:] = k7
+    c = k7.shape[2]
+    kb = np.zeros((4, 4, 4 * c, k7.shape[3]), k7.dtype)
+    for bh in range(4):
+        for bw in range(4):
+            for ph in range(2):
+                for pw in range(2):
+                    ch = (ph * 2 + pw) * c
+                    kb[bh, bw, ch:ch + c] = k_ext[2 * bh + ph, 2 * bw + pw]
+    p_s2d["stem_conv_s2d"] = {"kernel": jnp.asarray(kb)}
+
+    out_ref = ref.apply({"params": p_ref,
+                         "batch_stats": v_ref["batch_stats"]}, x, train=False)
+    out_s2d = s2d.apply({"params": p_s2d,
+                         "batch_stats": v_ref["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_s2d),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput benchmark.
+
+SURVEY.md §7.2 ranks feeding the chips as hard part #1: the ResNet-50 north
+star needs >10k img/s of sustained JPEG decode+augment per pod. This tool
+measures what one host's tf.data pipeline delivers, either over real ImageNet
+TFRecords (--data-dir) or over synthetic JPEG shards it writes itself, so the
+host-side budget can be checked without the dataset.
+
+    python tools/bench_input.py                 # synthetic shards, one line
+    python tools/bench_input.py --data-dir /data/tfrecord/train --steps 200
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def write_synthetic_shards(out_dir: str, num_shards: int, per_shard: int,
+                           size: int) -> str:
+    import numpy as np
+    import tensorflow as tf
+    rs = np.random.RandomState(0)
+    for shard in range(num_shards):
+        path = os.path.join(out_dir, f"train-{shard:05d}-of-{num_shards:05d}")
+        with tf.io.TFRecordWriter(path) as w:
+            for i in range(per_shard):
+                img = rs.randint(0, 255, (size, size, 3), np.uint8)
+                encoded = tf.io.encode_jpeg(img).numpy()
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[encoded])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[i % 1000 + 1])),
+                }))
+                w.write(ex.SerializeToString())
+    return os.path.join(out_dir, "train-*")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None,
+                   help="dir of ImageNet train TFRecords; synthetic if unset")
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--synthetic-shards", type=int, default=8)
+    p.add_argument("--synthetic-per-shard", type=int, default=128)
+    p.add_argument("--source-size", type=int, default=320,
+                   help="synthetic JPEG edge length before decode+crop")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepvision_tpu.data import imagenet as inet
+
+    tmp = None
+    if args.data_dir:
+        pattern = os.path.join(args.data_dir, "train*")
+    else:
+        tmp = tempfile.TemporaryDirectory()
+        pattern = write_synthetic_shards(
+            tmp.name, args.synthetic_shards, args.synthetic_per_shard,
+            args.source_size)
+
+    ds = inet.build_dataset(pattern, batch_size=args.batch_size,
+                            image_size=args.image_size, training=True)
+    it = ds.as_numpy_iterator()
+    next(it)  # warmup: file open, autotune ramp
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(args.steps):
+        images, _ = next(it)
+        n += images.shape[0]
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"input_pipeline_images_per_sec(b{args.batch_size},"
+                  f"{args.image_size}px,{'real' if args.data_dir else 'synthetic'})",
+        "value": round(n / dt, 1),
+        "unit": "images/sec/host",
+    }))
+    if tmp:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()
