@@ -28,14 +28,15 @@ class AlexNetV1(nn.Module):
         x = x.astype(self.dtype)
         conv = partial(nn.Conv, dtype=self.dtype,
                        bias_init=nn.initializers.ones)  # paper: bias 1 in some layers
-        x = nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
-                    dtype=self.dtype)(x)
+        x = nn.Conv(96, (11, 11), strides=(4, 4), padding=[(2, 2), (2, 2)],
+                    dtype=self.dtype)(x)  # pad 2, matching `alexnet_v1.py:33`
+                                          # (output 55x55 → FC sees 6x6x256)
         x = nn.relu(x)
-        x = lrn(x)
+        x = lrn(x, torch_size=96)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = conv(256, (5, 5), padding="SAME")(x)
         x = nn.relu(x)
-        x = lrn(x)
+        x = lrn(x, torch_size=256)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = conv(384, (3, 3), padding="SAME")(x)
         x = nn.relu(x)
@@ -55,8 +56,9 @@ class AlexNetV1(nn.Module):
 
 @MODELS.register("alexnet2")
 class AlexNetV2(nn.Module):
-    """"One weird trick" variant: no LRN, channel widths 64/192/384/256/256
-    (`AlexNet/pytorch/models/alexnet_v2.py:12-75`)."""
+    """"One weird trick" variant as the reference builds it: single tower,
+    widths 64/192/384/384/256, LRN retained after the first two conv blocks
+    "for study purpose" (`AlexNet/pytorch/models/alexnet_v2.py:30-50`)."""
     num_classes: int = 1000
     dtype: jnp.dtype = jnp.bfloat16
 
@@ -66,13 +68,15 @@ class AlexNetV2(nn.Module):
         x = nn.Conv(64, (11, 11), strides=(4, 4), padding=[(2, 2), (2, 2)],
                     dtype=self.dtype)(x)
         x = nn.relu(x)
+        x = lrn(x, torch_size=64)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.Conv(192, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
+        x = lrn(x, torch_size=192)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)

@@ -46,16 +46,29 @@ class ConvBN(nn.Module):
 
 
 def lrn(x, depth_radius: int = 2, bias: float = 2.0, alpha: float = 1e-4,
-        beta: float = 0.75):
+        beta: float = 0.75, torch_size: int = 0):
     """Local response normalization (AlexNet §3.3; reference uses nn.LocalResponseNorm
     `AlexNet/pytorch/models/alexnet_v1.py` and a custom Keras layer
-    `AlexNet/tensorflow/models/alexnet_v2.py:10-22`). Cross-channel, NHWC."""
+    `AlexNet/tensorflow/models/alexnet_v2.py:10-22`). Cross-channel, NHWC.
+
+    Defaults are the paper's (n=5, k=2). `torch_size=n` instead reproduces
+    `torch.nn.LocalResponseNorm(n)` exactly — k=1, alpha divided by n, and
+    torch's ASYMMETRIC n-tap window (n//2 channels before, (n-1)//2 after) —
+    the form the reference's models actually call (with n = the full channel
+    count, `alexnet_v1.py:41,59`), so imported checkpoints compute the same
+    function (tests/test_torch_convert.py::test_alexnet2_numerical_parity)."""
+    if torch_size:
+        before, after = torch_size // 2, (torch_size - 1) // 2
+        bias, alpha = 1.0, alpha / torch_size
+    else:
+        before = after = depth_radius
+    n = before + after + 1
     x32 = x.astype(jnp.float32)
     sq = x32 * x32
-    c = x.shape[-1]
-    # sum over a window of 2*depth_radius+1 channels via padded cumulative window
-    pads = [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)]
-    sq = jnp.pad(sq, pads)
-    win = sum(sq[..., i:i + c] for i in range(2 * depth_radius + 1))
+    # O(C) sliding-window sum over channels: pad, cumsum, subtract shifted
+    run = jnp.cumsum(jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(before, after)]),
+                     axis=-1)
+    run = jnp.pad(run, [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    win = run[..., n:] - run[..., :-n]
     denom = jnp.power(bias + alpha * win, beta)
     return (x32 / denom).astype(x.dtype)

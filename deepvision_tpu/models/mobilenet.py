@@ -29,7 +29,9 @@ class DepthwiseSeparable(nn.Module):
     def __call__(self, x, train: bool = False):
         in_ch = x.shape[-1]
         x = nn.Conv(in_ch, (3, 3), strides=(self.strides, self.strides),
-                    feature_group_count=in_ch, use_bias=False,
+                    padding=[(1, 1), (1, 1)],  # torch pad 1: SAME differs at
+                    feature_group_count=in_ch,  # stride 2 (`mobilenet_v1.py:112`)
+                    use_bias=False,
                     kernel_init=he_normal_fanout, dtype=self.dtype, name="dw")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
                          dtype=jnp.float32)(x)
@@ -57,7 +59,8 @@ class MobileNetV1(nn.Module):
         def c(ch):
             return max(8, int(ch * self.alpha))
         x = x.astype(self.dtype)
-        x = nn.Conv(c(32), (3, 3), strides=(2, 2), use_bias=False,
+        x = nn.Conv(c(32), (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False,  # torch pad-1 geometry (`mobilenet_v1.py:30`)
                     kernel_init=he_normal_fanout, dtype=self.dtype, name="stem")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
                          dtype=jnp.float32)(x)

@@ -106,12 +106,101 @@ def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict
     return params, stats
 
 
+def _linear_w(sd, key, flatten_hwc: Tuple[int, int, int] = None):
+    """torch (out, in) → flax (in, out); `flatten_hwc=(H, W, C)` additionally
+    permutes a first-FC weight from torch's CHW flatten order to our NHWC
+    flatten order (`x.reshape(n, -1)` of an NHWC tensor)."""
+    w = _np(sd[key])
+    if flatten_hwc is not None:
+        h, wd, c = flatten_hwc
+        w = w.reshape(w.shape[0], c, h, wd).transpose(2, 3, 1, 0)
+        return w.reshape(h * wd * c, -1)
+    return w.T
+
+
+def convert_sequential_cnn(state_dict: Dict, first_fc_hwc: Tuple[int, int, int]
+                           ) -> Tuple[Dict, Dict]:
+    """Reference VGG / AlexNet state_dicts → Flax trees.
+
+    Both families are `features` (convs at Sequential indices among
+    ReLU/LRN/MaxPool) + `classifier` (Linears at indices among Dropout/ReLU)
+    (`VGG/pytorch/models/vgg16.py:25-110`, `AlexNet/pytorch/models/
+    alexnet_v2.py:30-64`). Convs map in index order to Conv_0.. and Linears
+    to Dense_0..; the first Linear's weight is permuted from the torch CHW
+    flatten to our NHWC flatten (`first_fc_hwc` = conv output (H, W, C))."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+    conv_idx = sorted(int(k.split(".")[1]) for k in sd
+                      if k.startswith("features.") and k.endswith(".weight"))
+    fc_idx = sorted(int(k.split(".")[1]) for k in sd
+                    if k.startswith("classifier.") and k.endswith(".weight"))
+    params: Dict = {}
+    for j, i in enumerate(conv_idx):
+        params[f"Conv_{j}"] = {"kernel": _conv_w(sd, f"features.{i}.weight"),
+                               "bias": _np(sd[f"features.{i}.bias"])}
+    for j, i in enumerate(fc_idx):
+        params[f"Dense_{j}"] = {
+            "kernel": _linear_w(sd, f"classifier.{i}.weight",
+                                first_fc_hwc if j == 0 else None),
+            "bias": _np(sd[f"classifier.{i}.bias"])}
+    leftover = {k for k in sd if k not in sd.used}
+    if leftover:
+        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
+    return params, {}
+
+
+def convert_mobilenet_v1(state_dict: Dict) -> Tuple[Dict, Dict]:
+    """Reference MobileNetV1 state_dict → Flax trees: Sequential index 0/1 are
+    the stem conv+BN, indices 3..15 the 13 DepthwiseSeparableConv blocks with
+    dw.conv/dw.bn/pw.conv/pw.bn children, plus the `linear` head
+    (`MobileNet/pytorch/models/mobilenet_v1.py:27-91`)."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+    params: Dict = {"stem": {"kernel": _conv_w(sd, "features.0.weight")}}
+    stats: Dict = {}
+    stem_bn_p, stem_bn_s = _bn(sd, "features.1")
+    params["BatchNorm_0"] = stem_bn_p["BatchNorm_0"]
+    stats["BatchNorm_0"] = stem_bn_s["BatchNorm_0"]
+    for i in range(13):
+        t = f"features.{3 + i}"
+        blk_p: Dict = {"dw": {"kernel": _conv_w(sd, f"{t}.dw.conv.weight")},
+                       "pw": {"kernel": _conv_w(sd, f"{t}.pw.conv.weight")}}
+        blk_s: Dict = {}
+        for j, sub in enumerate(("dw", "pw")):
+            p, s = _bn(sd, f"{t}.{sub}.bn")
+            blk_p[f"BatchNorm_{j}"] = p["BatchNorm_0"]
+            blk_s[f"BatchNorm_{j}"] = s["BatchNorm_0"]
+        params[f"block{i}"] = blk_p
+        stats[f"block{i}"] = blk_s
+    params["head"] = {"kernel": _np(sd["linear.weight"]).T,
+                      "bias": _np(sd["linear.bias"])}
+    leftover = {k for k in sd if k not in sd.used
+                and not k.endswith("num_batches_tracked")}
+    if leftover:
+        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
+    return params, stats
+
+
+# final conv-output geometry (H, W, C) feeding the first FC at 224px input
+SEQUENTIAL_CNN_FC_HWC = {
+    "vgg16": (7, 7, 512),
+    "vgg19": (7, 7, 512),
+    "alexnet1": (6, 6, 256),
+    "alexnet2": (6, 6, 256),
+}
+
+
 def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
     """Dispatch by registry model name. Raises KeyError for models without a
     converter yet (extend RESNET_STAGE_SIZES / add a mapper)."""
     if model_name in RESNET_STAGE_SIZES:
         return convert_resnet_bottleneck(state_dict,
                                          RESNET_STAGE_SIZES[model_name])
+    if model_name in SEQUENTIAL_CNN_FC_HWC:
+        return convert_sequential_cnn(state_dict,
+                                      SEQUENTIAL_CNN_FC_HWC[model_name])
+    if model_name == "mobilenet_v1":
+        return convert_mobilenet_v1(state_dict)
+    available = sorted(set(RESNET_STAGE_SIZES) | set(SEQUENTIAL_CNN_FC_HWC)
+                       | {"mobilenet_v1"})
     raise KeyError(
         f"no torch-checkpoint converter for {model_name!r} "
-        f"(available: {sorted(RESNET_STAGE_SIZES)})")
+        f"(available: {available})")

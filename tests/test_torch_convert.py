@@ -144,3 +144,177 @@ def test_pinned_model_kwargs_applied(tmp_path):
 def test_basic_block_accepts_flag():
     from deepvision_tpu.models.resnet import BasicBlock
     BasicBlock(8, stride_on_first=True)  # no-op, must not raise
+
+
+class _TorchAlexNetV2(tnn.Module):
+    """Independent restatement of the reference checkpoint layout
+    (`AlexNet/pytorch/models/alexnet_v2.py:30-64`): features Sequential with
+    LRN kept, classifier Sequential of three Linears."""
+
+    def __init__(self, num_classes=7):
+        super().__init__()
+        self.features = tnn.Sequential(
+            tnn.Conv2d(3, 64, 11, stride=4, padding=2), tnn.ReLU(),
+            tnn.LocalResponseNorm(64), tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
+            tnn.LocalResponseNorm(192), tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(384, 384, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2))
+        self.classifier = tnn.Sequential(
+            tnn.Dropout(), tnn.Linear(6 * 6 * 256, 4096), tnn.ReLU(),
+            tnn.Dropout(), tnn.Linear(4096, 4096), tnn.ReLU(),
+            tnn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.classifier(x.reshape(x.size(0), -1))
+
+
+def test_alexnet2_numerical_parity():
+    torch.manual_seed(0)
+    tm = _TorchAlexNetV2(num_classes=7).eval()
+    params, batch_stats = convert("alexnet2", tm.state_dict())
+    from deepvision_tpu.models.alexnet import AlexNetV2
+    fm = AlexNetV2(num_classes=7, dtype=jnp.float32)
+    ref = fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))["params"]
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(ref)
+    x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(fm.apply({"params": params}, jnp.asarray(x), train=False))
+    # tight: LRN reproduces torch's asymmetric window exactly
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_lrn_matches_torch_exactly():
+    from deepvision_tpu.models.common import lrn
+    for n, c in ((64, 64), (96, 96), (4, 16), (5, 32)):
+        x = np.random.RandomState(1).randn(2, 3, 3, c).astype(np.float32)
+        t = tnn.LocalResponseNorm(n)(
+            torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+        got = np.asarray(lrn(jnp.asarray(x), torch_size=n))
+        np.testing.assert_allclose(got, t.transpose(0, 2, 3, 1),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class _TorchMiniVGG(tnn.Module):
+    """VGG checkpoint layout (`VGG/pytorch/models/vgg16.py:25-110`) at reduced
+    width: convs interleaved with ReLU/MaxPool in `features`, three Linears in
+    `classifier` (first consumes the CHW flatten)."""
+
+    def __init__(self, width=8, num_classes=5):
+        super().__init__()
+        layers, cin = [], 3
+        for stage, depth in enumerate((2, 2, 3, 3, 3)):
+            cout = width * min(2 ** stage, 8)
+            for _ in range(depth):
+                layers += [tnn.Conv2d(cin, cout, 3, padding=1), tnn.ReLU()]
+                cin = cout
+            layers.append(tnn.MaxPool2d(2, 2))
+        self.features = tnn.Sequential(*layers)
+        self.classifier = tnn.Sequential(
+            tnn.Dropout(), tnn.Linear(7 * 7 * cin, 32), tnn.ReLU(),
+            tnn.Dropout(), tnn.Linear(32, 32), tnn.ReLU(),
+            tnn.Linear(32, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.classifier(x.reshape(x.size(0), -1))
+
+
+def test_vgg16_numerical_parity():
+    torch.manual_seed(0)
+    tm = _TorchMiniVGG(width=8, num_classes=5).eval()
+    from deepvision_tpu.utils.torch_convert import convert_sequential_cnn
+    params, _ = convert_sequential_cnn(tm.state_dict(), (7, 7, 64))
+    from deepvision_tpu.models.vgg import VGG
+    # same reduced geometry on our side: width-8 stages, 32-wide FCs
+    import flax.linen as nn
+
+    class _MiniVGG(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            for stage, depth in enumerate((2, 2, 3, 3, 3)):
+                for _ in range(depth):
+                    x = nn.relu(nn.Conv(8 * min(2 ** stage, 8), (3, 3),
+                                        padding="SAME")(x))
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(5)(x)
+
+    fm = _MiniVGG()
+    x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(fm.apply({"params": params}, jnp.asarray(x)))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+class _TorchDWSep(tnn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = tnn.Module()
+        self.dw.conv = tnn.Conv2d(cin, cin, 3, stride=stride, padding=1,
+                                  groups=cin, bias=False)
+        self.dw.bn = tnn.BatchNorm2d(cin)
+        self.pw = tnn.Module()
+        self.pw.conv = tnn.Conv2d(cin, cout, 1, bias=False)
+        self.pw.bn = tnn.BatchNorm2d(cout)
+
+    def forward(self, x):
+        x = torch.relu(self.dw.bn(self.dw.conv(x)))
+        return torch.relu(self.pw.bn(self.pw.conv(x)))
+
+
+class _TorchMobileNetV1(tnn.Module):
+    """MobileNet checkpoint layout (`MobileNet/pytorch/models/mobilenet_v1.py:
+    27-91`): features[0/1] stem conv+BN, features[3..15] dw/pw blocks,
+    `linear` head."""
+
+    def __init__(self, num_classes=5):
+        super().__init__()
+        body = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+                (1024, 1)]
+        layers = [tnn.Conv2d(3, 32, 3, stride=2, padding=1, bias=False),
+                  tnn.BatchNorm2d(32), tnn.ReLU()]
+        cin = 32
+        for cout, stride in body:
+            layers.append(_TorchDWSep(cin, cout, stride))
+            cin = cout
+        layers.append(tnn.AdaptiveAvgPool2d((1, 1)))
+        self.features = tnn.Sequential(*layers)
+        self.linear = tnn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.linear(x.flatten(1))
+
+
+def test_mobilenet_v1_numerical_parity():
+    torch.manual_seed(0)
+    tm = _TorchMobileNetV1(num_classes=5).eval()
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 2.0)
+    params, batch_stats = convert("mobilenet_v1", tm.state_dict())
+    from deepvision_tpu.models.mobilenet import MobileNetV1
+    fm = MobileNetV1(num_classes=5, dtype=jnp.float32)
+    ref = fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(ref["params"])
+    assert jax.tree_util.tree_structure(batch_stats) == \
+        jax.tree_util.tree_structure(ref["batch_stats"])
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(fm.apply({"params": params, "batch_stats": batch_stats},
+                              jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)

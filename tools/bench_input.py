@@ -24,22 +24,26 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 def write_synthetic_shards(out_dir: str, num_shards: int, per_shard: int,
                            size: int) -> str:
+    """Synthetic JPEG shards via the SAME helpers the real converters use
+    (Datasets/common.py), so the benchmark exercises the production schema."""
     import numpy as np
     import tensorflow as tf
+
+    from Datasets.common import bytes_feature, int64_feature, write_shard
+
     rs = np.random.RandomState(0)
+
+    def example_fn(i):
+        img = rs.randint(0, 255, (size, size, 3), np.uint8)
+        encoded = tf.io.encode_jpeg(img).numpy()
+        return tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": bytes_feature(encoded),
+            "image/class/label": int64_feature(i % 1000 + 1),
+        }))
+
     for shard in range(num_shards):
         path = os.path.join(out_dir, f"train-{shard:05d}-of-{num_shards:05d}")
-        with tf.io.TFRecordWriter(path) as w:
-            for i in range(per_shard):
-                img = rs.randint(0, 255, (size, size, 3), np.uint8)
-                encoded = tf.io.encode_jpeg(img).numpy()
-                ex = tf.train.Example(features=tf.train.Features(feature={
-                    "image/encoded": tf.train.Feature(
-                        bytes_list=tf.train.BytesList(value=[encoded])),
-                    "image/class/label": tf.train.Feature(
-                        int64_list=tf.train.Int64List(value=[i % 1000 + 1])),
-                }))
-                w.write(ex.SerializeToString())
+        write_shard(list(range(per_shard)), path, example_fn)
     return os.path.join(out_dir, "train-*")
 
 

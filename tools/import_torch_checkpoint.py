@@ -21,7 +21,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", required=True,
-                   choices=["resnet50", "resnet101", "resnet152"])
+                   choices=["resnet50", "resnet101", "resnet152",
+                            "vgg16", "vgg19", "alexnet1", "alexnet2",
+                            "mobilenet_v1"])
     p.add_argument("--torch-ckpt", required=True)
     p.add_argument("--workdir", default=None)
     p.add_argument("--image-size", type=int, default=224)
@@ -55,15 +57,16 @@ def main(argv=None):
     params, batch_stats = convert(args.model, state_dict)
 
     cfg = get_config(args.model)
-    cfg = cfg.replace(model_kwargs={**cfg.model_kwargs,
-                                    "stride_on_first": True})
-    # pin the stride placement in the workdir so later `train.py -c latest` /
-    # evaluate runs rebuild the SAME architecture (Trainer reads this file)
+    # ResNet checkpoints stride on conv1 (`resnet50.py:101-106`); pin that in
+    # the workdir so later `train.py -c latest` / evaluate runs rebuild the
+    # SAME architecture (Trainer reads this file). Other families match as-is.
+    pinned = {"stride_on_first": True} if args.model.startswith("resnet") else {}
+    cfg = cfg.replace(model_kwargs={**cfg.model_kwargs, **pinned})
     workdir = args.workdir or os.path.join("runs", cfg.name)
     os.makedirs(workdir, exist_ok=True)
     import json
     with open(os.path.join(workdir, "model_kwargs.json"), "w") as fp:
-        json.dump({"stride_on_first": True}, fp)
+        json.dump(pinned, fp)
     trainer = Trainer(cfg, workdir=workdir)
     trainer.init_state((args.image_size, args.image_size, 3))
     import jax
