@@ -29,6 +29,9 @@ class ConvBN(nn.Module):
     groups: int = 1
     use_bias: bool = False
     relu: bool = True
+    use_bn: bool = True   # False → plain conv(+bias)+relu, the reference's
+                          # BN-free `BasicConv2d` (needed to import its
+                          # checkpoints; BN=True is this repo's modern recipe)
     dtype: jnp.dtype = jnp.bfloat16
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
@@ -36,10 +39,12 @@ class ConvBN(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.features, self.kernel, strides=self.strides, padding=self.padding,
-                    feature_group_count=self.groups, use_bias=self.use_bias,
+                    feature_group_count=self.groups,
+                    use_bias=self.use_bias or not self.use_bn,
                     kernel_init=he_normal_fanout, dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=self.bn_momentum,
-                         epsilon=self.bn_epsilon, dtype=jnp.float32)(x)
+        if self.use_bn:
+            x = nn.BatchNorm(use_running_average=not train, momentum=self.bn_momentum,
+                             epsilon=self.bn_epsilon, dtype=jnp.float32)(x)
         if self.relu:
             x = nn.relu(x)
         return x.astype(self.dtype)

@@ -32,11 +32,12 @@ class InceptionModule(nn.Module):
     b3_reduce: int
     b3: int
     b4: int
+    use_bn: bool = True
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        cb = partial(ConvBN, dtype=self.dtype)
+        cb = partial(ConvBN, dtype=self.dtype, use_bn=self.use_bn)
         y1 = cb(self.b1, (1, 1))(x, train)
         y2 = cb(self.b2_reduce, (1, 1))(x, train)
         y2 = cb(self.b2, (3, 3))(y2, train)
@@ -51,12 +52,13 @@ class AuxClassifier(nn.Module):
     """5x5/3 avg-pool → 1x1 conv(128) → FC(1024) → dropout(0.7) → FC(classes)
     (`inception_v1.py:161-190`)."""
     num_classes: int
+    use_bn: bool = True
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.avg_pool(x, (5, 5), strides=(3, 3))
-        x = ConvBN(128, (1, 1), dtype=self.dtype)(x, train)
+        x = ConvBN(128, (1, 1), dtype=self.dtype, use_bn=self.use_bn)(x, train)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(1024, dtype=self.dtype)(x))
         x = nn.Dropout(0.7, deterministic=not train)(x)
@@ -83,32 +85,38 @@ _V1_CFG = {
 class InceptionV1(nn.Module):
     num_classes: int = 1000
     aux: bool = True
+    use_bn: bool = True  # False = the reference's exact BN-free BasicConv2d
+                         # stack + its torch LRN windows (checkpoint import)
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = ConvBN(64, (7, 7), strides=(2, 2), dtype=self.dtype, name="stem1")(x, train)
+        cb = partial(ConvBN, dtype=self.dtype, use_bn=self.use_bn)
+        # explicit pad 3: SAME pads (2,3) at stride 2, shifting every window
+        # vs the reference's symmetric padding=3 (`inception_v1.py:27`)
+        x = cb(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+               name="stem1")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = lrn(x)
-        x = ConvBN(64, (1, 1), dtype=self.dtype, name="stem2a")(x, train)
-        x = ConvBN(192, (3, 3), dtype=self.dtype, name="stem2b")(x, train)
-        x = lrn(x)
+        x = lrn(x) if self.use_bn else lrn(x, torch_size=64)
+        x = cb(64, (1, 1), name="stem2a")(x, train)
+        x = cb(192, (3, 3), name="stem2b")(x, train)
+        x = lrn(x) if self.use_bn else lrn(x, torch_size=192)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
-        x = InceptionModule(*_V1_CFG["3a"], dtype=self.dtype, name="mod3a")(x, train)
-        x = InceptionModule(*_V1_CFG["3b"], dtype=self.dtype, name="mod3b")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["3a"], dtype=self.dtype, name="mod3a")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["3b"], dtype=self.dtype, name="mod3b")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = InceptionModule(*_V1_CFG["4a"], dtype=self.dtype, name="mod4a")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4a"], dtype=self.dtype, name="mod4a")(x, train)
         aux1_in = x
-        x = InceptionModule(*_V1_CFG["4b"], dtype=self.dtype, name="mod4b")(x, train)
-        x = InceptionModule(*_V1_CFG["4c"], dtype=self.dtype, name="mod4c")(x, train)
-        x = InceptionModule(*_V1_CFG["4d"], dtype=self.dtype, name="mod4d")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4b"], dtype=self.dtype, name="mod4b")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4c"], dtype=self.dtype, name="mod4c")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4d"], dtype=self.dtype, name="mod4d")(x, train)
         aux2_in = x
-        x = InceptionModule(*_V1_CFG["4e"], dtype=self.dtype, name="mod4e")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4e"], dtype=self.dtype, name="mod4e")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = InceptionModule(*_V1_CFG["5a"], dtype=self.dtype, name="mod5a")(x, train)
-        x = InceptionModule(*_V1_CFG["5b"], dtype=self.dtype, name="mod5b")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["5a"], dtype=self.dtype, name="mod5a")(x, train)
+        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["5b"], dtype=self.dtype, name="mod5b")(x, train)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.4, deterministic=not train)(x)
@@ -116,8 +124,10 @@ class InceptionV1(nn.Module):
         main = main.astype(jnp.float32)
 
         if train and self.aux:
-            a1 = AuxClassifier(self.num_classes, dtype=self.dtype, name="aux1")(aux1_in, train)
-            a2 = AuxClassifier(self.num_classes, dtype=self.dtype, name="aux2")(aux2_in, train)
+            a1 = AuxClassifier(self.num_classes, use_bn=self.use_bn,
+                               dtype=self.dtype, name="aux1")(aux1_in, train)
+            a2 = AuxClassifier(self.num_classes, use_bn=self.use_bn,
+                               dtype=self.dtype, name="aux2")(aux2_in, train)
             return main, a1, a2
         return main
 

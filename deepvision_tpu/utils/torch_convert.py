@@ -179,6 +179,55 @@ def convert_mobilenet_v1(state_dict: Dict) -> Tuple[Dict, Dict]:
     return params, stats
 
 
+_INCEPTION_STEM = {"conv7x7": "stem1", "conv1x1": "stem2a", "conv3x3": "stem2b"}
+_INCEPTION_BRANCHES = ("branch1_conv1x1", "branch2_conv1x1", "branch2_conv3x3",
+                       "branch3_conv1x1", "branch3_conv5x5", "branch4_conv1x1")
+_INCEPTION_MODULES = ("3a", "3b", "4a", "4b", "4c", "4d", "4e", "5a", "5b")
+
+
+def convert_inception_v1(state_dict: Dict) -> Tuple[Dict, Dict]:
+    """Reference GoogLeNet state_dict → Flax trees for
+    `InceptionV1(use_bn=False)` (the reference's BN-free BasicConv2d stack,
+    `Inception/pytorch/models/inception_v1.py:27-75,133-142,164-190`).
+
+    conv7x7/conv1x1/conv3x3 → stem1/stem2a/stem2b; inception_Xy branches map
+    in declaration order onto ConvBN_0..5; aux heads keep their avg-pool conv
+    + two Linears (first permuted from CHW flatten); `linear` → head."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+
+    def basic_conv(prefix):
+        return {"Conv_0": {"kernel": _conv_w(sd, f"{prefix}.conv.weight"),
+                           "bias": _np(sd[f"{prefix}.conv.bias"])}}
+
+    params: Dict = {}
+    for torch_name, ours in _INCEPTION_STEM.items():
+        params[ours] = basic_conv(torch_name)
+    for m in _INCEPTION_MODULES:
+        params[f"mod{m}"] = {
+            f"ConvBN_{j}": basic_conv(f"inception_{m}.{branch}")
+            for j, branch in enumerate(_INCEPTION_BRANCHES)}
+    for aux in ("aux1", "aux2"):
+        if f"{aux}.features.1.conv.weight" not in sd:
+            continue
+        c = _np(sd[f"{aux}.features.1.conv.weight"]).shape[0]
+        fc_in = _np(sd[f"{aux}.classifier.0.weight"]).shape[1]
+        hw = int(round((fc_in // c) ** 0.5))
+        params[aux] = {
+            "ConvBN_0": basic_conv(f"{aux}.features.1"),
+            "Dense_0": {"kernel": _linear_w(sd, f"{aux}.classifier.0.weight",
+                                            (hw, hw, c)),
+                        "bias": _np(sd[f"{aux}.classifier.0.bias"])},
+            "Dense_1": {"kernel": _linear_w(sd, f"{aux}.classifier.3.weight"),
+                        "bias": _np(sd[f"{aux}.classifier.3.bias"])},
+        }
+    params["head"] = {"kernel": _np(sd["linear.weight"]).T,
+                      "bias": _np(sd["linear.bias"])}
+    leftover = {k for k in sd if k not in sd.used}
+    if leftover:
+        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
+    return params, {}
+
+
 # final conv-output geometry (H, W, C) feeding the first FC at 224px input
 SEQUENTIAL_CNN_FC_HWC = {
     "vgg16": (7, 7, 512),
@@ -199,8 +248,10 @@ def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
                                       SEQUENTIAL_CNN_FC_HWC[model_name])
     if model_name == "mobilenet_v1":
         return convert_mobilenet_v1(state_dict)
+    if model_name in ("inception_v1", "googlenet"):
+        return convert_inception_v1(state_dict)
     available = sorted(set(RESNET_STAGE_SIZES) | set(SEQUENTIAL_CNN_FC_HWC)
-                       | {"mobilenet_v1"})
+                       | {"mobilenet_v1", "inception_v1"})
     raise KeyError(
         f"no torch-checkpoint converter for {model_name!r} "
         f"(available: {available})")

@@ -146,6 +146,33 @@ def test_basic_block_accepts_flag():
     BasicBlock(8, stride_on_first=True)  # no-op, must not raise
 
 
+def _kaiming_all(model):
+    """Proper relu-gain init for test models: torch's default kaiming-uniform
+    (a=sqrt(5)) underscales deep stacks until logits collapse to the head bias
+    and parity tests become vacuous. Also used to randomize biases."""
+    gen = torch.Generator().manual_seed(0)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, (tnn.Conv2d, tnn.Linear)):
+                tnn.init.kaiming_normal_(m.weight, nonlinearity="relu",
+                                         generator=gen)
+                if m.bias is not None:
+                    m.bias.uniform_(-0.1, 0.1, generator=gen)
+
+
+def _assert_discriminative(torch_model, x_nhwc, expected, atol):
+    """Guard against vacuous parity: the logits must respond to the input by
+    well more than the comparison tolerance."""
+    noise = np.random.RandomState(99).randn(*x_nhwc.shape).astype(np.float32)
+    with torch.no_grad():
+        shifted = torch_model(torch.from_numpy(
+            (x_nhwc + 0.2 * noise).transpose(0, 3, 1, 2))).numpy()
+    sensitivity = np.abs(shifted - expected).max()
+    assert sensitivity > 20 * atol, (
+        f"parity test is vacuous: input sensitivity {sensitivity:.2e} "
+        f"vs atol {atol:.0e}")
+
+
 class _TorchAlexNetV2(tnn.Module):
     """Independent restatement of the reference checkpoint layout
     (`AlexNet/pytorch/models/alexnet_v2.py:30-64`): features Sequential with
@@ -228,6 +255,7 @@ class _TorchMiniVGG(tnn.Module):
 def test_vgg16_numerical_parity():
     torch.manual_seed(0)
     tm = _TorchMiniVGG(width=8, num_classes=5).eval()
+    _kaiming_all(tm)
     from deepvision_tpu.utils.torch_convert import convert_sequential_cnn
     params, _ = convert_sequential_cnn(tm.state_dict(), (7, 7, 64))
     from deepvision_tpu.models.vgg import VGG
@@ -251,6 +279,7 @@ def test_vgg16_numerical_parity():
     x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
     with torch.no_grad():
         expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    _assert_discriminative(tm, x, expected, 2e-4)
     got = np.asarray(fm.apply({"params": params}, jnp.asarray(x)))
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
 
@@ -299,6 +328,7 @@ class _TorchMobileNetV1(tnn.Module):
 def test_mobilenet_v1_numerical_parity():
     torch.manual_seed(0)
     tm = _TorchMobileNetV1(num_classes=5).eval()
+    _kaiming_all(tm)
     with torch.no_grad():
         for m in tm.modules():
             if isinstance(m, tnn.BatchNorm2d):
@@ -315,6 +345,105 @@ def test_mobilenet_v1_numerical_parity():
     x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
     with torch.no_grad():
         expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    _assert_discriminative(tm, x, expected, 2e-4)
     got = np.asarray(fm.apply({"params": params, "batch_stats": batch_stats},
                               jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+class _TorchBasicConv(tnn.Module):
+    def __init__(self, cin, cout, k, **kw):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, k, **kw)
+
+    def forward(self, x):
+        return torch.relu(self.conv(x))
+
+
+class _TorchInceptionModule(tnn.Module):
+    def __init__(self, cin, p1, p2, p3, p4, p5, p6):
+        super().__init__()
+        self.branch1_conv1x1 = _TorchBasicConv(cin, p1, 1)
+        self.branch2_conv1x1 = _TorchBasicConv(cin, p2, 1)
+        self.branch2_conv3x3 = _TorchBasicConv(p2, p3, 3, padding=1)
+        self.branch3_conv1x1 = _TorchBasicConv(cin, p4, 1)
+        self.branch3_conv5x5 = _TorchBasicConv(p4, p5, 5, padding=2)
+        self.branch4_maxpool = tnn.MaxPool2d(3, 1, padding=1)
+        self.branch4_conv1x1 = _TorchBasicConv(cin, p6, 1)
+
+    def forward(self, x):
+        return torch.cat([
+            self.branch1_conv1x1(x),
+            self.branch2_conv3x3(self.branch2_conv1x1(x)),
+            self.branch3_conv5x5(self.branch3_conv1x1(x)),
+            self.branch4_conv1x1(self.branch4_maxpool(x))], dim=1)
+
+
+class _TorchGoogLeNet(tnn.Module):
+    """Reference checkpoint layout (`inception_v1.py:27-127`), full widths,
+    eval path (aux heads present in the state_dict but unused in forward)."""
+
+    CFG = {"3a": (192, 64, 96, 128, 16, 32, 32),
+           "3b": (256, 128, 128, 192, 32, 96, 64),
+           "4a": (480, 192, 96, 208, 16, 48, 64),
+           "4b": (512, 160, 112, 224, 24, 64, 64),
+           "4c": (512, 128, 128, 256, 24, 64, 64),
+           "4d": (512, 112, 144, 288, 32, 64, 64),
+           "4e": (528, 256, 160, 320, 32, 128, 128),
+           "5a": (832, 256, 160, 320, 32, 128, 128),
+           "5b": (832, 384, 192, 384, 48, 128, 128)}
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv7x7 = _TorchBasicConv(3, 64, 7, stride=2, padding=3)
+        self.maxpool1 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.lrn1 = tnn.LocalResponseNorm(64)
+        self.conv1x1 = _TorchBasicConv(64, 64, 1)
+        self.conv3x3 = _TorchBasicConv(64, 192, 3, padding=1)
+        self.lrn2 = tnn.LocalResponseNorm(192)
+        self.maxpool2 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        for name, cfg in self.CFG.items():
+            setattr(self, f"inception_{name}", _TorchInceptionModule(*cfg))
+        for aux, cin in (("aux1", 512), ("aux2", 528)):
+            m = tnn.Module()
+            m.features = tnn.Sequential(tnn.AvgPool2d(5, 3),
+                                        _TorchBasicConv(cin, 128, 1))
+            m.classifier = tnn.Sequential(
+                tnn.Linear(4 * 4 * 128, 1024), tnn.ReLU(), tnn.Dropout(0.7),
+                tnn.Linear(1024, num_classes))
+            setattr(self, aux, m)
+        self.maxpool3 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.maxpool4 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.avgpool = tnn.AvgPool2d(7, stride=1)
+        self.linear = tnn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.lrn1(self.maxpool1(self.conv7x7(x)))
+        x = self.maxpool2(self.lrn2(self.conv3x3(self.conv1x1(x))))
+        x = self.inception_3b(self.inception_3a(x))
+        x = self.inception_4a(self.maxpool3(x))
+        for n in ("4b", "4c", "4d", "4e"):
+            x = getattr(self, f"inception_{n}")(x)
+            if n == "4e":
+                x = self.maxpool4(x)
+        x = self.inception_5b(self.inception_5a(x))
+        x = self.avgpool(x).reshape(x.size(0), -1)
+        return self.linear(x)
+
+
+@pytest.mark.slow
+def test_inception_v1_numerical_parity():
+    torch.manual_seed(0)
+    tm = _TorchGoogLeNet(num_classes=1000).eval()
+    _kaiming_all(tm)
+    params, batch_stats = convert("inception_v1", tm.state_dict())
+    assert batch_stats == {}
+    from deepvision_tpu.models.inception import InceptionV1
+    fm = InceptionV1(num_classes=1000, use_bn=False, dtype=jnp.float32)
+    x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    _assert_discriminative(tm, x, expected, 2e-4)
+    got = np.asarray(fm.apply({"params": params}, jnp.asarray(x),
+                              train=False))
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)

@@ -23,7 +23,7 @@ def main(argv=None):
     p.add_argument("-m", "--model", required=True,
                    choices=["resnet50", "resnet101", "resnet152",
                             "vgg16", "vgg19", "alexnet1", "alexnet2",
-                            "mobilenet_v1"])
+                            "mobilenet_v1", "inception_v1"])
     p.add_argument("--torch-ckpt", required=True)
     p.add_argument("--workdir", default=None)
     p.add_argument("--image-size", type=int, default=224)
@@ -57,10 +57,16 @@ def main(argv=None):
     params, batch_stats = convert(args.model, state_dict)
 
     cfg = get_config(args.model)
-    # ResNet checkpoints stride on conv1 (`resnet50.py:101-106`); pin that in
-    # the workdir so later `train.py -c latest` / evaluate runs rebuild the
-    # SAME architecture (Trainer reads this file). Other families match as-is.
-    pinned = {"stride_on_first": True} if args.model.startswith("resnet") else {}
+    # Architecture pins for checkpoint compatibility, stored in the workdir so
+    # later `train.py -c latest` / evaluate runs rebuild the SAME architecture
+    # (Trainer reads this file). ResNet: stride on conv1 (`resnet50.py:101-106`);
+    # Inception: the reference's BN-free BasicConv2d stack.
+    if args.model.startswith("resnet"):
+        pinned = {"stride_on_first": True}
+    elif args.model == "inception_v1":
+        pinned = {"use_bn": False}
+    else:
+        pinned = {}
     cfg = cfg.replace(model_kwargs={**cfg.model_kwargs, **pinned})
     workdir = args.workdir or os.path.join("runs", cfg.name)
     os.makedirs(workdir, exist_ok=True)
