@@ -93,6 +93,7 @@ class InceptionV1(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         cb = partial(ConvBN, dtype=self.dtype, use_bn=self.use_bn)
+        im = partial(InceptionModule, use_bn=self.use_bn, dtype=self.dtype)
         # explicit pad 3: SAME pads (2,3) at stride 2, shifting every window
         # vs the reference's symmetric padding=3 (`inception_v1.py:27`)
         x = cb(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
@@ -104,19 +105,19 @@ class InceptionV1(nn.Module):
         x = lrn(x) if self.use_bn else lrn(x, torch_size=192)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["3a"], dtype=self.dtype, name="mod3a")(x, train)
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["3b"], dtype=self.dtype, name="mod3b")(x, train)
+        x = im(*_V1_CFG["3a"], name="mod3a")(x, train)
+        x = im(*_V1_CFG["3b"], name="mod3b")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4a"], dtype=self.dtype, name="mod4a")(x, train)
+        x = im(*_V1_CFG["4a"], name="mod4a")(x, train)
         aux1_in = x
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4b"], dtype=self.dtype, name="mod4b")(x, train)
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4c"], dtype=self.dtype, name="mod4c")(x, train)
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4d"], dtype=self.dtype, name="mod4d")(x, train)
+        x = im(*_V1_CFG["4b"], name="mod4b")(x, train)
+        x = im(*_V1_CFG["4c"], name="mod4c")(x, train)
+        x = im(*_V1_CFG["4d"], name="mod4d")(x, train)
         aux2_in = x
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["4e"], dtype=self.dtype, name="mod4e")(x, train)
+        x = im(*_V1_CFG["4e"], name="mod4e")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["5a"], dtype=self.dtype, name="mod5a")(x, train)
-        x = InceptionModule(use_bn=self.use_bn, *_V1_CFG["5b"], dtype=self.dtype, name="mod5b")(x, train)
+        x = im(*_V1_CFG["5a"], name="mod5a")(x, train)
+        x = im(*_V1_CFG["5b"], name="mod5b")(x, train)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.4, deterministic=not train)(x)

@@ -46,17 +46,23 @@ class BasicBlock(nn.Module):
     # BasicBlock always strides its first conv (both here and in the
     # reference), so the flag is accepted for API uniformity and is a no-op
     stride_on_first: bool = False
+    # The reference projects the FIRST block of every stage even when shapes
+    # already match (`resnet34.py:116-128` downsample=True on block 0, incl.
+    # the stride-1 64→64 conv2x stage) — required to import its checkpoints.
+    always_project: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
                        dtype=self.dtype)
         residual = x
-        y = conv(self.features, (3, 3), strides=self.strides)(x)
+        # explicit pad 1: torch pad-1 geometry; SAME differs at stride 2
+        y = conv(self.features, (3, 3), strides=self.strides,
+                 padding=[(1, 1), (1, 1)])(x)
         y = _BN()(y, train).astype(self.dtype)
-        y = conv(self.features, (3, 3))(y)
+        y = conv(self.features, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
-        if residual.shape != y.shape:
+        if self.always_project or residual.shape != y.shape:
             residual = conv(self.features, (1, 1), strides=self.strides,
                             name="proj")(residual)
             residual = _BN(relu=False)(residual, train)
@@ -74,6 +80,9 @@ class BottleneckBlock(nn.Module):
     expansion: int = 4
     dtype: jnp.dtype = jnp.bfloat16
     stride_on_first: bool = False
+    always_project: bool = False  # accepted for stage-policy uniformity with
+                                  # BasicBlock; bottleneck first blocks always
+                                  # change channels so this is normally moot
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -85,11 +94,12 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = conv(self.features, (1, 1), strides=s1)(x)
         y = _BN()(y, train).astype(self.dtype)
-        y = conv(self.features, (3, 3), strides=s2)(y)
+        y = conv(self.features, (3, 3), strides=s2,
+                 padding=[(1, 1), (1, 1)])(y)  # torch pad-1 geometry
         y = _BN()(y, train).astype(self.dtype)
         y = conv(out_features, (1, 1))(y)
         y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
-        if residual.shape != y.shape:
+        if self.always_project or residual.shape != y.shape:
             residual = conv(out_features, (1, 1), strides=self.strides,
                             name="proj")(residual)
             residual = _BN(relu=False)(residual, train)
@@ -105,6 +115,8 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     stride_on_first: bool = False  # reference stride placement, for imported
                                    # torch checkpoints (utils/torch_convert.py)
+    project_first_blocks: bool = False  # reference BasicBlock policy: project
+                                        # block 0 of every stage (import compat)
     stem_space_to_depth: bool = False  # MLPerf-style TPU stem: 2x2
     # space-to-depth then a 4x4/1 conv on (H/2, W/2, 4C). The C=3 7x7/2 stem
     # conv tiles poorly onto the MXU (channel dim far below the 128 lane
@@ -139,8 +151,11 @@ class ResNet(nn.Module):
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                kw = dict(block_kwargs)
+                if self.project_first_blocks and j == 0:
+                    kw["always_project"] = True
                 x = self.block(self.width * 2 ** i, strides=strides,
-                               dtype=self.dtype, **block_kwargs)(x, train=train)
+                               dtype=self.dtype, **kw)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      kernel_init=nn.initializers.normal(0.01), name="head")(x)

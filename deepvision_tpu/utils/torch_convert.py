@@ -179,6 +179,56 @@ def convert_mobilenet_v1(state_dict: Dict) -> Tuple[Dict, Dict]:
     return params, stats
 
 
+def infer_basic_stage_sizes(state_dict: Dict) -> Tuple[int, ...]:
+    """Blocks per stage, counted from the checkpoint keys. The reference's
+    `resnet34.py` actually builds 2 blocks per stage (a latent quirk — the
+    file cites Table 1's 34-layer column but passes num_blocks=2,
+    `resnet34.py:38-41`), so depth must follow the weights, not the name."""
+    sd = strip_data_parallel(state_dict)
+    sizes = []
+    for stage in RESNET_TORCH_STAGES:
+        n = 0
+        while f"{stage}.{n}.conv1.weight" in sd:
+            n += 1
+        sizes.append(n)
+    return tuple(sizes)
+
+
+def convert_resnet_basic(state_dict: Dict) -> Tuple[Dict, Dict]:
+    """Reference basic-block ResNet state_dict → Flax trees matching
+    `models/resnet.py` BasicBlock naming. Build the model with
+    `stage_sizes=infer_basic_stage_sizes(sd)` and `project_first_blocks=True`
+    (the reference projects block 0 of every stage, `resnet34.py:116-128`)."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+    params: Dict = {"stem_conv": {"kernel": _conv_w(sd, "conv1.weight")}}
+    stats: Dict = {}
+    params["_BN_0"], stats["_BN_0"] = _bn(sd, "bn1")
+    params["head"] = {"kernel": _np(sd["linear.weight"]).T,
+                      "bias": _np(sd["linear.bias"])}
+    b = 0
+    for stage, n in zip(RESNET_TORCH_STAGES, infer_basic_stage_sizes(sd)):
+        for i in range(n):
+            t = f"{stage}.{i}"
+            blk_p: Dict = {}
+            blk_s: Dict = {}
+            for j in range(2):
+                blk_p[f"Conv_{j}"] = {
+                    "kernel": _conv_w(sd, f"{t}.conv{j + 1}.weight")}
+                blk_p[f"_BN_{j}"], blk_s[f"_BN_{j}"] = _bn(sd, f"{t}.bn{j + 1}")
+            if f"{t}.projection.0.weight" in sd:
+                blk_p["proj"] = {
+                    "kernel": _conv_w(sd, f"{t}.projection.0.weight")}
+                blk_p["_BN_2"], blk_s["_BN_2"] = _bn(sd, f"{t}.projection.1")
+            params[f"BasicBlock_{b}"] = blk_p
+            stats[f"BasicBlock_{b}"] = blk_s
+            b += 1
+    leftover = {k for k in sd if k not in sd.used
+                and not k.endswith("num_batches_tracked")}
+    if leftover:
+        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
+    return params, stats
+
+
 _INCEPTION_STEM = {"conv7x7": "stem1", "conv1x1": "stem2a", "conv3x3": "stem2b"}
 _INCEPTION_BRANCHES = ("branch1_conv1x1", "branch2_conv1x1", "branch2_conv3x3",
                        "branch3_conv1x1", "branch3_conv5x5", "branch4_conv1x1")
@@ -243,6 +293,8 @@ def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
     if model_name in RESNET_STAGE_SIZES:
         return convert_resnet_bottleneck(state_dict,
                                          RESNET_STAGE_SIZES[model_name])
+    if model_name == "resnet34":
+        return convert_resnet_basic(state_dict)
     if model_name in SEQUENTIAL_CNN_FC_HWC:
         return convert_sequential_cnn(state_dict,
                                       SEQUENTIAL_CNN_FC_HWC[model_name])
@@ -251,7 +303,7 @@ def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
     if model_name in ("inception_v1", "googlenet"):
         return convert_inception_v1(state_dict)
     available = sorted(set(RESNET_STAGE_SIZES) | set(SEQUENTIAL_CNN_FC_HWC)
-                       | {"mobilenet_v1", "inception_v1"})
+                       | {"resnet34", "mobilenet_v1", "inception_v1"})
     raise KeyError(
         f"no torch-checkpoint converter for {model_name!r} "
         f"(available: {available})")

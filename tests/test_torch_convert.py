@@ -447,3 +447,81 @@ def test_inception_v1_numerical_parity():
     got = np.asarray(fm.apply({"params": params}, jnp.asarray(x),
                               train=False))
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+class _TorchBasicBlock(tnn.Module):
+    """Reference BasicBlock layout (`resnet34.py:92-142`): stride+projection
+    on block 0 of every stage (even stride-1 same-width conv2x)."""
+
+    def __init__(self, cin, cout, stride, project):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride=stride, padding=1,
+                                bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, padding=1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.projection = (tnn.Sequential(
+            tnn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+            tnn.BatchNorm2d(cout)) if project else None)
+
+    def forward(self, x):
+        identity = self.projection(x) if self.projection is not None else x
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(y + identity)
+
+
+class _TorchBasicResNet(tnn.Module):
+    """The reference's 'resnet34' (actually 2 blocks/stage, `resnet34.py:38-41`)
+    at reduced width."""
+
+    def __init__(self, width=8, num_classes=5):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 7, stride=2, padding=3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.maxpool = tnn.MaxPool2d(3, stride=2, padding=1)
+        w = width
+        def stage(cin, cout, stride):
+            return tnn.Sequential(_TorchBasicBlock(cin, cout, stride, True),
+                                  _TorchBasicBlock(cout, cout, 1, False))
+        self.conv2x = stage(w, w, 1)
+        self.conv3x = stage(w, 2 * w, 2)
+        self.conv4x = stage(2 * w, 4 * w, 2)
+        self.conv5x = stage(4 * w, 8 * w, 2)
+        self.linear = tnn.Linear(8 * w, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        for s in (self.conv2x, self.conv3x, self.conv4x, self.conv5x):
+            x = s(x)
+        return self.linear(x.mean(dim=(2, 3)))
+
+
+def test_resnet34_basicblock_numerical_parity():
+    from deepvision_tpu.models.resnet import BasicBlock, ResNet
+    from deepvision_tpu.utils.torch_convert import (convert_resnet_basic,
+                                                    infer_basic_stage_sizes)
+    torch.manual_seed(0)
+    tm = _TorchBasicResNet(width=8, num_classes=5).eval()
+    _kaiming_all(tm)
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 2.0)
+    sd = tm.state_dict()
+    assert infer_basic_stage_sizes(sd) == (2, 2, 2, 2)
+    params, batch_stats = convert_resnet_basic(sd)
+    fm = ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, width=8,
+                num_classes=5, dtype=jnp.float32, project_first_blocks=True)
+    ref = fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(ref["params"])
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    _assert_discriminative(tm, x, expected, 2e-4)
+    got = np.asarray(fm.apply({"params": params, "batch_stats": batch_stats},
+                              jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)

@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", required=True,
-                   choices=["resnet50", "resnet101", "resnet152",
+                   choices=["resnet34", "resnet50", "resnet101", "resnet152",
                             "vgg16", "vgg19", "alexnet1", "alexnet2",
                             "mobilenet_v1", "inception_v1"])
     p.add_argument("--torch-ckpt", required=True)
@@ -61,7 +61,13 @@ def main(argv=None):
     # later `train.py -c latest` / evaluate runs rebuild the SAME architecture
     # (Trainer reads this file). ResNet: stride on conv1 (`resnet50.py:101-106`);
     # Inception: the reference's BN-free BasicConv2d stack.
-    if args.model.startswith("resnet"):
+    if args.model == "resnet34":
+        # depth follows the weights (the reference's resnet34.py actually
+        # builds 2 blocks/stage); block 0 of every stage projects
+        from deepvision_tpu.utils.torch_convert import infer_basic_stage_sizes
+        pinned = {"stage_sizes": list(infer_basic_stage_sizes(state_dict)),
+                  "project_first_blocks": True}
+    elif args.model.startswith("resnet"):
         pinned = {"stride_on_first": True}
     elif args.model == "inception_v1":
         pinned = {"use_bn": False}
