@@ -25,10 +25,12 @@ class LeNet5(nn.Module):
         x = x.astype(self.dtype)
         x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="c1")(x)
         x = jnp.tanh(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        # the reference squashes AFTER the subsampling layers too (S2/S4 are
+        # "pool → trainable-free tanh" there, `lenet5.py:30-42`)
+        x = jnp.tanh(nn.avg_pool(x, (2, 2), strides=(2, 2)))
         x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="c3")(x)
         x = jnp.tanh(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.tanh(nn.avg_pool(x, (2, 2), strides=(2, 2)))
         x = nn.Conv(120, (5, 5), padding="VALID", dtype=self.dtype, name="c5")(x)
         x = jnp.tanh(x)
         x = x.reshape((x.shape[0], -1))

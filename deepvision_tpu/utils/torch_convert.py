@@ -70,9 +70,10 @@ class _RecordingDict(dict):
         return super().__getitem__(k)
 
 
-def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict]:
-    """Reference bottleneck-ResNet state_dict → (params, batch_stats) matching
-    `models/resnet.py` naming (stem_conv/_BN_0/BottleneckBlock_i/head)."""
+def _convert_resnet(state_dict: Dict, stage_sizes, convs_per_block: int,
+                    block_name: str) -> Tuple[Dict, Dict]:
+    """Shared reference-ResNet mapper: stem_conv/_BN_0, per-block
+    Conv_j/_BN_j (+ proj/_BN_<convs_per_block>), head."""
     sd = _RecordingDict(strip_data_parallel(state_dict))
     params: Dict = {"stem_conv": {"kernel": _conv_w(sd, "conv1.weight")}}
     stats: Dict = {}
@@ -86,14 +87,15 @@ def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict
             t = f"{stage}.{i}"
             blk_p: Dict = {}
             blk_s: Dict = {}
-            for j in range(3):
+            for j in range(convs_per_block):
                 blk_p[f"Conv_{j}"] = {"kernel": _conv_w(sd, f"{t}.conv{j + 1}.weight")}
                 blk_p[f"_BN_{j}"], blk_s[f"_BN_{j}"] = _bn(sd, f"{t}.bn{j + 1}")
             if f"{t}.projection.0.weight" in sd:
                 blk_p["proj"] = {"kernel": _conv_w(sd, f"{t}.projection.0.weight")}
-                blk_p["_BN_3"], blk_s["_BN_3"] = _bn(sd, f"{t}.projection.1")
-            params[f"BottleneckBlock_{b}"] = blk_p
-            stats[f"BottleneckBlock_{b}"] = blk_s
+                blk_p[f"_BN_{convs_per_block}"], blk_s[f"_BN_{convs_per_block}"] = \
+                    _bn(sd, f"{t}.projection.1")
+            params[f"{block_name}_{b}"] = blk_p
+            stats[f"{block_name}_{b}"] = blk_s
             b += 1
 
     leftover = {k for k in sd if k not in sd.used
@@ -104,6 +106,12 @@ def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict
             f"— checkpoint depth doesn't match stage_sizes={tuple(stage_sizes)}; "
             f"wrong -m model for this .pt?")
     return params, stats
+
+
+def convert_resnet_bottleneck(state_dict: Dict, stage_sizes) -> Tuple[Dict, Dict]:
+    """Reference bottleneck-ResNet state_dict → (params, batch_stats) matching
+    `models/resnet.py` naming (stem_conv/_BN_0/BottleneckBlock_i/head)."""
+    return _convert_resnet(state_dict, stage_sizes, 3, "BottleneckBlock")
 
 
 def _linear_w(sd, key, flatten_hwc: Tuple[int, int, int] = None):
@@ -142,6 +150,28 @@ def convert_sequential_cnn(state_dict: Dict, first_fc_hwc: Tuple[int, int, int]
             "kernel": _linear_w(sd, f"classifier.{i}.weight",
                                 first_fc_hwc if j == 0 else None),
             "bias": _np(sd[f"classifier.{i}.bias"])}
+    leftover = {k for k in sd if k not in sd.used}
+    if leftover:
+        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
+    return params, {}
+
+
+def convert_lenet5(state_dict: Dict) -> Tuple[Dict, Dict]:
+    """Reference LeNet-5 state_dict → Flax trees (`LeNet/pytorch/models/
+    lenet5.py:24-60`: convs at features indices 0/4/8 among Tanh/AvgPool,
+    Linears at classifier 0/2). C5's 1x1 spatial output makes the flatten
+    permutation trivial."""
+    sd = _RecordingDict(strip_data_parallel(state_dict))
+    conv_names = ("c1", "c3", "c5")
+    conv_idx = sorted(int(k.split(".")[1]) for k in sd
+                      if k.startswith("features.") and k.endswith(".weight"))
+    params: Dict = {}
+    for name, i in zip(conv_names, conv_idx):
+        params[name] = {"kernel": _conv_w(sd, f"features.{i}.weight"),
+                        "bias": _np(sd[f"features.{i}.bias"])}
+    for name, i in zip(("f6", "output"), (0, 2)):
+        params[name] = {"kernel": _linear_w(sd, f"classifier.{i}.weight"),
+                        "bias": _np(sd[f"classifier.{i}.bias"])}
     leftover = {k for k in sd if k not in sd.used}
     if leftover:
         raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
@@ -199,34 +229,8 @@ def convert_resnet_basic(state_dict: Dict) -> Tuple[Dict, Dict]:
     `models/resnet.py` BasicBlock naming. Build the model with
     `stage_sizes=infer_basic_stage_sizes(sd)` and `project_first_blocks=True`
     (the reference projects block 0 of every stage, `resnet34.py:116-128`)."""
-    sd = _RecordingDict(strip_data_parallel(state_dict))
-    params: Dict = {"stem_conv": {"kernel": _conv_w(sd, "conv1.weight")}}
-    stats: Dict = {}
-    params["_BN_0"], stats["_BN_0"] = _bn(sd, "bn1")
-    params["head"] = {"kernel": _np(sd["linear.weight"]).T,
-                      "bias": _np(sd["linear.bias"])}
-    b = 0
-    for stage, n in zip(RESNET_TORCH_STAGES, infer_basic_stage_sizes(sd)):
-        for i in range(n):
-            t = f"{stage}.{i}"
-            blk_p: Dict = {}
-            blk_s: Dict = {}
-            for j in range(2):
-                blk_p[f"Conv_{j}"] = {
-                    "kernel": _conv_w(sd, f"{t}.conv{j + 1}.weight")}
-                blk_p[f"_BN_{j}"], blk_s[f"_BN_{j}"] = _bn(sd, f"{t}.bn{j + 1}")
-            if f"{t}.projection.0.weight" in sd:
-                blk_p["proj"] = {
-                    "kernel": _conv_w(sd, f"{t}.projection.0.weight")}
-                blk_p["_BN_2"], blk_s["_BN_2"] = _bn(sd, f"{t}.projection.1")
-            params[f"BasicBlock_{b}"] = blk_p
-            stats[f"BasicBlock_{b}"] = blk_s
-            b += 1
-    leftover = {k for k in sd if k not in sd.used
-                and not k.endswith("num_batches_tracked")}
-    if leftover:
-        raise ValueError(f"unconsumed weights: {sorted(leftover)[:5]}")
-    return params, stats
+    return _convert_resnet(state_dict, infer_basic_stage_sizes(state_dict),
+                           2, "BasicBlock")
 
 
 _INCEPTION_STEM = {"conv7x7": "stem1", "conv1x1": "stem2a", "conv3x3": "stem2b"}
@@ -300,10 +304,12 @@ def convert(model_name: str, state_dict: Dict) -> Tuple[Dict, Dict]:
                                       SEQUENTIAL_CNN_FC_HWC[model_name])
     if model_name == "mobilenet_v1":
         return convert_mobilenet_v1(state_dict)
+    if model_name == "lenet5":
+        return convert_lenet5(state_dict)
     if model_name in ("inception_v1", "googlenet"):
         return convert_inception_v1(state_dict)
     available = sorted(set(RESNET_STAGE_SIZES) | set(SEQUENTIAL_CNN_FC_HWC)
-                       | {"resnet34", "mobilenet_v1", "inception_v1"})
+                       | {"resnet34", "mobilenet_v1", "inception_v1", "lenet5"})
     raise KeyError(
         f"no torch-checkpoint converter for {model_name!r} "
         f"(available: {available})")

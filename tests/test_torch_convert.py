@@ -525,3 +525,38 @@ def test_resnet34_basicblock_numerical_parity():
     got = np.asarray(fm.apply({"params": params, "batch_stats": batch_stats},
                               jnp.asarray(x), train=False))
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+class _TorchLeNet5(tnn.Module):
+    """Reference LeNet-5 layout (`LeNet/pytorch/models/lenet5.py:24-60`):
+    tanh after every conv AND after each avg-pool subsampling."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = tnn.Sequential(
+            tnn.Conv2d(1, 6, 5), tnn.Tanh(), tnn.AvgPool2d(2, 2), tnn.Tanh(),
+            tnn.Conv2d(6, 16, 5), tnn.Tanh(), tnn.AvgPool2d(2, 2), tnn.Tanh(),
+            tnn.Conv2d(16, 120, 5), tnn.Tanh())
+        self.classifier = tnn.Sequential(tnn.Linear(120, 84), tnn.Tanh(),
+                                         tnn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.classifier(x.reshape(x.size(0), -1))
+
+
+def test_lenet5_numerical_parity():
+    from deepvision_tpu.models.lenet import LeNet5
+    torch.manual_seed(0)
+    tm = _TorchLeNet5().eval()
+    _kaiming_all(tm)
+    params, batch_stats = convert("lenet5", tm.state_dict())
+    assert batch_stats == {}
+    fm = LeNet5()
+    x = np.random.RandomState(0).rand(2, 32, 32, 1).astype(np.float32)
+    with torch.no_grad():
+        expected = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    _assert_discriminative(tm, x, expected, 1e-5)
+    got = np.asarray(fm.apply({"params": params}, jnp.asarray(x),
+                              train=False))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)

@@ -23,10 +23,12 @@ def main(argv=None):
     p.add_argument("-m", "--model", required=True,
                    choices=["resnet34", "resnet50", "resnet101", "resnet152",
                             "vgg16", "vgg19", "alexnet1", "alexnet2",
-                            "mobilenet_v1", "inception_v1"])
+                            "mobilenet_v1", "inception_v1", "lenet5"])
     p.add_argument("--torch-ckpt", required=True)
     p.add_argument("--workdir", default=None)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="sample input edge for model init (default: the "
+                        "model config's image size)")
     p.add_argument("--allow-pickle", action="store_true",
                    help="permit full unpickling of non-weights-only "
                         "checkpoints (runs arbitrary code; trusted files only)")
@@ -80,7 +82,8 @@ def main(argv=None):
     with open(os.path.join(workdir, "model_kwargs.json"), "w") as fp:
         json.dump(pinned, fp)
     trainer = Trainer(cfg, workdir=workdir)
-    trainer.init_state((args.image_size, args.image_size, 3))
+    size = args.image_size or cfg.data.image_size
+    trainer.init_state((size, size, cfg.data.channels))
     import jax
     trainer.state = trainer.state.replace(
         params=jax.device_put(params), batch_stats=jax.device_put(batch_stats))
