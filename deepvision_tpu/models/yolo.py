@@ -39,8 +39,10 @@ class ConvBNLeaky(nn.Module):
         x = nn.Conv(self.features, (self.kernel, self.kernel),
                     strides=(self.strides, self.strides), padding="SAME",
                     use_bias=False, dtype=self.dtype)(x)
+        # epsilon matches the reference's Keras BatchNormalization default
+        # (1e-3, `yolov3.py:36`) so its h5 weights compute the same function
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32)(x)
+                         epsilon=1e-3, dtype=jnp.float32)(x)
         return nn.leaky_relu(x, 0.1).astype(self.dtype)
 
 

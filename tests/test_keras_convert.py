@@ -1,0 +1,136 @@
+"""Keras save_weights h5 import (`deepvision_tpu/utils/keras_convert.py`).
+
+Builds an independent tiny Keras model using the REFERENCE's deterministic
+layer-naming scheme (`YOLO/tensorflow/yolov3.py:23-235`), saves its weights to
+h5 the way the reference trainer does (`train.py:244-257`), converts, and
+checks our Flax YoloV3 computes the same three raw heads."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax.numpy as jnp  # noqa: E402
+
+from deepvision_tpu.models.yolo import YoloV3  # noqa: E402
+from deepvision_tpu.utils.keras_convert import (  # noqa: E402
+    convert, convert_yolov3, load_h5_weights)
+
+WIDTH_MULT = 0.125
+STAGE_BLOCKS = (1, 1, 2, 2, 1)  # tiny but same shape grammar
+NUM_CLASSES = 2
+
+
+def _w(f):
+    return max(1, int(f * WIDTH_MULT))
+
+
+def _darknet_conv(x, filters, kernel, strides, name):
+    L = tf.keras.layers
+    x = L.Conv2D(filters, kernel, strides=strides, padding="same",
+                 use_bias=False, name=name + "_conv2d")(x)
+    x = L.BatchNormalization(name=name + "_bn")(x)
+    return L.LeakyReLU(alpha=0.1, name=name + "_leakyrelu")(x)
+
+
+def _residual(x, f1, f2, name):
+    y = _darknet_conv(x, f1, 1, 1, name + "_1x1")
+    y = _darknet_conv(y, f2, 3, 1, name + "_3x3")
+    return tf.keras.layers.Add(name=name + "_add")([x, y])
+
+
+def _build_keras_yolo(shape=(64, 64, 3)):
+    L = tf.keras.layers
+    inputs = L.Input(shape=shape)
+    x = _darknet_conv(inputs, _w(32), 3, 1, "conv2d_0")
+    outs = []
+    for stage, (blocks, f) in enumerate(zip(STAGE_BLOCKS,
+                                            (64, 128, 256, 512, 1024))):
+        x = _darknet_conv(x, _w(f), 3, 2, f"conv2d_{stage + 1}")
+        for j in range(blocks):
+            x = _residual(x, _w(f // 2), _w(f), f"residual_{stage}_{j}")
+        if stage >= 2:
+            outs.append(x)
+    x_small, x_medium, x_large = outs
+
+    final_filters = 3 * (5 + NUM_CLASSES)
+
+    def tower(x, f, scale):
+        n = f"detector_scale_{scale}"
+        x = _darknet_conv(x, f, 1, 1, f"{n}_1x1_1")
+        x = _darknet_conv(x, f * 2, 3, 1, f"{n}_3x3_1")
+        x = _darknet_conv(x, f, 1, 1, f"{n}_1x1_2")
+        x = _darknet_conv(x, f * 2, 3, 1, f"{n}_3x3_2")
+        x = _darknet_conv(x, f, 1, 1, f"{n}_1x1_3")
+        y = _darknet_conv(x, f * 2, 3, 1, f"{n}_3x3_3")
+        y = L.Conv2D(final_filters, 1, padding="same",
+                     name=f"{n}_final_conv2d")(y)
+        return x, y
+
+    x, y_large = tower(x_large, _w(512), "large")
+    x = _darknet_conv(x, _w(256), 1, 1, "detector_scale_medium_1x1_0")
+    x = L.UpSampling2D(2)(x)
+    x = L.Concatenate()([x, x_medium])
+    x, y_medium = tower(x, _w(256), "medium")
+    x = _darknet_conv(x, _w(128), 1, 1, "detector_scale_small_1x1_0")
+    x = L.UpSampling2D(2)(x)
+    x = L.Concatenate()([x, x_small])
+    _, y_small = tower(x, _w(128), "small")
+    return tf.keras.Model(inputs, (y_small, y_medium, y_large))
+
+
+def test_yolov3_h5_numerical_parity(tmp_path):
+    tf.random.set_seed(0)
+    km = _build_keras_yolo()
+    # randomize BN stats so the moving_* conversion is exercised
+    for layer in km.layers:
+        if isinstance(layer, tf.keras.layers.BatchNormalization):
+            mean, var = layer.moving_mean, layer.moving_variance
+            mean.assign(tf.random.uniform(mean.shape, -0.5, 0.5, seed=1))
+            var.assign(tf.random.uniform(var.shape, 0.5, 2.0, seed=2))
+    # Write the LEGACY Keras-2 h5 layout the reference's TF2.1-era
+    # `save_weights` produced (per-layer groups, `<weight>:0` datasets) —
+    # Keras 3 in this environment can no longer write it itself.
+    import h5py
+    h5 = str(tmp_path / "yolov3_best.h5")
+    with h5py.File(h5, "w") as f:
+        for layer in km.layers:
+            if not layer.weights:
+                continue
+            if isinstance(layer, tf.keras.layers.BatchNormalization):
+                names = ("gamma", "beta", "moving_mean", "moving_variance")
+            elif len(layer.weights) == 2:
+                names = ("kernel", "bias")
+            else:
+                names = ("kernel",)
+            g = f.create_group(layer.name).create_group(layer.name)
+            for name, w in zip(names, layer.weights):
+                g.create_dataset(f"{name}:0", data=w.numpy())
+
+    weights = load_h5_weights(h5)
+    params, batch_stats = convert_yolov3(weights, stage_blocks=STAGE_BLOCKS)
+
+    fm = YoloV3(num_classes=NUM_CLASSES, width_mult=WIDTH_MULT,
+                stage_blocks=STAGE_BLOCKS, dtype=jnp.float32)
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    expected = [np.asarray(t) for t in km(x, training=False)]
+    # same reshape the reference applies before returning (yolov3.py:208-218)
+    expected = [e.reshape(e.shape[0], e.shape[1], e.shape[2], 3,
+                          5 + NUM_CLASSES) for e in expected]
+
+    got = fm.apply({"params": params, "batch_stats": batch_stats},
+                   jnp.asarray(x), train=False, decode=False)
+    assert len(got) == 3
+    for g, e in zip(got, expected):
+        np.testing.assert_allclose(np.asarray(g), e, rtol=2e-4, atol=2e-4)
+
+    # discriminative guard: heads must respond to the input
+    noise = np.random.RandomState(9).randn(*x.shape).astype(np.float32)
+    shifted = np.asarray(km(x + 0.2 * noise, training=False)[0])
+    assert np.abs(shifted.reshape(expected[0].shape) - expected[0]).max() \
+        > 20 * 2e-4
+
+
+def test_convert_dispatch_unknown():
+    with pytest.raises(KeyError):
+        convert("hourglass104", {})

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Import a reference Keras `save_weights` h5 checkpoint into Orbax format.
+
+The reference's TF2 trainers publish best-on-val-loss h5 weight files
+(`YOLO/tensorflow/train.py:244-257`, filenames like
+`yolov3_mscoco_..._0.87.h5`). This maps them onto our Flax YoloV3 via
+`deepvision_tpu/utils/keras_convert.py` and saves epoch N so
+`YOLO/jax/train.py -c latest` / `detect.py` / `evaluate.py` pick them up.
+
+Usage:
+    python tools/import_keras_checkpoint.py -m yolov3 \
+        --h5 yolov3_best.h5 --workdir runs/yolov3 [--epoch 40]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True,
+                   choices=["yolov3", "yolov3_voc"])
+    p.add_argument("--h5", required=True,
+                   help="Keras save_weights file (legacy TF2 h5 layout)")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--epoch", type=int, default=0,
+                   help="epoch number to record (the reference encodes it in "
+                        "the filename, train.py:300-304)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer
+    from deepvision_tpu.utils.keras_convert import convert, load_h5_weights
+
+    weights = load_h5_weights(args.h5)
+    params, batch_stats = convert(args.model, weights)
+
+    cfg = get_config(args.model)
+    workdir = args.workdir or os.path.join("runs", cfg.name)
+    trainer = DetectionTrainer(cfg, workdir=workdir)
+    size = cfg.data.image_size
+    trainer.init_state((size, size, cfg.data.channels))
+
+    # fail fast on structure/shape mismatches (e.g. an 80-class COCO h5 fed
+    # to -m yolov3_voc) instead of an opaque error later in train/evaluate
+    def check(path, got, want):
+        got = jax.numpy.asarray(got)
+        if got.shape != want.shape:
+            raise SystemExit(
+                f"{args.h5} does not fit {args.model}: "
+                f"{jax.tree_util.keystr(path)} has shape {got.shape}, "
+                f"model expects {want.shape}")
+        return got
+    params = jax.tree_util.tree_map_with_path(
+        check, params, trainer.state.params)
+    batch_stats = jax.tree_util.tree_map_with_path(
+        check, batch_stats, trainer.state.batch_stats)
+
+    trainer.state = trainer.state.replace(
+        params=jax.device_put(params), batch_stats=jax.device_put(batch_stats))
+    trainer.ckpt.save(args.epoch, trainer.state,
+                      host_state={"imported_from": args.h5})
+    trainer.close()
+    print(f"imported epoch {args.epoch} from {args.h5} into {workdir}")
+
+
+if __name__ == "__main__":
+    main()
