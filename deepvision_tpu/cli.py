@@ -62,6 +62,9 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="mesh 'spatial' axis size: shard activations along "
                         "image height (context parallelism; GSPMD "
                         "halo-exchanges the convs)")
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore (-c/--auto-resume) and run validation once; "
+                        "no training")
     p.add_argument("--multihost", action="store_true",
                    help="force jax.distributed.initialize() (auto-detected "
                         "when a coordinator address env var is set)")
@@ -81,10 +84,14 @@ def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,
     common = dict(image_size=data.image_size,
                   num_process=jax.process_count(),
                   process_index=jax.process_index())
-    train_ds = build_dataset(os.path.join(data_dir, "train*"), training=True,
-                             batch_size=per_host, **common)
     val_ds = build_dataset(os.path.join(data_dir, "val*"), training=False,
                            batch_size=eval_per_host, **common)
+    if getattr(args, "eval_only", False):
+        def val_fn(epoch, _ds=val_ds):
+            return epoch_iterator(_ds)
+        return _no_train_data, val_fn
+    train_ds = build_dataset(os.path.join(data_dir, "train*"), training=True,
+                             batch_size=per_host, **common)
     # imagenet repeats its dataset → always bound each epoch; detection/pose
     # datasets are single-pass per epoch (reference semantics) → iterate fully
     # unless --steps-per-epoch explicitly bounds them
@@ -152,17 +159,38 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     # mnist pipeline pads 28→32, matching the configured image_size
     sample_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     trainer.init_state(sample_shape)
+    restored = None
     if args.checkpoint:
-        trainer.resume(None if args.checkpoint == "latest" else int(args.checkpoint))
+        restored = trainer.resume(
+            None if args.checkpoint == "latest" else int(args.checkpoint))
     elif args.auto_resume:
         # preemption recovery (SURVEY.md §5.3): latest checkpoint if present,
         # fresh start otherwise — resume() returns None when the dir is empty
-        trainer.resume()
+        restored = trainer.resume()
+    if args.eval_only:
+        if restored is None:
+            # random weights would print a plausible-looking number; the
+            # whole point of --eval-only is checking a restored checkpoint
+            raise SystemExit(
+                "--eval-only requires a restored checkpoint: pass -c "
+                f"latest|N (and check --workdir; nothing restorable in "
+                f"{trainer.workdir!r})")
+        # evaluate a restored (e.g. imported) checkpoint without training —
+        # the tail of the migration workflow: import_torch_checkpoint.py
+        # then `train.py -m <model> -c latest --eval-only`
+        result = trainer.evaluate(val_fn(0))
+        trainer.close()
+        print("eval: " + " ".join(f"{k}={v:.4f}" for k, v in result.items()))
+        return result
     result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
                          profile_dir=args.profile_dir)
     trainer.close()
     print(f"done: best={result.get('best_metric')}")
     return result
+
+
+def _no_train_data(epoch):
+    raise RuntimeError("training data was not built (--eval-only)")
 
 
 def _synthetic_data(cfg, make_batches: Callable):
@@ -184,12 +212,15 @@ def _classification_data(cfg, args):
     elif data.dataset == "mnist":
         from .data.mnist import MnistBatches, load_split
         data_dir = args.data_dir or data.data_dir or "dataset/mnist"
-        train_x, train_y = load_split(data_dir, "train")
         test_x, test_y = load_split(data_dir, "test")
+        if getattr(args, "eval_only", False):
+            train_fn = _no_train_data
+        else:
+            train_x, train_y = load_split(data_dir, "train")
 
-        def train_fn(epoch):
-            return MnistBatches(train_x, train_y, cfg.batch_size, shuffle=True,
-                                seed=epoch)
+            def train_fn(epoch):
+                return MnistBatches(train_x, train_y, cfg.batch_size,
+                                    shuffle=True, seed=epoch)
 
         def val_fn(epoch):
             return MnistBatches(test_x, test_y,
@@ -215,17 +246,20 @@ def _classification_data(cfg, args):
         steps = args.steps_per_epoch
         # one instance per split: the directory scan happens once, and
         # FlatImageNet reshuffles internally on each __iter__ (epoch bump)
-        train_ds = FlatImageNet(os.path.join(data_dir, "train_flatten"),
-                                synsets, training=True,
-                                batch_size=cfg.batch_size // jax.process_count(),
-                                **common)
         val_ds = FlatImageNet(
             os.path.join(data_dir, "val_flatten"), synsets, training=False,
             batch_size=(cfg.eval_batch_size or cfg.batch_size)
             // jax.process_count(), **common)
+        if getattr(args, "eval_only", False):
+            train_fn = _no_train_data
+        else:
+            train_ds = FlatImageNet(
+                os.path.join(data_dir, "train_flatten"), synsets,
+                training=True,
+                batch_size=cfg.batch_size // jax.process_count(), **common)
 
-        def train_fn(epoch, _ds=train_ds, _steps=steps):
-            return itertools.islice(iter(_ds), _steps) if _steps else _ds
+            def train_fn(epoch, _ds=train_ds, _steps=steps):
+                return itertools.islice(iter(_ds), _steps) if _steps else _ds
 
         def val_fn(epoch, _ds=val_ds):
             return _ds

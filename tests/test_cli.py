@@ -64,3 +64,22 @@ def test_eval_batch_size_flag(tmp_path):
     assert args.eval_batch_size == 64
     cfg = get_config("lenet5").replace(eval_batch_size=args.eval_batch_size)
     assert (cfg.eval_batch_size or cfg.batch_size) == 64
+
+
+def test_eval_only_restores_and_validates(tmp_path):
+    """--eval-only: the tail of the checkpoint-migration workflow — restore
+    and validate without training."""
+    base = ["-m", "lenet5", "--synthetic", "--batch-size", "16",
+            "--steps-per-epoch", "2", "--workdir", str(tmp_path)]
+    run_classification("LeNet", ["lenet5"], argv=base + ["--epochs", "1"])
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=base + ["-c", "latest", "--eval-only"])
+    assert "top1" in result and "count" in result
+    # no second epoch was trained
+    from deepvision_tpu.core.trainer import Trainer
+    tr = Trainer(get_config("lenet5").replace(batch_size=16),
+                 workdir=str(tmp_path))
+    tr.init_state((32, 32, 1))
+    assert tr.resume() == 1
+    tr.close()
