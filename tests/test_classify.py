@@ -51,3 +51,22 @@ def test_load_class_names_fallback_and_json(tmp_path):
     names = load_class_names(str(p), 4)
     assert names[0] == "tench" and names[2] == "goldfish"
     assert names[1] == "class 1"
+
+
+def test_classify_cli_tool(lenet_workdir, tmp_path, capsys):
+    """tools/classify.py: the script form of the notebook predict() cell."""
+    import importlib.util
+    import os
+    from PIL import Image
+    img = tmp_path / "d.png"
+    Image.fromarray((np.random.RandomState(0).rand(28, 28) * 255)
+                    .astype(np.uint8)).save(img)
+    spec = importlib.util.spec_from_file_location(
+        "classify_tool", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "classify.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["-m", "lenet5", "--workdir", lenet_workdir, "--top", "2",
+              str(img)])
+    out = capsys.readouterr().out
+    assert str(img) in out and "%" in out

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Single-image classification from a trained/imported checkpoint — the
+script form of the per-family demo notebooks' `predict()` cell
+(`<Family>/jax/notebooks/*.ipynb`; reference: the `predict(net, img)` cells in
+`ResNet/pytorch/notebooks/ResNet50.ipynb`).
+
+Usage:
+    python tools/classify.py -m resnet50 --workdir runs/resnet50 \
+        [--class-names Datasets/ILSVRC2012/indices.json] img1.jpg img2.jpg
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True)
+    p.add_argument("--workdir", default=None,
+                   help="training workdir holding ckpt/ (default runs/<model>)")
+    def _epoch(v):
+        if v == "latest":
+            return None
+        try:
+            return int(v)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an epoch number or 'latest', got {v!r}")
+
+    p.add_argument("-c", "--checkpoint", default=None, type=_epoch,
+                   help="epoch number (default: latest)")
+    p.add_argument("--top", type=int, default=5)
+    p.add_argument("--class-names", default=None,
+                   help="indices.json or one-name-per-line file")
+    p.add_argument("images", nargs="+")
+    args = p.parse_args(argv)
+
+    from deepvision_tpu.core.classify import Classifier
+
+    clf = Classifier(args.model, workdir=args.workdir,
+                     checkpoint=args.checkpoint,
+                     class_names_file=args.class_names)
+    if clf.epoch is None:
+        raise SystemExit(f"no checkpoint found under "
+                         f"{args.workdir or os.path.join('runs', args.model)!r}")
+    for path in args.images:
+        print(path)
+        for name, prob in clf.predict(path, top=args.top):
+            print(f"  {prob:6.2%}  {name}")
+
+
+if __name__ == "__main__":
+    main()
