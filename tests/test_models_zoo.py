@@ -120,3 +120,25 @@ def test_channel_shuffle_roundtrip():
     assert (z == x).all()
     # channels actually move
     assert not (y == x).all()
+
+
+def test_aux_losses_are_combined_with_paper_weight():
+    """SURVEY §7.2 hard part #6: the reference returned (main, aux1, aux2)
+    but never combined them; here the loss must equal
+    main + 0.3*(aux1 + aux2), each with the same label smoothing."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.core import losses
+
+    rs = np.random.RandomState(0)
+    main, a1, a2 = (jnp.asarray(rs.randn(4, 10), jnp.float32)
+                    for _ in range(3))
+    labels = jnp.asarray(rs.randint(0, 10, 4))
+    combined = losses.classification_loss((main, a1, a2), labels,
+                                          label_smoothing=0.1, aux_weight=0.3)
+    parts = [losses.classification_loss(t, labels, label_smoothing=0.1)
+             for t in (main, a1, a2)]
+    np.testing.assert_allclose(
+        float(combined), float(parts[0] + 0.3 * (parts[1] + parts[2])),
+        rtol=1e-6)
