@@ -107,8 +107,9 @@ def pad_to_multiple(n: int, k: int) -> int:
 
 
 def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
-    """Sharding pytree for params: shard the largest axis of big tensors over 'model',
-    replicate everything else.
+    """Sharding pytree for params: for big tensors, shard the LAST axis
+    (output features of conv HWIO / dense kernels) over 'model' when it
+    divides, else the largest divisible axis; replicate everything else.
 
     When the mesh's model axis is 1 (pure DP) this degenerates to full replication,
     matching the reference's replicated-weights semantics. For wide final projections
@@ -120,8 +121,12 @@ def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
     def rule(x):
         if model_size == 1 or x.ndim == 0 or x.size < min_size_to_shard:
             return NamedSharding(mesh, P())
-        # shard the largest divisible axis over 'model'
-        axes = sorted(range(x.ndim), key=lambda a: -x.shape[a])
+        # Prefer the LAST axis (output features for conv HWIO / dense kernels):
+        # output-channel sharding propagates cleanly through the layer's
+        # activations, where sharding an inner axis forces GSPMD reshards in
+        # the backward pass. Fall back to the largest divisible axis.
+        axes = [x.ndim - 1] + sorted(range(x.ndim - 1),
+                                     key=lambda a: -x.shape[a])
         for a in axes:
             if x.shape[a] % model_size == 0:
                 spec = [None] * x.ndim

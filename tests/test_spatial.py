@@ -134,13 +134,10 @@ def test_make_mesh_rejects_bad_factorization():
 
 def test_yolo_spatial_train_step_matches_dp():
     """Detection steps rely on input shardings (no explicit constraint): a
-    tiny YOLO train step on a (4,2,1) data+spatial mesh must produce the same
-    loss and updated params as pure DP — boxes (B,100,4) stay batch-sharded
-    (rank-3 rule) while images get H sharded."""
+    tiny YOLO train step on a (4,2,1) data+spatial mesh must land in the same
+    loss band as pure DP with matching global update magnitude — boxes
+    (B,100,4) stay batch-sharded (rank-3 rule) while images get H sharded."""
     from deepvision_tpu.core.detection import make_yolo_train_step
-    from deepvision_tpu.core.train_state import TrainState, init_model
-    from deepvision_tpu.core.optim import build_optimizer
-    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
     from deepvision_tpu.models import MODELS
     from deepvision_tpu.ops.yolo import MAX_BOXES
 
@@ -158,6 +155,7 @@ def test_yolo_spatial_train_step_matches_dp():
     def one_step(mesh):
         params, batch_stats = init_model(model, rng,
                                          jnp.zeros((2, size, size, 3)))
+        init_params = jax.tree_util.tree_map(np.asarray, params)
         tx = build_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3),
                              ScheduleConfig(name="constant"), 10, 1)
         state = TrainState.create(model.apply, params, tx, batch_stats)
@@ -168,19 +166,45 @@ def test_yolo_spatial_train_step_matches_dp():
         sharded = mesh_lib.shard_batch_pytree(
             mesh, (images, boxes, classes, valid))
         state, metrics = step(state, *sharded, rng)
-        return float(metrics["loss"]), state
+        delta = jax.tree_util.tree_map(
+            lambda new, old: np.asarray(new) - old, state.params, init_params)
+        return float(metrics["loss"]), delta
 
-    loss_dp, state_dp = one_step(mesh_lib.make_mesh())
-    loss_sp, state_sp = one_step(_mesh_spatial())
+    loss_dp, delta_dp = one_step(mesh_lib.make_mesh())
+    loss_sp, delta_sp = one_step(_mesh_spatial())
     assert np.isfinite(loss_sp)
     # The YOLO loss is chaotically sensitive to float reassociation at random
     # init: the IoU ignore mask is a hard threshold, and near-threshold boxes
     # flip with any reduction-order change (even pure-DP differs from
-    # single-device by ~0.5% on this batch). Exact equivalence is therefore
-    # not a meaningful bar here — assert the spatial run lands within the
-    # same few-percent band and produced finite, same-shaped updates.
+    # single-device by ~0.5% on this batch). Exact per-element equivalence is
+    # therefore not a meaningful bar — instead the loss must land in the same
+    # few-percent band and the GLOBAL update magnitude must agree (a
+    # mis-reduced gradient, e.g. the 2x over-reduction documented above,
+    # scales every update and fails the norm check).
     np.testing.assert_allclose(loss_dp, loss_sp, rtol=0.05)
-    for a, b in zip(jax.tree_util.tree_leaves(state_dp.params),
-                    jax.tree_util.tree_leaves(state_sp.params)):
-        assert np.all(np.isfinite(np.asarray(b)))
-        assert a.shape == b.shape
+    norm = lambda tree: float(np.sqrt(sum(  # noqa: E731
+        np.sum(np.square(x)) for x in jax.tree_util.tree_leaves(tree))))
+    n_dp, n_sp = norm(delta_dp), norm(delta_sp)
+    assert n_dp > 0 and np.isfinite(n_sp)
+    np.testing.assert_allclose(n_dp, n_sp, rtol=0.2)
+
+
+def test_param_sharding_rules_axis_choice(mesh_4x2):
+    """Model-parallel sharding rules: big tensors shard their LAST axis
+    (output features) when it divides the model axis, fall back to the
+    largest divisible axis, and small tensors stay replicated."""
+    P = jax.sharding.PartitionSpec
+    params = {
+        "head": np.zeros((2048, 1000), np.float32),     # last axis divisible
+        "odd_last": np.zeros((2048, 1001), np.float32),  # falls back to dim 0
+        "small": np.zeros((64,), np.float32),            # < 1MiB → replicated
+        "indivisible": np.zeros((1001, 1001), np.float32),  # nothing divides
+    }
+    rules = mesh_lib.param_sharding_rules(mesh_4x2, params)
+    assert rules["head"].spec == P(None, "model")
+    assert rules["odd_last"].spec == P("model", None)
+    assert rules["small"].spec == P()
+    assert rules["indivisible"].spec == P()
+    # pure-DP mesh degenerates to full replication
+    dp_rules = mesh_lib.param_sharding_rules(mesh_lib.make_mesh(), params)
+    assert all(r.spec == P() for r in jax.tree_util.tree_leaves(dp_rules))
