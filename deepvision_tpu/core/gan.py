@@ -191,6 +191,7 @@ class DCGANTrainer(AdversarialTrainer):
         from ..models.gan import DCGANDiscriminator, DCGANGenerator
         self.noise_dim = noise_dim
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = DCGANGenerator(noise_dim=noise_dim)
         self.discriminator = DCGANDiscriminator()
 
@@ -358,6 +359,7 @@ class CycleGANTrainer(AdversarialTrainer):
         building LinearDecay); defaults to config.data.train_examples / batch."""
         from ..models.gan import CycleGANGenerator, PatchGANDiscriminator
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = CycleGANGenerator(n_blocks=n_blocks)
         self.discriminator = PatchGANDiscriminator()
 

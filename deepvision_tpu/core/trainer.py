@@ -88,6 +88,8 @@ class Trainer:
             model = model_ctor(**kwargs)
         self.model = model
 
+        mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
+
         self.steps_per_epoch = max(
             1, config.data.train_examples // config.batch_size)
         opt_cfg = config.optimizer

@@ -106,6 +106,17 @@ def pad_to_multiple(n: int, k: int) -> int:
     return int(math.ceil(n / k) * k)
 
 
+def check_batch_divisible(batch_size: int, mesh: Mesh) -> None:
+    """Train batches shard over 'data' with no padding — fail early with a
+    remedy instead of a deep device_put shape error."""
+    data_axis = mesh.shape[DATA_AXIS]
+    if batch_size % data_axis != 0:
+        raise ValueError(
+            f"global batch_size={batch_size} must be divisible by the mesh "
+            f"data axis ({data_axis} devices); nearest valid: "
+            f"{pad_to_multiple(batch_size, data_axis)}")
+
+
 def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
     """Sharding pytree for params: for big tensors, shard the LAST axis
     (output features of conv HWIO / dense kernels) over 'model' when it
