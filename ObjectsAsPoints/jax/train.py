@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Train CenterNet (ObjectsAsPoints) on TPU — `python train.py -m centernet`.
+"""Train CenterNet (ObjectsAsPoints) on TPU — `python train.py -m centernet` (alias: `objects_as_points`).
 
 The reference left this family disabled (`ObjectsAsPoints/tensorflow/train.py:35,248`
 — empty loss list, commented-out runner); this entrypoint runs the completed
@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from deepvision_tpu.cli import run_centernet
 
-MODELS = ["centernet"]
+MODELS = ["centernet", "objects_as_points"]
 
 if __name__ == "__main__":
     run_centernet("ObjectsAsPoints", MODELS)

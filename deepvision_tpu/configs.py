@@ -178,14 +178,19 @@ CONFIGS.register("yolov3_voc", TrainConfig(
 # -- CenterNet / ObjectsAsPoints (ObjectsAsPoints/tensorflow/model.py:130-131:
 #    256px 2-stack hourglass, COCO 80 classes; the reference trainer was never
 #    wired — recipe per Zhou 2019 §5.2 adapted to the plateau convention) ------
-CONFIGS.register("centernet", TrainConfig(
+_CENTERNET = TrainConfig(
     name="centernet", model="centernet", batch_size=64, total_epochs=140,
     optimizer=OptimizerConfig(name="adam", learning_rate=1.25e-4),
     schedule=ScheduleConfig(name="step", boundaries_epochs=(90, 120),
                             decay_factor=0.1),
     data=DataConfig(dataset="detection", image_size=256, num_classes=80,
                     train_examples=118287, val_examples=5000),
-))
+)
+CONFIGS.register("centernet", _CENTERNET)
+# the reference names the family ObjectsAsPoints; accept the paper name too
+# (own name → own runs/objects_as_points workdir, no checkpoint clobbering)
+CONFIGS.register("objects_as_points", _CENTERNET.replace(
+    name="objects_as_points"))
 
 
 def get_config(name: str) -> TrainConfig:

@@ -89,6 +89,13 @@ class Trainer:
         self.model = model
 
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
+        if config.eval_batch_size:
+            # classification eval pads partial batches, but the loss-watched
+            # evaluate (detection/pose/centernet) shards without padding —
+            # validate up front either way so the failure isn't a post-epoch
+            # device_put error
+            mesh_lib.check_batch_divisible(config.eval_batch_size, self.mesh,
+                                           what="eval_batch_size")
 
         self.steps_per_epoch = max(
             1, config.data.train_examples // config.batch_size)

@@ -106,15 +106,19 @@ def pad_to_multiple(n: int, k: int) -> int:
     return int(math.ceil(n / k) * k)
 
 
-def check_batch_divisible(batch_size: int, mesh: Mesh) -> None:
-    """Train batches shard over 'data' with no padding — fail early with a
-    remedy instead of a deep device_put shape error."""
+def check_batch_divisible(batch_size: int, mesh: Mesh,
+                          what: str = "batch_size") -> None:
+    """Batches shard over 'data' with no padding — fail early with a remedy
+    instead of a deep device_put shape error."""
     data_axis = mesh.shape[DATA_AXIS]
     if batch_size % data_axis != 0:
+        down = (batch_size // data_axis) * data_axis
+        nearest = max(data_axis,
+                      down if batch_size - down <= data_axis // 2
+                      else down + data_axis)
         raise ValueError(
-            f"global batch_size={batch_size} must be divisible by the mesh "
-            f"data axis ({data_axis} devices); nearest valid: "
-            f"{pad_to_multiple(batch_size, data_axis)}")
+            f"global {what}={batch_size} must be divisible by the mesh "
+            f"data axis ({data_axis} devices); nearest valid: {nearest}")
 
 
 def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
