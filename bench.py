@@ -19,18 +19,48 @@ import numpy as np
 P100_BASELINE_IMG_PER_SEC = 230.0
 
 
-def _devices_with_cpu_fallback():
+def _devices_with_cpu_fallback(probe_timeout_s: int = 240):
     """jax.devices(), falling back to CPU if the TPU backend is unreachable
-    (tunnel flakes must yield a number, not a crash)."""
+    (tunnel flakes must yield a number, not a crash).
+
+    The tunnel can HANG rather than error (observed: >10 min stuck claiming
+    the relay), which would hang this process at the first backend touch.
+    So the TPU is probed in a SUBPROCESS with a hard timeout first; only a
+    healthy probe lets this process touch the default backend."""
+    import os
+    import subprocess
     import sys
-    try:
-        return jax.devices()
-    except RuntimeError as e:
-        # stderr only — stdout is the one-JSON-line contract
-        print(f"TPU backend unavailable ({e}); falling back to CPU",
+
+    def _fall_back(reason):
+        print(f"TPU backend unavailable ({reason}); falling back to CPU",
               file=sys.stderr, flush=True)
         jax.config.update("jax_platforms", "cpu")
         return jax.devices()
+
+    # Probe unless CPU was explicitly requested: the unset/auto-discovery
+    # default also initializes installed PJRT plugins and can hang the same
+    # way. DEVNULL + its own session so a tunnel helper process inheriting
+    # pipes can't block us past the timeout (killpg reaps the whole group).
+    if jax.config.jax_platforms != "cpu":
+        import signal
+        probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=dict(os.environ), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        try:
+            rc = probe.wait(timeout=probe_timeout_s)
+            if rc != 0:
+                return _fall_back(f"probe exited {rc}")
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(probe.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            return _fall_back(f"probe timed out after {probe_timeout_s}s")
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        return _fall_back(e)
 
 
 def main():

@@ -29,16 +29,29 @@ def test_sigkill_mid_training_then_auto_resume(tmp_path):
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     try:
-        # wait until at least one checkpoint is fully written
+        # Wait until at least one checkpoint is fully COMMITTED (orbax step
+        # dir present without an in-progress marker): a kill during the very
+        # first async save correctly leaves nothing restorable — that's the
+        # atomicity property, not a resume failure — so killing on the first
+        # sign of a directory makes the test race itself.
         ckpt_root = tmp_path / "ckpt"
-        deadline = time.time() + 240
+
+        def committed_steps():
+            # orbax finalizes by atomically renaming
+            # `<step>.orbax-checkpoint-tmp-*` → `<step>`, so a pure-digit
+            # directory name IS the commit marker
+            if not ckpt_root.is_dir():
+                return []
+            return [int(d.name) for d in ckpt_root.iterdir()
+                    if d.is_dir() and d.name.isdigit()]
+
+        deadline = time.time() + 420
         while time.time() < deadline:
-            if ckpt_root.is_dir() and any(ckpt_root.iterdir()):
-                time.sleep(2)  # let one more save land mid-flight
+            if committed_steps():
                 break
             time.sleep(1)
         else:
-            pytest.fail("no checkpoint appeared within 240s")
+            pytest.fail("no committed checkpoint appeared within 420s")
         proc.send_signal(signal.SIGKILL)  # preemption: no cleanup possible
         proc.wait(timeout=60)
     finally:
