@@ -22,6 +22,13 @@ from .configs import CONFIGS, get_config
 SYNTH_STEPS_DEFAULT = 8
 
 
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
 def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description=f"Train {family} models (TPU-native JAX). Models: {', '.join(models)}")
@@ -43,6 +50,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="validation batch size (defaults to --batch-size)")
     p.add_argument("--learning-rate", type=float, default=None,
                    help="override the config's base learning rate")
+    p.add_argument("--accum-steps", type=_positive_int, default=None,
+                   help="gradient accumulation: average grads over k "
+                        "micro-batches per optimizer update (effective batch "
+                        "= batch-size * k)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="override output classes/keypoints (e.g. MPII=16 "
                         "heatmaps, custom VOC subsets)")
@@ -130,6 +141,9 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, learning_rate=args.learning_rate,
             base_batch_size=None))
+    if args.accum_steps:
+        cfg = cfg.replace(optimizer=dataclasses.replace(
+            cfg.optimizer, accum_steps=args.accum_steps))
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))

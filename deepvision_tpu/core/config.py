@@ -30,6 +30,14 @@ class OptimizerConfig:
     # the 75.3% north star needs at pod batch sizes (BASELINE.md). None keeps
     # the configured LR verbatim (reference semantics at batch 256).
     base_batch_size: Optional[int] = None
+    # Gradient accumulation: average grads over k micro-batches before one
+    # optimizer update, making effective global batch = batch_size * k. Lets a
+    # single chip reproduce the reference's multi-GPU global batches (e.g. the
+    # 8-GPU batch-512 ResNet-34 run, `ResNet/pytorch/README.md:47`) — a
+    # capability absent from the reference itself (SURVEY.md §2.8). The LR
+    # schedule ticks once per applied update, and linear LR scaling uses the
+    # effective batch. Note BN statistics remain per-micro-batch.
+    accum_steps: int = 1
 
 
 @dataclasses.dataclass

@@ -17,7 +17,16 @@ from .schedules import build_schedule
 
 def build_optimizer(opt_cfg: OptimizerConfig, sched_cfg: ScheduleConfig,
                     steps_per_epoch: int, total_epochs: int) -> optax.GradientTransformation:
-    schedule = build_schedule(sched_cfg, opt_cfg.learning_rate, steps_per_epoch, total_epochs)
+    accum = opt_cfg.accum_steps
+    if accum < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum}")
+    # Under accumulation the inner chain (and thus the schedule counter) ticks
+    # once per APPLIED update, not per micro-batch — epoch boundaries in the
+    # schedule must be expressed in updates/epoch. Kept fractional: flooring
+    # would compress warmup/boundaries/total whenever accum doesn't divide
+    # steps_per_epoch (MultiSteps' buffer carries across epoch edges).
+    schedule = build_schedule(sched_cfg, opt_cfg.learning_rate,
+                              steps_per_epoch / accum, total_epochs)
 
     parts = []
     if opt_cfg.grad_clip_norm:
@@ -52,11 +61,28 @@ def build_optimizer(opt_cfg: OptimizerConfig, sched_cfg: ScheduleConfig,
                             optax.scale(-1.0), optax.scale(lr_scale))
         return chain
 
-    return optax.inject_hyperparams(lambda lr_scale: _lr(lr_scale))(lr_scale=1.0)
+    tx = optax.inject_hyperparams(lambda lr_scale: _lr(lr_scale))(lr_scale=1.0)
+    if accum > 1:
+        # MultiSteps buffers the running mean of the micro-batch grads and
+        # emits zero updates until the k-th call, when the inner chain
+        # (weight decay, momentum, schedule) sees the averaged gradient —
+        # identical semantics to one large-batch step for everything except
+        # BatchNorm statistics.
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    return tx
 
 
 def set_lr_scale(opt_state, scale: float):
-    """Write the plateau scale into an inject_hyperparams state (host side)."""
+    """Write the plateau scale into an inject_hyperparams state (host side).
+
+    With gradient accumulation the inject_hyperparams state lives inside
+    MultiStepsState.inner_opt_state — walk down to it."""
     import jax.numpy as jnp
-    opt_state.hyperparams["lr_scale"] = jnp.asarray(scale, dtype=jnp.float32)
+    inner = opt_state
+    while not hasattr(inner, "hyperparams"):
+        if hasattr(inner, "inner_opt_state"):
+            inner = inner.inner_opt_state
+        else:
+            raise ValueError("opt_state has no inject_hyperparams layer")
+    inner.hyperparams["lr_scale"] = jnp.asarray(scale, dtype=jnp.float32)
     return opt_state

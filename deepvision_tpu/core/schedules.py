@@ -22,8 +22,10 @@ import optax
 from .config import ScheduleConfig
 
 
-def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: int,
+def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: float,
                    total_epochs: int) -> optax.Schedule:
+    # steps_per_epoch may be fractional (updates/epoch under gradient
+    # accumulation); every use below multiplies first, then truncates.
     warmup_steps = int(cfg.warmup_epochs * steps_per_epoch)
     total_steps = max(1, int(total_epochs * steps_per_epoch))
 
@@ -31,7 +33,13 @@ def build_schedule(cfg: ScheduleConfig, base_lr: float, steps_per_epoch: int,
         # plateau: base schedule is constant; the host-side PlateauState scales it.
         base = optax.constant_schedule(base_lr)
     elif cfg.name == "step":
-        boundaries = {int(e * steps_per_epoch): cfg.decay_factor for e in cfg.boundaries_epochs}
+        # compound factors when distinct boundary epochs land on the same
+        # update index (possible when updates/epoch < 1 under accumulation —
+        # a plain dict comprehension would silently drop all but one decay)
+        boundaries: dict = {}
+        for e in cfg.boundaries_epochs:
+            k = int(e * steps_per_epoch)
+            boundaries[k] = boundaries.get(k, 1.0) * cfg.decay_factor
         base = optax.piecewise_constant_schedule(base_lr, boundaries)
     elif cfg.name == "cosine":
         base = optax.cosine_decay_schedule(base_lr, total_steps,

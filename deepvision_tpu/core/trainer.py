@@ -100,12 +100,20 @@ class Trainer:
         self.steps_per_epoch = max(
             1, config.data.train_examples // config.batch_size)
         opt_cfg = config.optimizer
-        if opt_cfg.base_batch_size and config.batch_size != opt_cfg.base_batch_size:
-            scaled = opt_cfg.learning_rate * config.batch_size / opt_cfg.base_batch_size
+        # effective global batch includes gradient accumulation (one optimizer
+        # update sees batch_size * accum_steps examples); build_optimizer
+        # rejects accum_steps < 1
+        accum = opt_cfg.accum_steps
+        effective_batch = config.batch_size * accum
+        if accum > 1 and _is_main_process():
+            print(f"[{config.name}] gradient accumulation: {accum} micro-steps "
+                  f"-> effective batch {effective_batch}", flush=True)
+        if opt_cfg.base_batch_size and effective_batch != opt_cfg.base_batch_size:
+            scaled = opt_cfg.learning_rate * effective_batch / opt_cfg.base_batch_size
             if _is_main_process():
                 print(f"[{config.name}] linear LR scaling: "
                       f"{opt_cfg.learning_rate} -> {scaled:g} "
-                      f"(batch {config.batch_size}/{opt_cfg.base_batch_size})",
+                      f"(batch {effective_batch}/{opt_cfg.base_batch_size})",
                       flush=True)
             opt_cfg = dataclasses.replace(opt_cfg, learning_rate=scaled)
         self.tx = build_optimizer(opt_cfg, config.schedule,

@@ -173,3 +173,74 @@ def test_seeded_runs_are_bitwise_identical(tmp_path):
     a, b = run("a"), run("b")
     for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
         np.testing.assert_array_equal(x, y)
+
+
+def test_gradient_accumulation_optimizer_semantics():
+    """accum_steps=k: zero updates for k-1 micro-batches, then one inner step
+    on the MEAN gradient — bitwise what a k-times-larger batch would do
+    (modulo BN stats). SURVEY.md §2.8 lists accumulation as absent from the
+    reference; this is the single-chip path to its 8-GPU batch sizes."""
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.core.optim import build_optimizer, set_lr_scale
+
+    k, lr = 3, 0.5
+    opt = OptimizerConfig(name="sgd", learning_rate=lr, momentum=0.0,
+                          weight_decay=0.0, accum_steps=k)
+    tx = build_optimizer(opt, ScheduleConfig(name="constant"),
+                         steps_per_epoch=30, total_epochs=1)
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    grads = [{"w": jnp.full((4,), g)} for g in (1.0, 2.0, 6.0)]
+
+    p = params
+    for i, g in enumerate(grads):
+        updates, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, updates)
+        if i < k - 1:  # buffered: no visible change yet
+            np.testing.assert_allclose(np.asarray(p["w"]), 1.0)
+    # mean grad = 3.0 -> w = 1 - lr * 3
+    np.testing.assert_allclose(np.asarray(p["w"]), 1.0 - lr * 3.0, rtol=1e-6)
+
+    # the plateau hook must reach the inject_hyperparams layer through
+    # MultiStepsState and remain a no-op on the pytree structure
+    state = set_lr_scale(state, 0.1)
+    tx.update(grads[0], state, p)
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        build_optimizer(OptimizerConfig(name="sgd", accum_steps=0),
+                        ScheduleConfig(name="constant"), 10, 1)
+
+
+def test_gradient_accumulation_trainer_runs(tmp_path):
+    """Trainer integration: accum_steps>1 trains, loss decreases, and the
+    linear-scaling rule sees the EFFECTIVE batch (batch * accum)."""
+    cfg = _config(tmp_path, total_epochs=3,
+                  optimizer=OptimizerConfig(name="momentum", learning_rate=0.01,
+                                            accum_steps=2, base_batch_size=32))
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    result = tr.fit(_data(), _data(epoch_seedless=True), sample_shape=(32, 32, 1))
+    hist = tr.logger.history["train_loss"]["value"]
+    assert hist[-1] < hist[0], f"loss did not decrease: {hist}"
+    assert result["best_metric"] is not None
+    tr.close()
+
+    # MultiStepsState (mini_step / acc_grads / nested hyperparams) must
+    # round-trip through the Orbax checkpoint
+    tr2 = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    assert tr2.resume() == 3
+    tr2.close()
+
+
+def test_gradient_accumulation_effective_batch_scaling(tmp_path, capsys):
+    """batch 32 x accum 4 against base 64 -> LR doubles (not halves)."""
+    cfg = _config(tmp_path,
+                  optimizer=OptimizerConfig(name="momentum", learning_rate=0.1,
+                                            accum_steps=4, base_batch_size=64))
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd2"))
+    out = capsys.readouterr().out
+    assert "gradient accumulation: 4 micro-steps -> effective batch 128" in out
+    assert "linear LR scaling: 0.1 -> 0.2" in out
+    tr.close()
