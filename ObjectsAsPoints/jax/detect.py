@@ -1,9 +1,11 @@
 #!/usr/bin/env python
-"""YOLO V3 inference: restore a checkpoint, detect objects in images, print/save
-boxes — the role of the reference's demo notebook + `Postprocessor`
-(`YOLO/tensorflow/demo_mscoco.ipynb`, `postprocess.py:6-36`).
+"""CenterNet inference: restore a checkpoint, detect objects in images, print
+boxes — completing the inference surface the reference's WIP family never
+shipped (`ObjectsAsPoints/tensorflow/train.py:248` disabled runner; no
+inference script or README upstream). Peak-pick decode replaces NMS
+(paper §3 via `ops/centernet.py:decode`).
 
-Usage: python detect.py -m yolov3 --workdir runs/yolov3 image1.jpg ...
+Usage: python detect.py --workdir runs/centernet image1.jpg ...
 """
 import argparse
 import os
@@ -12,36 +14,34 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("-m", "--model", default="yolov3",
-                   choices=["yolov3", "yolov3_voc"])
     p.add_argument("--workdir", default=None,
                    help="training workdir holding ckpt/ (default runs/<model>)")
-    p.add_argument("--iou-thresh", type=float, default=0.5)
-    p.add_argument("--score-thresh", type=float, default=0.5)
-    p.add_argument("--image-size", type=int, default=416)
+    p.add_argument("--score-thresh", type=float, default=0.3)
+    p.add_argument("--max-detections", type=int, default=100)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="inference resolution (default: the config's)")
     p.add_argument("images", nargs="+")
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax.numpy as jnp
     import numpy as np
     from PIL import Image
 
     from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.detection import DetectionTrainer, make_predict_step
+    from deepvision_tpu.core.centernet import (CenterNetTrainer,
+                                               make_centernet_predict_step)
 
-    cfg = get_config(args.model)
-    trainer = DetectionTrainer(
+    cfg = get_config("centernet")
+    trainer = CenterNetTrainer(
         cfg, workdir=args.workdir or os.path.join("runs", cfg.name))
-    trainer.init_state((args.image_size, args.image_size, 3))
+    size = args.image_size or cfg.data.image_size
+    trainer.init_state((size, size, 3))
     if trainer.resume() is None:
         print("WARNING: no checkpoint found — using random weights")
 
-    size = args.image_size
-    # decoded per-scale outputs → flatten → NMS (`postprocess.py:12-36`)
-    predict = make_predict_step(iou_thresh=args.iou_thresh,
-                                score_thresh=args.score_thresh)
+    predict = make_centernet_predict_step(max_detections=args.max_detections)
     from deepvision_tpu.data.class_names import names_for
     names = names_for(cfg.data.num_classes)
 
@@ -54,15 +54,15 @@ def main():
         for j, path in enumerate(paths):
             img = Image.open(path).convert("RGB").resize((size, size))
             batch[j] = np.asarray(img, np.float32) / 127.5 - 1.0
-        nms_boxes, nms_scores, nms_classes, counts = predict(
-            trainer.state, jnp.asarray(batch))
+        boxes, scores, classes = map(np.asarray,
+                                     predict(trainer.state, jnp.asarray(batch)))
         for i, path in enumerate(paths):
-            n = int(counts[i])
+            keep = scores[i] >= args.score_thresh  # scores are top-k descending
+            n = int(keep.sum())
             print(f"{path}: {n} detections")
             for d in range(n):
-                x1, y1, x2, y2 = np.asarray(nms_boxes[i, d])
-                cls = int(jnp.argmax(nms_classes[i, d]))
-                print(f"  {names[cls]} score={float(nms_scores[i, d]):.3f} "
+                x1, y1, x2, y2 = boxes[i, d]
+                print(f"  {names[int(classes[i, d])]} score={scores[i, d]:.3f} "
                       f"box=({x1:.3f},{y1:.3f},{x2:.3f},{y2:.3f})")
     trainer.close()
 
