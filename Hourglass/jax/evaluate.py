@@ -52,7 +52,7 @@ def main(argv=None):
         batches = itertools.islice(batches, args.max_batches)
 
     thresholds = tuple(float(t) for t in args.thresholds.split(","))
-    metrics = evaluate_pckh(trainer.state, batches,
+    metrics = evaluate_pckh(trainer.eval_state(), batches,
                             num_joints=cfg.data.num_classes,
                             thresholds=thresholds)
     trainer.close()

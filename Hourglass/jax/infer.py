@@ -48,7 +48,7 @@ def main(argv=None):
     batch = np.stack([
         np.asarray(Image.open(f).convert("RGB").resize((size, size)),
                    np.float32) / 127.5 - 1.0 for f in args.images])
-    state = trainer.state
+    state = trainer.eval_state()
     outputs = state.apply_fn(
         {"params": state.params, "batch_stats": state.batch_stats},
         jnp.asarray(batch), train=False)

@@ -55,7 +55,7 @@ def main(argv=None):
             img = Image.open(path).convert("RGB").resize((size, size))
             batch[j] = np.asarray(img, np.float32) / 127.5 - 1.0
         boxes, scores, classes = map(np.asarray,
-                                     predict(trainer.state, jnp.asarray(batch)))
+                                     predict(trainer.eval_state(), jnp.asarray(batch)))
         for i, path in enumerate(paths):
             keep = scores[i] >= args.score_thresh  # scores are top-k descending
             n = int(keep.sum())

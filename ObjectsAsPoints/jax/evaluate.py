@@ -53,7 +53,7 @@ def main(argv=None):
     if args.max_batches:
         batches = itertools.islice(batches, args.max_batches)
 
-    metrics = evaluate_map(trainer.state, batches,
+    metrics = evaluate_map(trainer.eval_state(), batches,
                            num_classes=cfg.data.num_classes,
                            metric=args.metric, score_thresh=args.score_thresh)
     trainer.close()

@@ -55,7 +55,7 @@ def main():
             img = Image.open(path).convert("RGB").resize((size, size))
             batch[j] = np.asarray(img, np.float32) / 127.5 - 1.0
         nms_boxes, nms_scores, nms_classes, counts = predict(
-            trainer.state, jnp.asarray(batch))
+            trainer.eval_state(), jnp.asarray(batch))
         for i, path in enumerate(paths):
             n = int(counts[i])
             print(f"{path}: {n} detections")

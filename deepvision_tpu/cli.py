@@ -54,6 +54,9 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="gradient accumulation: average grads over k "
                         "micro-batches per optimizer update (effective batch "
                         "= batch-size * k)")
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="Polyak averaging: validate/select-best with the "
+                        "EMA of the weights (typical 0.999-0.9999)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="override output classes/keypoints (e.g. MPII=16 "
                         "heatmaps, custom VOC subsets)")
@@ -144,6 +147,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.accum_steps:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, accum_steps=args.accum_steps))
+    if args.ema_decay is not None:
+        if not 0.0 < args.ema_decay < 1.0:
+            raise SystemExit(f"--ema-decay must be in (0, 1), got {args.ema_decay}")
+        cfg = cfg.replace(ema_decay=args.ema_decay)
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))

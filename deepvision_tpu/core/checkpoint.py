@@ -50,14 +50,18 @@ class CheckpointManager:
     @staticmethod
     def _payload(state):
         """TrainState → dict payload; any other pytree (e.g. the GAN trainers'
-        {gen, disc} dicts) is saved as-is."""
+        {gen, disc} dicts) is saved as-is. `ema_params` is included only when
+        EMA is enabled (non-empty), so non-EMA checkpoints keep their layout."""
         if isinstance(state, TrainState):
-            return {
+            p = {
                 "step": state.step,
                 "params": state.params,
                 "batch_stats": state.batch_stats,
                 "opt_state": state.opt_state,
             }
+            if jax.tree_util.tree_leaves(state.ema_params):
+                p["ema_params"] = state.ema_params
+            return p
         return state
 
     def save(self, epoch: int, state, host_state: Optional[Dict[str, Any]] = None,
@@ -95,18 +99,55 @@ class CheckpointManager:
         if epoch is None:
             return state, {}, None
         template = self._payload(state)
-        restored = self._mgr.restore(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template),
-                host=ocp.args.JsonRestore(),
-            ),
-        )
+
+        def _restore(tmpl):
+            return self._mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(tmpl),
+                    host=ocp.args.JsonRestore(),
+                ),
+            )
+
+        try:
+            restored = _restore(template)
+        except ValueError as e:
+            # Orbax requires template == on-disk structure; the EMA slot is
+            # the one legitimately run-dependent key. Retry with it toggled:
+            # a checkpoint WITHOUT EMA restored into an EMA run (ema then
+            # seeds from params below), or a checkpoint WITH EMA restored
+            # into a non-EMA run (eval-only / classify of an EMA-trained
+            # model — restored alongside and dropped below). Any other
+            # structure mismatch (wrong architecture, num_classes...) must
+            # surface as-is, not as a confusing ema-flipped diff.
+            if not isinstance(state, TrainState) or "ema_params" not in str(e):
+                raise
+            flipped = dict(template)
+            if "ema_params" in flipped:
+                flipped.pop("ema_params")
+            else:
+                flipped["ema_params"] = flipped["params"]
+            restored = _restore(flipped)
         payload = restored["state"]
         if isinstance(state, TrainState):
+            ema = payload.get("ema_params")
+            if ema is None:
+                if jax.tree_util.tree_leaves(state.ema_params):
+                    # EMA enabled but the checkpoint predates it: start the
+                    # average at a COPY of the restored params (aliasing them
+                    # would make the train step donate the same buffer twice)
+                    import jax.numpy as jnp
+                    ema = jax.tree_util.tree_map(jnp.copy, payload["params"])
+                else:
+                    ema = state.ema_params
+            # else: checkpoint carries EMA weights — keep them even when this
+            # run didn't ask for EMA, so eval-only/classify of an EMA-trained
+            # model scores the same weights training validated (Trainer.fit
+            # discards them with a note before training without --ema-decay)
             new_state = state.replace(
                 step=payload["step"], params=payload["params"],
-                batch_stats=payload["batch_stats"], opt_state=payload["opt_state"])
+                batch_stats=payload["batch_stats"], opt_state=payload["opt_state"],
+                ema_params=ema)
         else:
             new_state = payload
         return new_state, dict(restored["host"] or {}), epoch

@@ -129,8 +129,9 @@ class Classifier:
 
     def predict(self, image, top: int = 5) -> List[Tuple[str, float]]:
         """Top-k (class name, probability), like the reference notebooks'
-        `predict()` (softmax → topk over `indices.json` names)."""
-        state = self.trainer.state
+        `predict()` (softmax → topk over `indices.json` names). Uses the EMA
+        weights when the checkpoint carries them."""
+        state = self.trainer.eval_state()
         logits = self._logits(state.params, state.batch_stats,
                               jnp.asarray(self.preprocess(image)))
         if isinstance(logits, (tuple, list)):  # inception aux heads

@@ -99,6 +99,11 @@ class TrainConfig:
     remat: bool = False             # jax.checkpoint the forward: recompute
                                     # activations in backward, trading ~1/3 more
                                     # FLOPs for HBM (big batches / deep stacks)
+    # Exponential moving average of params (Polyak averaging): validation and
+    # best-model selection use ema = d*ema + (1-d)*params instead of the raw
+    # weights. Absent from the reference — part of the modern large-batch
+    # recipe (typical d: 0.999-0.9999). None disables (reference semantics).
+    ema_decay: Optional[float] = None
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

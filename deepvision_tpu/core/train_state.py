@@ -27,6 +27,9 @@ class TrainState:
     # static (not part of the pytree):
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # EMA of params for eval/best-model (empty pytree when disabled — keeps
+    # the checkpoint template structure static either way)
+    ema_params: Any = FrozenDict({})
 
     def apply_gradients(self, grads) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -34,15 +37,31 @@ class TrainState:
         return self.replace(step=self.step + 1, params=new_params, opt_state=new_opt_state)
 
     @classmethod
-    def create(cls, apply_fn, params, tx, batch_stats=None) -> "TrainState":
+    def create(cls, apply_fn, params, tx, batch_stats=None, ema=False) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats if batch_stats is not None else FrozenDict({}),
             opt_state=tx.init(params),
+            ema_params=jax.tree_util.tree_map(jnp.array, params) if ema
+            else FrozenDict({}),
             apply_fn=apply_fn,
             tx=tx,
         )
+
+
+def make_ema_update(decay: float):
+    """Jitted `state -> state` Polyak update: ema = d*ema + (1-d)*params.
+
+    Kept OUTSIDE the per-task train steps so every trainer (classification,
+    detection, pose, centernet) gets EMA with no per-task wiring; the
+    elementwise tree op is negligible next to a train step."""
+    def f(state: TrainState) -> TrainState:
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: e * decay + (1.0 - decay) * p,
+            state.ema_params, state.params)
+        return state.replace(ema_params=new_ema)
+    return jax.jit(f, donate_argnums=0)
 
 
 def init_model(model, rng: jax.Array, sample_input):

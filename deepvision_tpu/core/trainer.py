@@ -26,7 +26,7 @@ from .config import TrainConfig
 from .metrics import MeanAccumulator, MetricsLogger
 from .optim import build_optimizer, set_lr_scale
 from .schedules import PlateauState
-from .train_state import TrainState, init_model, param_count
+from .train_state import TrainState, init_model, make_ema_update, param_count
 from ..parallel import mesh as mesh_lib
 from ..models import MODELS  # importing ..models registers the whole zoo
 
@@ -127,6 +127,10 @@ class Trainer:
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh)
 
+        # Polyak averaging: eval/best-model use the EMA weights (config.ema_decay)
+        self.ema_update = (make_ema_update(config.ema_decay)
+                           if config.ema_decay else None)
+
         self.plateau = PlateauState(
             patience=config.schedule.plateau_patience,
             factor=config.schedule.plateau_factor,
@@ -163,7 +167,8 @@ class Trainer:
         init_rng, self.rng = jax.random.split(self.rng)
         sample = jnp.zeros((2, *sample_shape), jnp.float32)
         params, batch_stats = init_model(self.model, init_rng, sample)
-        state = TrainState.create(self.model.apply, params, self.tx, batch_stats)
+        state = TrainState.create(self.model.apply, params, self.tx, batch_stats,
+                                  ema=self.ema_update is not None)
         # Replicate (or model-shard large tensors) across the mesh.
         rules = mesh_lib.param_sharding_rules(self.mesh, state.params)
         repl = mesh_lib.replicated(self.mesh)
@@ -171,6 +176,9 @@ class Trainer:
             params=jax.device_put(state.params, rules),
             batch_stats=jax.device_put(state.batch_stats, repl),
             opt_state=jax.device_put(state.opt_state, repl),
+            ema_params=jax.device_put(
+                state.ema_params,
+                mesh_lib.param_sharding_rules(self.mesh, state.ema_params)),
             step=jax.device_put(state.step, repl),
         )
         self.state = state
@@ -214,6 +222,8 @@ class Trainer:
             # detection — forwarded positionally to the task's train step.
             batch = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
             self.state, metrics = self.train_step(self.state, *batch, step_rng)
+            if self.ema_update is not None:
+                self.state = self.ema_update(self.state)
             device_metrics.append(metrics)
             n_img += len(jax.tree_util.tree_leaves(batch)[0])
             if (i + 1) % self.config.log_every_steps == 0:
@@ -230,9 +240,17 @@ class Trainer:
         out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         return out
 
+    def eval_state(self) -> TrainState:
+        """State whose params are the eval weights — the EMA whenever present
+        (enabled for this run, or restored from an EMA-trained checkpoint)."""
+        if jax.tree_util.tree_leaves(self.state.ema_params):
+            return self.state.replace(params=self.state.ema_params)
+        return self.state
+
     def evaluate(self, data: Iterable) -> dict:
         """Masked eval: partial final batches are zero-padded up to a multiple of the
         data axis; padded rows carry mask 0 and don't affect the metric sums."""
+        eval_state = self.eval_state()
         data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
         sums: dict = {}
         for images, labels in data:
@@ -245,7 +263,7 @@ class Trainer:
                 images = np.pad(np.asarray(images), pad + [(0, 0)] * (images.ndim - 1))
                 labels = np.pad(np.asarray(labels), pad)
             batch = mesh_lib.shard_batch_pytree(self.mesh, (images, labels, mask))
-            m = jax.device_get(self.eval_step(self.state, *batch))
+            m = jax.device_get(self.eval_step(eval_state, *batch))
             for k, v in m.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
         count = sums.pop("count", 0.0)
@@ -277,6 +295,18 @@ class Trainer:
             self.init_state(sample_shape)
         if resume:
             self.resume()
+        if self.ema_update is None and jax.tree_util.tree_leaves(
+                self.state.ema_params):
+            # restored from an EMA-trained checkpoint but this run won't
+            # update the average — training on while re-saving a frozen EMA
+            # would be silently stale, so drop it loudly
+            from flax.core import FrozenDict
+            if _is_main_process():
+                print(f"[{cfg.name}] checkpoint carries EMA weights but "
+                      f"ema_decay is unset: discarding them for this training "
+                      f"run (pass --ema-decay to keep updating the average)",
+                      flush=True)
+            self.state = self.state.replace(ema_params=FrozenDict({}))
 
         watch_key, watch_mode = self.watch_key, self.watch_mode
         last_val = {}
@@ -341,10 +371,11 @@ class LossWatchedTrainer(Trainer):
     def evaluate(self, data: Iterable) -> dict:
         """Mean of per-batch val losses (`distributed_val_epoch`,
         `YOLO/tensorflow/train.py:182-193,228-233`)."""
+        eval_state = self.eval_state()
         total, n = 0.0, 0
         for batch in data:
             sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
-            m = jax.device_get(self.eval_step(self.state, *sharded))
+            m = jax.device_get(self.eval_step(eval_state, *sharded))
             loss = float(m["loss"])
             if np.isfinite(loss):
                 total += loss
