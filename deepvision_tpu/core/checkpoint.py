@@ -127,7 +127,13 @@ class CheckpointManager:
                 flipped.pop("ema_params")
             else:
                 flipped["ema_params"] = flipped["params"]
-            restored = _restore(flipped)
+            try:
+                restored = _restore(flipped)
+            except ValueError:
+                # the mismatch wasn't (only) the EMA slot — e.g. a genuinely
+                # different architecture; the ORIGINAL error describes the
+                # user's real template, not the flipped one
+                raise e
         payload = restored["state"]
         if isinstance(state, TrainState):
             ema = payload.get("ema_params")

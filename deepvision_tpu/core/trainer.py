@@ -127,9 +127,13 @@ class Trainer:
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh)
 
-        # Polyak averaging: eval/best-model use the EMA weights (config.ema_decay)
+        # Polyak averaging: eval/best-model use the EMA weights (config.ema_decay).
+        # Under gradient accumulation the average must advance once per APPLIED
+        # optimizer update, not per micro-batch (decay^k would shorten the
+        # configured horizon k-fold) — _micro_count tracks MultiSteps' cycle.
         self.ema_update = (make_ema_update(config.ema_decay)
                            if config.ema_decay else None)
+        self._micro_count = 0
 
         self.plateau = PlateauState(
             patience=config.schedule.plateau_patience,
@@ -206,6 +210,12 @@ class Trainer:
             self.plateau.scale = p.get("scale", 1.0)
             self.state = self.state.replace(
                 opt_state=set_lr_scale(self.state.opt_state, self.plateau.scale))
+        if self.ema_update is not None and hasattr(self.state.opt_state,
+                                                   "mini_step"):
+            # re-align the EMA cadence with MultiSteps' restored accumulation
+            # cycle (a run can stop mid-cycle when accum doesn't divide
+            # steps_per_epoch)
+            self._micro_count = int(self.state.opt_state.mini_step)
         if _is_main_process():
             print(f"[{self.config.name}] resumed from epoch {got}", flush=True)
         return got
@@ -223,7 +233,9 @@ class Trainer:
             batch = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
             self.state, metrics = self.train_step(self.state, *batch, step_rng)
             if self.ema_update is not None:
-                self.state = self.ema_update(self.state)
+                self._micro_count += 1
+                if self._micro_count % self.config.optimizer.accum_steps == 0:
+                    self.state = self.ema_update(self.state)
             device_metrics.append(metrics)
             n_img += len(jax.tree_util.tree_leaves(batch)[0])
             if (i + 1) % self.config.log_every_steps == 0:

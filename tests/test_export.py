@@ -142,3 +142,48 @@ def test_rewrite_reaches_through_jit_and_remat():
         assert "lhs_dilation=(2, 2)" not in jaxpr_str, "rewrite bypassed"
         np.testing.assert_allclose(np.asarray(rewritten(x)), expected,
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_export_cli_tool(tmp_path, capsys):
+    """tools/export.py: checkpoint -> TFLite for a trained classifier, and a
+    clean refusal when the workdir has no checkpoint."""
+    import importlib.util
+    import os
+
+    import numpy as np
+    import pytest
+
+    from deepvision_tpu.cli import run_classification
+
+    wd = tmp_path / "wd"
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--workdir", str(wd)])
+
+    spec = importlib.util.spec_from_file_location(
+        "export_tool", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "export.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out_path = tmp_path / "lenet5.tflite"
+    mod.main(["-m", "lenet5", "--workdir", str(wd),
+              "--tflite", str(out_path)])
+    assert out_path.exists() and out_path.stat().st_size > 1000
+    assert str(out_path) in capsys.readouterr().out
+
+    # the exported model must run and emit 10 logits
+    import tensorflow as tf
+    interp = tf.lite.Interpreter(model_path=str(out_path))
+    interp.allocate_tensors()
+    inp = interp.get_input_details()[0]
+    interp.set_tensor(inp["index"],
+                      np.zeros(inp["shape"], np.float32))
+    interp.invoke()
+    out = interp.get_tensor(interp.get_output_details()[0]["index"])
+    assert out.shape == (1, 10)
+
+    with pytest.raises(SystemExit, match="no checkpoint"):
+        mod.main(["-m", "lenet5", "--workdir", str(tmp_path / "empty"),
+                  "--tflite", str(tmp_path / "x.tflite")])

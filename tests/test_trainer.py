@@ -325,3 +325,19 @@ def test_ema_checkpoint_cross_compat(tmp_path):
             total_epochs=3)
     assert not jax.tree_util.tree_leaves(tr3.state.ema_params)
     tr3.close()
+
+
+def test_ema_cadence_under_accumulation(tmp_path):
+    """EMA advances once per APPLIED optimizer update, not per micro-batch —
+    otherwise --accum-steps k silently compresses the horizon to decay^k."""
+    cfg = _config(tmp_path, total_epochs=1, ema_decay=0.9,
+                  optimizer=OptimizerConfig(name="momentum", learning_rate=0.01,
+                                            accum_steps=3))
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    calls = []
+    orig = tr.ema_update
+    tr.ema_update = lambda s: (calls.append(1), orig(s))[1]
+    tr.fit(_data(), None, sample_shape=(32, 32, 1))
+    # 6 micro-batches / accum 3 -> exactly 2 EMA advances
+    assert len(calls) == 2, len(calls)
+    tr.close()

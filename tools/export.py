@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Export any trained model in the zoo as a TF SavedModel and/or TFLite
+flatbuffer for serving.
+
+Beyond-parity surface: the reference only ships a TFLite converter for
+CycleGAN generators (`CycleGAN/tensorflow/convert.py:8-14`, covered by
+`CycleGAN/jax/convert.py`); this tool generalizes the same jax2tf bridge
+(`deepvision_tpu/core/export.py`) to every registered config — classifiers,
+detectors, pose — restoring the checkpoint exactly like the eval CLIs do
+(pinned model kwargs, EMA weights when the checkpoint carries them).
+
+Usage:
+    python tools/export.py -m resnet50 --workdir runs/resnet50 \
+        --saved-model exported/resnet50 [--tflite resnet50.tflite]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True,
+                   help="config name (e.g. resnet50, yolov3, hourglass104)")
+    p.add_argument("-c", "--checkpoint", default="latest")
+    p.add_argument("--workdir", default=None,
+                   help="training workdir holding ckpt/ (default runs/<model>)")
+    p.add_argument("--saved-model", default=None,
+                   help="write a TF SavedModel to this directory")
+    p.add_argument("--tflite", default=None,
+                   help="write a .tflite flatbuffer to this path")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="export resolution (default: the config's)")
+    p.add_argument("--batch-size", type=int, default=1,
+                   help="static batch dim of the exported signature")
+    p.add_argument("--no-optimize", action="store_true",
+                   help="skip the default TFLite size/latency optimization")
+    args = p.parse_args(argv)
+    if not (args.saved_model or args.tflite):
+        p.error("nothing to do: pass --saved-model and/or --tflite")
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.export import export_saved_model, export_tflite
+    from deepvision_tpu.core.trainer import Trainer
+
+    cfg = get_config(args.model)
+    trainer = Trainer(cfg, workdir=args.workdir or os.path.join("runs", cfg.name))
+    size = args.image_size or cfg.data.image_size
+    trainer.init_state((size, size, cfg.data.channels))
+    if trainer.resume(None if args.checkpoint == "latest"
+                      else int(args.checkpoint)) is None:
+        raise SystemExit(
+            f"no checkpoint restorable from {trainer.workdir!r} — exporting "
+            "random weights is never what you want (train first, or pass "
+            "--workdir/-c)")
+    state = trainer.eval_state()  # EMA weights when the checkpoint has them
+    variables = {"params": state.params}
+    import jax.tree_util as jtu
+    if jtu.tree_leaves(state.batch_stats):
+        variables["batch_stats"] = state.batch_stats
+
+    def apply_fn(variables, images):
+        # eval-mode outputs as-is: plain logits for classifiers (aux heads
+        # exist only in train mode), the per-scale tuple for detectors
+        return state.apply_fn(variables, images, train=False)
+
+    shape = (size, size, cfg.data.channels)
+    if args.tflite:
+        export_tflite(apply_fn, variables, shape, args.tflite,
+                      batch_size=args.batch_size,
+                      optimize=not args.no_optimize,
+                      saved_model_dir=args.saved_model)
+        print(f"wrote {args.tflite}"
+              + (f" (SavedModel kept at {args.saved_model})"
+                 if args.saved_model else ""))
+    else:
+        export_saved_model(apply_fn, variables, shape, args.saved_model,
+                           batch_size=args.batch_size)
+        print(f"wrote {args.saved_model}")
+    trainer.close()
+
+
+if __name__ == "__main__":
+    main()
