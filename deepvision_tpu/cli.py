@@ -57,6 +57,9 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--ema-decay", type=float, default=None,
                    help="Polyak averaging: validate/select-best with the "
                         "EMA of the weights (typical 0.999-0.9999)")
+    p.add_argument("--mixup-alpha", type=float, default=None,
+                   help="mixup augmentation strength (classification; "
+                        "lam ~ Beta(a, a), typical 0.1-0.4)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="override output classes/keypoints (e.g. MPII=16 "
                         "heatmaps, custom VOC subsets)")
@@ -151,6 +154,10 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         if not 0.0 < args.ema_decay < 1.0:
             raise SystemExit(f"--ema-decay must be in (0, 1), got {args.ema_decay}")
         cfg = cfg.replace(ema_decay=args.ema_decay)
+    if args.mixup_alpha is not None:
+        if args.mixup_alpha < 0.0:
+            raise SystemExit(f"--mixup-alpha must be >= 0, got {args.mixup_alpha}")
+        cfg = cfg.replace(mixup_alpha=args.mixup_alpha)
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))

@@ -104,6 +104,11 @@ class TrainConfig:
     # weights. Absent from the reference — part of the modern large-batch
     # recipe (typical d: 0.999-0.9999). None disables (reference semantics).
     ema_decay: Optional[float] = None
+    # Mixup (Zhang et al. 2018, classification only, absent from the
+    # reference): per-step lam ~ Beta(a, a) blends the batch with a
+    # permutation of itself on device. 0 disables (reference semantics);
+    # typical a: 0.1-0.4.
+    mixup_alpha: float = 0.0
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -123,7 +123,7 @@ class Trainer:
         self.train_step = steps.make_classification_train_step(
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
             compute_dtype=compute_dtype, mesh=self.mesh,
-            remat=config.remat)
+            remat=config.remat, mixup_alpha=config.mixup_alpha)
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh)
 
@@ -379,6 +379,16 @@ class LossWatchedTrainer(Trainer):
     `Hourglass/tensorflow/train.py:126-130`, applied uniformly."""
 
     default_watch = ("loss", "min")
+
+    def __init__(self, config: TrainConfig, *args, **kwargs):
+        if config.mixup_alpha:
+            # the subclasses replace train_step with task-specific steps that
+            # never see mixup — erroring beats a silent no-op
+            raise ValueError(
+                "mixup_alpha is classification-only; the "
+                f"{type(self).__name__} ignores it — use the task's own "
+                "augmentations (flip/crop in the data pipeline) instead")
+        super().__init__(config, *args, **kwargs)
 
     def evaluate(self, data: Iterable) -> dict:
         """Mean of per-batch val losses (`distributed_val_epoch`,

@@ -341,3 +341,77 @@ def test_ema_cadence_under_accumulation(tmp_path):
     # 6 micro-batches / accum 3 -> exactly 2 EMA advances
     assert len(calls) == 2, len(calls)
     tr.close()
+
+
+def test_mixup_step_semantics(tmp_path):
+    """mixup_alpha>0: loss is the lam-blend of the two label views; with all
+    labels identical it reduces exactly to the plain loss (mixing identical
+    targets is a no-op), and training still converges."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+
+    model = MODELS.get("lenet5")(num_classes=10)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 32, 32, 1)))
+    tx = build_optimizer(OptimizerConfig(name="sgd", learning_rate=0.0),
+                         ScheduleConfig(name="constant"), 10, 1)
+
+    images = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 1))
+    same_labels = jnp.full((8,), 3, jnp.int32)
+
+    def run(alpha, labels):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, mixup_alpha=alpha, donate=False)
+        _, metrics = step(state, images, labels, rng)
+        return float(metrics["loss"])
+
+    assert np.isfinite(run(0.0, same_labels)) and np.isfinite(
+        run(0.2, same_labels))
+
+    # analytic check: replicate the step's key derivation (state.step=0) and
+    # assert loss == lam*L(mixed, y) + (1-lam)*L(mixed, y[perm]) where L is
+    # the PLAIN step evaluated on the pre-mixed images with the same rng
+    distinct = jnp.arange(8, dtype=jnp.int32) % 10
+    step_rng = jax.random.fold_in(rng, 0)
+    mix_rng, perm_rng = jax.random.split(jax.random.fold_in(step_rng, 1))
+    lam = float(jax.random.beta(mix_rng, 0.2, 0.2, dtype=jnp.float32))
+    perm = jax.random.permutation(perm_rng, 8)
+    mixed = lam * images + (1.0 - lam) * images[perm]
+
+    def run_on(imgs, labels):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, mixup_alpha=0.0, donate=False)
+        _, metrics = step(state, imgs, labels, rng)
+        return float(metrics["loss"])
+
+    expected = lam * run_on(mixed, distinct) + \
+        (1.0 - lam) * run_on(mixed, distinct[perm])
+    np.testing.assert_allclose(run(0.2, distinct), expected, rtol=1e-5)
+
+
+def test_mixup_trainer_integration(tmp_path):
+    cfg = _config(tmp_path, total_epochs=3, mixup_alpha=0.2)
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    tr.fit(_data(), _data(epoch_seedless=True), sample_shape=(32, 32, 1))
+    hist = tr.logger.history["train_loss"]["value"]
+    assert hist[-1] < hist[0], f"loss did not decrease: {hist}"
+    tr.close()
+
+
+def test_mixup_rejected_by_task_trainers(tmp_path):
+    """--mixup-alpha on a detection trainer must error, not silently no-op
+    (their task steps replace the classification step)."""
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer
+
+    cfg = get_config("yolov3_voc").replace(mixup_alpha=0.2, batch_size=8)
+    with pytest.raises(ValueError, match="classification-only"):
+        DetectionTrainer(cfg, workdir=str(tmp_path))
