@@ -224,3 +224,49 @@ def test_per_host_sharding_partitions_files(tmp_path):
     assert not set(host0) & set(host1), "hosts must read disjoint shards"
     # pipeline maps the schema's 1-based labels to 0-based class ids
     assert sorted(host0 + host1) == [0, 1, 2, 3], "union must cover all examples"
+
+
+def test_process_bounding_boxes(tmp_path, capsys):
+    """ImageNet bbox XML → normalized CSV (`Datasets/ILSVRC2012/
+    process_bounding_boxes.py`): coordinates normalized+clamped to [0,1],
+    degenerate boxes dropped, synset allow-list honored."""
+    import importlib.util
+    import os
+    import sys
+
+    xml = """<annotation><filename>{name}</filename>
+      <size><width>200</width><height>100</height></size>
+      <object><bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin>
+        <xmax>{x2}</xmax><ymax>{y2}</ymax></bndbox></object>
+    </annotation>"""
+    d = tmp_path / "n01440764"
+    d.mkdir()
+    (d / "a.xml").write_text(xml.format(name="n01440764_1", x1=50, y1=25,
+                                        x2=150, y2=75))
+    # out-of-range coords clamp; inverted box is dropped
+    (d / "b.xml").write_text(xml.format(name="n01440764_2", x1=-10, y1=0,
+                                        x2=400, y2=100))
+    (d / "c.xml").write_text(xml.format(name="n01440764_3", x1=90, y1=50,
+                                        x2=10, y2=40))
+    other = tmp_path / "n99999999"
+    other.mkdir()
+    (other / "d.xml").write_text(xml.format(name="n99999999_1", x1=0, y1=0,
+                                            x2=100, y2=50))
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("n01440764\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "pbb", os.path.join(os.path.dirname(__file__), "..", "Datasets",
+                            "ILSVRC2012", "process_bounding_boxes.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv, sys.argv = sys.argv, ["pbb", str(tmp_path), str(synsets)]
+    try:
+        mod.main()
+    finally:
+        sys.argv = argv
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == [
+        "n01440764_1,0.250000,0.250000,0.750000,0.750000",
+        "n01440764_2,0.000000,0.000000,1.000000,1.000000",
+    ]
