@@ -60,6 +60,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--mixup-alpha", type=float, default=None,
                    help="mixup augmentation strength (classification; "
                         "lam ~ Beta(a, a), typical 0.1-0.4)")
+    p.add_argument("--cutmix-alpha", type=float, default=None,
+                   help="CutMix augmentation strength (classification; "
+                        "pasted-box blending, typical 1.0; exclusive with "
+                        "--mixup-alpha)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="override output classes/keypoints (e.g. MPII=16 "
                         "heatmaps, custom VOC subsets)")
@@ -158,6 +162,13 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         if args.mixup_alpha < 0.0:
             raise SystemExit(f"--mixup-alpha must be >= 0, got {args.mixup_alpha}")
         cfg = cfg.replace(mixup_alpha=args.mixup_alpha)
+    if args.cutmix_alpha is not None:
+        if args.cutmix_alpha < 0.0:
+            raise SystemExit(f"--cutmix-alpha must be >= 0, got {args.cutmix_alpha}")
+        cfg = cfg.replace(cutmix_alpha=args.cutmix_alpha)
+    if cfg.mixup_alpha > 0.0 and cfg.cutmix_alpha > 0.0:
+        raise SystemExit("--mixup-alpha and --cutmix-alpha are mutually "
+                         "exclusive; pass one of them")
     if args.num_classes:
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, num_classes=args.num_classes))

@@ -109,6 +109,10 @@ class TrainConfig:
     # permutation of itself on device. 0 disables (reference semantics);
     # typical a: 0.1-0.4.
     mixup_alpha: float = 0.0
+    # CutMix (Yun et al. 2019, classification only): paste a random box from
+    # the permuted batch instead of blending pixels; lam = exact kept-pixel
+    # fraction. Mutually exclusive with mixup_alpha. Typical a: 1.0.
+    cutmix_alpha: float = 0.0
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

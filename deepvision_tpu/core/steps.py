@@ -33,6 +33,7 @@ def make_classification_train_step(
     mesh: Optional[Mesh] = None,
     remat: bool = False,
     mixup_alpha: float = 0.0,
+    cutmix_alpha: float = 0.0,
 ) -> Callable:
     """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step.
 
@@ -41,11 +42,17 @@ def make_classification_train_step(
     TPU lever for batch sizes / model depths that don't otherwise fit
     (dot-products still saved via the dots_with_no_batch_dims policy).
 
-    `mixup_alpha>0` enables mixup (Zhang et al. 2018, absent from the
-    reference): each step draws lam ~ Beta(a, a), blends the batch with a
-    permutation of itself, and mixes the two losses — all on device, so the
-    host pipeline is untouched. Reported top-k is against the primary labels.
+    `mixup_alpha>0` enables mixup (Zhang et al. 2018) and `cutmix_alpha>0`
+    CutMix (Yun et al. 2019) — both absent from the reference: each step
+    draws lam ~ Beta(a, a) and blends the batch with a permutation of itself
+    (pixel blend for mixup; a pasted random box for CutMix, lam corrected to
+    the exact pasted-pixel fraction), then mixes the two losses — all on
+    device, so the host pipeline is untouched. Mutually exclusive; reported
+    top-k is against the primary labels.
     """
+    if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
+        raise ValueError("mixup_alpha and cutmix_alpha are mutually exclusive")
+    mixing = mixup_alpha > 0.0 or cutmix_alpha > 0.0
 
     def step(state: TrainState, images, labels, rng):
         images = images.astype(compute_dtype)
@@ -57,14 +64,34 @@ def make_classification_train_step(
                 images, mesh_lib.batch_sharding(mesh, images.ndim,
                                                 dim1=images.shape[1]))
         step_rng = jax.random.fold_in(rng, state.step)
+        if mixing:
+            mix_rng, perm_rng, box_rng = jax.random.split(
+                jax.random.fold_in(step_rng, 1), 3)
+            perm = jax.random.permutation(perm_rng, images.shape[0])
+            labels_b = labels[perm]
         if mixup_alpha > 0.0:
-            mix_rng, perm_rng = jax.random.split(
-                jax.random.fold_in(step_rng, 1))
             lam = jax.random.beta(mix_rng, mixup_alpha, mixup_alpha,
                                   dtype=jnp.float32).astype(compute_dtype)
-            perm = jax.random.permutation(perm_rng, images.shape[0])
             images = lam * images + (1.0 - lam) * images[perm]
-            labels_b = labels[perm]
+        elif cutmix_alpha > 0.0:
+            # one box per step (canonical CutMix): area fraction 1-lam0,
+            # center uniform, clipped to the image; lam re-derived as the
+            # exact kept-pixel fraction after clipping
+            h, w = images.shape[1], images.shape[2]
+            lam0 = jax.random.beta(mix_rng, cutmix_alpha, cutmix_alpha,
+                                   dtype=jnp.float32)
+            r = jnp.sqrt(1.0 - lam0)
+            cy, cx = jax.random.uniform(box_rng, (2,), dtype=jnp.float32)
+            y1 = jnp.clip((cy - r / 2) * h, 0, h)
+            y2 = jnp.clip((cy + r / 2) * h, 0, h)
+            x1 = jnp.clip((cx - r / 2) * w, 0, w)
+            x2 = jnp.clip((cx + r / 2) * w, 0, w)
+            rows = jnp.arange(h, dtype=jnp.float32)
+            cols = jnp.arange(w, dtype=jnp.float32)
+            in_box = (((rows >= y1) & (rows < y2))[:, None]
+                      & ((cols >= x1) & (cols < x2))[None, :])  # (H, W)
+            images = jnp.where(in_box[None, :, :, None], images[perm], images)
+            lam = 1.0 - in_box.mean()  # exact fraction, kept f32
 
         def forward(params, images):
             return state.apply_fn(
@@ -82,7 +109,7 @@ def make_classification_train_step(
             outputs, mutated = forward(params, images)
             loss = losses.classification_loss(
                 outputs, labels, label_smoothing=label_smoothing, aux_weight=aux_weight)
-            if mixup_alpha > 0.0:
+            if mixing:
                 loss_b = losses.classification_loss(
                     outputs, labels_b, label_smoothing=label_smoothing,
                     aux_weight=aux_weight)

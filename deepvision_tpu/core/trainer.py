@@ -123,7 +123,8 @@ class Trainer:
         self.train_step = steps.make_classification_train_step(
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
             compute_dtype=compute_dtype, mesh=self.mesh,
-            remat=config.remat, mixup_alpha=config.mixup_alpha)
+            remat=config.remat, mixup_alpha=config.mixup_alpha,
+            cutmix_alpha=config.cutmix_alpha)
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh)
 
@@ -381,12 +382,12 @@ class LossWatchedTrainer(Trainer):
     default_watch = ("loss", "min")
 
     def __init__(self, config: TrainConfig, *args, **kwargs):
-        if config.mixup_alpha:
+        if config.mixup_alpha or config.cutmix_alpha:
             # the subclasses replace train_step with task-specific steps that
-            # never see mixup — erroring beats a silent no-op
+            # never see mixup/cutmix — erroring beats a silent no-op
             raise ValueError(
-                "mixup_alpha is classification-only; the "
-                f"{type(self).__name__} ignores it — use the task's own "
+                "mixup_alpha/cutmix_alpha are classification-only; the "
+                f"{type(self).__name__} ignores them — use the task's own "
                 "augmentations (flip/crop in the data pipeline) instead")
         super().__init__(config, *args, **kwargs)
 

@@ -380,7 +380,8 @@ def test_mixup_step_semantics(tmp_path):
     # the PLAIN step evaluated on the pre-mixed images with the same rng
     distinct = jnp.arange(8, dtype=jnp.int32) % 10
     step_rng = jax.random.fold_in(rng, 0)
-    mix_rng, perm_rng = jax.random.split(jax.random.fold_in(step_rng, 1))
+    # mirror the step's 3-way split exactly (box_rng unused by mixup)
+    mix_rng, perm_rng, _ = jax.random.split(jax.random.fold_in(step_rng, 1), 3)
     lam = float(jax.random.beta(mix_rng, 0.2, 0.2, dtype=jnp.float32))
     perm = jax.random.permutation(perm_rng, 8)
     mixed = lam * images + (1.0 - lam) * images[perm]
@@ -465,3 +466,61 @@ def test_accum_ema_model_parallel_compose(tmp_path):
     assert int(tr2.state.opt_state.mini_step) == 1
     assert tr2._micro_count == 1
     tr2.close()
+
+
+def test_cutmix_step_semantics():
+    """CutMix: loss equals the lam-blend of the two label views on the
+    box-pasted images, with lam the exact kept-pixel fraction; mixup+cutmix
+    together are rejected."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+
+    model = MODELS.get("lenet5")(num_classes=10)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 32, 32, 1)))
+    tx = build_optimizer(OptimizerConfig(name="sgd", learning_rate=0.0),
+                         ScheduleConfig(name="constant"), 10, 1)
+    images = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 1))
+    labels = jnp.arange(8, dtype=jnp.int32) % 10
+
+    def make(alpha_kw):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, donate=False, **alpha_kw)
+        return state, step
+
+    # replicate the step's key/box derivation (state.step=0)
+    a = 1.0
+    step_rng = jax.random.fold_in(rng, 0)
+    mix_rng, perm_rng, box_rng = jax.random.split(
+        jax.random.fold_in(step_rng, 1), 3)
+    perm = jax.random.permutation(perm_rng, 8)
+    lam0 = jax.random.beta(mix_rng, a, a, dtype=jnp.float32)
+    r = jnp.sqrt(1.0 - lam0)
+    cy, cx = jax.random.uniform(box_rng, (2,), dtype=jnp.float32)
+    y1, y2 = jnp.clip((cy - r / 2) * 32, 0, 32), jnp.clip((cy + r / 2) * 32, 0, 32)
+    x1, x2 = jnp.clip((cx - r / 2) * 32, 0, 32), jnp.clip((cx + r / 2) * 32, 0, 32)
+    g = jnp.arange(32, dtype=jnp.float32)
+    in_box = (((g >= y1) & (g < y2))[:, None] & ((g >= x1) & (g < x2))[None, :])
+    pasted = jnp.where(in_box[None, :, :, None], images[perm], images)
+    lam = float(1.0 - in_box.mean())
+    assert 0.0 < lam < 1.0  # the drawn box is non-degenerate for this seed
+
+    def plain_loss(imgs, lbls):
+        state, step = make({})
+        _, m = step(state, imgs, lbls, rng)
+        return float(m["loss"])
+
+    expected = lam * plain_loss(pasted, labels) + \
+        (1.0 - lam) * plain_loss(pasted, labels[perm])
+    state, step = make({"cutmix_alpha": a})
+    _, m = step(state, images, labels, rng)
+    np.testing.assert_allclose(float(m["loss"]), expected, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        steps.make_classification_train_step(mixup_alpha=0.2, cutmix_alpha=1.0)
