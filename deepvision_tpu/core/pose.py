@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models import MODELS
 from ..ops.heatmap import render_gaussian_heatmaps
 from .config import TrainConfig
 from .trainer import LossWatchedTrainer
@@ -109,17 +108,14 @@ def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
 
 class PoseTrainer(LossWatchedTrainer):
     """Hourglass trainer: shared epoch/checkpoint/plateau machinery with pose
-    steps; loss-watched validation with NaN-batch skip comes from the base."""
+    steps; loss-watched validation with NaN-batch skip comes from the base.
+    Model construction stays in the base (via `num_classes_kwarg`) so the
+    workdir's pinned model_kwargs.json applies here like everywhere else."""
+
+    num_classes_kwarg = "num_heatmap"  # pose models take num_heatmap
 
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
-        if model is None:
-            kwargs = dict(config.model_kwargs)
-            # pose models take num_heatmap, not num_classes
-            kwargs.setdefault("num_heatmap", config.data.num_classes)
-            if config.dtype:
-                kwargs.setdefault("dtype", jnp.dtype(config.dtype))
-            model = MODELS.get(config.model)(**kwargs)
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16

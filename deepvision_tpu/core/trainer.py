@@ -57,6 +57,11 @@ class Trainer:
     # subclass override for the watched metric, e.g. ("loss", "min");
     # None → derived from the plateau config (top-1 max by default)
     default_watch = None
+    # constructor kwarg that receives config.data.num_classes when the base
+    # builds the model (pose models take num_heatmap instead) — subclasses
+    # override the NAME rather than pre-building the model, so the workdir's
+    # pinned model_kwargs.json applies to every family
+    num_classes_kwarg = "num_classes"
 
     def __init__(self, config: TrainConfig, model=None,
                  mesh: Optional[Any] = None, workdir: Optional[str] = None):
@@ -82,7 +87,7 @@ class Trainer:
         if model is None:
             model_ctor = MODELS.get(config.model)
             kwargs = dict(config.model_kwargs)
-            kwargs.setdefault("num_classes", config.data.num_classes)
+            kwargs.setdefault(self.num_classes_kwarg, config.data.num_classes)
             if config.dtype and "dtype" not in kwargs and _accepts_kwarg(model_ctor, "dtype"):
                 kwargs["dtype"] = jnp.dtype(config.dtype)
             model = model_ctor(**kwargs)
