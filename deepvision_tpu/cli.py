@@ -83,6 +83,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="mesh 'spatial' axis size: shard activations along "
                         "image height (context parallelism; GSPMD "
                         "halo-exchanges the convs)")
+    p.add_argument("--device-normalize", action="store_true",
+                   help="ship raw uint8 pixels to the device and normalize "
+                        "inside the jitted step (4x less host->device "
+                        "traffic; classification ImageNet TFRecords only)")
     p.add_argument("--eval-only", action="store_true",
                    help="restore (-c/--auto-resume) and run validation once; "
                         "no training")
@@ -177,6 +181,9 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         if args.dataset == "mnist":
             over.update(image_size=32, channels=1)  # pipeline pads 28→32, grayscale
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **over))
+    if getattr(args, "device_normalize", False):
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, normalize_on_device=True))
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
     if args.model_parallel:
@@ -243,6 +250,10 @@ def _synthetic_data(cfg, make_batches: Callable):
 
 def _classification_data(cfg, args):
     data = cfg.data
+    if data.normalize_on_device and data.dataset != "imagenet":
+        raise SystemExit(
+            "--device-normalize is supported by the TFRecord ImageNet "
+            f"pipeline only (dataset={data.dataset!r} normalizes on host)")
     if args.synthetic or data.dataset == "synthetic":
         from .data.synthetic import SyntheticClassification
         return _synthetic_data(cfg, lambda steps, seed: SyntheticClassification(
@@ -266,8 +277,13 @@ def _classification_data(cfg, args):
                                 cfg.eval_batch_size or cfg.batch_size,
                                 shuffle=False, drop_remainder=False)
     elif data.dataset == "imagenet":
+        import functools
+
         from .data import imagenet as inet
-        return _tfrecord_data(inet.build_dataset, cfg, args, "dataset/tfrecord",
+        build = functools.partial(
+            inet.build_dataset, normalize_on_host=not data.normalize_on_device,
+            mean=data.mean, std=data.std)
+        return _tfrecord_data(build, cfg, args, "dataset/tfrecord",
                               bounded_train_steps=True)
     elif data.dataset == "imagenet_flat":
         # the reference's flat-dir layout (`ResNet/pytorch/data_load.py:20-44`:

@@ -12,6 +12,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+# Canonical ImageNet channel statistics in [0,1] units (torchvision
+# convention) — the single source of truth for both host-side normalization
+# (data/imagenet.py) and the on-device path (DataConfig.mean/std → the jitted
+# step's input_norm).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
 
 @dataclasses.dataclass
 class OptimizerConfig:
@@ -69,6 +76,16 @@ class DataConfig:
     shuffle_buffer: int = 10000
     num_parallel_calls: int = 16    # reference num_workers=16, ResNet/pytorch/train.py:229
     cache_val: bool = False
+    # Ship raw uint8 pixels to the device and normalize ((x/255-mean)/std)
+    # inside the jitted step instead of on the host: 4x less host->device
+    # traffic — the bandwidth lever for input-bound pods (SURVEY.md §7.2.1).
+    # Supported by the TFRecord ImageNet pipeline (`--device-normalize`).
+    normalize_on_device: bool = False
+    # channel mean/std in [0,1] units; both the host pipeline and the
+    # on-device normalization read these, so overriding them affects the two
+    # modes identically
+    mean: Tuple[float, ...] = IMAGENET_MEAN
+    std: Tuple[float, ...] = IMAGENET_STD
 
 
 @dataclasses.dataclass

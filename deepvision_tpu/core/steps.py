@@ -24,6 +24,20 @@ from ..parallel import mesh as mesh_lib
 from ..parallel.mesh import DATA_AXIS
 
 
+def _normalize_input(images, input_norm, compute_dtype):
+    """Cast to compute dtype; with `input_norm=(mean, std)` the images are raw
+    [0,255] pixels (uint8 transfer) normalized here on device instead of on
+    the host. Division/subtraction happen in f32 so uint8 pixel values stay
+    exact, then the result drops to the compute dtype once."""
+    if input_norm is None:
+        return images.astype(compute_dtype)
+    mean, std = input_norm
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    images = images.astype(jnp.float32) / 255.0
+    return ((images - mean) / std).astype(compute_dtype)
+
+
 def make_classification_train_step(
     *,
     label_smoothing: float = 0.0,
@@ -34,6 +48,7 @@ def make_classification_train_step(
     remat: bool = False,
     mixup_alpha: float = 0.0,
     cutmix_alpha: float = 0.0,
+    input_norm: Optional[tuple] = None,
 ) -> Callable:
     """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step.
 
@@ -49,13 +64,19 @@ def make_classification_train_step(
     the exact pasted-pixel fraction), then mixes the two losses — all on
     device, so the host pipeline is untouched. Mutually exclusive; reported
     top-k is against the primary labels.
+
+    `input_norm=(mean, std)` (each length-C, in [0,1] units) declares that
+    images arrive as RAW [0,255] pixels (typically uint8 from a
+    `normalize_on_host=False` pipeline) and normalizes them ON DEVICE:
+    (x/255 - mean)/std. uint8 transfer is 4x smaller than normalized f32 —
+    the host->device bandwidth lever for input-bound pods (SURVEY.md §7.2.1).
     """
     if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
         raise ValueError("mixup_alpha and cutmix_alpha are mutually exclusive")
     mixing = mixup_alpha > 0.0 or cutmix_alpha > 0.0
 
     def step(state: TrainState, images, labels, rng):
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         if mesh is not None:
             # batch over 'data'; on a spatial mesh also H over 'spatial' —
             # GSPMD partitions every conv with halo exchange (context
@@ -135,7 +156,8 @@ def make_classification_train_step(
 
 
 def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
-                                  mesh: Optional[Mesh] = None) -> Callable:
+                                  mesh: Optional[Mesh] = None,
+                                  input_norm: Optional[tuple] = None) -> Callable:
     """Build a jitted `(state, images, labels, mask) -> sums` step (no_grad validate
     loop, reference `validate()` ResNet/pytorch/train.py:488-520).
 
@@ -145,7 +167,7 @@ def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
     """
 
     def step(state: TrainState, images, labels, mask):
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         if mesh is not None:
             images = jax.lax.with_sharding_constraint(
                 images, mesh_lib.batch_sharding(mesh, images.ndim,

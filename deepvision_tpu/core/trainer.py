@@ -125,13 +125,15 @@ class Trainer:
                                   self.steps_per_epoch, config.total_epochs)
 
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        input_norm = ((config.data.mean, config.data.std)
+                      if config.data.normalize_on_device else None)
         self.train_step = steps.make_classification_train_step(
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
             compute_dtype=compute_dtype, mesh=self.mesh,
             remat=config.remat, mixup_alpha=config.mixup_alpha,
-            cutmix_alpha=config.cutmix_alpha)
+            cutmix_alpha=config.cutmix_alpha, input_norm=input_norm)
         self.eval_step = steps.make_classification_eval_step(
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm)
 
         # Polyak averaging: eval/best-model use the EMA weights (config.ema_decay).
         # Under gradient accumulation the average must advance once per APPLIED
@@ -394,6 +396,13 @@ class LossWatchedTrainer(Trainer):
                 "mixup_alpha/cutmix_alpha are classification-only; the "
                 f"{type(self).__name__} ignores them — use the task's own "
                 "augmentations (flip/crop in the data pipeline) instead")
+        if config.data.normalize_on_device:
+            # task steps normalize in their own pipelines; a silently ignored
+            # flag would mean doubly- or un-normalized inputs
+            raise ValueError(
+                "normalize_on_device (--device-normalize) is supported by the "
+                f"classification ImageNet pipeline only; {type(self).__name__} "
+                "does not honor it")
         super().__init__(config, *args, **kwargs)
 
     def evaluate(self, data: Iterable) -> dict:

@@ -20,8 +20,10 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-MEAN_RGB = np.array([0.485, 0.456, 0.406], np.float32)   # torchvision-convention
-STDDEV_RGB = np.array([0.229, 0.224, 0.225], np.float32)
+from ..core.config import IMAGENET_MEAN, IMAGENET_STD
+
+MEAN_RGB = np.array(IMAGENET_MEAN, np.float32)   # torchvision-convention
+STDDEV_RGB = np.array(IMAGENET_STD, np.float32)
 
 CROP_FRACTION = 0.875  # eval: 224/256 central crop
 
@@ -84,14 +86,26 @@ def central_crop(encoded, image_size, tf):
     return tf.slice(image, [offset_y, offset_x, 0], [image_size, image_size, 3])
 
 
-def preprocess(encoded, label, image_size, training, tf):
+def preprocess(encoded, label, image_size, training, tf, normalize_on_host=True,
+               mean=None, std=None):
     if training:
         image = distorted_crop(encoded, image_size, tf)
         image = tf.image.random_flip_left_right(image)
     else:
         image = central_crop(encoded, image_size, tf)
-    image = tf.cast(image, tf.float32) / 255.0
-    image = (image - MEAN_RGB) / STDDEV_RGB
+    # bicubic resize overshoots outside [0,255] on high-contrast edges; clip
+    # in BOTH normalization modes so the uint8 path (which must clip to fit
+    # the dtype) and the float path stay equivalent up to quantization
+    image = tf.clip_by_value(image, 0.0, 255.0)
+    if normalize_on_host:
+        image = tf.cast(image, tf.float32) / 255.0
+        image = (image - (MEAN_RGB if mean is None else np.asarray(mean, np.float32))) \
+            / (STDDEV_RGB if std is None else np.asarray(std, np.float32))
+    else:
+        # raw uint8 pixels: the device normalizes ((x/255 - mean)/std inside
+        # the jitted step) — host->device transfer drops to 1/4 the bytes,
+        # the lever that matters when a pod is input-bound (SURVEY.md §7.2.1)
+        image = tf.cast(tf.round(image), tf.uint8)
     image.set_shape([image_size, image_size, 3])
     return image, label
 
@@ -100,11 +114,17 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
                   training: bool = True, shuffle_buffer: int = 10000,
                   num_process: int = 1, process_index: int = 0,
                   num_parallel_calls: Optional[int] = None, cache: bool = False,
-                  seed: int = 0):
+                  seed: int = 0, normalize_on_host: bool = True,
+                  mean=None, std=None):
     """Per-host tf.data pipeline over sharded TFRecords.
 
     `batch_size` here is the PER-HOST batch (global / process_count); the caller
     shards it over local devices via the mesh.
+
+    `normalize_on_host=False` emits uint8 pixels (mean/std applied on device by
+    the train/eval step's `input_norm`) — 4x less host->device traffic.
+    `mean`/`std` override the ImageNet channel statistics (pass
+    `DataConfig.mean/std` so both normalization modes see the same values).
     """
     tf = _tf()
     AUTOTUNE = tf.data.AUTOTUNE
@@ -119,7 +139,9 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
         ds = ds.cache()
     if training:
         ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
-    ds = ds.map(lambda s: preprocess(*parse_example(s, tf), image_size, training, tf),
+    ds = ds.map(lambda s: preprocess(*parse_example(s, tf), image_size, training,
+                                     tf, normalize_on_host=normalize_on_host,
+                                     mean=mean, std=std),
                 num_parallel_calls=num_parallel_calls or AUTOTUNE)
     ds = ds.batch(batch_size, drop_remainder=True)
     ds = ds.prefetch(AUTOTUNE)

@@ -83,3 +83,14 @@ def test_eval_only_restores_and_validates(tmp_path):
     tr.init_state((32, 32, 1))
     assert tr.resume() == 1
     tr.close()
+
+
+def test_device_normalize_rejected_off_imagenet(tmp_path):
+    """--device-normalize only makes sense where the pipeline can emit raw
+    uint8 (TFRecord ImageNet); elsewhere it must fail, not double-normalize."""
+    with pytest.raises(SystemExit, match="device-normalize"):
+        run_classification(
+            "LeNet", ["lenet5"],
+            argv=["-m", "lenet5", "--synthetic", "--epochs", "1",
+                  "--batch-size", "16", "--steps-per-epoch", "1",
+                  "--device-normalize", "--workdir", str(tmp_path)])

@@ -270,3 +270,43 @@ def test_process_bounding_boxes(tmp_path, capsys):
         "n01440764_1,0.250000,0.250000,0.750000,0.750000",
         "n01440764_2,0.000000,0.000000,1.000000,1.000000",
     ]
+
+
+def test_imagenet_uint8_pipeline_matches_host_normalized(tmp_path):
+    """normalize_on_host=False emits uint8 pixels; device-normalizing them
+    (steps._normalize_input) reproduces the host-normalized float pipeline up
+    to uint8 quantization (<= 0.5/255 per pixel before the mean/std affine)."""
+    import tensorflow as tf
+
+    from deepvision_tpu.core.steps import _normalize_input
+    from deepvision_tpu.data import imagenet as inet
+
+    jpeg = tmp_path / "img.jpg"
+    _write_jpeg(jpeg, size=(48, 64), color=(200, 30, 90))
+    record = tmp_path / "train-00000"
+    with tf.io.TFRecordWriter(str(record)) as w:
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
+                value=[jpeg.read_bytes()])),
+            "image/class/label": tf.train.Feature(int64_list=tf.train.Int64List(
+                value=[1])),
+        }))
+        w.write(ex.SerializeToString())
+
+    def batch(normalize_on_host):
+        ds = inet.build_dataset(str(record), batch_size=1, image_size=32,
+                                training=False,
+                                normalize_on_host=normalize_on_host)
+        return next(iter(ds.as_numpy_iterator()))
+
+    imgs8, labels8 = batch(False)
+    imgsf, labelsf = batch(True)
+    assert imgs8.dtype == np.uint8 and imgsf.dtype == np.float32
+    assert np.array_equal(labels8, labelsf)
+
+    import jax.numpy as jnp
+    normed = np.asarray(_normalize_input(
+        jnp.asarray(imgs8), (inet.MEAN_RGB, inet.STDDEV_RGB), jnp.float32))
+    # solid-color source: no bicubic overshoot, so the only difference is the
+    # 0.5/255 rounding step, scaled by 1/min(std)
+    np.testing.assert_allclose(normed, imgsf, atol=0.5 / 255 / 0.224 + 1e-6)

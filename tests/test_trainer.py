@@ -524,3 +524,68 @@ def test_cutmix_step_semantics():
 
     with pytest.raises(ValueError, match="mutually exclusive"):
         steps.make_classification_train_step(mixup_alpha=0.2, cutmix_alpha=1.0)
+
+
+def test_device_normalize_step_matches_host_normalized(tmp_path):
+    """input_norm=(mean, std): a uint8 batch normalized on device produces the
+    same train/eval results as the host-normalized float batch — the uint8
+    transfer path (--device-normalize) changes bandwidth, not math. The
+    task trainers reject the flag rather than silently ignoring it."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+
+    mean, std = (0.5,), (0.25,)
+    model = MODELS.get("lenet5")(num_classes=10)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 32, 32, 1)))
+    tx = build_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1),
+                         ScheduleConfig(name="constant"), 10, 1)
+
+    images8 = np.random.RandomState(0).randint(
+        0, 256, size=(8, 32, 32, 1)).astype(np.uint8)
+    host = ((images8.astype(np.float32) / 255.0 - mean[0]) / std[0])
+    labels = np.arange(8, dtype=np.int32) % 10
+
+    def run(step, imgs):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        new_state, m = step(state, jnp.asarray(imgs), jnp.asarray(labels), rng)
+        return new_state, float(m["loss"])
+
+    dev_step = steps.make_classification_train_step(
+        compute_dtype=jnp.float32, donate=False, input_norm=(mean, std))
+    host_step = steps.make_classification_train_step(
+        compute_dtype=jnp.float32, donate=False)
+    s_dev, loss_dev = run(dev_step, images8)
+    s_host, loss_host = run(host_step, host)
+    np.testing.assert_allclose(loss_dev, loss_host, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        jax.device_get(s_dev.params), jax.device_get(s_host.params))
+
+    # eval path too
+    mask = np.ones((8,), np.float32)
+    ev_dev = steps.make_classification_eval_step(
+        compute_dtype=jnp.float32, input_norm=(mean, std))
+    ev_host = steps.make_classification_eval_step(compute_dtype=jnp.float32)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+    m_dev = jax.device_get(ev_dev(state, jnp.asarray(images8),
+                                  jnp.asarray(labels), mask))
+    m_host = jax.device_get(ev_host(state, jnp.asarray(host),
+                                    jnp.asarray(labels), mask))
+    np.testing.assert_allclose(m_dev["loss"], m_host["loss"], rtol=1e-6)
+    assert m_dev["top1"] == m_host["top1"]
+
+    # task trainers must reject normalize_on_device loudly
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer
+    import dataclasses as _dc
+    cfg = get_config("yolov3").replace(
+        batch_size=8, checkpoint_dir=str(tmp_path / "c"))
+    cfg = cfg.replace(data=_dc.replace(cfg.data, normalize_on_device=True))
+    with pytest.raises(ValueError, match="device-normalize"):
+        DetectionTrainer(cfg, workdir=str(tmp_path / "wd"))

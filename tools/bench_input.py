@@ -58,6 +58,10 @@ def main(argv=None):
     p.add_argument("--synthetic-per-shard", type=int, default=128)
     p.add_argument("--source-size", type=int, default=320,
                    help="synthetic JPEG edge length before decode+crop")
+    p.add_argument("--device-normalize", action="store_true",
+                   help="emit raw uint8 (normalization deferred to the "
+                        "device) — measure the before/after for the "
+                        "--device-normalize training flag")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -73,7 +77,8 @@ def main(argv=None):
             args.source_size)
 
     ds = inet.build_dataset(pattern, batch_size=args.batch_size,
-                            image_size=args.image_size, training=True)
+                            image_size=args.image_size, training=True,
+                            normalize_on_host=not args.device_normalize)
     it = ds.as_numpy_iterator()
     next(it)  # warmup: file open, autotune ramp
     t0 = time.perf_counter()
@@ -84,7 +89,8 @@ def main(argv=None):
     dt = time.perf_counter() - t0
     print(json.dumps({
         "metric": f"input_pipeline_images_per_sec(b{args.batch_size},"
-                  f"{args.image_size}px,{'real' if args.data_dir else 'synthetic'})",
+                  f"{args.image_size}px,{'real' if args.data_dir else 'synthetic'}"
+                  f"{',uint8' if args.device_normalize else ''})",
         "value": round(n / dt, 1),
         "unit": "images/sec/host",
     }))
