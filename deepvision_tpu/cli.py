@@ -250,10 +250,15 @@ def _synthetic_data(cfg, make_batches: Callable):
 
 def _classification_data(cfg, args):
     data = cfg.data
-    if data.normalize_on_device and data.dataset != "imagenet":
+    if data.normalize_on_device and (args.synthetic
+                                     or data.dataset != "imagenet"):
+        # must match the EFFECTIVE pipeline: --synthetic on an
+        # imagenet-configured model yields standard-normal floats, which the
+        # step's (x/255-mean)/std would silently mangle
+        what = "--synthetic data" if args.synthetic else f"dataset={data.dataset!r}"
         raise SystemExit(
             "--device-normalize is supported by the TFRecord ImageNet "
-            f"pipeline only (dataset={data.dataset!r} normalizes on host)")
+            f"pipeline only ({what} normalizes on host)")
     if args.synthetic or data.dataset == "synthetic":
         from .data.synthetic import SyntheticClassification
         return _synthetic_data(cfg, lambda steps, seed: SyntheticClassification(
@@ -277,12 +282,16 @@ def _classification_data(cfg, args):
                                 cfg.eval_batch_size or cfg.batch_size,
                                 shuffle=False, drop_remainder=False)
     elif data.dataset == "imagenet":
-        import functools
-
         from .data import imagenet as inet
-        build = functools.partial(
-            inet.build_dataset, normalize_on_host=not data.normalize_on_device,
-            mean=data.mean, std=data.std)
+
+        def build(pattern, *, training, **kw):
+            if not training and data.cache_val:
+                kw["cache"] = True  # val records cached after the first epoch
+            return inet.build_dataset(
+                pattern, training=training,
+                normalize_on_host=not data.normalize_on_device,
+                mean=data.mean, std=data.std, **kw)
+
         return _tfrecord_data(build, cfg, args, "dataset/tfrecord",
                               bounded_train_steps=True)
     elif data.dataset == "imagenet_flat":

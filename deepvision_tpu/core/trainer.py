@@ -234,6 +234,16 @@ class Trainer:
         n_img = 0
         step_rng = jax.random.fold_in(self.rng, epoch)
         device_metrics = []  # device arrays; fetched once at epoch end (no per-step sync)
+        # Per-interval logging must not stall the dispatch pipeline: fetching
+        # the CURRENT step's metrics would block until the device catches up
+        # (expensive through a relayed TPU). Instead each interval enqueues
+        # (host-side step number, device metrics) and logs the PREVIOUS
+        # interval's entry — by then that step has long finished, so the
+        # device_get costs only transfer latency. The tail flushes after the
+        # epoch-end barrier. Step numbers are tracked on host (one sync here,
+        # while the device is idle between epochs).
+        step0 = int(self.state.step)
+        pending: list = []
         for i, batch in enumerate(data):
             # batch is any tuple of arrays with a leading batch dim — (images,
             # labels) for classification, (images, boxes, classes, valid) for
@@ -247,9 +257,15 @@ class Trainer:
             device_metrics.append(metrics)
             n_img += len(jax.tree_util.tree_leaves(batch)[0])
             if (i + 1) % self.config.log_every_steps == 0:
-                self.logger.log(int(self.state.step), jax.device_get(metrics),
-                                epoch=epoch, prefix="train_", echo=_is_main_process())
+                pending.append((step0 + i + 1, metrics))
+                if len(pending) > 1:
+                    s, m = pending.pop(0)
+                    self.logger.log(s, jax.device_get(m), epoch=epoch,
+                                    prefix="train_", echo=_is_main_process())
         jax.block_until_ready(self.state.params)
+        for s, m in pending:
+            self.logger.log(s, jax.device_get(m), epoch=epoch,
+                            prefix="train_", echo=_is_main_process())
         dt = time.time() - t0
         if device_metrics:
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs).mean(),

@@ -87,10 +87,18 @@ def test_eval_only_restores_and_validates(tmp_path):
 
 def test_device_normalize_rejected_off_imagenet(tmp_path):
     """--device-normalize only makes sense where the pipeline can emit raw
-    uint8 (TFRecord ImageNet); elsewhere it must fail, not double-normalize."""
+    uint8 (TFRecord ImageNet); elsewhere it must fail, not double-normalize —
+    including --synthetic on an imagenet-configured model, whose standard-
+    normal floats were never [0,255] pixels."""
     with pytest.raises(SystemExit, match="device-normalize"):
         run_classification(
             "LeNet", ["lenet5"],
             argv=["-m", "lenet5", "--synthetic", "--epochs", "1",
+                  "--batch-size", "16", "--steps-per-epoch", "1",
+                  "--device-normalize", "--workdir", str(tmp_path)])
+    with pytest.raises(SystemExit, match="synthetic"):
+        run_classification(
+            "ResNet", ["resnet50"],
+            argv=["-m", "resnet50", "--synthetic", "--epochs", "1",
                   "--batch-size", "16", "--steps-per-epoch", "1",
                   "--device-normalize", "--workdir", str(tmp_path)])

@@ -589,3 +589,21 @@ def test_device_normalize_step_matches_host_normalized(tmp_path):
     cfg = cfg.replace(data=_dc.replace(cfg.data, normalize_on_device=True))
     with pytest.raises(ValueError, match="device-normalize"):
         DetectionTrainer(cfg, workdir=str(tmp_path / "wd"))
+
+
+def test_delayed_metric_logging_labels_and_coverage(tmp_path):
+    """Interval train logs are fetched one interval late (so logging never
+    stalls the dispatch pipeline) but keep their own step labels; the last
+    interval flushes after the epoch barrier — every interval is logged."""
+    import json
+
+    cfg = _config(tmp_path, total_epochs=2, log_every_steps=2)  # 6 batches/epoch
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    tr.fit(_data(), None, sample_shape=(32, 32, 1))
+    tr.close()
+
+    with open(tmp_path / "wd" / "test.jsonl") as fp:
+        recs = [json.loads(line) for line in fp]
+    per_step = [r for r in recs if "train_loss" in r]
+    assert [r["step"] for r in per_step] == [2, 4, 6, 8, 10, 12]
+    assert [r["epoch"] for r in per_step] == [1, 1, 1, 2, 2, 2]
