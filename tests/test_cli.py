@@ -102,3 +102,81 @@ def test_device_normalize_rejected_off_imagenet(tmp_path):
             argv=["-m", "resnet50", "--synthetic", "--epochs", "1",
                   "--batch-size", "16", "--steps-per-epoch", "1",
                   "--device-normalize", "--workdir", str(tmp_path)])
+
+
+def test_roofline_tool(capsys):
+    """tools/roofline.py: XLA cost analysis for a registered model — FLOPs
+    scale with batch, eval costs less than train, unknown models fail with
+    the known-name list."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "roofline_tool", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "roofline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def run(extra):
+        mod.main(["-m", "lenet5", "--image-size", "32", "--channels", "1",
+                  "--num-classes", "10", "--dtype", "float32"] + extra)
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    train8 = run(["--batch-size", "8"])
+    train16 = run(["--batch-size", "16"])
+    eval16 = run(["--batch-size", "16", "--eval"])
+    assert train8["params"] == 61706
+    assert train16["gflops_per_step"] > 1.5 * train8["gflops_per_step"]
+    assert eval16["gflops_per_step"] < train16["gflops_per_step"]
+    assert train16["gflops_per_image"] > 0
+
+    with pytest.raises(SystemExit, match="unknown model"):
+        mod.main(["-m", "nope"])
+
+
+def test_cache_val_flag_reaches_imagenet_pipeline(tmp_path):
+    """--cache-val wires DataConfig.cache_val into the val TFRecord pipeline;
+    the cached dataset serves identical batches on every epoch."""
+    import dataclasses
+    import io
+
+    import numpy as np
+    from PIL import Image
+    import tensorflow as tf
+
+    from deepvision_tpu.cli import _classification_data
+
+    rs = np.random.RandomState(0)
+    for split in ("train", "val"):
+        with tf.io.TFRecordWriter(str(tmp_path / f"{split}-00000")) as w:
+            for i in range(8):
+                buf = io.BytesIO()
+                Image.fromarray(rs.randint(0, 256, (40, 40, 3), np.uint8)
+                                ).save(buf, "JPEG")
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[buf.getvalue()])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[i + 1])),
+                }))
+                w.write(ex.SerializeToString())
+
+    # flag -> config override path (what _run does for --cache-val)
+    args = build_parser("AlexNet", ["alexnet2"]).parse_args(
+        ["-m", "alexnet2", "--cache-val", "--data-dir", str(tmp_path)])
+    assert args.cache_val
+    cfg = get_config("alexnet2").replace(batch_size=8)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, cache_val=args.cache_val, image_size=32))
+    args.synthetic = False
+    args.steps_per_epoch = 1
+    args.eval_only = False
+
+    train_fn, val_fn = _classification_data(cfg, args)
+    def epoch_sums(epoch):
+        return [(float(np.sum(im)), lb.tolist()) for im, lb in val_fn(epoch)]
+    first, second = epoch_sums(0), epoch_sums(1)
+    assert len(first) == 1  # 8 examples / batch 8
+    assert first == second  # cached val: identical across epochs
+    for images, labels in train_fn(0):
+        assert images.shape == (8, 32, 32, 3)

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""XLA cost analysis + roofline numbers for any registered classification model.
+
+Formalizes the methodology in docs/TUNING.md: XLA's own FLOP/byte estimates for
+the jitted train step (`compiled.cost_analysis()`), optionally combined with a
+measured step time to report sustained FLOP/s and MFU. The reference had no
+profiling hooks at all (SURVEY.md §5.1); this plus `--profile-dir` traces are
+the TPU build's observability surface.
+
+    python tools/roofline.py -m resnet50                  # static analysis only
+    python tools/roofline.py -m resnet50 --time           # + measured img/s, MFU
+    python tools/roofline.py -m lenet5 --image-size 32 --channels 1 --num-classes 10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# bf16 peak per chip, TFLOP/s — used for MFU when --peak-tflops is not given
+KNOWN_PEAKS = {"tpu v5 lite": 197.0, "tpu v4": 275.0, "tpu v3": 123.0,
+               "tpu v2": 46.0, "tpu v6 lite": 918.0}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--channels", type=int, default=3)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--eval", action="store_true",
+                   help="analyze the eval (forward-only) step instead")
+    p.add_argument("--time", action="store_true",
+                   help="also run + time the step on the current backend "
+                        "(two loop lengths, delta timing — see docs/TUNING.md)")
+    p.add_argument("--peak-tflops", type=float, default=None,
+                   help="chip peak for MFU (defaults from the device kind)")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model, param_count
+    from deepvision_tpu.models import MODELS
+
+    if args.model not in MODELS:
+        raise SystemExit(f"unknown model {args.model!r}; known: "
+                         f"{', '.join(sorted(MODELS.names()))}")
+    compute_dtype = jnp.dtype(args.dtype)
+    model = MODELS.get(args.model)(num_classes=args.num_classes)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((2, args.image_size, args.image_size, args.channels),
+                       jnp.float32)
+    params, batch_stats = init_model(model, rng, sample)
+    tx = build_optimizer(OptimizerConfig(name="momentum", learning_rate=0.1),
+                         ScheduleConfig(name="constant"), 1000, 100)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+
+    shape = (args.batch_size, args.image_size, args.image_size, args.channels)
+    images = jnp.zeros(shape, jnp.float32)
+    labels = jnp.zeros((args.batch_size,), jnp.int32)
+
+    # run returns (state, syncable scalar) — fetching the scalar is the only
+    # honest completion barrier through a relayed TPU (docs/TUNING.md:
+    # block_until_ready can return before remote execution finishes). The
+    # AOT-compiled executable serves both cost_analysis and the timing loop,
+    # so the step compiles exactly once.
+    if args.eval:
+        step = steps.make_classification_eval_step(compute_dtype=compute_dtype)
+        mask = jnp.ones((args.batch_size,), jnp.float32)
+        compiled = step.lower(state, images, labels, mask).compile()
+        run = lambda s: (s, compiled(s, images, labels, mask)["loss"])
+    else:
+        # donate=False so repeated timing calls can reuse the same state
+        step = steps.make_classification_train_step(
+            compute_dtype=compute_dtype, donate=False)
+        compiled = step.lower(state, images, labels, rng).compile()
+        def run(s):
+            s, m = compiled(s, images, labels, rng)
+            return s, m["loss"]
+
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    out = {
+        "model": args.model,
+        "step": "eval" if args.eval else "train",
+        "batch": args.batch_size,
+        "image_size": args.image_size,
+        "dtype": str(compute_dtype),
+        "params": param_count(params),
+        "gflops_per_step": round(flops / 1e9, 2),
+        "gflops_per_image": round(flops / args.batch_size / 1e9, 3),
+        "hbm_gbytes_per_step": round(bytes_accessed / 1e9, 3),
+        # FLOPs per HBM byte: compare against the chip's compute/bandwidth
+        # ratio (v5e: ~197e12/819e9 ≈ 240) to see if the step is compute- or
+        # bandwidth-bound in XLA's model
+        "arithmetic_intensity": round(flops / bytes_accessed, 1)
+        if bytes_accessed else None,
+    }
+
+    if args.time:
+        dev = jax.devices()[0]
+        platform = dev.platform
+        sync = None
+        for _ in range(3):
+            state, sync = run(state)
+        float(sync)  # honest barrier: scalar host transfer (docs/TUNING.md)
+        def timed(n):
+            s, sc = state, None
+            t0 = time.perf_counter()
+            for _ in range(n):
+                s, sc = run(s)
+            float(sc)  # depends on the full chain of n steps
+            return time.perf_counter() - t0
+        # two loop lengths; the delta cancels constant dispatch/transfer
+        # latency (same methodology as bench.py)
+        n1, n2 = (5, 25) if platform == "tpu" else (1, 3)
+        t1, t2 = timed(n1), timed(n2)
+        dt, n_steps = t2 - t1, n2 - n1
+        if dt <= 0:  # clock noise — fall back to the long run
+            dt, n_steps = t2, n2
+        step_s = dt / n_steps
+        out["measured_step_ms"] = round(step_s * 1e3, 2)
+        out["images_per_sec"] = round(args.batch_size / step_s, 1)
+        out["sustained_tflops"] = round(flops / step_s / 1e12, 2)
+        peak = args.peak_tflops
+        if peak is None:
+            kind = getattr(dev, "device_kind", "").lower()
+            peak = next((v for k, v in KNOWN_PEAKS.items() if k in kind), None)
+        if peak:
+            out["mfu"] = round(flops / step_s / 1e12 / peak, 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()
