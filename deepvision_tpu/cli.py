@@ -90,6 +90,9 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--cache-val", action="store_true",
                    help="cache the validation records in host RAM after the "
                         "first epoch (classification ImageNet TFRecords)")
+    p.add_argument("--prefetch-batches", type=_positive_int, default=None,
+                   help="stage this many training batches ahead on device "
+                        "from a producer thread (default 2; 1 disables)")
     p.add_argument("--eval-only", action="store_true",
                    help="restore (-c/--auto-resume) and run validation once; "
                         "no training")
@@ -189,6 +192,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
             cfg.data, normalize_on_device=True))
     if getattr(args, "cache_val", False):
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, cache_val=True))
+    if args.prefetch_batches:
+        cfg = cfg.replace(prefetch_batches=args.prefetch_batches)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
     if args.model_parallel:

@@ -130,6 +130,11 @@ class TrainConfig:
     # the permuted batch instead of blending pixels; lam = exact kept-pixel
     # fraction. Mutually exclusive with mixup_alpha. Typical a: 1.0.
     cutmix_alpha: float = 0.0
+    # Host->device staging depth for training batches: a producer thread
+    # device_puts up to this many batches ahead so the transfer of batch i+1
+    # overlaps compute of batch i (parallel/prefetch.py). 1 disables the
+    # thread (inline staging). HBM cost: up to this many extra batches.
+    prefetch_batches: int = 2
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

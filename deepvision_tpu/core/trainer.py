@@ -28,6 +28,7 @@ from .optim import build_optimizer, set_lr_scale
 from .schedules import PlateauState
 from .train_state import TrainState, init_model, make_ema_update, param_count
 from ..parallel import mesh as mesh_lib
+from ..parallel.prefetch import prefetch_to_device
 from ..models import MODELS  # importing ..models registers the whole zoo
 
 
@@ -244,11 +245,14 @@ class Trainer:
         # while the device is idle between epochs).
         step0 = int(self.state.step)
         pending: list = []
-        for i, batch in enumerate(data):
-            # batch is any tuple of arrays with a leading batch dim — (images,
-            # labels) for classification, (images, boxes, classes, valid) for
-            # detection — forwarded positionally to the task's train step.
-            batch = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
+        # each batch is any tuple of arrays with a leading batch dim —
+        # (images, labels) for classification, (images, boxes, classes,
+        # valid) for detection — forwarded positionally to the task's train
+        # step. Staged to device ahead of consumption by a producer thread
+        # (prefetch_batches > 1) so host->device transfer overlaps compute.
+        staged = prefetch_to_device(self.mesh, data,
+                                    self.config.prefetch_batches)
+        for i, batch in enumerate(staged):
             self.state, metrics = self.train_step(self.state, *batch, step_rng)
             if self.ema_update is not None:
                 self._micro_count += 1
