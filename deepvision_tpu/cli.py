@@ -86,7 +86,8 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--device-normalize", action="store_true",
                    help="ship raw uint8 pixels to the device and normalize "
                         "inside the jitted step (4x less host->device "
-                        "traffic; classification ImageNet TFRecords only)")
+                        "traffic; TFRecord pipelines: ImageNet / "
+                        "detection / pose)")
     p.add_argument("--cache-val", action="store_true",
                    help="cache the validation records in host RAM after the "
                         "first epoch (classification ImageNet TFRecords)")
@@ -349,9 +350,21 @@ def run_classification(family: str, models: Sequence[str],
 
 # -- detection -----------------------------------------------------------------
 
+def _guard_device_normalize_synthetic(cfg, args):
+    """--device-normalize needs a pipeline that can emit raw uint8; the
+    synthetic generators yield floats that were never [0,255] pixels."""
+    if cfg.data.normalize_on_device and (args.synthetic
+                                         or cfg.data.dataset == "synthetic"):
+        raise SystemExit("--device-normalize is incompatible with synthetic "
+                         "data (random floats were never raw pixels)")
+
+
 def _detection_data(cfg, args):
+    import functools
+
     from .data import detection as det
     data = cfg.data
+    _guard_device_normalize_synthetic(cfg, args)
     if args.synthetic or data.dataset == "synthetic":
         return _synthetic_data(cfg, lambda steps, seed: det.synthetic_batches(
             batch_size=cfg.batch_size, image_size=data.image_size,
@@ -359,7 +372,9 @@ def _detection_data(cfg, args):
     if data.dataset != "detection":
         raise ValueError(f"detection families read 'detection' TFRecords, "
                          f"not dataset={data.dataset!r}")
-    return _tfrecord_data(det.build_dataset, cfg, args, "dataset/tfrecords")
+    build = functools.partial(det.build_dataset,
+                              normalize_on_host=not data.normalize_on_device)
+    return _tfrecord_data(build, cfg, args, "dataset/tfrecords")
 
 
 def run_detection(family: str, models: Sequence[str],
@@ -374,8 +389,11 @@ def run_detection(family: str, models: Sequence[str],
 # -- pose ----------------------------------------------------------------------
 
 def _pose_data(cfg, args):
+    import functools
+
     from .data import pose as pose_data
     data = cfg.data
+    _guard_device_normalize_synthetic(cfg, args)
     if args.synthetic or data.dataset == "synthetic":
         return _synthetic_data(
             cfg, lambda steps, seed: pose_data.synthetic_batches(
@@ -384,8 +402,9 @@ def _pose_data(cfg, args):
     if data.dataset != "pose":
         raise ValueError(f"pose families read 'pose' TFRecords, "
                          f"not dataset={data.dataset!r}")
-    return _tfrecord_data(pose_data.build_dataset, cfg, args,
-                          "dataset/tfrecords_mpii")
+    build = functools.partial(pose_data.build_dataset,
+                              normalize_on_host=not data.normalize_on_device)
+    return _tfrecord_data(build, cfg, args, "dataset/tfrecords_mpii")
 
 
 def run_centernet(family: str, models: Sequence[str],

@@ -15,19 +15,22 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import centernet as cn_ops
-from .config import TrainConfig
+from .config import TrainConfig, UNIT_RANGE_NORM
+from .steps import _normalize_input
 from .trainer import LossWatchedTrainer
 
 
 def make_centernet_train_step(*, num_classes: int, grid: int,
                               compute_dtype=jnp.bfloat16, donate: bool = True,
-                              mesh=None, remat: bool = False) -> Callable:
+                              mesh=None, remat: bool = False,
+                              input_norm=None) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
-    `remat=True` recomputes forward activations in backward (cf. steps.py)."""
+    `remat=True` recomputes forward activations in backward (cf. steps.py);
+    `input_norm=(mean, std)` normalizes raw [0,255] pixels on device."""
 
     def step(state, images, boxes, classes, valid, rng):
         del rng
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
 
         def forward(params, images):
@@ -63,9 +66,10 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
 
 
 def make_centernet_eval_step(*, num_classes: int, grid: int,
-                             compute_dtype=jnp.bfloat16, mesh=None) -> Callable:
+                             compute_dtype=jnp.bfloat16, mesh=None,
+                             input_norm=None) -> Callable:
     def step(state, images, boxes, classes, valid):
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
         outputs = state.apply_fn(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -88,12 +92,15 @@ class CenterNetTrainer(LossWatchedTrainer):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
         grid = config.data.image_size // 4  # output stride 4
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
         self.train_step = make_centernet_train_step(
             num_classes=config.data.num_classes, grid=grid,
-            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat)
+            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
+            input_norm=input_norm)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh,
+            input_norm=input_norm)
 
 
 def make_centernet_predict_step(*, compute_dtype=jnp.bfloat16,

@@ -19,6 +19,12 @@ from typing import Any, Dict, Optional, Tuple
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
+# The detection/pose pipelines normalize to [-1,1] (x/127.5 - 1, the
+# reference's convention `YOLO/tensorflow/preprocess.py:25`) — as mean/std in
+# [0,1] units that is (0.5, 0.5): the on-device input_norm their steps use
+# when the pipeline ships raw uint8 (`--device-normalize`).
+UNIT_RANGE_NORM = ((0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+
 
 @dataclasses.dataclass
 class OptimizerConfig:

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import yolo as yolo_ops
-from .config import TrainConfig
+from .config import TrainConfig, UNIT_RANGE_NORM
+from .steps import _normalize_input
 from .trainer import LossWatchedTrainer
 
 
@@ -33,17 +34,20 @@ def yolo_grid_sizes(image_size: int) -> Sequence[int]:
 
 def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
-                         mesh=None, remat: bool = False) -> Callable:
+                         mesh=None, remat: bool = False,
+                         input_norm=None) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
 
     boxes: (B, N, 4) normalized corner ground truth padded to N=MAX_BOXES;
     classes: (B, N) int32; valid: (B, N) 0/1. `remat=True` recomputes forward
     activations in the backward pass (HBM-for-FLOPs, cf. steps.py).
+    `input_norm=(mean, std)`: images arrive as raw [0,255] pixels (uint8
+    transfer, `--device-normalize`) and are normalized on device (steps.py).
     """
 
     def step(state, images, boxes, classes, valid, rng):
         del rng  # YOLO has no dropout; augmentation happens host-side
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
 
@@ -82,11 +86,12 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
 
 
 def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
-                        compute_dtype=jnp.bfloat16, mesh=None) -> Callable:
+                        compute_dtype=jnp.bfloat16, mesh=None,
+                        input_norm=None) -> Callable:
     """Validation loss step (`val_step`, `YOLO/tensorflow/train.py:105-117`)."""
 
     def step(state, images, boxes, classes, valid):
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
         outputs = state.apply_fn(
@@ -171,9 +176,12 @@ class DetectionTrainer(LossWatchedTrainer):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
         grids = yolo_grid_sizes(config.data.image_size)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
         self.train_step = make_yolo_train_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
-            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat)
+            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
+            input_norm=input_norm)
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
-            compute_dtype=compute_dtype, mesh=self.mesh)
+            compute_dtype=compute_dtype, mesh=self.mesh,
+            input_norm=input_norm)

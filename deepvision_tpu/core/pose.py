@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.heatmap import render_gaussian_heatmaps
-from .config import TrainConfig
+from .config import TrainConfig, UNIT_RANGE_NORM
+from .steps import _normalize_input
 from .trainer import LossWatchedTrainer
 
 FOREGROUND_WEIGHT = 81.0  # `Hourglass/tensorflow/train.py:69`
@@ -42,7 +43,8 @@ def weighted_mse_loss(labels: jnp.ndarray, outputs) -> jnp.ndarray:
 
 def make_pose_train_step(*, heatmap_size: Tuple[int, int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
-                         mesh=None, remat: bool = False) -> Callable:
+                         mesh=None, remat: bool = False,
+                         input_norm=None) -> Callable:
     """(state, images, kp_x, kp_y, visibility, rng) -> (state, metrics).
 
     kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K). `remat=True`
@@ -53,7 +55,7 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
 
     def step(state, images, kp_x, kp_y, visibility, rng):
         del rng
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         labels = jax.vmap(
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
@@ -87,11 +89,12 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
 
 
 def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
-                        compute_dtype=jnp.bfloat16, mesh=None) -> Callable:
+                        compute_dtype=jnp.bfloat16, mesh=None,
+                        input_norm=None) -> Callable:
     h, w = heatmap_size
 
     def step(state, images, kp_x, kp_y, visibility):
-        images = images.astype(compute_dtype)
+        images = _normalize_input(images, input_norm, compute_dtype)
         labels = jax.vmap(
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
@@ -119,8 +122,10 @@ class PoseTrainer(LossWatchedTrainer):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
+        input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
         self.train_step = make_pose_train_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
-            remat=config.remat)
+            remat=config.remat, input_norm=input_norm)
         self.eval_step = make_pose_eval_step(
-            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh)
+            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
+            input_norm=input_norm)

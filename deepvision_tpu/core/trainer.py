@@ -252,20 +252,27 @@ class Trainer:
         # (prefetch_batches > 1) so host->device transfer overlaps compute.
         staged = prefetch_to_device(self.mesh, data,
                                     self.config.prefetch_batches)
-        for i, batch in enumerate(staged):
-            self.state, metrics = self.train_step(self.state, *batch, step_rng)
-            if self.ema_update is not None:
-                self._micro_count += 1
-                if self._micro_count % self.config.optimizer.accum_steps == 0:
-                    self.state = self.ema_update(self.state)
-            device_metrics.append(metrics)
-            n_img += len(jax.tree_util.tree_leaves(batch)[0])
-            if (i + 1) % self.config.log_every_steps == 0:
-                pending.append((step0 + i + 1, metrics))
-                if len(pending) > 1:
-                    s, m = pending.pop(0)
-                    self.logger.log(s, jax.device_get(m), epoch=epoch,
-                                    prefix="train_", echo=_is_main_process())
+        try:
+            for i, batch in enumerate(staged):
+                self.state, metrics = self.train_step(self.state, *batch,
+                                                      step_rng)
+                if self.ema_update is not None:
+                    self._micro_count += 1
+                    if self._micro_count % self.config.optimizer.accum_steps == 0:
+                        self.state = self.ema_update(self.state)
+                device_metrics.append(metrics)
+                n_img += len(jax.tree_util.tree_leaves(batch)[0])
+                if (i + 1) % self.config.log_every_steps == 0:
+                    pending.append((step0 + i + 1, metrics))
+                    if len(pending) > 1:
+                        s, m = pending.pop(0)
+                        self.logger.log(s, jax.device_get(m), epoch=epoch,
+                                        prefix="train_", echo=_is_main_process())
+        finally:
+            # a step exception must release the producer's staged device
+            # batches NOW (a retained traceback would otherwise pin them
+            # exactly when a recovering driver needs the HBM back)
+            staged.close()
         jax.block_until_ready(self.state.params)
         for s, m in pending:
             self.logger.log(s, jax.device_get(m), epoch=epoch,
@@ -416,13 +423,6 @@ class LossWatchedTrainer(Trainer):
                 "mixup_alpha/cutmix_alpha are classification-only; the "
                 f"{type(self).__name__} ignores them — use the task's own "
                 "augmentations (flip/crop in the data pipeline) instead")
-        if config.data.normalize_on_device:
-            # task steps normalize in their own pipelines; a silently ignored
-            # flag would mean doubly- or un-normalized inputs
-            raise ValueError(
-                "normalize_on_device (--device-normalize) is supported by the "
-                f"classification ImageNet pipeline only; {type(self).__name__} "
-                "does not honor it")
         super().__init__(config, *args, **kwargs)
 
     def evaluate(self, data: Iterable) -> dict:

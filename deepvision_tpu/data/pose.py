@@ -81,7 +81,8 @@ def crop_roi(image, kp_x, kp_y, vis, margin, tf):
     return image, kp_x, kp_y
 
 
-def preprocess(serialized, image_size: int, training: bool, tf):
+def preprocess(serialized, image_size: int, training: bool, tf,
+               normalize_on_host: bool = True):
     encoded, kp_x, kp_y, vis = parse_example(serialized, tf)
     image = tf.cast(tf.io.decode_jpeg(encoded, channels=3), tf.float32)
     margin = (tf.random.uniform([], 0.1, 0.3) if training
@@ -94,7 +95,11 @@ def preprocess(serialized, image_size: int, training: bool, tf):
         lambda: crop_roi(image, kp_x, kp_y, vis, margin, tf),
         lambda: (image, kp_x, kp_y))
     image = tf.image.resize(image, [image_size, image_size])
-    image = image / 127.5 - 1.0
+    if normalize_on_host:
+        image = image / 127.5 - 1.0
+    else:
+        # raw uint8: the step normalizes on device (UNIT_RANGE_NORM)
+        image = tf.cast(tf.round(tf.clip_by_value(image, 0.0, 255.0)), tf.uint8)
 
     def fix(t):
         t = t[:NUM_JOINTS]
@@ -108,9 +113,11 @@ def preprocess(serialized, image_size: int, training: bool, tf):
 
 def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 256,
                   training: bool = True, shuffle_buffer: int = 512,
-                  num_process: int = 1, process_index: int = 0, seed: int = 0):
+                  num_process: int = 1, process_index: int = 0, seed: int = 0,
+                  normalize_on_host: bool = True):
     """Per-host tf.data pose pipeline (cf. `create_dataset`,
-    `Hourglass/tensorflow/train.py:175-190`)."""
+    `Hourglass/tensorflow/train.py:175-190`). `normalize_on_host=False`
+    emits raw uint8 (the step normalizes on device, `--device-normalize`)."""
     tf = _tf()
     AUTOTUNE = tf.data.AUTOTUNE
     files = tf.data.Dataset.list_files(file_pattern, shuffle=training, seed=seed)
@@ -119,7 +126,8 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 256,
     ds = tf.data.TFRecordDataset(files, num_parallel_reads=AUTOTUNE)
     if training:
         ds = ds.shuffle(shuffle_buffer, seed=seed)
-    ds = ds.map(lambda s: preprocess(s, image_size, training, tf),
+    ds = ds.map(lambda s: preprocess(s, image_size, training, tf,
+                                     normalize_on_host=normalize_on_host),
                 num_parallel_calls=AUTOTUNE)
     ds = ds.batch(batch_size, drop_remainder=True)
     return ds.prefetch(AUTOTUNE)

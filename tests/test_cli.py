@@ -180,3 +180,13 @@ def test_cache_val_flag_reaches_imagenet_pipeline(tmp_path):
     assert first == second  # cached val: identical across epochs
     for images, labels in train_fn(0):
         assert images.shape == (8, 32, 32, 3)
+
+
+def test_device_normalize_detection_synthetic_rejected(tmp_path):
+    from deepvision_tpu.cli import run_detection
+    with pytest.raises(SystemExit, match="synthetic"):
+        run_detection(
+            "YOLO", ["yolov3"],
+            argv=["-m", "yolov3", "--synthetic", "--epochs", "1",
+                  "--batch-size", "8", "--steps-per-epoch", "1",
+                  "--device-normalize", "--workdir", str(tmp_path)])

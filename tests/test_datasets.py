@@ -310,3 +310,80 @@ def test_imagenet_uint8_pipeline_matches_host_normalized(tmp_path):
     # solid-color source: no bicubic overshoot, so the only difference is the
     # 0.5/255 rounding step, scaled by 1/min(std)
     np.testing.assert_allclose(normed, imgsf, atol=0.5 / 255 / 0.224 + 1e-6)
+
+
+def test_detection_and_pose_uint8_pipelines(tmp_path):
+    """normalize_on_host=False on the detection/pose pipelines emits raw
+    uint8; device-normalizing with UNIT_RANGE_NORM reproduces the [-1,1]
+    host path up to uint8 quantization."""
+    import io
+
+    import jax.numpy as jnp
+    import tensorflow as tf
+
+    from deepvision_tpu.core.config import UNIT_RANGE_NORM
+    from deepvision_tpu.core.steps import _normalize_input
+    from deepvision_tpu.data import detection as det
+    from deepvision_tpu.data import pose as pose_data
+
+    # detection record (VOC-style schema via the pipeline's own parser)
+    _write_jpeg(tmp_path / "img.jpg", size=(48, 40), color=(120, 200, 40))
+    encoded = (tmp_path / "img.jpg").read_bytes()
+    det_rec = tmp_path / "det-train-00000"
+    with tf.io.TFRecordWriter(str(det_rec)) as w:
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[encoded])),
+            "image/object/bbox/xmin": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.1])),
+            "image/object/bbox/ymin": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.1])),
+            "image/object/bbox/xmax": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.5])),
+            "image/object/bbox/ymax": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.5])),
+            "image/object/class/label": tf.train.Feature(
+                int64_list=tf.train.Int64List(value=[3])),
+        }))
+        w.write(ex.SerializeToString())
+
+    def det_batch(normalize_on_host):
+        ds = det.build_dataset(str(det_rec), batch_size=1, image_size=32,
+                               training=False,
+                               normalize_on_host=normalize_on_host)
+        return next(iter(ds.as_numpy_iterator()))
+
+    img8 = det_batch(False)[0]
+    imgf = det_batch(True)[0]
+    assert img8.dtype == np.uint8 and imgf.dtype == np.float32
+    normed = np.asarray(_normalize_input(jnp.asarray(img8), UNIT_RANGE_NORM,
+                                         jnp.float32))
+    np.testing.assert_allclose(normed, imgf, atol=0.5 / 127.5 + 1e-6)
+
+    # pose record (MPII schema via the pose pipeline's parser)
+    pose_rec = tmp_path / "pose-train-00000"
+    with tf.io.TFRecordWriter(str(pose_rec)) as w:
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[encoded])),
+            "image/keypoint/x": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.5] * 16)),
+            "image/keypoint/y": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.5] * 16)),
+            "image/keypoint/visibility": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[1.0] * 16)),
+        }))
+        w.write(ex.SerializeToString())
+
+    def pose_batch(normalize_on_host):
+        ds = pose_data.build_dataset(str(pose_rec), batch_size=1,
+                                     image_size=32, training=False,
+                                     normalize_on_host=normalize_on_host)
+        return next(iter(ds.as_numpy_iterator()))
+
+    pimg8 = pose_batch(False)[0]
+    pimgf = pose_batch(True)[0]
+    assert pimg8.dtype == np.uint8 and pimgf.dtype == np.float32
+    pnormed = np.asarray(_normalize_input(jnp.asarray(pimg8), UNIT_RANGE_NORM,
+                                          jnp.float32))
+    np.testing.assert_allclose(pnormed, pimgf, atol=0.5 / 127.5 + 1e-6)

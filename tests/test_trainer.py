@@ -580,15 +580,32 @@ def test_device_normalize_step_matches_host_normalized(tmp_path):
     np.testing.assert_allclose(m_dev["loss"], m_host["loss"], rtol=1e-6)
     assert m_dev["top1"] == m_host["top1"]
 
-    # task trainers must reject normalize_on_device loudly
-    from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.detection import DetectionTrainer
-    import dataclasses as _dc
-    cfg = get_config("yolov3").replace(
-        batch_size=8, checkpoint_dir=str(tmp_path / "c"))
-    cfg = cfg.replace(data=_dc.replace(cfg.data, normalize_on_device=True))
-    with pytest.raises(ValueError, match="device-normalize"):
-        DetectionTrainer(cfg, workdir=str(tmp_path / "wd"))
+    # task steps honor the same contract: a uint8 batch through the YOLO
+    # step with UNIT_RANGE_NORM equals the [-1,1]-normalized float batch
+    from deepvision_tpu.core.config import UNIT_RANGE_NORM
+    from deepvision_tpu.core.detection import (make_yolo_eval_step,
+                                               yolo_grid_sizes)
+    from deepvision_tpu.models import MODELS as _M
+
+    yolo = _M.get("yolov3")(num_classes=4)
+    yp, ybs = init_model(yolo, rng, jnp.zeros((1, 64, 64, 3)))
+    ystate = TrainState.create(yolo.apply, yp, tx, ybs)
+    det8 = np.random.RandomState(1).randint(
+        0, 256, size=(2, 64, 64, 3)).astype(np.uint8)
+    detf = det8.astype(np.float32) / 127.5 - 1.0
+    boxes = np.tile(np.array([[0.2, 0.2, 0.6, 0.6]], np.float32), (2, 1, 1))
+    boxes = np.pad(boxes, [(0, 0), (0, 99), (0, 0)])
+    classes = np.zeros((2, 100), np.int32)
+    valid = np.pad(np.ones((2, 1), np.float32), [(0, 0), (0, 99)])
+    grids = yolo_grid_sizes(64)
+    ev8 = make_yolo_eval_step(num_classes=4, grid_sizes=grids,
+                              compute_dtype=jnp.float32,
+                              input_norm=UNIT_RANGE_NORM)
+    evf = make_yolo_eval_step(num_classes=4, grid_sizes=grids,
+                              compute_dtype=jnp.float32)
+    l8 = float(ev8(ystate, jnp.asarray(det8), boxes, classes, valid)["loss"])
+    lf = float(evf(ystate, jnp.asarray(detf), boxes, classes, valid)["loss"])
+    np.testing.assert_allclose(l8, lf, rtol=1e-5)
 
 
 def test_delayed_metric_logging_labels_and_coverage(tmp_path):
