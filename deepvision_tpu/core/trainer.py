@@ -262,12 +262,15 @@ class Trainer:
                         self.state = self.ema_update(self.state)
                 device_metrics.append(metrics)
                 n_img += len(jax.tree_util.tree_leaves(batch)[0])
-                if (i + 1) % self.config.log_every_steps == 0:
+                if ((i + 1) % self.config.log_every_steps == 0
+                        and _is_main_process()):
+                    # JSONL/TB writes are process-0-only, like checkpoints
+                    # (SURVEY.md §5.8) — other hosts skip the device_get too
                     pending.append((step0 + i + 1, metrics))
                     if len(pending) > 1:
                         s, m = pending.pop(0)
                         self.logger.log(s, jax.device_get(m), epoch=epoch,
-                                        prefix="train_", echo=_is_main_process())
+                                        prefix="train_", echo=True)
         finally:
             # a step exception must release the producer's staged device
             # batches NOW (a retained traceback would otherwise pin them
@@ -276,7 +279,7 @@ class Trainer:
         jax.block_until_ready(self.state.params)
         for s, m in pending:
             self.logger.log(s, jax.device_get(m), epoch=epoch,
-                            prefix="train_", echo=_is_main_process())
+                            prefix="train_", echo=True)  # main process only
         dt = time.time() - t0
         if device_metrics:
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs).mean(),

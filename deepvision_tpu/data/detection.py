@@ -23,6 +23,7 @@ from typing import Iterator, Tuple
 import numpy as np
 
 from ..ops.yolo import MAX_BOXES
+from .util import to_uint8_pixels
 from .imagenet import _tf
 
 
@@ -115,7 +116,7 @@ def preprocess(serialized, image_size: int, training: bool, tf,
     else:
         # raw uint8: the step normalizes on device (UNIT_RANGE_NORM) —
         # 4x less host->device traffic (`--device-normalize`)
-        image = tf.cast(tf.round(tf.clip_by_value(image, 0.0, 255.0)), tf.uint8)
+        image = to_uint8_pixels(image, tf)
 
     n = tf.minimum(tf.shape(boxes)[0], MAX_BOXES)
     boxes = tf.pad(boxes[:n], [[0, MAX_BOXES - n], [0, 0]])

@@ -21,6 +21,7 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from ..core.config import IMAGENET_MEAN, IMAGENET_STD
+from .util import to_uint8_pixels
 
 MEAN_RGB = np.array(IMAGENET_MEAN, np.float32)   # torchvision-convention
 STDDEV_RGB = np.array(IMAGENET_STD, np.float32)
@@ -105,7 +106,7 @@ def preprocess(encoded, label, image_size, training, tf, normalize_on_host=True,
         # raw uint8 pixels: the device normalizes ((x/255 - mean)/std inside
         # the jitted step) — host->device transfer drops to 1/4 the bytes,
         # the lever that matters when a pod is input-bound (SURVEY.md §7.2.1)
-        image = tf.cast(tf.round(image), tf.uint8)
+        image = to_uint8_pixels(image, tf)
     image.set_shape([image_size, image_size, 3])
     return image, label
 

@@ -25,6 +25,7 @@ from typing import Iterator, Tuple
 import numpy as np
 
 from .imagenet import _tf
+from .util import to_uint8_pixels
 
 NUM_JOINTS = 16  # MPII
 
@@ -99,7 +100,7 @@ def preprocess(serialized, image_size: int, training: bool, tf,
         image = image / 127.5 - 1.0
     else:
         # raw uint8: the step normalizes on device (UNIT_RANGE_NORM)
-        image = tf.cast(tf.round(tf.clip_by_value(image, 0.0, 255.0)), tf.uint8)
+        image = to_uint8_pixels(image, tf)
 
     def fix(t):
         t = t[:NUM_JOINTS]

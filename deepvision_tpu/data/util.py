@@ -1,0 +1,12 @@
+"""Shared helpers for the tf.data pipelines."""
+
+from __future__ import annotations
+
+
+def to_uint8_pixels(image, tf):
+    """Emit raw uint8 pixels for device-side normalization
+    (`--device-normalize`): clip to [0,255] (bicubic resize can overshoot),
+    round, cast. The one definition all pipelines share, so the
+    round/clip contract with the jitted step's `input_norm`
+    (`core/steps._normalize_input`) cannot silently diverge per family."""
+    return tf.cast(tf.round(tf.clip_by_value(image, 0.0, 255.0)), tf.uint8)

@@ -64,7 +64,20 @@ def make_mesh(
     else:
         shape = (n // model_parallel, model_parallel)
         names = axis_names or (DATA_AXIS, MODEL_AXIS)
-    return Mesh(np.asarray(devices).reshape(shape), names)
+    grid = np.asarray(devices).reshape(shape)
+    if spatial_parallel > 1 and jax.process_count() > 1:
+        # Per-host batch assembly (make_array_from_process_local_data in
+        # shard_batch_pytree) infers the global H from the number of
+        # PROCESSES the 'spatial' axis spans. If a spatial column crossed
+        # hosts, each host's full-height images would be silently stitched
+        # as H-slices of composite garbage — reject the layout instead.
+        procs = np.vectorize(lambda d: d.process_index)(grid)
+        if (procs != procs[:, :1, :]).any():
+            raise ValueError(
+                "the 'spatial' mesh axis crosses process boundaries; pick "
+                "spatial_parallel (x model_parallel) dividing the per-host "
+                "device count so each spatial group stays on one host")
+    return Mesh(grid, names)
 
 
 def has_spatial(mesh: Mesh) -> bool:
@@ -94,11 +107,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_batch_pytree(mesh: Mesh, batch):
     """Device-put a host pytree of arrays with the batch dim sharded over 'data'
-    (and H over 'spatial' for NHWC arrays on a spatial mesh)."""
+    (and H over 'spatial' for NHWC arrays on a spatial mesh).
+
+    Multi-process: each array holds this PROCESS's batch rows (the per-host
+    pipeline's shard; global batch = rows × process_count), assembled with
+    `make_array_from_process_local_data`. Plain `device_put` of a host array
+    onto a cross-process sharding would instead treat it as a GLOBAL value
+    and allgather-assert equality across hosts — wrong for per-host data, a
+    hidden per-batch DCN collective, and deadlock-prone off the main thread
+    (the prefetch producer racing the Orbax save barrier)."""
+    multiprocess = jax.process_count() > 1
+
     def _put(x):
         x = np.asarray(x)
         dim1 = x.shape[1] if x.ndim > 1 else None
-        return jax.device_put(x, batch_sharding(mesh, x.ndim, dim1=dim1))
+        sharding = batch_sharding(mesh, x.ndim, dim1=dim1)
+        if multiprocess:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
     return jax.tree_util.tree_map(_put, batch)
 
 

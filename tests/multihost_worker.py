@@ -1,0 +1,88 @@
+"""Worker process for the multi-host integration test (test_multihost.py).
+
+Run as: python multihost_worker.py <process_id> <coordinator_port> <workdir>
+
+Each of the 2 processes gets 4 virtual CPU devices; `jax.distributed`
+coordinates them into one 8-device global mesh — the same SPMD shape as a
+2-host TPU slice (SURVEY.md §5.8), with per-host data sharding and the
+collective Orbax save every process must enter.
+"""
+import os
+import sys
+
+
+def main():
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps all stacks
+    pid, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                               num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                            ScheduleConfig, TrainConfig)
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.synthetic import SyntheticClassification
+
+    global_batch = 16
+    cfg = TrainConfig(
+        name="mh", model="lenet5", batch_size=global_batch, total_epochs=2,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        train_examples=global_batch * 4),
+        dtype="float32", checkpoint_dir=os.path.join(workdir, "ckpt"),
+        log_every_steps=2, prefetch_batches=2,
+    )
+
+    def data(epoch):
+        # each process feeds its PER-HOST shard of the global batch
+        # (global_batch // process_count rows — the shape of a real per-host
+        # tf.data pipeline); shard_batch_pytree assembles the global array
+        # from the process-local rows. Distinct seeds per process = distinct
+        # host data, exactly like sharded TFRecord files.
+        return SyntheticClassification(global_batch // 2, 32, 1, 10,
+                                       num_batches=4, seed=epoch * 100 + pid)
+
+    tr = Trainer(cfg, workdir=workdir)
+    result = tr.fit(data, data, sample_shape=(32, 32, 1))
+    # the watched metric is computed from globally-reduced sums — it must be
+    # bitwise identical across processes (printed; the launcher compares)
+    print(f"MHRESULT pid={pid} best={result['best_metric']:.6f} "
+          f"top1={result['top1']:.6f} step={int(tr.state.step)}", flush=True)
+    tr.close()
+
+    # resume path: every process restores the collective checkpoint
+    tr2 = Trainer(cfg, workdir=workdir)
+    tr2.init_state((32, 32, 1))
+    got = tr2.resume()
+    assert got == 2, got
+    print(f"MHRESUME pid={pid} epoch={got} step={int(tr2.state.step)}",
+          flush=True)
+    tr2.close()
+
+    # a spatial axis crossing hosts must be rejected (per-host batch assembly
+    # would stitch different hosts' images); a process-local one is fine
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    try:
+        mesh_lib.make_mesh(spatial_parallel=8)
+        print(f"MHSPATIAL pid={pid} FAIL-no-error", flush=True)
+    except ValueError:
+        mesh_lib.make_mesh(spatial_parallel=4)  # within each host: allowed
+        print(f"MHSPATIAL pid={pid} guard-ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Multi-host SPMD integration: 2 real processes × 4 virtual CPU devices form
+one 8-device global mesh via `jax.distributed` (SURVEY.md §5.8's multi-host
+story, which the reference never had). The full Trainer runs in both
+processes — per-host data feeding, GSPMD gradient all-reduce across the
+process boundary, the collective Orbax save, and resume."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_training_and_resume(tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), str(port), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    def line(out, tag):
+        matches = [ln for ln in out.splitlines() if ln.startswith(tag)]
+        assert matches, f"{tag} missing in:\n{out}"
+        return matches[0].split(" ", 1)[1]  # strip the tag
+
+    # compare everything after the pid field: both processes must agree on
+    # the globally-reduced metrics and the final step
+    results = [line(o, "MHRESULT").split(" ", 1)[1] for o in outs]
+    assert results[0] == results[1], results
+    resumes = [line(o, "MHRESUME").split(" ", 1)[1] for o in outs]
+    assert resumes[0] == resumes[1] == "epoch=2 step=8", resumes
+    spatial = [line(o, "MHSPATIAL").split(" ", 1)[1] for o in outs]
+    assert spatial == ["guard-ok", "guard-ok"], spatial
