@@ -104,24 +104,38 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
 
 
 def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,
-                   bounded_train_steps: bool = False):
+                   bounded_train_steps: bool = False,
+                   builder_hint: str = ""):
     """Per-host train*/val* TFRecord pipelines shared by the tf.data tasks."""
     import jax
 
-    from .data.imagenet import epoch_iterator
+    from .data.imagenet import _tf, epoch_iterator
     data = cfg.data
     data_dir = args.data_dir or data.data_dir or default_dir
+
+    def _check(pattern):
+        # fail NOW with a remedy, not a tf.data NotFoundError mid-epoch.
+        # tf.io.gfile.glob is the pipeline's own resolver (list_files), so
+        # remote filesystems (gs://, s3://) pass the same way local dirs do.
+        if not _tf().io.gfile.glob(pattern):
+            hint = f" Build them with {builder_hint}." if builder_hint else ""
+            raise SystemExit(
+                f"no TFRecords match {pattern!r} — point --data-dir at the "
+                f"dataset (or use --synthetic for a smoke run).{hint}")
+
     per_host = cfg.batch_size // jax.process_count()
     eval_per_host = (cfg.eval_batch_size or cfg.batch_size) // jax.process_count()
     common = dict(image_size=data.image_size,
                   num_process=jax.process_count(),
                   process_index=jax.process_index())
+    _check(os.path.join(data_dir, "val*"))
     val_ds = build_dataset(os.path.join(data_dir, "val*"), training=False,
                            batch_size=eval_per_host, **common)
     if getattr(args, "eval_only", False):
         def val_fn(epoch, _ds=val_ds):
             return epoch_iterator(_ds)
         return _no_train_data, val_fn
+    _check(os.path.join(data_dir, "train*"))
     train_ds = build_dataset(os.path.join(data_dir, "train*"), training=True,
                              batch_size=per_host, **common)
     # imagenet repeats its dataset → always bound each epoch; detection/pose
@@ -301,8 +315,9 @@ def _classification_data(cfg, args):
                 normalize_on_host=not data.normalize_on_device,
                 mean=data.mean, std=data.std, **kw)
 
-        return _tfrecord_data(build, cfg, args, "dataset/tfrecord",
-                              bounded_train_steps=True)
+        return _tfrecord_data(
+            build, cfg, args, "dataset/tfrecord", bounded_train_steps=True,
+            builder_hint="Datasets/ILSVRC2012/build_imagenet_tfrecord.py")
     elif data.dataset == "imagenet_flat":
         # the reference's flat-dir layout (`ResNet/pytorch/data_load.py:20-44`:
         # dataset/{train_flatten,val_flatten}/ + synsets.txt)
@@ -374,7 +389,9 @@ def _detection_data(cfg, args):
                          f"not dataset={data.dataset!r}")
     build = functools.partial(det.build_dataset,
                               normalize_on_host=not data.normalize_on_device)
-    return _tfrecord_data(build, cfg, args, "dataset/tfrecords")
+    return _tfrecord_data(
+        build, cfg, args, "dataset/tfrecords",
+        builder_hint="Datasets/VOC2007|VOC2012|MSCOCO/tfrecords.py")
 
 
 def run_detection(family: str, models: Sequence[str],
@@ -404,7 +421,9 @@ def _pose_data(cfg, args):
                          f"not dataset={data.dataset!r}")
     build = functools.partial(pose_data.build_dataset,
                               normalize_on_host=not data.normalize_on_device)
-    return _tfrecord_data(build, cfg, args, "dataset/tfrecords_mpii")
+    return _tfrecord_data(
+        build, cfg, args, "dataset/tfrecords_mpii",
+        builder_hint="Datasets/MPII/tfrecords_mpii.py")
 
 
 def run_centernet(family: str, models: Sequence[str],

@@ -190,3 +190,13 @@ def test_device_normalize_detection_synthetic_rejected(tmp_path):
             argv=["-m", "yolov3", "--synthetic", "--epochs", "1",
                   "--batch-size", "8", "--steps-per-epoch", "1",
                   "--device-normalize", "--workdir", str(tmp_path)])
+
+
+def test_missing_tfrecords_fail_fast_with_remedy(tmp_path):
+    """A wrong --data-dir fails at startup with the pattern and the builder
+    script named — not a tf.data NotFoundError mid-epoch."""
+    with pytest.raises(SystemExit, match=r"no TFRecords match.*val\*.*build_imagenet"):
+        run_classification(
+            "ResNet", ["resnet50"],
+            argv=["-m", "resnet50", "--data-dir", str(tmp_path / "nope"),
+                  "--epochs", "1", "--workdir", str(tmp_path)])
