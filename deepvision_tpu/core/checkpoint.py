@@ -158,6 +158,10 @@ class CheckpointManager:
             new_state = payload
         return new_state, dict(restored["host"] or {}), epoch
 
+    def flush(self):
+        """Barrier on any in-flight async save (the manager stays usable)."""
+        self._mgr.wait_until_finished()
+
     def close(self):
         self._mgr.wait_until_finished()
         self._mgr.close()

@@ -403,6 +403,10 @@ class Trainer:
                                    "num_bad_epochs": self.plateau.num_bad_epochs,
                                    "scale": self.plateau.scale}
             self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+        # fit returning means "training done": the last async save must be
+        # committed, or a fresh Trainer on this workdir (library UX — the CLI
+        # also calls close()) would resume from the previous epoch
+        self.ckpt.flush()
         return {"best_metric": self.best_metric, **last_val}
 
     def close(self):

@@ -70,3 +70,30 @@ def test_classify_cli_tool(lenet_workdir, tmp_path, capsys):
               str(img)])
     out = capsys.readouterr().out
     assert str(img) in out and "%" in out
+
+
+def test_summarize_cli_tool(capsys):
+    """tools/summarize.py: the torchsummary call the reference makes before
+    training (`ResNet/pytorch/train.py:350`) — per-layer table + param total
+    for any registered config or model name."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "summarize_tool", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "summarize.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    mod.main(["-m", "lenet5"])
+    out = capsys.readouterr().out
+    assert "Total Parameters: 61,706" in out  # LeNet-5's exact count
+    assert "Conv" in out and "Dense" in out
+
+    # model-registry fallback (names with no training config): an image model
+    # and the latent-input DCGAN generator (sample must be a noise vector)
+    mod.main(["-m", "dcgan_discriminator", "--image-size", "28",
+              "--channels", "1"])
+    assert "Total Parameters" in capsys.readouterr().out
+    mod.main(["-m", "dcgan_generator"])
+    assert "ConvTranspose" in capsys.readouterr().out

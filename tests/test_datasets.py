@@ -387,3 +387,52 @@ def test_detection_and_pose_uint8_pipelines(tmp_path):
     pnormed = np.asarray(_normalize_input(jnp.asarray(pimg8), UNIT_RANGE_NORM,
                                           jnp.float32))
     np.testing.assert_allclose(pnormed, pimgf, atol=0.5 / 127.5 + 1e-6)
+
+
+def test_flatten_tool_feeds_flat_loader(tmp_path):
+    """Datasets/ILSVRC2012/flatten.py (the untar/flatten shell scripts of the
+    reference, `flatten-script.sh`/`flatten-val-script.sh`) must produce the
+    exact layout `data/imagenet_flat.FlatImageNet` parses: flat JPEGs named
+    `<synset>_<...>.JPEG`."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "flatten_tool", os.path.join(os.path.dirname(__file__), "..",
+                                     "Datasets", "ILSVRC2012", "flatten.py"))
+    flat = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(flat)
+
+    # train/<synset>/<name>.JPEG — one file already prefixed, one not
+    train = tmp_path / "train"
+    (train / "n01440764").mkdir(parents=True)
+    (train / "n01443537").mkdir()
+    _write_jpeg(str(train / "n01440764" / "n01440764_10026.JPEG"))
+    _write_jpeg(str(train / "n01443537" / "10027.JPEG"))
+    out_train = tmp_path / "train_flatten"
+    n = flat.flatten_train(str(train), str(out_train), copy=True)
+    assert n == 2
+    assert sorted(os.listdir(out_train)) == [
+        "n01440764_10026.JPEG", "n01443537_10027.JPEG"]
+
+    # validation/ILSVRC2012_val_0000000X.JPEG + line-per-file synset labels
+    val = tmp_path / "validation"
+    val.mkdir()
+    _write_jpeg(str(val / "ILSVRC2012_val_00000001.JPEG"))
+    _write_jpeg(str(val / "ILSVRC2012_val_00000002.JPEG"))
+    labels = tmp_path / "val_labels.txt"
+    labels.write_text("n01443537\nn01440764\n")
+    out_val = tmp_path / "val_flatten"
+    n = flat.flatten_val(str(val), str(labels), str(out_val), copy=True)
+    assert n == 2
+    assert sorted(os.listdir(out_val)) == [
+        "n01440764_val_00000002.JPEG", "n01443537_val_00000001.JPEG"]
+
+    # the flat loader must batch both outputs with the right labels
+    from deepvision_tpu.data.imagenet_flat import FlatImageNet
+    synsets = {"n01440764": 0, "n01443537": 1}
+    for root, expect in ((out_train, {0, 1}), (out_val, {0, 1})):
+        ds = FlatImageNet(str(root), synsets, batch_size=2, training=False,
+                          image_size=32, workers=1)
+        images, got = next(iter(ds))
+        assert images.shape == (2, 32, 32, 3)
+        assert set(got.tolist()) == expect

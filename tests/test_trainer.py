@@ -63,6 +63,21 @@ def test_checkpoint_resume(tmp_path):
     tr2.close()
 
 
+def test_fit_return_means_last_save_committed(tmp_path):
+    """`fit()` must barrier on the final async Orbax save: a second Trainer
+    opened on the workdir right after fit returns (library UX, no close())
+    resumes from the LAST epoch, not the previous one."""
+    cfg = _config(tmp_path, total_epochs=2)
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    tr.fit(_data(), None, sample_shape=(32, 32, 1))
+    # deliberately NO tr.close() before the second manager opens the dir
+    tr2 = Trainer(cfg.replace(total_epochs=3), workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    assert tr2.resume() == 2
+    tr2.close()
+    tr.close()
+
+
 def test_plateau_state_machine():
     p = PlateauState(patience=1, factor=0.5, mode="max")
     assert p.update(0.5) == 1.0      # first value = best

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Per-layer model summary — the `summary(net, (3, 224, 224))` torchsummary
+call the reference makes before training (`ResNet/pytorch/train.py:350`),
+for any registered model, via `flax.linen.tabulate`.
+
+Usage:
+    python tools/summarize.py -m resnet50 [--image-size 224] [--batch 1]
+    python tools/summarize.py -m hourglass104 --depth 2
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_model_and_sample(name, image_size=None, channels=None, batch=1):
+    """Resolve `name` through the config registry (preferred: carries the
+    right image size / class count / pinned kwargs) or the model registry."""
+    import jax.numpy as jnp
+    from deepvision_tpu.models import MODELS
+    from deepvision_tpu.utils.registry import CONFIGS
+    from deepvision_tpu.core.trainer import _accepts_kwarg
+    import deepvision_tpu.configs  # noqa: F401  (populates CONFIGS)
+
+    kwargs, num_classes = {}, 1000
+    if name in CONFIGS.names():
+        cfg = CONFIGS.get(name)
+        kwargs = dict(cfg.model_kwargs)
+        num_classes = cfg.data.num_classes
+        image_size = image_size or cfg.data.image_size
+        channels = channels or cfg.data.channels
+        name = cfg.model
+    ctor = MODELS.get(name)
+    for kw in ("num_classes", "num_heatmap"):
+        if kw not in kwargs and _accepts_kwarg(ctor, kw) and num_classes:
+            kwargs.setdefault(kw, num_classes)
+            break
+    model = ctor(**kwargs)
+    if hasattr(model, "noise_dim"):  # latent-input generator (DCGAN): the
+        sample = jnp.zeros((batch, model.noise_dim), jnp.float32)  # input is
+    else:                            # a noise vector, not an image
+        sample = jnp.zeros((batch, image_size or 224, image_size or 224,
+                            channels or 3), jnp.float32)
+    return model, sample
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", required=True,
+                   help="config name (resnet50, yolov3, ...) or model name")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--channels", type=int, default=None)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--depth", type=int, default=1,
+                   help="module nesting depth to expand (default 1)")
+    args = p.parse_args(argv)
+
+    import flax.linen as nn
+    import jax
+
+    model, sample = build_model_and_sample(
+        args.model, args.image_size, args.channels, args.batch)
+    table = nn.tabulate(
+        model, jax.random.PRNGKey(0), depth=args.depth,
+        console_kwargs={"width": 160, "force_terminal": False})(
+            sample, train=False)
+    print(table)
+
+
+if __name__ == "__main__":
+    main()
