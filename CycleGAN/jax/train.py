@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 def main():
     p = argparse.ArgumentParser(description="Train CycleGAN (TPU-native JAX).")
     p.add_argument("--dataset", help="dataset name under tfrecords/")
-    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--batch_size", "--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--image-size", type=int, default=256)
     p.add_argument("--workdir", default=None)
@@ -28,11 +28,19 @@ def main():
     p.add_argument("--steps-per-epoch", type=int, default=2)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--compilation-cache",
+                   default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                          "auto"),
+                   metavar="DIR|off", help="persistent XLA compilation cache "
+                   "(see the shared trainer CLIs); 'off' disables")
     args = p.parse_args()
 
+    from deepvision_tpu.cli import setup_compilation_cache
     from deepvision_tpu.configs import get_config
     from deepvision_tpu.core.gan import CycleGANTrainer
     from deepvision_tpu.data import gan as gan_data
+
+    setup_compilation_cache(args.compilation_cache)
 
     cfg = get_config("cyclegan")
     if args.epochs:

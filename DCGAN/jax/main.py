@@ -25,11 +25,19 @@ def main():
                    help="steps per epoch in --synthetic mode")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--compilation-cache",
+                   default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                          "auto"),
+                   metavar="DIR|off", help="persistent XLA compilation cache "
+                   "(see the shared trainer CLIs); 'off' disables")
     args = p.parse_args()
 
+    from deepvision_tpu.cli import setup_compilation_cache
     from deepvision_tpu.configs import get_config
     from deepvision_tpu.core.gan import DCGANTrainer
     from deepvision_tpu.data import gan as gan_data
+
+    setup_compilation_cache(args.compilation_cache)
 
     cfg = get_config("dcgan")
     if args.epochs:

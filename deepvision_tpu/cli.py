@@ -100,7 +100,40 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--multihost", action="store_true",
                    help="force jax.distributed.initialize() (auto-detected "
                         "when a coordinator address env var is set)")
+    p.add_argument("--compilation-cache",
+                   default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                          "auto"),
+                   metavar="DIR|off",
+                   help="persistent XLA compilation cache: restarted runs "
+                        "(resume after preemption, --eval-only) skip the "
+                        "20-40s TPU compile. 'auto' (default, or env "
+                        "DEEPVISION_COMPILATION_CACHE) uses "
+                        "~/.cache/deepvision_tpu/xla; 'off' disables")
     return p
+
+
+def setup_compilation_cache(arg: str) -> None:
+    """Point JAX's persistent compilation cache at a durable directory, so a
+    relaunched process (auto-resume after preemption — SURVEY.md §5.3 — or a
+    second --eval-only run) reuses compiled executables instead of paying the
+    first-compile latency again. 'off' also unsets a cache dir enabled by an
+    earlier run in this process. An unwritable cache path degrades to no
+    caching, never to a failed run."""
+    import jax
+    if arg == "off":
+        jax.config.update("jax_compilation_cache_dir", None)
+        return
+    path = (os.path.join(os.path.expanduser("~"), ".cache", "deepvision_tpu",
+                         "xla") if arg == "auto" else arg)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        print(f"compilation cache disabled ({e})", flush=True)
+        return
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("DEEPVISION_CACHE_MIN_COMPILE_SECS", "1.0")))
 
 
 def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,
@@ -159,6 +192,7 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
          synthetic_image_size: Optional[int] = None) -> dict:
     """Shared driver: parse → config overrides → trainer → data → fit."""
     args = build_parser(family, models).parse_args(argv)
+    setup_compilation_cache(args.compilation_cache)
 
     from .parallel.mesh import maybe_init_distributed
     maybe_init_distributed(force=args.multihost)

@@ -51,6 +51,35 @@ def _accepts_kwarg(ctor, name: str) -> bool:
                                  for p in params.values())
 
 
+def build_model_from_config(config, *, num_classes_kwarg: str = "num_classes",
+                            workdir: Optional[str] = None, verbose: bool = False):
+    """Construct the Flax module a config describes — single source of truth
+    for ctor-kwarg plumbing (model_kwargs + class-count + dtype injection),
+    shared by Trainer and tools/summarize.py.
+
+    A workdir can pin model kwargs (model_kwargs.json, written by
+    tools/import_torch_checkpoint.py) so every later run builds the
+    architecture the imported weights expect. Returns (model, config) with
+    any pinned kwargs folded into the returned config."""
+    pinned = os.path.join(workdir, "model_kwargs.json") if workdir else None
+    if pinned and os.path.exists(pinned):
+        with open(pinned) as fp:
+            extra = json.load(fp)
+        if extra:
+            if verbose:
+                print(f"[{config.name}] applying pinned model kwargs {extra}",
+                      flush=True)
+            config = config.replace(
+                model_kwargs={**config.model_kwargs, **extra})
+    model_ctor = MODELS.get(config.model)
+    kwargs = dict(config.model_kwargs)
+    if config.data.num_classes:  # 0 for the GAN configs — nothing to inject
+        kwargs.setdefault(num_classes_kwarg, config.data.num_classes)
+    if config.dtype and "dtype" not in kwargs and _accepts_kwarg(model_ctor, "dtype"):
+        kwargs["dtype"] = jnp.dtype(config.dtype)
+    return model_ctor(**kwargs), config
+
+
 class Trainer:
     """Classification trainer: `fit(train_data, val_data)` where each dataset is an
     iterable of (images NHWC float32, labels int32) numpy batches per epoch."""
@@ -72,26 +101,11 @@ class Trainer:
             model_parallel=config.model_parallel,
             spatial_parallel=config.spatial_parallel)
 
-        # a workdir can pin model kwargs (e.g. stride_on_first for imported
-        # torch checkpoints, tools/import_torch_checkpoint.py) so every later
-        # train/evaluate run builds the architecture the weights expect
-        pinned = os.path.join(self.workdir, "model_kwargs.json")
-        if model is None and os.path.exists(pinned):
-            with open(pinned) as fp:
-                extra = json.load(fp)
-            if extra:
-                print(f"[{config.name}] applying pinned model kwargs {extra}",
-                      flush=True)
-                config = self.config = config.replace(
-                    model_kwargs={**config.model_kwargs, **extra})
-
         if model is None:
-            model_ctor = MODELS.get(config.model)
-            kwargs = dict(config.model_kwargs)
-            kwargs.setdefault(self.num_classes_kwarg, config.data.num_classes)
-            if config.dtype and "dtype" not in kwargs and _accepts_kwarg(model_ctor, "dtype"):
-                kwargs["dtype"] = jnp.dtype(config.dtype)
-            model = model_ctor(**kwargs)
+            model, config = build_model_from_config(
+                config, num_classes_kwarg=self.num_classes_kwarg,
+                workdir=self.workdir, verbose=True)
+            self.config = config
         self.model = model
 
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)

@@ -7,6 +7,10 @@ import os
 # Force CPU: the session env presets JAX_PLATFORMS=axon (TPU-via-tunnel), which is
 # wrong for unit tests — override, don't setdefault.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CLI tests must not write compiled executables to the real ~/.cache (or mask
+# recompilation bugs with stale cross-run hits); tests that exercise the cache
+# pass an explicit --compilation-cache DIR, which overrides this default.
+os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -200,3 +200,37 @@ def test_missing_tfrecords_fail_fast_with_remedy(tmp_path):
             "ResNet", ["resnet50"],
             argv=["-m", "resnet50", "--data-dir", str(tmp_path / "nope"),
                   "--epochs", "1", "--workdir", str(tmp_path)])
+
+
+def test_compilation_cache_flag(tmp_path, monkeypatch):
+    """--compilation-cache DIR persists compiled executables so a relaunch
+    (auto-resume, --eval-only) skips the first-compile latency; 'off'
+    disables, including a cache enabled earlier in the same process."""
+    import jax
+
+    # drop the persistence threshold so even a fast-compiling tiny model
+    # writes entries (the default 1.0s is a production knob, not a contract)
+    monkeypatch.setenv("DEEPVISION_CACHE_MIN_COMPILE_SECS", "0")
+    cache = tmp_path / "xla_cache"
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--workdir", str(tmp_path / "wd"),
+              "--compilation-cache", str(cache)])
+    assert cache.is_dir() and len(list(cache.iterdir())) > 0
+    assert jax.config.jax_compilation_cache_dir == str(cache)
+    # 'off' must also unset the previously-enabled cache dir
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2",
+              "--workdir", str(tmp_path / "wd2"), "--compilation-cache", "off"])
+    assert jax.config.jax_compilation_cache_dir is None
+    # an unwritable path degrades to a warning, not a failed run
+    run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "2",
+              "--workdir", str(tmp_path / "wd3"),
+              "--compilation-cache", "/proc/nope/cache"])
+    assert jax.config.jax_compilation_cache_dir is None

@@ -6,6 +6,7 @@ for any registered model, via `flax.linen.tabulate`.
 Usage:
     python tools/summarize.py -m resnet50 [--image-size 224] [--batch 1]
     python tools/summarize.py -m hourglass104 --depth 2
+    python tools/summarize.py -m resnet50 --workdir runs/resnet50  # pinned kwargs
 """
 import argparse
 import os
@@ -14,29 +15,32 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def build_model_and_sample(name, image_size=None, channels=None, batch=1):
+def build_model_and_sample(name, image_size=None, channels=None, batch=1,
+                           workdir=None):
     """Resolve `name` through the config registry (preferred: carries the
-    right image size / class count / pinned kwargs) or the model registry."""
+    right image size / class count / dtype / pinned kwargs via the same
+    `build_model_from_config` the Trainer uses) or the model registry."""
     import jax.numpy as jnp
     from deepvision_tpu.models import MODELS
     from deepvision_tpu.utils.registry import CONFIGS
-    from deepvision_tpu.core.trainer import _accepts_kwarg
+    from deepvision_tpu.core.trainer import _accepts_kwarg, build_model_from_config
     import deepvision_tpu.configs  # noqa: F401  (populates CONFIGS)
 
-    kwargs, num_classes = {}, 1000
     if name in CONFIGS.names():
         cfg = CONFIGS.get(name)
-        kwargs = dict(cfg.model_kwargs)
-        num_classes = cfg.data.num_classes
+        ctor = MODELS.get(cfg.model)
+        kw = ("num_classes" if _accepts_kwarg(ctor, "num_classes")
+              else "num_heatmap")
+        model, cfg = build_model_from_config(cfg, num_classes_kwarg=kw,
+                                             workdir=workdir, verbose=True)
         image_size = image_size or cfg.data.image_size
         channels = channels or cfg.data.channels
-        name = cfg.model
-    ctor = MODELS.get(name)
-    for kw in ("num_classes", "num_heatmap"):
-        if kw not in kwargs and _accepts_kwarg(ctor, kw) and num_classes:
-            kwargs.setdefault(kw, num_classes)
-            break
-    model = ctor(**kwargs)
+    else:
+        ctor = MODELS.get(name)
+        kwargs = {}
+        if _accepts_kwarg(ctor, "num_classes"):
+            kwargs["num_classes"] = 1000
+        model = ctor(**kwargs)
     if hasattr(model, "noise_dim"):  # latent-input generator (DCGAN): the
         sample = jnp.zeros((batch, model.noise_dim), jnp.float32)  # input is
     else:                            # a noise vector, not an image
@@ -54,13 +58,17 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--depth", type=int, default=1,
                    help="module nesting depth to expand (default 1)")
+    p.add_argument("--workdir", default=None,
+                   help="training workdir whose pinned model_kwargs.json "
+                        "(imported checkpoints) should shape the model")
     args = p.parse_args(argv)
 
     import flax.linen as nn
     import jax
 
     model, sample = build_model_and_sample(
-        args.model, args.image_size, args.channels, args.batch)
+        args.model, args.image_size, args.channels, args.batch,
+        workdir=args.workdir)
     table = nn.tabulate(
         model, jax.random.PRNGKey(0), depth=args.depth,
         console_kwargs={"width": 160, "force_terminal": False})(
