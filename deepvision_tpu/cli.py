@@ -54,6 +54,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="gradient accumulation: average grads over k "
                         "micro-batches per optimizer update (effective batch "
                         "= batch-size * k)")
+    p.add_argument("--no-decay-bn-bias", action="store_true",
+                   help="skip weight decay on BatchNorm scales/biases and "
+                        "layer biases (large-batch recipe; default keeps the "
+                        "reference's decay-everything SGD semantics)")
     p.add_argument("--ema-decay", type=float, default=None,
                    help="Polyak averaging: validate/select-best with the "
                         "EMA of the weights (typical 0.999-0.9999)")
@@ -213,6 +217,9 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.accum_steps:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, accum_steps=args.accum_steps))
+    if args.no_decay_bn_bias:
+        cfg = cfg.replace(optimizer=dataclasses.replace(
+            cfg.optimizer, no_decay_bn_bias=True))
     if args.ema_decay is not None:
         if not 0.0 < args.ema_decay < 1.0:
             raise SystemExit(f"--ema-decay must be in (0, 1), got {args.ema_decay}")

@@ -32,25 +32,38 @@ def build_optimizer(opt_cfg: OptimizerConfig, sched_cfg: ScheduleConfig,
     if opt_cfg.grad_clip_norm:
         parts.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
 
+    # no_decay_bn_bias: decay only rank>1 tensors (conv HWIO / dense kernels);
+    # 1-D leaves are exactly the BN scales/biases and layer biases. The mask
+    # is a callable so it adapts to whatever param tree the optimizer is
+    # init'd with.
+    decay_mask = None
+    if opt_cfg.no_decay_bn_bias:
+        import jax
+        decay_mask = (lambda params: jax.tree_util.tree_map(
+            lambda x: x.ndim > 1, params))
+
+    def decayed_weights():
+        return optax.add_decayed_weights(opt_cfg.weight_decay, mask=decay_mask)
+
     name = opt_cfg.name
     if name in ("sgd", "momentum"):
         # L2-coupled weight decay, matching torch.optim.SGD(weight_decay=...) used by
         # the reference configs (e.g. resnet50: lr .1, momentum .9, wd 1e-4,
         # ResNet/pytorch/train.py:141-164).
         if opt_cfg.weight_decay:
-            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+            parts.append(decayed_weights())
         if opt_cfg.momentum:
             parts.append(optax.trace(decay=opt_cfg.momentum, nesterov=opt_cfg.nesterov))
     elif name == "rmsprop":
         parts.append(optax.scale_by_rms(decay=opt_cfg.rmsprop_decay, eps=opt_cfg.eps))
         if opt_cfg.weight_decay:
-            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+            parts.append(decayed_weights())
     elif name == "adam":
         parts.append(optax.scale_by_adam(b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps))
     elif name == "adamw":
         parts.append(optax.scale_by_adam(b1=opt_cfg.beta1, b2=opt_cfg.beta2, eps=opt_cfg.eps))
         if opt_cfg.weight_decay:
-            parts.append(optax.add_decayed_weights(opt_cfg.weight_decay))
+            parts.append(decayed_weights())
     else:
         raise ValueError(f"unknown optimizer {name!r}")
 

@@ -73,7 +73,10 @@ def build_model_from_config(config, *, num_classes_kwarg: str = "num_classes",
                 model_kwargs={**config.model_kwargs, **extra})
     model_ctor = MODELS.get(config.model)
     kwargs = dict(config.model_kwargs)
-    if config.data.num_classes:  # 0 for the GAN configs — nothing to inject
+    # Guarded injection: some configs carry a class count their model ctor
+    # doesn't take (e.g. dcgan's data.num_classes=10 labels MNIST, but the
+    # generator is class-unconditional) — inject only when accepted.
+    if config.data.num_classes and _accepts_kwarg(model_ctor, num_classes_kwarg):
         kwargs.setdefault(num_classes_kwarg, config.data.num_classes)
     if config.dtype and "dtype" not in kwargs and _accepts_kwarg(model_ctor, "dtype"):
         kwargs["dtype"] = jnp.dtype(config.dtype)

@@ -711,3 +711,76 @@ def test_prefetch_close_stops_producer():
     time.sleep(0.5)
     assert len(produced) == n, "producer kept running after close()"
     assert n < 1000
+
+
+def test_elastic_resume_across_mesh_shapes(tmp_path):
+    """A checkpoint saved on one mesh must restore onto a DIFFERENT one —
+    fewer devices AND a different sharding layout (model-sharded params back
+    to pure DP). Pod resizes after preemption are routine on TPU (SURVEY.md
+    §5.3's recovery gap); Orbax reshards on load via the template's
+    shardings, and this pins that property."""
+    import jax
+
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    cfg = _config(tmp_path, total_epochs=1, model_parallel=2,
+                  model="resnet50",  # big head tensors actually shard
+                  batch_size=16,
+                  data=DataConfig(dataset="synthetic", image_size=32,
+                                  num_classes=10, train_examples=16 * 3),
+                  optimizer=OptimizerConfig(name="momentum", learning_rate=0.01))
+
+    def data(epoch):
+        return SyntheticClassification(batch_size=16, image_size=32, channels=3,
+                                       num_classes=10, num_batches=3, seed=epoch)
+
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    tr.fit(data, None, sample_shape=(32, 32, 3))
+    saved = jax.device_get(tr.state.params)
+    tr.close()
+
+    # relaunch on HALF the pod, pure data-parallel: device count, mesh axes,
+    # and per-param layouts all change
+    small = mesh_lib.make_mesh(jax.devices()[:4])
+    tr2 = Trainer(cfg.replace(model_parallel=1, total_epochs=2),
+                  mesh=small, workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 3))
+    assert tr2.resume() == 1
+    restored = jax.device_get(tr2.state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, saved, restored)
+    small_devices = set(np.asarray(small.devices).flat)
+    for leaf in jax.tree_util.tree_leaves(tr2.state.params):
+        assert set(leaf.sharding.device_set) <= small_devices
+    # and training continues on the new mesh
+    tr2.fit(data, None, sample_shape=(32, 32, 3))
+    assert int(tr2.state.step) == 6
+    tr2.close()
+
+
+def test_no_decay_bn_bias_mask():
+    """With no_decay_bn_bias, weight decay reaches rank>1 kernels only; 1-D
+    leaves (BN scale/bias, layer biases) get exactly zero decay. Default
+    keeps the reference's decay-everything torch.optim.SGD semantics."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.optim import build_optimizer
+
+    params = {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))}
+    grads = {"kernel": jnp.zeros((2, 2)), "bias": jnp.zeros((2,))}
+
+    def one_update(no_decay):
+        cfg = OptimizerConfig(name="momentum", learning_rate=1.0, momentum=0.0,
+                              weight_decay=0.1, no_decay_bn_bias=no_decay)
+        tx = build_optimizer(cfg, ScheduleConfig(name="constant"),
+                             steps_per_epoch=1, total_epochs=1)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        return updates
+
+    masked = one_update(True)
+    np.testing.assert_allclose(masked["kernel"], -0.1 * np.ones((2, 2)),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(masked["bias"], np.zeros((2,)))
+
+    unmasked = one_update(False)
+    np.testing.assert_allclose(unmasked["bias"], -0.1 * np.ones((2,)),
+                               rtol=1e-6)
