@@ -41,22 +41,39 @@ def _devices_with_cpu_fallback(probe_timeout_s: int = 240):
     # default also initializes installed PJRT plugins and can hang the same
     # way. DEVNULL + its own session so a tunnel helper process inheriting
     # pipes can't block us past the timeout (killpg reaps the whole group).
+    # Tunnel outages are usually transient, and a CPU-fallback number reads
+    # as a ~170x regression next to a real-chip run — so retry the probe a
+    # few times before giving up on the TPU.
     if jax.config.jax_platforms != "cpu":
         import signal
-        probe = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            env=dict(os.environ), stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL, start_new_session=True)
-        try:
-            rc = probe.wait(timeout=probe_timeout_s)
-            if rc != 0:
-                return _fall_back(f"probe exited {rc}")
-        except subprocess.TimeoutExpired:
+        attempts = 3
+        for attempt in range(1, attempts + 1):
+            probe = subprocess.Popen(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=dict(os.environ), stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, start_new_session=True)
             try:
-                os.killpg(os.getpgid(probe.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            return _fall_back(f"probe timed out after {probe_timeout_s}s")
+                rc = probe.wait(timeout=probe_timeout_s)
+                if rc == 0:
+                    break
+                reason = f"probe exited {rc}"
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(probe.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                reason = f"probe timed out after {probe_timeout_s}s"
+            if attempt == attempts:
+                return _fall_back(f"{reason} ({attempts} attempts)")
+            # timeouts = tunnel wedged, give it time to recover; fast nonzero
+            # exits (broken/absent plugin, connection refused) retry
+            # immediately so a deterministic failure costs seconds, not sleeps
+            delay = 30 if "timed out" in reason else 0
+            print(f"TPU probe attempt {attempt}/{attempts} failed ({reason}); "
+                  f"retrying{f' in {delay}s' if delay else ''}",
+                  file=sys.stderr, flush=True)
+            if delay:
+                time.sleep(delay)
     try:
         return jax.devices()
     except RuntimeError as e:

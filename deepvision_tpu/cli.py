@@ -54,6 +54,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="gradient accumulation: average grads over k "
                         "micro-batches per optimizer update (effective batch "
                         "= batch-size * k)")
+    p.add_argument("--no-halt-on-nonfinite", action="store_true",
+                   help="keep training after a NaN/inf epoch loss instead of "
+                        "halting with the last-good checkpoint (divergence "
+                        "guard is on by default)")
     p.add_argument("--no-decay-bn-bias", action="store_true",
                    help="skip weight decay on BatchNorm scales/biases and "
                         "layer biases (large-batch recipe; default keeps the "
@@ -217,6 +221,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.accum_steps:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, accum_steps=args.accum_steps))
+    if args.no_halt_on_nonfinite:
+        cfg = cfg.replace(halt_on_nonfinite=False)
     if args.no_decay_bn_bias:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, no_decay_bn_bias=True))
@@ -294,8 +300,15 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         trainer.close()
         print("eval: " + " ".join(f"{k}={v:.4f}" for k, v in result.items()))
         return result
-    result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
-                         profile_dir=args.profile_dir)
+    from .core.trainer import TrainingDivergedError
+    try:
+        result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
+                             profile_dir=args.profile_dir)
+    except TrainingDivergedError as e:
+        # the guard's UX is the curated one-line remedy + nonzero exit, not a
+        # traceback; close() first so buffered JSONL/TB metrics survive
+        trainer.close()
+        raise SystemExit(f"error: {e}")
     trainer.close()
     print(f"done: best={result.get('best_metric')}")
     return result

@@ -142,6 +142,11 @@ class TrainConfig:
     # the permuted batch instead of blending pixels; lam = exact kept-pixel
     # fraction. Mutually exclusive with mixup_alpha. Typical a: 1.0.
     cutmix_alpha: float = 0.0
+    # Halt with TrainingDivergedError when an epoch's mean train loss comes
+    # back non-finite (NaN/inf): the optimizer state is poisoned and further
+    # steps waste pod-hours. The error names the last committed checkpoint to
+    # resume from. False trains on regardless (the reference's behavior).
+    halt_on_nonfinite: bool = True
     # Host->device staging depth for training batches: a producer thread
     # device_puts up to this many batches ahead so the transfer of batch i+1
     # overlaps compute of batch i (parallel/prefetch.py). 1 disables the

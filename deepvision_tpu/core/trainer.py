@@ -36,6 +36,14 @@ def _is_main_process() -> bool:
     return jax.process_index() == 0
 
 
+class TrainingDivergedError(RuntimeError):
+    """Raised when an epoch's mean train loss is non-finite (NaN/inf): the
+    optimizer state is poisoned, so training on would only burn pod-hours.
+    The reference's only gesture at this was skipping NaN val batches with a
+    TODO (`Hourglass/tensorflow/train.py:126-130`); here divergence halts
+    loudly with the last committed checkpoint to resume from."""
+
+
 def _accepts_kwarg(ctor, name: str) -> bool:
     import functools
     import inspect
@@ -304,6 +312,20 @@ class Trainer:
             out = {k: float(v) for k, v in jax.device_get(stacked).items()}
         else:
             out = {}
+        if self.config.halt_on_nonfinite and not np.isfinite(
+                out.get("loss", 0.0)):
+            # Every process computes the same epoch mean from the same SPMD
+            # program, so all hosts raise together (no straggler stuck in a
+            # collective). One diverged batch poisons momentum/Adam state —
+            # later "recovery" steps train the wrong weights.
+            last = self.ckpt.latest_epoch()
+            resume = (f"resume from epoch {last} with `-c {last}`"
+                      if last is not None else "no checkpoint committed yet")
+            raise TrainingDivergedError(
+                f"[{self.config.name}] epoch {epoch} mean train loss is "
+                f"{out['loss']} — training diverged. {resume}; consider a "
+                f"lower learning rate, warmup_epochs, or grad_clip_norm. "
+                f"(Set halt_on_nonfinite=False to keep going anyway.)")
         out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         return out
 

@@ -784,3 +784,32 @@ def test_no_decay_bn_bias_mask():
     unmasked = one_update(False)
     np.testing.assert_allclose(unmasked["bias"], -0.1 * np.ones((2,)),
                                rtol=1e-6)
+
+
+def test_halt_on_nonfinite_train_loss(tmp_path):
+    """A NaN batch must halt the epoch with TrainingDivergedError naming the
+    last committed checkpoint; halt_on_nonfinite=False trains through it
+    (the reference's behavior)."""
+    from deepvision_tpu.core.trainer import TrainingDivergedError
+
+    cfg = _config(tmp_path, total_epochs=2)
+
+    def poisoned(epoch):
+        for i, (images, labels) in enumerate(
+                SyntheticClassification(batch_size=32, image_size=32,
+                                        channels=1, num_classes=10,
+                                        num_batches=3, seed=epoch)):
+            if epoch == 2 and i == 1:
+                images = np.asarray(images).copy()
+                images[0, 0, 0, 0] = np.nan
+            yield images, labels
+
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    with pytest.raises(TrainingDivergedError, match="resume from epoch 1"):
+        tr.fit(poisoned, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+    tr2 = Trainer(cfg.replace(halt_on_nonfinite=False),
+                  workdir=str(tmp_path / "wd2"))
+    tr2.fit(poisoned, None, sample_shape=(32, 32, 1))  # must not raise
+    tr2.close()
