@@ -104,19 +104,32 @@ class AdversarialTrainer:
                 jax.profiler.start_trace(profile_dir)
             t0 = time.time()
             step_metrics = []  # device arrays; fetched once at epoch end so a
-            for batch in train_data_fn(epoch):  # pool-free step stays async
-                if not isinstance(batch, tuple):
-                    batch = (batch,)
-                step_metrics.append(self.train_batch(*batch))
-            if step_metrics:
-                stacked = jax.tree_util.tree_map(
-                    lambda *xs: float(np.mean(jax.device_get(jnp.stack(
-                        [jnp.asarray(x) for x in xs])))), *step_metrics)
-                metrics = dict(stacked)
-            else:
-                metrics = {}
-            if profiling:  # the metric fetch above synced the device
-                jax.profiler.stop_trace()
+            try:
+                for batch in train_data_fn(epoch):  # pool-free step stays async
+                    if not isinstance(batch, tuple):
+                        batch = (batch,)
+                    step_metrics.append(self.train_batch(*batch))
+                if step_metrics:
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: float(np.mean(jax.device_get(jnp.stack(
+                            [jnp.asarray(x) for x in xs])))), *step_metrics)
+                    metrics = dict(stacked)
+                else:
+                    metrics = {}
+            finally:
+                # the metric fetch above synced the device; finally so a step
+                # failure still writes the captured trace
+                if profiling:
+                    jax.profiler.stop_trace()
+            if self.config.halt_on_nonfinite and any(
+                    not np.isfinite(v) for v in metrics.values()):
+                # adversarial training collapses to NaN more readily than
+                # supervised (two coupled optimizers); same guard as
+                # Trainer.train_epoch, with this family's --resume UX
+                from .trainer import divergence_halt
+                divergence_halt(self.config, self.ckpt, epoch,
+                                f"mean metrics contain a non-finite value "
+                                f"({metrics})", resume_cmd="--resume")
             metrics["epoch_seconds"] = time.time() - t0
             self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
                             echo=jax.process_index() == 0)

@@ -44,6 +44,20 @@ class TrainingDivergedError(RuntimeError):
     loudly with the last committed checkpoint to resume from."""
 
 
+def divergence_halt(config, ckpt, epoch: int, what: str,
+                    resume_cmd: str = "-c {last}"):
+    """Raise TrainingDivergedError with the actionable remedy — shared by the
+    supervised and adversarial trainers so the hint text can't drift.
+    `resume_cmd` is the trainer family's resume UX ('{last}' substituted)."""
+    last = ckpt.latest_epoch()
+    resume = (f"resume from epoch {last} with `{resume_cmd.format(last=last)}`"
+              if last is not None else "no checkpoint committed yet")
+    raise TrainingDivergedError(
+        f"[{config.name}] epoch {epoch} {what} — training diverged. "
+        f"{resume}; consider a lower learning rate, warmup_epochs, or "
+        f"grad_clip_norm. (Set halt_on_nonfinite=False to keep going anyway.)")
+
+
 def _accepts_kwarg(ctor, name: str) -> bool:
     import functools
     import inspect
@@ -318,14 +332,8 @@ class Trainer:
             # program, so all hosts raise together (no straggler stuck in a
             # collective). One diverged batch poisons momentum/Adam state —
             # later "recovery" steps train the wrong weights.
-            last = self.ckpt.latest_epoch()
-            resume = (f"resume from epoch {last} with `-c {last}`"
-                      if last is not None else "no checkpoint committed yet")
-            raise TrainingDivergedError(
-                f"[{self.config.name}] epoch {epoch} mean train loss is "
-                f"{out['loss']} — training diverged. {resume}; consider a "
-                f"lower learning rate, warmup_epochs, or grad_clip_norm. "
-                f"(Set halt_on_nonfinite=False to keep going anyway.)")
+            divergence_halt(self.config, self.ckpt, epoch,
+                            f"mean train loss is {out['loss']}")
         out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         return out
 
@@ -403,9 +411,14 @@ class Trainer:
             profiling = profile_dir and epoch == self.start_epoch
             if profiling:
                 jax.profiler.start_trace(profile_dir)
-            train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
-            if profiling:  # train_epoch blocks on params → trace is complete
-                jax.profiler.stop_trace()
+            try:
+                train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
+            finally:
+                # train_epoch blocks on params → trace is complete; finally so
+                # a divergence halt (or any step failure) still writes the
+                # trace of the epoch the user most wants to inspect
+                if profiling:
+                    jax.profiler.stop_trace()
             if _is_main_process():
                 self.logger.log(int(self.state.step), train_metrics, epoch=epoch,
                                 prefix="epoch_train_")

@@ -129,6 +129,16 @@ def test_roofline_tool(capsys):
     assert train16["gflops_per_step"] > 1.5 * train8["gflops_per_step"]
     assert eval16["gflops_per_step"] < train16["gflops_per_step"]
     assert train16["gflops_per_image"] > 0
+    # HBM analysis: arguments dominate for tiny batches (params + opt state
+    # are fixed), and the peak estimate adds up from its parts
+    assert train16["hbm_arguments_gbytes"] > 0
+    assert train16["hbm_peak_estimate_gbytes"] > 0
+    # remat recomputes the forward: never fewer FLOPs for the same step
+    # (LeNet is too small for a strict increase to survive 2-decimal
+    # rounding; resnet50 at 64px shows +30% — docs/TUNING.md)
+    remat16 = run(["--batch-size", "16", "--remat"])
+    assert remat16["remat"] is True
+    assert remat16["gflops_per_step"] >= train16["gflops_per_step"]
 
     with pytest.raises(SystemExit, match="unknown model"):
         mod.main(["-m", "nope"])

@@ -160,3 +160,33 @@ def test_cyclegan_train_batch_smoke(mesh8):
         jax.tree_util.tree_leaves(trainer.gen_state.params["a2b"])[0])
     assert not np.allclose(g0, g1)
     trainer.close()
+
+
+def test_gan_halt_on_nonfinite(mesh8, tmp_path):
+    """A NaN batch halts the adversarial fit() with TrainingDivergedError
+    (GAN collapse detection); halt_on_nonfinite=False trains through."""
+    import pytest
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import DCGANTrainer
+    from deepvision_tpu.core.trainer import TrainingDivergedError
+
+    cfg = get_config("dcgan").replace(batch_size=16, total_epochs=1)
+
+    def poisoned(epoch):
+        rs = np.random.RandomState(epoch)
+        for i in range(2):
+            images = rs.uniform(-1, 1, (16, 28, 28, 1)).astype(np.float32)
+            if i == 1:
+                images[0, 0, 0, 0] = np.nan
+            yield images
+
+    trainer = DCGANTrainer(cfg, workdir=str(tmp_path / "halt"), mesh=mesh8)
+    with pytest.raises(TrainingDivergedError, match="diverged"):
+        trainer.fit(poisoned)
+    trainer.close()
+
+    trainer2 = DCGANTrainer(cfg.replace(halt_on_nonfinite=False),
+                            workdir=str(tmp_path / "keep"), mesh=mesh8)
+    trainer2.fit(poisoned)  # must not raise
+    trainer2.close()

@@ -36,12 +36,19 @@ def main(argv=None):
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--eval", action="store_true",
                    help="analyze the eval (forward-only) step instead")
+    p.add_argument("--remat", action="store_true",
+                   help="analyze the rematerialized train step (compare "
+                        "hbm_temp_gbytes with/without to see what "
+                        "activation recompute buys — docs/TUNING.md knob 3)")
     p.add_argument("--time", action="store_true",
                    help="also run + time the step on the current backend "
                         "(two loop lengths, delta timing — see docs/TUNING.md)")
     p.add_argument("--peak-tflops", type=float, default=None,
                    help="chip peak for MFU (defaults from the device kind)")
     args = p.parse_args(argv)
+    if args.remat and args.eval:
+        p.error("--remat applies to the train step (there is no backward "
+                "pass to recompute for); drop --eval")
 
     import jax
     import jax.numpy as jnp
@@ -83,7 +90,7 @@ def main(argv=None):
     else:
         # donate=False so repeated timing calls can reuse the same state
         step = steps.make_classification_train_step(
-            compute_dtype=compute_dtype, donate=False)
+            compute_dtype=compute_dtype, donate=False, remat=args.remat)
         compiled = step.lower(state, images, labels, rng).compile()
         def run(s):
             s, m = compiled(s, images, labels, rng)
@@ -110,6 +117,29 @@ def main(argv=None):
         "arithmetic_intensity": round(flops / bytes_accessed, 1)
         if bytes_accessed else None,
     }
+    if args.remat:
+        out["remat"] = True
+
+    # HBM footprint of the compiled executable: arguments (params, opt state,
+    # batch) + outputs + XLA's temp buffers (live activations between forward
+    # and backward — the piece remat/--spatial-parallel shrink). Peak live
+    # memory ≈ arguments + outputs + temps; compare against the chip's HBM
+    # (v5e: 16GB) to plan batch sizes without an OOM loop on real hardware.
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        gib = float(2 ** 30)
+        for key, attr in (("hbm_arguments_gbytes", "argument_size_in_bytes"),
+                          ("hbm_outputs_gbytes", "output_size_in_bytes"),
+                          ("hbm_temp_gbytes", "temp_size_in_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[key] = round(v / gib, 3)
+        if all(k in out for k in ("hbm_arguments_gbytes", "hbm_outputs_gbytes",
+                                  "hbm_temp_gbytes")):
+            alias = getattr(mem, "alias_size_in_bytes", 0) or 0
+            out["hbm_peak_estimate_gbytes"] = round(
+                out["hbm_arguments_gbytes"] + out["hbm_outputs_gbytes"]
+                + out["hbm_temp_gbytes"] - alias / gib, 3)
 
     if args.time:
         dev = jax.devices()[0]
