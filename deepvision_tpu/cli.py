@@ -54,6 +54,9 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="gradient accumulation: average grads over k "
                         "micro-batches per optimizer update (effective batch "
                         "= batch-size * k)")
+    p.add_argument("--log-grad-norm", action="store_true",
+                   help="log the global L2 gradient norm per step (divergence "
+                        "forensics; informs grad_clip_norm)")
     p.add_argument("--no-halt-on-nonfinite", action="store_true",
                    help="keep training after a NaN/inf epoch loss instead of "
                         "halting with the last-good checkpoint (divergence "
@@ -221,6 +224,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if args.accum_steps:
         cfg = cfg.replace(optimizer=dataclasses.replace(
             cfg.optimizer, accum_steps=args.accum_steps))
+    if args.log_grad_norm:
+        cfg = cfg.replace(log_grad_norm=True)
     if args.no_halt_on_nonfinite:
         cfg = cfg.replace(halt_on_nonfinite=False)
     if args.no_decay_bn_bias:

@@ -16,14 +16,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import centernet as cn_ops
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input
+from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 
 def make_centernet_train_step(*, num_classes: int, grid: int,
                               compute_dtype=jnp.bfloat16, donate: bool = True,
                               mesh=None, remat: bool = False,
-                              input_norm=None) -> Callable:
+                              input_norm=None,
+                              log_grad_norm: bool = False) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
     `remat=True` recomputes forward activations in backward (cf. steps.py);
     `input_norm=(mean, std)` normalizes raw [0,255] pixels on device."""
@@ -54,7 +55,8 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,
                    **{f"{k}_loss": jnp.mean(v) for k, v in comp.items()
-                      if k != "total"}}
+                      if k != "total"},
+                   **maybe_grad_norm(log_grad_norm, grads)}
         return new_state, metrics
 
     jit_kwargs = {}
@@ -96,7 +98,7 @@ class CenterNetTrainer(LossWatchedTrainer):
         self.train_step = make_centernet_train_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
-            input_norm=input_norm)
+            input_norm=input_norm, log_grad_norm=config.log_grad_norm)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh,

@@ -142,6 +142,14 @@ class TrainConfig:
     # the permuted batch instead of blending pixels; lam = exact kept-pixel
     # fraction. Mutually exclusive with mixup_alpha. Typical a: 1.0.
     cutmix_alpha: float = 0.0
+    # Log the global L2 gradient norm as a per-step metric (`grad_norm` in
+    # JSONL/TensorBoard) — divergence forensics to pair with the halt below
+    # and the data for choosing grad_clip_norm. Off by default: it's one
+    # fused reduction per step, but also one more scalar in every log line.
+    # Under gradient accumulation this is the PER-MICRO-BATCH norm (larger
+    # and noisier than the k-step-averaged gradient the optimizer — and
+    # clip_by_global_norm — actually consumes); scale thresholds accordingly.
+    log_grad_norm: bool = False
     # Halt with TrainingDivergedError when an epoch's mean train loss comes
     # back non-finite (NaN/inf): the optimizer state is poisoned and further
     # steps waste pod-hours. The error names the last committed checkpoint to

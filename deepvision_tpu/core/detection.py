@@ -22,7 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import yolo as yolo_ops
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input
+from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 
@@ -35,7 +35,7 @@ def yolo_grid_sizes(image_size: int) -> Sequence[int]:
 def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
                          mesh=None, remat: bool = False,
-                         input_norm=None) -> Callable:
+                         input_norm=None, log_grad_norm: bool = False) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
 
     boxes: (B, N, 4) normalized corner ground truth padded to N=MAX_BOXES;
@@ -74,7 +74,8 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,
                    **{f"{k}_loss": jnp.mean(v) for k, v in comp.items()
-                      if k != "total"}}
+                      if k != "total"},
+                   **maybe_grad_norm(log_grad_norm, grads)}
         return new_state, metrics
 
     jit_kwargs = {}
@@ -180,7 +181,7 @@ class DetectionTrainer(LossWatchedTrainer):
         self.train_step = make_yolo_train_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
             compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
-            input_norm=input_norm)
+            input_norm=input_norm, log_grad_norm=config.log_grad_norm)
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
             compute_dtype=compute_dtype, mesh=self.mesh,

@@ -24,7 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.heatmap import render_gaussian_heatmaps
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input
+from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 FOREGROUND_WEIGHT = 81.0  # `Hourglass/tensorflow/train.py:69`
@@ -44,7 +44,7 @@ def weighted_mse_loss(labels: jnp.ndarray, outputs) -> jnp.ndarray:
 def make_pose_train_step(*, heatmap_size: Tuple[int, int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
                          mesh=None, remat: bool = False,
-                         input_norm=None) -> Callable:
+                         input_norm=None, log_grad_norm: bool = False) -> Callable:
     """(state, images, kp_x, kp_y, visibility, rng) -> (state, metrics).
 
     kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K). `remat=True`
@@ -78,7 +78,8 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
             loss_fn, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
-        return new_state, {"loss": loss}
+        metrics = {"loss": loss, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
 
     jit_kwargs = {}
     if donate:
@@ -125,7 +126,8 @@ class PoseTrainer(LossWatchedTrainer):
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
         self.train_step = make_pose_train_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
-            remat=config.remat, input_norm=input_norm)
+            remat=config.remat, input_norm=input_norm,
+            log_grad_norm=config.log_grad_norm)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)

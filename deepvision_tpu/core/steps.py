@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import losses
@@ -38,6 +39,14 @@ def _normalize_input(images, input_norm, compute_dtype):
     return ((images - mean) / std).astype(compute_dtype)
 
 
+def maybe_grad_norm(enabled: bool, grads) -> dict:
+    """{'grad_norm': global L2 of grads} when enabled, else {} — the one
+    definition of the metric, shared by every task's train step. One tree of
+    square-sums + a sqrt, fused by XLA: divergence forensics ("what was the
+    norm when it went NaN") at negligible step cost."""
+    return {"grad_norm": optax.global_norm(grads)} if enabled else {}
+
+
 def make_classification_train_step(
     *,
     label_smoothing: float = 0.0,
@@ -49,6 +58,7 @@ def make_classification_train_step(
     mixup_alpha: float = 0.0,
     cutmix_alpha: float = 0.0,
     input_norm: Optional[tuple] = None,
+    log_grad_norm: bool = False,
 ) -> Callable:
     """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step.
 
@@ -142,7 +152,8 @@ def make_classification_train_step(
             state.params)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
-        metrics = {"loss": loss, **losses.topk_accuracies(outputs, labels)}
+        metrics = {"loss": loss, **losses.topk_accuracies(outputs, labels),
+                   **maybe_grad_norm(log_grad_norm, grads)}
         return new_state, metrics
 
     jit_kwargs = {}

@@ -171,7 +171,8 @@ class Trainer:
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
             compute_dtype=compute_dtype, mesh=self.mesh,
             remat=config.remat, mixup_alpha=config.mixup_alpha,
-            cutmix_alpha=config.cutmix_alpha, input_norm=input_norm)
+            cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
+            log_grad_norm=config.log_grad_norm)
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm)
 

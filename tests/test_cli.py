@@ -244,3 +244,32 @@ def test_compilation_cache_flag(tmp_path, monkeypatch):
               "--workdir", str(tmp_path / "wd3"),
               "--compilation-cache", "/proc/nope/cache"])
     assert jax.config.jax_compilation_cache_dir is None
+
+
+@pytest.mark.slow
+def test_roofline_family_steps(capsys):
+    """--family analyzes the detection/pose train steps (on-device label
+    encoding + task loss included); --eval is classification-only."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "roofline_tool2", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "roofline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def run(argv):
+        mod.main(argv)
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    yolo = run(["-m", "yolov3", "--family", "yolo", "--image-size", "128",
+                "--batch-size", "2", "--num-classes", "5",
+                "--dtype", "float32"])
+    assert yolo["family"] == "yolo" and yolo["gflops_per_step"] > 0
+    pose = run(["-m", "hourglass104", "--family", "pose", "--image-size", "64",
+                "--batch-size", "2", "--dtype", "float32"])
+    assert pose["family"] == "pose" and pose["hbm_peak_estimate_gbytes"] > 0
+
+    with pytest.raises(SystemExit):
+        mod.main(["-m", "yolov3", "--family", "yolo", "--eval"])

@@ -560,3 +560,53 @@ def test_lenet5_numerical_parity():
     got = np.asarray(fm.apply({"params": params}, jnp.asarray(x),
                               train=False))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_mini_resnet_gradient_parity():
+    """Backward parity: forward parity is necessary but not sufficient for an
+    imported checkpoint to FINE-TUNE identically. Same weights, same batch,
+    same CE loss → the full gradient trees (convs, BN scales/biases,
+    projections, head) must match through train-mode BN, residual adds, and
+    GAP. The torch grads are mapped through the SAME converter as the
+    weights, so every leaf is compared without hand-built name tables."""
+    import torch.nn.functional as F
+
+    from deepvision_tpu.core.losses import per_example_xent
+
+    torch.manual_seed(1)
+    tm = _TorchMiniResNet(width=8, num_classes=5).train()
+    sd = tm.state_dict()
+    params, batch_stats = convert_resnet_bottleneck(sd, stage_sizes=(1, 1, 1, 1))
+    fm = ResNet(stage_sizes=(1, 1, 1, 1), block=BottleneckBlock, width=8,
+                num_classes=5, dtype=jnp.float32, stride_on_first=True)
+
+    rs = np.random.RandomState(3)
+    x = rs.rand(4, 64, 64, 3).astype(np.float32)
+    labels = np.arange(4, dtype=np.int64) % 5
+
+    logits = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    F.cross_entropy(logits, torch.from_numpy(labels)).backward()
+    # a state_dict-shaped tree of GRADIENTS: grads for parameters, original
+    # buffers for BN running stats (the converter needs them present; the
+    # batch_stats half of its output is ignored below)
+    grad_sd = dict(sd)
+    for name, p in tm.named_parameters():
+        assert p.grad is not None, name
+        grad_sd[name] = p.grad
+    grad_tree_t, _ = convert_resnet_bottleneck(grad_sd, stage_sizes=(1, 1, 1, 1))
+
+    def loss_fn(p):
+        out, _ = fm.apply({"params": p, "batch_stats": batch_stats},
+                          jnp.asarray(x), train=True, mutable=["batch_stats"])
+        return per_example_xent(out, jnp.asarray(labels.astype(np.int32))).mean()
+
+    grads = jax.grad(loss_fn)(params)
+
+    flat_t = jax.tree_util.tree_leaves_with_path(grad_tree_t)
+    flat_j = dict(jax.tree_util.tree_leaves_with_path(grads))
+    assert len(flat_t) == len(flat_j) and len(flat_t) >= 30  # every leaf pairs up
+    for path, g_t in flat_t:
+        g_j = np.asarray(flat_j[path])
+        np.testing.assert_allclose(
+            g_j, np.asarray(g_t), rtol=1e-3, atol=1e-4,
+            err_msg=f"gradient mismatch at {jax.tree_util.keystr(path)}")

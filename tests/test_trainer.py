@@ -813,3 +813,35 @@ def test_halt_on_nonfinite_train_loss(tmp_path):
                   workdir=str(tmp_path / "wd2"))
     tr2.fit(poisoned, None, sample_shape=(32, 32, 1))  # must not raise
     tr2.close()
+
+
+def test_log_grad_norm_metric(tmp_path):
+    """log_grad_norm adds a positive `grad_norm` scalar to every family's
+    train-step metrics; off by default."""
+    import jax
+
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
+    from deepvision_tpu.core.optim import build_optimizer
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+
+    model = MODELS.get("lenet5")(num_classes=10)
+    rng = jax.random.PRNGKey(0)
+    params, batch_stats = init_model(model, rng, np.zeros((2, 32, 32, 1),
+                                                          np.float32))
+    tx = build_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3),
+                         ScheduleConfig(name="constant"), 4, 1)
+    images = np.random.RandomState(0).randn(8, 32, 32, 1).astype(np.float32)
+    labels = np.arange(8, dtype=np.int32) % 10
+
+    def run(**kw):
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        step = steps.make_classification_train_step(
+            compute_dtype=np.float32, donate=False, **kw)
+        _, metrics = step(state, images, labels, rng)
+        return jax.device_get(metrics)
+
+    on = run(log_grad_norm=True)
+    assert float(on["grad_norm"]) > 0
+    assert "grad_norm" not in run()

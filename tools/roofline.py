@@ -30,12 +30,22 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", required=True)
     p.add_argument("--batch-size", type=int, default=256)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="default per family: 224/416/256/512")
     p.add_argument("--channels", type=int, default=3)
-    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="classes (or pose heatmaps); default per family: "
+                        "1000/80/16/80")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--family",
+                   choices=["classification", "yolo", "pose", "centernet"],
+                   default="classification",
+                   help="which task's train step to analyze (detection/pose "
+                        "steps include on-device label encoding + task loss "
+                        "— the 416px shapes where HBM planning matters most)")
     p.add_argument("--eval", action="store_true",
-                   help="analyze the eval (forward-only) step instead")
+                   help="analyze the eval (forward-only) step instead "
+                        "(classification only)")
     p.add_argument("--remat", action="store_true",
                    help="analyze the rematerialized train step (compare "
                         "hbm_temp_gbytes with/without to see what "
@@ -49,6 +59,8 @@ def main(argv=None):
     if args.remat and args.eval:
         p.error("--remat applies to the train step (there is no backward "
                 "pass to recompute for); drop --eval")
+    if args.eval and args.family != "classification":
+        p.error("--eval analysis is classification-only; drop --eval")
 
     import jax
     import jax.numpy as jnp
@@ -63,8 +75,15 @@ def main(argv=None):
     if args.model not in MODELS:
         raise SystemExit(f"unknown model {args.model!r}; known: "
                          f"{', '.join(sorted(MODELS.names()))}")
+    if args.image_size is None:
+        args.image_size = {"classification": 224, "yolo": 416, "pose": 256,
+                           "centernet": 512}[args.family]
+    if args.num_classes is None:
+        args.num_classes = {"classification": 1000, "yolo": 80, "pose": 16,
+                            "centernet": 80}[args.family]
     compute_dtype = jnp.dtype(args.dtype)
-    model = MODELS.get(args.model)(num_classes=args.num_classes)
+    ctor_kwarg = "num_heatmap" if args.family == "pose" else "num_classes"
+    model = MODELS.get(args.model)(**{ctor_kwarg: args.num_classes})
     rng = jax.random.PRNGKey(0)
     sample = jnp.zeros((2, args.image_size, args.image_size, args.channels),
                        jnp.float32)
@@ -73,28 +92,70 @@ def main(argv=None):
                          ScheduleConfig(name="constant"), 1000, 100)
     state = TrainState.create(model.apply, params, tx, batch_stats)
 
-    shape = (args.batch_size, args.image_size, args.image_size, args.channels)
-    images = jnp.zeros(shape, jnp.float32)
-    labels = jnp.zeros((args.batch_size,), jnp.int32)
+    b = args.batch_size
+    shape = (b, args.image_size, args.image_size, args.channels)
+    # random, not zeros: constant images give BatchNorm zero batch variance,
+    # whose backward amplifies cotangents by ~1/sqrt(eps) per layer — deep
+    # stacks overflow to inf/NaN, and degenerate values can skew --time
+    images = jnp.asarray(
+        np.random.RandomState(1).uniform(-1, 1, shape), jnp.float32)
 
     # run returns (state, syncable scalar) — fetching the scalar is the only
     # honest completion barrier through a relayed TPU (docs/TUNING.md:
     # block_until_ready can return before remote execution finishes). The
     # AOT-compiled executable serves both cost_analysis and the timing loop,
-    # so the step compiles exactly once.
-    if args.eval:
+    # so the step compiles exactly once. Task batches are synthetic but
+    # realistically occupied (a few valid boxes/keypoints) so the on-device
+    # label encoding isn't analyzed on degenerate all-padding inputs.
+    def _lower_train(step, *batch):
+        compiled = step.lower(state, *batch, rng).compile()
+
+        def run(s):
+            s, m = compiled(s, *batch, rng)
+            return s, m["loss"]
+        return compiled, run
+
+    if args.family == "yolo" or args.family == "centernet":
+        from deepvision_tpu.core import centernet as cn
+        from deepvision_tpu.core import detection
+        from deepvision_tpu.data.detection import synthetic_batches
+        # the real pipeline's synthetic generator: same MAX_BOXES pad, box
+        # convention, and valid-mask layout the trainers consume
+        _, boxes, classes, valid = next(synthetic_batches(
+            batch_size=b, image_size=args.image_size,
+            num_classes=args.num_classes, steps=1, num_boxes=8))
+        if args.family == "yolo":
+            step = detection.make_yolo_train_step(
+                num_classes=args.num_classes,
+                grid_sizes=detection.yolo_grid_sizes(args.image_size),
+                compute_dtype=compute_dtype, donate=False, remat=args.remat)
+        else:
+            step = cn.make_centernet_train_step(
+                num_classes=args.num_classes, grid=args.image_size // 4,
+                compute_dtype=compute_dtype, donate=False, remat=args.remat)
+        compiled, run = _lower_train(step, images, boxes, classes, valid)
+    elif args.family == "pose":
+        from deepvision_tpu.core import pose
+        from deepvision_tpu.data.pose import synthetic_batches
+        _, kp_x, kp_y, vis = next(synthetic_batches(
+            batch_size=b, image_size=args.image_size,
+            num_joints=args.num_classes, steps=1))
+        step = pose.make_pose_train_step(
+            heatmap_size=(args.image_size // 4, args.image_size // 4),
+            compute_dtype=compute_dtype, donate=False, remat=args.remat)
+        compiled, run = _lower_train(step, images, kp_x, kp_y, vis)
+    elif args.eval:
         step = steps.make_classification_eval_step(compute_dtype=compute_dtype)
-        mask = jnp.ones((args.batch_size,), jnp.float32)
+        labels = jnp.zeros((b,), jnp.int32)
+        mask = jnp.ones((b,), jnp.float32)
         compiled = step.lower(state, images, labels, mask).compile()
         run = lambda s: (s, compiled(s, images, labels, mask)["loss"])
     else:
         # donate=False so repeated timing calls can reuse the same state
         step = steps.make_classification_train_step(
             compute_dtype=compute_dtype, donate=False, remat=args.remat)
-        compiled = step.lower(state, images, labels, rng).compile()
-        def run(s):
-            s, m = compiled(s, images, labels, rng)
-            return s, m["loss"]
+        labels = jnp.zeros((b,), jnp.int32)
+        compiled, run = _lower_train(step, images, labels)
 
     cost = compiled.cost_analysis() or {}
     if isinstance(cost, (list, tuple)):  # older jax returns [dict]
@@ -103,6 +164,7 @@ def main(argv=None):
     bytes_accessed = float(cost.get("bytes accessed", 0.0))
     out = {
         "model": args.model,
+        "family": args.family,
         "step": "eval" if args.eval else "train",
         "batch": args.batch_size,
         "image_size": args.image_size,
@@ -122,9 +184,13 @@ def main(argv=None):
 
     # HBM footprint of the compiled executable: arguments (params, opt state,
     # batch) + outputs + XLA's temp buffers (live activations between forward
-    # and backward — the piece remat/--spatial-parallel shrink). Peak live
-    # memory ≈ arguments + outputs + temps; compare against the chip's HBM
-    # (v5e: 16GB) to plan batch sizes without an OOM loop on real hardware.
+    # and backward — the piece remat/--spatial-parallel shrink). The steps
+    # here compile with donate=False (the timing loop reuses one state), but
+    # PRODUCTION train steps donate their state: the new-state output buffers
+    # alias the argument buffers, so the realistic peak is arguments + temps.
+    # Eval has no donated state — its outputs are genuinely extra. Compare
+    # the peak against the chip's HBM (v5e: 16GB) to plan batch sizes
+    # without an OOM loop on real hardware.
     mem = compiled.memory_analysis()
     if mem is not None:
         gib = float(2 ** 30)
@@ -136,10 +202,10 @@ def main(argv=None):
                 out[key] = round(v / gib, 3)
         if all(k in out for k in ("hbm_arguments_gbytes", "hbm_outputs_gbytes",
                                   "hbm_temp_gbytes")):
-            alias = getattr(mem, "alias_size_in_bytes", 0) or 0
-            out["hbm_peak_estimate_gbytes"] = round(
-                out["hbm_arguments_gbytes"] + out["hbm_outputs_gbytes"]
-                + out["hbm_temp_gbytes"] - alias / gib, 3)
+            peak = out["hbm_arguments_gbytes"] + out["hbm_temp_gbytes"]
+            if args.eval:
+                peak += out["hbm_outputs_gbytes"]
+            out["hbm_peak_estimate_gbytes"] = round(peak, 3)
 
     if args.time:
         dev = jax.devices()[0]
