@@ -82,8 +82,21 @@ def main(argv=None):
         args.num_classes = {"classification": 1000, "yolo": 80, "pose": 16,
                             "centernet": 80}[args.family]
     compute_dtype = jnp.dtype(args.dtype)
-    ctor_kwarg = "num_heatmap" if args.family == "pose" else "num_classes"
-    model = MODELS.get(args.model)(**{ctor_kwarg: args.num_classes})
+    # guarded ctor plumbing, same policy as build_model_from_config
+    # (trainer.py): class-count under whichever kwarg the model takes
+    # (num_heatmap for pose models), and --dtype must reach the MODEL —
+    # registered models default to bf16, so without this the reported
+    # dtype would not be the dtype the convs actually ran in
+    from deepvision_tpu.core.trainer import _accepts_kwarg
+    ctor = MODELS.get(args.model)
+    kwargs = {}
+    for kw in ("num_classes", "num_heatmap"):
+        if _accepts_kwarg(ctor, kw):
+            kwargs[kw] = args.num_classes
+            break
+    if _accepts_kwarg(ctor, "dtype"):
+        kwargs["dtype"] = compute_dtype
+    model = ctor(**kwargs)
     rng = jax.random.PRNGKey(0)
     sample = jnp.zeros((2, args.image_size, args.image_size, args.channels),
                        jnp.float32)
@@ -120,9 +133,11 @@ def main(argv=None):
         from deepvision_tpu.core import detection
         from deepvision_tpu.data.detection import synthetic_batches
         # the real pipeline's synthetic generator: same MAX_BOXES pad, box
-        # convention, and valid-mask layout the trainers consume
+        # convention, and valid-mask layout the trainers consume. Its images
+        # are discarded (`images` above is used) — image_size=8 skips
+        # generating a full-size batch just to throw it away.
         _, boxes, classes, valid = next(synthetic_batches(
-            batch_size=b, image_size=args.image_size,
+            batch_size=b, image_size=8,
             num_classes=args.num_classes, steps=1, num_boxes=8))
         if args.family == "yolo":
             step = detection.make_yolo_train_step(
@@ -138,7 +153,7 @@ def main(argv=None):
         from deepvision_tpu.core import pose
         from deepvision_tpu.data.pose import synthetic_batches
         _, kp_x, kp_y, vis = next(synthetic_batches(
-            batch_size=b, image_size=args.image_size,
+            batch_size=b, image_size=8,
             num_joints=args.num_classes, steps=1))
         step = pose.make_pose_train_step(
             heatmap_size=(args.image_size // 4, args.image_size // 4),
