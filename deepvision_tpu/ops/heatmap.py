@@ -8,9 +8,15 @@ the heatmap ("a ground truth heatmap of all zeros is provided", Newell §3).
 The reference renders each patch with a nested autograph loop + TensorArray
 scatter per keypoint (`preprocess.py:143-149`); here the whole (H, W, K) tensor is
 one broadcasted expression, so it runs inside the jitted train step on device.
-(The reference's patch loop also drops the right-most row/column of each 7×7 patch
-— `range(patch_min, patch_max)` with an exclusive bound, `:143-144`; we render the
-full symmetric patch.)
+Two reference quirks deliberately NOT replicated (both pinned against the
+reference implementation in tests/test_hourglass.py):
+1. its patch loop drops the right-most row/column of each 7×7 patch
+   (`range(patch_min, patch_max)` with an exclusive bound, `:143-144`); we
+   render the full symmetric patch;
+2. for patches clipped at the top/left edge it scatters at `heatmap_min + j`
+   where j already starts at patch_min (`:145-147`), double-shifting the
+   gaussian away from the keypoint (a (0,0) keypoint peaks at (3,3)); we
+   center the gaussian on the keypoint as the paper describes.
 """
 
 from __future__ import annotations

@@ -196,8 +196,14 @@ def yolo_loss_one_scale(y_true: jnp.ndarray, y_pred: jnp.ndarray,
     class_loss = jnp.sum(true_obj * class_bce, axis=(1, 2, 3, 4))
 
     # ignore mask: predictions overlapping ANY ground truth > 0.5 IoU are not
-    # penalized for objectness (`yolov3.py:436-470`); padded GT rows have zero
-    # area → IoU 0 → never mask anything.
+    # penalized for objectness; padded GT rows have zero area → IoU 0 → never
+    # mask anything. Deliberate deviation from the reference
+    # (`yolov3.py:448-454`): it derives the candidate boxes from this scale's
+    # dense y_true — a GT assigned to another scale never ignores predictions
+    # here, and its coordinate-wise `tf.sort` scrambles multi-box lists. We
+    # follow darknet (yolo_layer.c: every truth is compared) using the exact
+    # padded GT list; pinned vs the reference in
+    # tests/test_yolo.py::test_loss_matches_reference_tf_implementation.
     b, g = y_pred.shape[0], y_pred.shape[1]
     flat_pred = pred_box_corners.reshape(b, -1, 4)
     masked_gt = gt_boxes * gt_valid[..., None].astype(gt_boxes.dtype)

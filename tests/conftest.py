@@ -36,3 +36,31 @@ def mesh8():
 def mesh_4x2():
     from deepvision_tpu.parallel import mesh as mesh_lib
     return mesh_lib.make_mesh(model_parallel=2)
+
+
+def import_reference_module(subdir: str, name: str):
+    """Import a module from the read-only reference checkout for oracle-parity
+    tests. The reference uses generic top-level module names (`preprocess`,
+    `utils`, `yolov3`) that collide across its per-model directories, so the
+    cached entries are dropped before AND after the import — each test gets a
+    fresh module from ITS directory and leaks nothing to later tests.
+
+    Returns None when the reference checkout is absent (callers skip)."""
+    import importlib
+    import os
+    import sys
+
+    generic = ("preprocess", "utils", "yolov3", "postprocess")
+    ref_dir = os.environ.get("DEEPVISION_REFERENCE", "/root/reference")
+    path = os.path.join(ref_dir, subdir)
+    if not os.path.isfile(os.path.join(path, name + ".py")):
+        return None
+    for m in generic:
+        sys.modules.pop(m, None)
+    sys.path.insert(0, path)
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+        for m in generic:
+            sys.modules.pop(m, None)

@@ -194,3 +194,59 @@ def test_pose_infer_cli_tool(tmp_path, capsys):
     assert "no checkpoint found" in out
     assert "r_ankle" in out and "head_top" in out
     assert (out_dir / "p_pose.png").exists()
+
+
+def test_heatmap_matches_reference_tf_implementation():
+    """Oracle parity for the gaussian renderer: the reference's per-keypoint
+    TensorArray scatter (`Hourglass/tensorflow/preprocess.py:91-155`) and our
+    broadcasted renderer must agree everywhere the reference writes
+    correctly. Two documented deviations are pinned explicitly:
+    1. the reference's exclusive `range(patch_min, patch_max)` bound drops
+       each patch's right-most column and bottom row (dx==+3s or dy==+3s);
+       we render the full symmetric patch (ops/heatmap.py docstring);
+    2. for patches clipped at the TOP/LEFT edge the reference scatters at
+       `heatmap_min + j` where j already starts at patch_min — double-
+       shifting the patch away from the keypoint (a keypoint at (0,0) puts
+       its peak at (3,3), `preprocess.py:145-147`). We center the gaussian
+       on the keypoint, as the paper describes; the misplacement is asserted
+       here as reference behavior we deliberately do not replicate.
+    """
+    import pytest
+
+    from conftest import import_reference_module
+
+    tf = pytest.importorskip("tensorflow")
+    ref_pre = import_reference_module("Hourglass/tensorflow", "preprocess")
+    if ref_pre is None:
+        pytest.skip("reference checkout not available")
+
+    pre = ref_pre.Preprocessor.__new__(ref_pre.Preprocessor)  # needs no state
+    ref_gauss = tf.function(pre.generate_2d_guassian)
+
+    h = w = 64
+    # unclipped / right-bottom-clipped / fully-oob / invisible: the reference
+    # scatter places these correctly, so they must match up to deviation (1)
+    cases = [(32, 20, 2), (63, 63, 2), (61, 33, 1), (70, 32, 2), (-5, -5, 2),
+             (32, 32, 0)]
+    kp_x = np.array([c[0] / w for c in cases], np.float32)
+    kp_y = np.array([c[1] / h for c in cases], np.float32)
+    vis = np.array([c[2] for c in cases], np.float32)
+    ours = np.asarray(render_gaussian_heatmaps(
+        jnp.asarray(kp_x), jnp.asarray(kp_y), jnp.asarray(vis), h, w))
+
+    ys, xs = np.mgrid[0:h, 0:w]
+    for k, (x0, y0, v) in enumerate(cases):
+        theirs = ref_gauss(h, w, y0, x0, v).numpy()
+        dropped = (xs - x0 == 3) | (ys - y0 == 3)  # deviation (1)
+        np.testing.assert_allclose(
+            ours[..., k][~dropped], theirs[~dropped], atol=1e-5,
+            err_msg=f"case {k} {(x0, y0, v)}")
+        assert (theirs[dropped] == 0).all(), f"case {k}: reference wrote edge"
+
+    # deviation (2): top-left-clipped keypoint (0, 0) — the reference peak is
+    # double-shifted to (3, 3); ours peaks at the keypoint itself
+    theirs = ref_gauss(h, w, 0, 0, 2).numpy()
+    assert theirs[0, 0] == 0.0 and theirs[3, 3] == 12.0
+    ours00 = np.asarray(render_gaussian_heatmaps(
+        jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([2.0]), h, w))
+    assert ours00[0, 0, 0] == 12.0
