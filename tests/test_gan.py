@@ -190,3 +190,32 @@ def test_gan_halt_on_nonfinite(mesh8, tmp_path):
                             workdir=str(tmp_path / "keep"), mesh=mesh8)
     trainer2.fit(poisoned)  # must not raise
     trainer2.close()
+
+
+def test_linear_decay_matches_reference_tf_implementation():
+    """Oracle parity: our optax linear_decay schedule equals the reference's
+    LinearDecay LearningRateSchedule (`CycleGAN/tensorflow/utils.py:5-28`)
+    at every step of a whole training run."""
+    import pytest
+
+    from conftest import import_reference_module
+    from deepvision_tpu.core.config import ScheduleConfig
+    from deepvision_tpu.core.schedules import build_schedule
+
+    tf = pytest.importorskip("tensorflow")
+    ref_utils = import_reference_module("CycleGAN/tensorflow", "utils")
+    if ref_utils is None:
+        pytest.skip("reference checkout not available")
+
+    steps_per_epoch, total_epochs, decay_start_epoch = 7, 20, 10
+    total = steps_per_epoch * total_epochs
+    theirs = ref_utils.LinearDecay(2e-4, total,
+                                   decay_start_epoch * steps_per_epoch)
+    ours = build_schedule(
+        ScheduleConfig(name="linear_decay", decay_start_epoch=decay_start_epoch),
+        base_lr=2e-4, steps_per_epoch=steps_per_epoch,
+        total_epochs=total_epochs)
+    for step in range(total + 1):
+        np.testing.assert_allclose(
+            float(ours(step)), float(theirs(tf.constant(step, tf.float32))),
+            rtol=1e-6, atol=1e-10, err_msg=f"step {step}")

@@ -16,6 +16,23 @@ from deepvision_tpu.ops import yolo as yolo_ops
 from deepvision_tpu.ops.nms import batched_nms
 from deepvision_tpu.ops.yolo import ANCHORS_WH, MAX_BOXES
 
+# One box per anchor group (best anchors 0 / 4 / 7 → scales 0 / 1 / 2), the
+# shared fixture of the oracle-parity tests so all three scales are exercised;
+# test_anchor_targeted_boxes_span_scales re-derives the assignment so
+# ANCHORS_WH drift can't leave it silently stale.
+ANCHOR_TARGETED_BOXES = np.array(
+    [[0.08, 0.10, 0.104, 0.131],   # ~anchor 0 -> stride 8
+     [0.40, 0.30, 0.549, 0.408],   # ~anchor 4 -> stride 16
+     [0.30, 0.25, 0.675, 0.726]],  # ~anchor 7 -> stride 32
+    np.float32)
+
+
+def test_anchor_targeted_boxes_span_scales():
+    np.testing.assert_array_equal(
+        np.asarray(yolo_ops.find_best_anchor(
+            jnp.asarray(ANCHOR_TARGETED_BOXES))), [0, 4, 7])
+
+
 # jit the composite ops once per shape — eager dispatch would pay a per-primitive
 # compile on the 8-device CPU test platform (100+ tiny compiles, minutes)
 _jit_loss = jax.jit(yolo_ops.yolo_loss, static_argnums=(4,))
@@ -399,12 +416,8 @@ def test_loss_matches_reference_tf_implementation():
     rs = np.random.RandomState(11)
     b, num_classes = 3, 4
     boxes = np.zeros((b, MAX_BOXES, 4), np.float32)
-    boxes[0, 0] = [0.08, 0.10, 0.104, 0.131]   # ~anchor 0 -> scale 0
-    boxes[1, 0] = [0.40, 0.30, 0.549, 0.408]   # ~anchor 4 -> scale 1
-    boxes[2, 0] = [0.30, 0.25, 0.675, 0.726]   # ~anchor 7 -> scale 2
-    np.testing.assert_array_equal(
-        np.asarray(yolo_ops.find_best_anchor(jnp.asarray(boxes[:, 0]))),
-        [0, 4, 7])
+    for i in range(b):
+        boxes[i, 0] = ANCHOR_TARGETED_BOXES[i]  # image i's box -> scale i
     valid = np.zeros((b, MAX_BOXES), np.float32)
     valid[:, 0] = 1.0
     classes = rs.randint(0, num_classes, (b, MAX_BOXES)).astype(np.int32)
@@ -472,17 +485,10 @@ def test_label_encoder_matches_reference_tf_implementation():
     # tf.range loop inside dataset.map) — trace it the same way
     ref_encode = tf.function(pre.preprocess_label_for_one_scale)
 
-    # sizes matching anchors 0 / 4 / 7 so every anchor group (and thus every
-    # scale's encoder path) receives a box — asserted below, no empty-scale
-    # exemption; distinct corners so every (cell, anchor) slot is written at
-    # most once
-    boxes_list = np.array([[0.08, 0.10, 0.104, 0.131],  # anchor 0 -> stride 8
-                           [0.40, 0.30, 0.549, 0.408],  # anchor 4 -> stride 16
-                           [0.30, 0.25, 0.675, 0.726]],  # anchor 7 -> stride 32
-                          np.float32)
-    np.testing.assert_array_equal(
-        np.asarray(yolo_ops.find_best_anchor(jnp.asarray(boxes_list))),
-        [0, 4, 7])
+    # every anchor group (and thus every scale's encoder path) receives a
+    # box; distinct corners so every (cell, anchor) slot is written at most
+    # once
+    boxes_list = ANCHOR_TARGETED_BOXES
     class_ids = np.array([2, 0, 5], np.int32)
     onehot = np.eye(num_classes, dtype=np.float32)[class_ids]
 
@@ -503,3 +509,51 @@ def test_label_encoder_matches_reference_tf_implementation():
         assert theirs[..., 4].sum() > 0, f"scale {scale} got no object"
         np.testing.assert_allclose(ours, theirs, atol=1e-6,
                                    err_msg=f"scale {scale}")
+
+
+@pytest.mark.slow
+def test_nms_matches_reference_tf_implementation():
+    """Oracle parity for NMS: the reference's dynamic-shape greedy loop
+    (`postprocess.py:38-99`, python `while` inside tf.map_fn) and our
+    fixed-shape `lax.fori_loop` formulation must pick the same boxes in the
+    same order with the same valid counts — same greedy algorithm, different
+    machine (theirs can't compile to XLA; ours runs jitted on device)."""
+    from conftest import import_reference_module
+
+    tf = pytest.importorskip("tensorflow")
+    ref_post = import_reference_module("YOLO/tensorflow", "postprocess")
+    if ref_post is None:
+        pytest.skip("reference checkout not available")
+
+    rs = np.random.RandomState(5)
+    b, n, c, max_det = 2, 40, 3, 10
+    xy1 = rs.uniform(0, 0.7, (b, n, 2))
+    wh = rs.uniform(0.05, 0.35, (b, n, 2))
+    boxes = np.concatenate([xy1, np.minimum(xy1 + wh, 1.0)], -1).astype(
+        np.float32)
+    scores = rs.uniform(0, 1, (b, n)).astype(np.float32)  # distinct: no ties
+    classes = rs.uniform(0, 1, (b, n, c)).astype(np.float32)
+
+    # the reference's dynamic-size `while` predates today's map_fn autograph
+    # shape invariants; substitute an eager per-element map for the call so
+    # its loop runs with the eager semantics it was written for
+    orig_map_fn = tf.map_fn
+    tf.map_fn = lambda fn, elems, **kw: tf.stack([fn(e) for e in elems])
+    try:
+        t_boxes, t_scores, t_classes, t_counts = (
+            ref_post.Postprocessor.batch_non_maximum_suppression(
+                tf.constant(boxes), tf.constant(scores[..., None]),
+                tf.constant(classes), 0.45, 0.3, max_det))
+    finally:
+        tf.map_fn = orig_map_fn
+    o_boxes, o_scores, o_classes, o_counts = batched_nms(
+        boxes, scores, classes, iou_thresh=0.45, score_thresh=0.3,
+        max_detection=max_det)
+
+    np.testing.assert_array_equal(np.asarray(o_counts),
+                                  t_counts.numpy().reshape(-1))
+    np.testing.assert_allclose(np.asarray(o_boxes), t_boxes.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_scores),
+                               t_scores.numpy()[..., 0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_classes), t_classes.numpy(),
+                               atol=1e-6)
