@@ -50,7 +50,8 @@ def import_reference_module(subdir: str, name: str):
     import os
     import sys
 
-    generic = ("preprocess", "utils", "yolov3", "postprocess")
+    generic = ("preprocess", "utils", "yolov3", "postprocess", "models",
+               "train", "hourglass104")
     ref_dir = os.environ.get("DEEPVISION_REFERENCE", "/root/reference")
     path = os.path.join(ref_dir, subdir)
     if not os.path.isfile(os.path.join(path, name + ".py")):

@@ -273,3 +273,29 @@ def test_roofline_family_steps(capsys):
 
     with pytest.raises(SystemExit):
         mod.main(["-m", "yolov3", "--family", "yolo", "--eval"])
+
+
+def test_bench_input_tool(capsys):
+    """tools/bench_input.py: synthetic-shard mode produces a throughput line
+    (the host-side budget check for SURVEY §7.2's hard part #1) in both
+    normalization modes, without a dataset."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_input_tool", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "bench_input.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def run(extra):
+        mod.main(["--batch-size", "8", "--image-size", "64", "--steps", "3",
+                  "--synthetic-shards", "2", "--synthetic-per-shard", "16",
+                  "--source-size", "96"] + extra)
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    out = run([])
+    assert out["value"] > 0 and out["unit"] == "images/sec/host"
+    assert "synthetic" in out["metric"]
+    out_u8 = run(["--device-normalize"])
+    assert out_u8["value"] > 0 and "uint8" in out_u8["metric"]

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Import a reference GAN `tf.train.Checkpoint` into an Orbax workdir.
+
+The reference's GAN trainers checkpoint with `tf.train.Checkpoint` +
+CheckpointManager — DCGAN saves objects `generator`/`discriminator`
+(`DCGAN/tensorflow/main.py:34-39`), CycleGAN saves `generator_a2b`/
+`generator_b2a`/`discriminator_a`/`discriminator_b` plus an `epoch` variable
+(`CycleGAN/tensorflow/train.py:134-148`). This maps those weights onto our
+Flax models (utils/gan_convert.py) and writes a trainer-compatible Orbax
+checkpoint, so `DCGAN/jax/inference.py` / `CycleGAN/jax/inference.py` /
+`--resume` pick up the reference's published weights.
+
+Usage:
+    python tools/import_gan_checkpoint.py --family dcgan \
+        --ckpt ./checkpoints [--workdir runs/dcgan]
+    python tools/import_gan_checkpoint.py --family cyclegan \
+        --ckpt ./checkpoints-horse2zebra [--n-blocks 9] [--workdir runs/cyclegan]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _read_counter(reader, names=("epoch", "step")) -> int:
+    """The reference persists the epoch (CycleGAN) / step (DCGAN) as a
+    checkpointed tf.Variable — recover it for the Orbax save number."""
+    for name in names:
+        key = f"{name}/.ATTRIBUTES/VARIABLE_VALUE"
+        if reader.has_tensor(key):
+            return int(reader.get_tensor(key))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--family", required=True, choices=["dcgan", "cyclegan"])
+    p.add_argument("--ckpt", required=True,
+                   help="tf.train checkpoint prefix (.../ckpt-40) or the "
+                        "reference's checkpoint directory (latest is used)")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--n-blocks", type=int, default=9,
+                   help="CycleGAN generator residual blocks (reference: 9)")
+    p.add_argument("--epoch", type=int, default=None,
+                   help="epoch to record (default: the checkpoint's own "
+                        "epoch/step counter)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.utils import gan_convert
+
+    try:
+        # one reader for the counter + every object: the files are scanned
+        # once however many networks the family has
+        reader = gan_convert.open_reader(args.ckpt)
+    except FileNotFoundError as e:
+        raise SystemExit(f"error: {e}")
+    epoch = args.epoch if args.epoch is not None else _read_counter(reader)
+
+    def check_shapes(what, init_tree, new_tree):
+        """Every imported leaf must match the freshly-initialized state, so a
+        wrong --n-blocks or truncated checkpoint fails HERE with the paths
+        named, not at inference time."""
+        init_flat = dict(jax.tree_util.tree_leaves_with_path(init_tree))
+        new_flat = dict(jax.tree_util.tree_leaves_with_path(new_tree))
+        missing = set(init_flat) - set(new_flat)
+        extra = set(new_flat) - set(init_flat)
+        if missing or extra:
+            # sort the rendered strings: jax DictKey path tuples themselves
+            # are not orderable
+            raise SystemExit(
+                f"{what}: structure mismatch — missing "
+                f"{sorted(jax.tree_util.keystr(p) for p in missing)}, extra "
+                f"{sorted(jax.tree_util.keystr(p) for p in extra)}")
+        for path in init_flat:
+            if init_flat[path].shape != new_flat[path].shape:
+                raise SystemExit(
+                    f"{what}{jax.tree_util.keystr(path)}: checkpoint shape "
+                    f"{new_flat[path].shape} != model {init_flat[path].shape}")
+
+    if args.family == "dcgan":
+        from deepvision_tpu.core.gan import DCGANTrainer
+
+        cfg = get_config("dcgan")
+        workdir = args.workdir or os.path.join("runs", cfg.name)
+        trainer = DCGANTrainer(cfg, workdir=workdir)
+        g_params, g_stats = gan_convert.convert_object(reader, "generator")
+        d_params, d_stats = gan_convert.convert_object(reader,
+                                                       "discriminator")
+        check_shapes("generator", trainer.gen_state.params, g_params)
+        check_shapes("discriminator", trainer.disc_state.params, d_params)
+        trainer.gen_state = trainer.gen_state.replace(
+            params=g_params, batch_stats=g_stats)
+        trainer.disc_state = trainer.disc_state.replace(params=d_params)
+    else:
+        from deepvision_tpu.core.gan import CycleGANTrainer
+
+        cfg = get_config("cyclegan")
+        workdir = args.workdir or os.path.join("runs", cfg.name)
+        trainer = CycleGANTrainer(cfg, workdir=workdir,
+                                  n_blocks=args.n_blocks)
+        g_params, g_stats = {}, {}
+        for name in ("a2b", "b2a"):
+            g_params[name], g_stats[name] = gan_convert.convert_object(
+                reader, f"generator_{name}", n_blocks=args.n_blocks)
+        d_params, d_stats = {}, {}
+        for name in ("a", "b"):
+            d_params[name], d_stats[name] = gan_convert.convert_object(
+                reader, f"discriminator_{name}")
+        check_shapes("generators", trainer.gen_state.params, g_params)
+        check_shapes("discriminators", trainer.disc_state.params, d_params)
+        trainer.gen_state = trainer.gen_state.replace(
+            params=g_params, batch_stats=g_stats)
+        trainer.disc_state = trainer.disc_state.replace(
+            params=d_params, batch_stats=d_stats)
+
+    trainer.ckpt.save(epoch, trainer._payload())
+    trainer.ckpt.flush()
+    trainer.close()
+    print(f"imported {args.family} checkpoint {args.ckpt} -> {workdir} "
+          f"(epoch {epoch})")
+    return workdir
+
+
+if __name__ == "__main__":
+    main()
