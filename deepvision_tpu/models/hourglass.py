@@ -40,7 +40,7 @@ class PreActBottleneck(nn.Module):
         conv = partial(nn.Conv, padding="SAME", kernel_init=he_normal_fanout,
                        dtype=self.dtype)
         bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                     dtype=jnp.float32)
+                     epsilon=1e-3, dtype=jnp.float32)
         identity = x
         if x.shape[-1] != self.features:
             identity = conv(self.features, (1, 1), name="proj")(x)
@@ -104,7 +104,7 @@ class StackedHourglass(nn.Module):
         # stem (`hourglass104.py:121-133`)
         x = conv(w(64), (7, 7), strides=(2, 2), name="stem_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         dtype=jnp.float32)(x)
+                         epsilon=1e-3, dtype=jnp.float32)(x)
         x = nn.relu(x).astype(self.dtype)
         x = PreActBottleneck(w(128), self.dtype)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -121,7 +121,7 @@ class StackedHourglass(nn.Module):
             # linear layer (`hourglass104.py:101-110,142`)
             x = conv(f, (1, 1))(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                             dtype=jnp.float32)(x)
+                             epsilon=1e-3, dtype=jnp.float32)(x)
             x = nn.relu(x).astype(self.dtype)
             y = nn.Conv(self.num_heatmap, (1, 1), padding="SAME",
                         kernel_init=he_normal_fanout, dtype=jnp.float32,

@@ -1,0 +1,202 @@
+"""Generic call-order Keras → Flax weight conversion.
+
+For architectures ported layer-for-layer (the whole zoo: every Flax model here
+mirrors its reference builder's layer creation order), the reference model's
+weighted Keras layers and our Flax model's weighted submodules correspond 1:1
+*per kind, in creation order*: Keras auto-names carry a per-type creation
+counter (`conv2d_7`, `batch_normalization_12`), and a Flax
+`nn.intercept_methods` interceptor recovers our call order (== creation order
+under `nn.compact`) during init. Pairing the per-kind sequences converts any
+such checkpoint without a hand-written per-layer name table (the approach
+`keras_convert.py` needs for YOLO's explicitly-named layers, and
+`gan_convert.py` for checkpoint object paths). Pairing per kind — not over
+the single interleaved sequence — matters because Keras `model.layers` is
+TOPOLOGICAL order, which permutes parallel branches (a residual projection
+lands mid-branch), while the per-type counters are pure creation order.
+
+Used for the Stacked Hourglass h5 import (`tools/import_keras_checkpoint.py
+-m hourglass104`), whose ~200 auto-named layers (`conv2d_37`,
+`batch_normalization_52`, ...) would make a name table unmaintainable.
+
+Kernel layouts: Keras Conv2D/Dense kernels are HWIO/IO like Flax — copied
+as-is; Conv2DTranspose needs (kh, kw, out, in) → (kh, kw, in, out) plus a
+spatial flip (verified numerically in tests/test_gan_convert.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# Keras layer-class / auto-name prefix → Flax module class name
+KERAS_TO_FLAX_KIND = {
+    "Conv2D": "Conv",
+    "Dense": "Dense",
+    "BatchNormalization": "BatchNorm",
+    "Conv2DTranspose": "ConvTranspose",
+}
+_NAME_PREFIXES = (  # longest first: conv2d_transpose starts with conv2d
+    ("conv2d_transpose", "ConvTranspose"),
+    ("batch_normalization", "BatchNorm"),
+    ("conv2d", "Conv"),
+    ("dense", "Dense"),
+)
+
+
+def flax_modules_in_call_order(model, *init_args, **init_kwargs):
+    """Init `model`, recording every weighted submodule in first-call order.
+
+    Returns (ordered [(path_tuple, flax_kind)], init variables)."""
+    import flax.linen as nn
+
+    types = (nn.Conv, nn.ConvTranspose, nn.Dense, nn.BatchNorm)
+    records: List[Tuple[tuple, str]] = []
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, types) and context.method_name == "__call__":
+            records.append((mod.path, type(mod).__name__))
+        return next_fun(*args, **kwargs)
+
+    with nn.intercept_methods(interceptor):
+        variables = model.init(*init_args, **init_kwargs)
+
+    seen, ordered = set(), []
+    for path, kind in records:  # shared modules record once, at first call
+        if path not in seen:
+            seen.add(path)
+            ordered.append((path, kind))
+    return ordered, variables
+
+
+def _kind_and_counter(lname: str) -> Tuple[str, int]:
+    """('conv2d_7' → ('Conv', 7)); counter 0 for the unsuffixed first layer."""
+    for prefix, kind in _NAME_PREFIXES:
+        if lname == prefix:
+            return kind, 0
+        if lname.startswith(prefix + "_"):
+            tail = lname[len(prefix) + 1:]
+            if tail.isdigit():
+                return kind, int(tail)
+    raise NotImplementedError(f"unrecognized auto-generated layer name "
+                              f"{lname!r}")
+
+
+def layers_from_keras_model(model) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """[(flax_kind, {attr: array})] from a built Keras model, in per-type
+    CREATION order (the auto-name counters)."""
+    rows = []
+    for layer in model.layers:
+        if not layer.weights:
+            continue
+        kind, counter = _kind_and_counter(layer.name)
+        names = [w.name.split("/")[-1].split(":")[0] for w in layer.weights]
+        rows.append((kind, counter, dict(zip(names, layer.get_weights()))))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return [(kind, weights) for kind, _, weights in rows]
+
+
+def layers_from_legacy_h5(path: str) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """[(flax_kind, {attr: array})] from a TF2.1-era `save_weights` h5
+    (per-layer groups named with the auto-name counters), in per-type
+    creation order. File walking reuses `keras_convert.load_h5_weights`;
+    on-disk order is irrelevant because the auto-name counters carry the
+    order."""
+    from .keras_convert import load_h5_weights
+
+    rows = []
+    for lname, weights in load_h5_weights(path).items():
+        kind, counter = _kind_and_counter(lname)
+        rows.append((kind, counter, weights))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return [(kind, weights) for kind, _, weights in rows]
+
+
+_BN_PARAMS = {"gamma": "scale", "beta": "bias"}
+_BN_STATS = {"moving_mean": "mean", "moving_variance": "var"}
+
+
+def _set_in(tree: Dict, path: Sequence[str], leaf: str, value, what: str):
+    node = tree
+    for p in path:
+        if p not in node:
+            raise KeyError(f"{what}: no module at {'/'.join(path)}")
+        node = node[p]
+    if leaf not in node:
+        raise KeyError(f"{what}: no weight {leaf!r} at {'/'.join(path)}")
+    if tuple(node[leaf].shape) != tuple(value.shape):
+        raise ValueError(
+            f"{what} {'/'.join(path)}/{leaf}: checkpoint shape {value.shape} "
+            f"!= model {tuple(node[leaf].shape)}")
+    node[leaf] = value.astype(node[leaf].dtype)
+
+
+def convert_by_call_order(model, keras_layers, *init_args, **init_kwargs):
+    """Map ordered Keras weight layers onto `model`'s params/batch_stats.
+
+    Fails loudly on any count, kind, or shape mismatch — a structural
+    disagreement between the two models means the order pairing is wrong and
+    nothing should be silently imported."""
+    import jax
+
+    ordered, variables = flax_modules_in_call_order(model, *init_args,
+                                                    **init_kwargs)
+    if len(ordered) != len(keras_layers):
+        raise ValueError(
+            f"layer count mismatch: flax model has {len(ordered)} weighted "
+            f"modules, checkpoint has {len(keras_layers)}")
+    # both sides sorted by (kind, per-kind order): flax call order within a
+    # kind IS its creation order, matching the Keras auto-name counters
+    by_kind: Dict[str, List] = {}
+    for path, kind in ordered:
+        by_kind.setdefault(kind, []).append(path)
+    flax_seq = [(kind, path) for kind in sorted(by_kind)
+                for path in by_kind[kind]]
+
+    params = jax.tree_util.tree_map(np.asarray, variables["params"])
+    params = _to_mutable(params)
+    stats = _to_mutable(jax.tree_util.tree_map(
+        np.asarray, variables.get("batch_stats", {})))
+
+    for i, ((flax_kind, path), (kind, weights)) in enumerate(
+            zip(flax_seq, keras_layers)):
+        where = f"layer {i} ({'/'.join(path)})"
+        if kind != flax_kind:
+            raise ValueError(f"{where}: checkpoint layer is {kind}, "
+                             f"model expects {flax_kind} — per-kind layer "
+                             f"counts differ between checkpoint and model")
+        if kind == "BatchNorm":
+            for src, dst in _BN_PARAMS.items():
+                _set_in(params, path, dst, weights[src], where)
+            for src, dst in _BN_STATS.items():
+                _set_in(stats, path, dst, weights[src], where)
+            continue
+        kernel = weights["kernel"]
+        if kind == "ConvTranspose":  # (kh, kw, out, in) → flipped (.., in, out)
+            kernel = np.ascontiguousarray(
+                np.transpose(kernel, (0, 1, 3, 2))[::-1, ::-1])
+        _set_in(params, path, "kernel", kernel, where)
+        node = params
+        for p in path:
+            node = node[p]
+        if ("bias" in node) != ("bias" in weights):
+            # a silent keep of our random bias (or a dropped checkpoint bias)
+            # would "import" a subtly wrong model
+            raise ValueError(f"{where}: bias mismatch — model "
+                             f"{'has' if 'bias' in node else 'lacks'} one, "
+                             f"checkpoint "
+                             f"{'has' if 'bias' in weights else 'lacks'} one")
+        if "bias" in weights:
+            _set_in(params, path, "bias", weights["bias"], where)
+    return params, stats
+
+
+def _to_mutable(tree):
+    if isinstance(tree, dict):
+        return {k: _to_mutable(v) for k, v in tree.items()}
+    try:  # FrozenDict
+        items = tree.items()
+    except AttributeError:
+        return tree
+    return {k: _to_mutable(v) for k, v in items}

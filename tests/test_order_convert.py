@@ -1,0 +1,174 @@
+"""Call-order Keras→Flax conversion (utils/order_convert.py): oracle parity
+for the Stacked Hourglass — the family whose ~200 auto-named layers rule out
+a hand-written name table. The reference's own Keras model is built, its
+weights paired with our Flax modules purely by call order, and the forward
+passes must agree for every stack's heatmap output.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from conftest import import_reference_module  # noqa: E402
+from deepvision_tpu.models.hourglass import StackedHourglass  # noqa: E402
+from deepvision_tpu.utils import order_convert  # noqa: E402
+
+
+def _build_reference_hourglass(num_stack):
+    ref = import_reference_module("Hourglass/tensorflow", "hourglass104")
+    if ref is None:
+        pytest.skip("reference checkout not available")
+    model = ref.StackedHourglassNetwork(input_shape=(64, 64, 3),
+                                        num_stack=num_stack, num_residual=1,
+                                        num_heatmap=16)
+    rs = np.random.RandomState(0)
+    for v in model.variables:  # exercise the moving-stat conversion
+        if "moving_mean" in v.name:
+            v.assign(rs.uniform(-0.5, 0.5, v.shape).astype(np.float32))
+        elif "moving_variance" in v.name:
+            v.assign(rs.uniform(0.5, 2.0, v.shape).astype(np.float32))
+    return model
+
+
+@pytest.mark.slow
+def test_hourglass_call_order_parity():
+    num_stack = 2  # >1 so the intermediate re-injection convs are paired too
+    keras_model = _build_reference_hourglass(num_stack)
+    layers = order_convert.layers_from_keras_model(keras_model)
+
+    model = StackedHourglass(num_heatmap=16, num_stack=num_stack,
+                             num_residual=1, dtype=jnp.float32)
+    params, stats = order_convert.convert_by_call_order(
+        model, layers, jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+
+    rs = np.random.RandomState(1)
+    x = rs.uniform(-1, 1, (1, 64, 64, 3)).astype(np.float32)
+    theirs = keras_model(tf.constant(x), training=False)
+    ours = model.apply({"params": params, "batch_stats": stats},
+                       jnp.asarray(x), train=False)
+    assert len(ours) == len(theirs) == num_stack
+    # ~100 conv/BN layers of f32 round-off on unnormalized random weights
+    # (outputs O(100)): 2e-2 absolute is ~1e-4 relative precision
+    for i, (o, t) in enumerate(zip(ours, theirs)):
+        np.testing.assert_allclose(np.asarray(o), t.numpy(), rtol=1e-3,
+                                   atol=2e-2, err_msg=f"stack {i}")
+
+
+@pytest.mark.slow
+def test_hourglass_legacy_h5_import(tmp_path):
+    """Same pairing from a TF2.1-era `save_weights` h5 layout (per-layer
+    groups + layer_names/weight_names attrs), written the way that era's
+    Keras did — the on-disk format of the reference's published pose
+    checkpoints."""
+    keras_model = _build_reference_hourglass(1)
+    h5 = str(tmp_path / "hourglass_best.h5")
+    _write_legacy_h5(keras_model, h5)
+
+    layers = order_convert.layers_from_legacy_h5(h5)
+    model = StackedHourglass(num_heatmap=16, num_stack=1, num_residual=1,
+                             dtype=jnp.float32)
+    params, stats = order_convert.convert_by_call_order(
+        model, layers, jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+
+    rs = np.random.RandomState(2)
+    x = rs.uniform(-1, 1, (1, 64, 64, 3)).astype(np.float32)
+    theirs = keras_model(tf.constant(x), training=False)
+    theirs = theirs[0] if isinstance(theirs, (list, tuple)) else theirs
+    ours = model.apply({"params": params, "batch_stats": stats},
+                       jnp.asarray(x), train=False)[0]
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(), rtol=1e-3,
+                               atol=2e-2)
+
+
+def test_kind_and_count_mismatches_fail():
+    """Structural disagreements must fail loudly, not import garbage."""
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(2)(nn.Conv(3, (1, 1))(x).mean(axis=(1, 2)))
+
+    args = (jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 2)))
+    conv_w = {"kernel": np.zeros((1, 1, 2, 3), np.float32),
+              "bias": np.zeros((3,), np.float32)}
+    dense_w = {"kernel": np.zeros((3, 2), np.float32),
+               "bias": np.zeros((2,), np.float32)}
+
+    with pytest.raises(ValueError, match="count mismatch"):
+        order_convert.convert_by_call_order(Tiny(), [("Conv", conv_w)], *args)
+    with pytest.raises(ValueError, match="checkpoint layer is BatchNorm"):
+        order_convert.convert_by_call_order(
+            Tiny(), [("BatchNorm", {}), ("Dense", dense_w)], *args)
+    with pytest.raises(ValueError, match="shape"):
+        bad = dict(conv_w, kernel=np.zeros((1, 1, 2, 5), np.float32))
+        order_convert.convert_by_call_order(
+            Tiny(), [("Conv", bad), ("Dense", dense_w)], *args)
+
+
+def _write_legacy_h5(keras_model, path):
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        layer_names = []
+        for layer in keras_model.layers:
+            if not layer.weights:
+                continue
+            grp = f.create_group(layer.name)
+            wnames = []
+            for w, val in zip(layer.weights, layer.get_weights()):
+                wname = f"{layer.name}/{w.name.split('/')[-1].split(':')[0]}:0"
+                grp.create_dataset(wname, data=val)
+                wnames.append(wname.encode())
+            grp.attrs["weight_names"] = wnames
+            layer_names.append(layer.name.encode())
+        f.attrs["layer_names"] = layer_names
+
+
+@pytest.mark.slow
+def test_import_keras_checkpoint_cli_hourglass(tmp_path):
+    """End-to-end: reference h5 -> import CLI (-m hourglass104, config pinned
+    to the checkpoint's 1-stack shape via model_kwargs.json) -> PoseTrainer
+    resume -> identical heatmaps."""
+    import importlib.util
+    import json
+    import os
+
+    keras_model = _build_reference_hourglass(1)
+    h5 = str(tmp_path / "hourglass_best.h5")
+    _write_legacy_h5(keras_model, h5)
+
+    workdir = str(tmp_path / "wd")
+    os.makedirs(workdir)
+    with open(os.path.join(workdir, "model_kwargs.json"), "w") as fp:
+        json.dump({"num_stack": 1, "num_residual": 1, "dtype": "float32"}, fp)
+
+    spec = importlib.util.spec_from_file_location(
+        "import_keras_tool", os.path.join(os.path.dirname(__file__), "..",
+                                          "tools", "import_keras_checkpoint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["-m", "hourglass104", "--h5", h5, "--workdir", workdir,
+              "--epoch", "3"])
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.pose import PoseTrainer
+
+    trainer = PoseTrainer(get_config("hourglass104"), workdir=workdir)
+    trainer.init_state((256, 256, 3))
+    assert trainer.resume() == 3
+    rs = np.random.RandomState(5)
+    x = rs.uniform(-1, 1, (1, 64, 64, 3)).astype(np.float32)
+    theirs = keras_model(tf.constant(x), training=False)
+    theirs = theirs[0] if isinstance(theirs, (list, tuple)) else theirs
+    ours = trainer.model.apply(
+        {"params": trainer.state.params,
+         "batch_stats": trainer.state.batch_stats}, jnp.asarray(x),
+        train=False)[0]
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(), rtol=1e-3,
+                               atol=2e-2)
+    trainer.close()

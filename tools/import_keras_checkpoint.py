@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", required=True,
-                   choices=["yolov3", "yolov3_voc"])
+                   choices=["yolov3", "yolov3_voc", "hourglass104"])
     p.add_argument("--h5", required=True,
                    help="Keras save_weights file (legacy TF2 h5 layout)")
     p.add_argument("--workdir", default=None)
@@ -33,16 +33,40 @@ def main(argv=None):
     import jax
 
     from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.detection import DetectionTrainer
-    from deepvision_tpu.utils.keras_convert import convert, load_h5_weights
-
-    weights = load_h5_weights(args.h5)
-    params, batch_stats = convert(args.model, weights)
 
     cfg = get_config(args.model)
     workdir = args.workdir or os.path.join("runs", cfg.name)
-    trainer = DetectionTrainer(cfg, workdir=workdir)
-    size = cfg.data.image_size
+    if args.model == "hourglass104":
+        # auto-named layers (conv2d_37, ...): per-kind creation-order pairing
+        # instead of a name table (utils/order_convert.py)
+        import jax.numpy as jnp
+
+        from deepvision_tpu.core.pose import PoseTrainer
+        from deepvision_tpu.core.trainer import build_model_from_config
+        from deepvision_tpu.utils import order_convert
+
+        model, cfg = build_model_from_config(cfg,
+                                             num_classes_kwarg="num_heatmap",
+                                             workdir=workdir)
+        size = cfg.data.image_size
+        try:
+            layers = order_convert.layers_from_legacy_h5(args.h5)
+            params, batch_stats = order_convert.convert_by_call_order(
+                model, layers, jax.random.PRNGKey(0),
+                jnp.zeros((1, size, size, cfg.data.channels)))
+        except (ValueError, KeyError, NotImplementedError) as e:
+            # a yolo h5 (explicitly-named layers) or a full-model save both
+            # land here with the offending name/attr in the message
+            raise SystemExit(f"{args.h5} does not fit {args.model}: {e}")
+        trainer = PoseTrainer(cfg, workdir=workdir)
+    else:
+        from deepvision_tpu.core.detection import DetectionTrainer
+        from deepvision_tpu.utils.keras_convert import convert, load_h5_weights
+
+        weights = load_h5_weights(args.h5)
+        params, batch_stats = convert(args.model, weights)
+        trainer = DetectionTrainer(cfg, workdir=workdir)
+        size = cfg.data.image_size
     trainer.init_state((size, size, cfg.data.channels))
 
     # fail fast on structure/shape mismatches (e.g. an 80-class COCO h5 fed
