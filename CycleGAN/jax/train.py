@@ -81,8 +81,8 @@ def main():
         got = trainer.resume()
         print(f"resumed from epoch {got}" if got else "no checkpoint found")
 
-    metrics = trainer.fit(train_fn, profile_dir=args.profile_dir)
-    trainer.close()
+    from deepvision_tpu.core.trainer import fit_and_close
+    metrics = fit_and_close(trainer, train_fn, profile_dir=args.profile_dir)
     print(f"done: {metrics}")
 
 

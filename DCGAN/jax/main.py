@@ -59,8 +59,8 @@ def main():
             return gan_data.mnist_gan_batches(args.data_dir, cfg.batch_size,
                                               seed=epoch)
 
-    metrics = trainer.fit(train_fn, profile_dir=args.profile_dir)
-    trainer.close()
+    from deepvision_tpu.core.trainer import fit_and_close
+    metrics = fit_and_close(trainer, train_fn, profile_dir=args.profile_dir)
     print(f"done: {metrics}")
 
 

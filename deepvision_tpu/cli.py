@@ -305,16 +305,9 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         trainer.close()
         print("eval: " + " ".join(f"{k}={v:.4f}" for k, v in result.items()))
         return result
-    from .core.trainer import TrainingDivergedError
-    try:
-        result = trainer.fit(train_fn, val_fn, sample_shape=sample_shape,
-                             profile_dir=args.profile_dir)
-    except TrainingDivergedError as e:
-        # the guard's UX is the curated one-line remedy + nonzero exit, not a
-        # traceback; close() first so buffered JSONL/TB metrics survive
-        trainer.close()
-        raise SystemExit(f"error: {e}")
-    trainer.close()
+    from .core.trainer import fit_and_close
+    result = fit_and_close(trainer, train_fn, val_fn, sample_shape=sample_shape,
+                           profile_dir=args.profile_dir)
     print(f"done: best={result.get('best_metric')}")
     return result
 

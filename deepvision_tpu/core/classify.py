@@ -60,6 +60,15 @@ def load_metrics(workdir: str) -> dict:
                 rec = json.loads(line)
                 step = rec.get("epoch", rec.get("step", 0))
                 for key, val in rec.items():
+                    if isinstance(val, str):
+                        # MetricsLogger serializes non-finite values as
+                        # strings ("nan"/"inf") to keep the JSONL strict —
+                        # surface them as the floats they were, so diverged
+                        # epochs appear in plots instead of silently dropping
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            continue
                     if key in ("epoch", "step", "t") or not isinstance(
                             val, (int, float)):
                         continue

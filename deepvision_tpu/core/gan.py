@@ -121,6 +121,12 @@ class AdversarialTrainer:
                 # failure still writes the captured trace
                 if profiling:
                     jax.profiler.stop_trace()
+            metrics["epoch_seconds"] = time.time() - t0
+            # log BEFORE the divergence check: the diverged epoch's metrics
+            # (which loss went NaN, epoch time) belong in JSONL/TB, not only
+            # in the exception text (same ordering as Trainer.train_epoch)
+            self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
+                            echo=jax.process_index() == 0)
             if self.config.halt_on_nonfinite and any(
                     not np.isfinite(v) for v in metrics.values()):
                 # adversarial training collapses to NaN more readily than
@@ -130,9 +136,6 @@ class AdversarialTrainer:
                 divergence_halt(self.config, self.ckpt, epoch,
                                 f"mean metrics contain a non-finite value "
                                 f"({metrics})", resume_cmd="--resume")
-            metrics["epoch_seconds"] = time.time() - t0
-            self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
-                            echo=jax.process_index() == 0)
             if epoch % save_every == 0 or epoch == total_epochs:
                 self.ckpt.save(epoch, self._payload())
         return metrics

@@ -63,7 +63,13 @@ class MetricsLogger:
         rec = {"step": step, "epoch": epoch, "t": round(time.time() - self._t0, 3),
                **{prefix + k: round(v, 6) for k, v in metrics.items()}}
         if self._jsonl:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            # json.dumps would emit bare NaN/Infinity tokens for non-finite
+            # values (invalid JSON — jq/pandas choke on exactly the diverged-
+            # epoch forensics lines); serialize them as strings instead
+            safe = {k: (v if not isinstance(v, float) or np.isfinite(v)
+                        else str(v))
+                    for k, v in rec.items()}
+            self._jsonl.write(json.dumps(safe, allow_nan=False) + "\n")
             self._jsonl.flush()
         if self._tb_pending:  # lazy: inference-only runs never pay the TF cost
             self._tb_pending = False

@@ -58,6 +58,20 @@ def divergence_halt(config, ckpt, epoch: int, what: str,
         f"grad_clip_norm. (Set halt_on_nonfinite=False to keep going anyway.)")
 
 
+def fit_and_close(trainer, *args, **kwargs):
+    """`trainer.fit(...)` then `close()`, with the entry-point divergence UX:
+    a TrainingDivergedError becomes a one-line remedy + nonzero exit instead
+    of a traceback, and close() still runs first so buffered JSONL/TB metrics
+    survive. Shared by the CLI and the GAN mains so the UX can't drift."""
+    try:
+        result = trainer.fit(*args, **kwargs)
+    except TrainingDivergedError as e:
+        trainer.close()
+        raise SystemExit(f"error: {e}")
+    trainer.close()
+    return result
+
+
 def _accepts_kwarg(ctor, name: str) -> bool:
     import functools
     import inspect
@@ -327,15 +341,22 @@ class Trainer:
             out = {k: float(v) for k, v in jax.device_get(stacked).items()}
         else:
             out = {}
+        out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         if self.config.halt_on_nonfinite and not np.isfinite(
                 out.get("loss", 0.0)):
             # Every process computes the same epoch mean from the same SPMD
             # program, so all hosts raise together (no straggler stuck in a
             # collective). One diverged batch poisons momentum/Adam state —
             # later "recovery" steps train the wrong weights.
+            if _is_main_process():
+                # the diverged epoch's metrics (which loss went non-finite,
+                # throughput) must reach JSONL/TB before the raise aborts
+                # fit's normal epoch_train_ record — forensics belong in the
+                # metrics stream, not only the exception text
+                self.logger.log(int(self.state.step), out, epoch=epoch,
+                                prefix="epoch_train_")
             divergence_halt(self.config, self.ckpt, epoch,
                             f"mean train loss is {out['loss']}")
-        out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
         return out
 
     def eval_state(self) -> TrainState:

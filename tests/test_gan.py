@@ -6,6 +6,8 @@ asserts, `CycleGAN/tensorflow/utils.py:5-61` pool + LR decay,
 `CycleGAN/tensorflow/train.py:150-246` two-phase adversarial step).
 """
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -185,6 +187,16 @@ def test_gan_halt_on_nonfinite(mesh8, tmp_path):
     with pytest.raises(TrainingDivergedError, match="diverged"):
         trainer.fit(poisoned)
     trainer.close()
+
+    # the diverged epoch's metrics were logged to JSONL before the halt
+    # (non-finite values serialized as strings — every line stays valid JSON)
+    jsonl = (tmp_path / "halt" / f"{cfg.name}.jsonl").read_text()
+    assert "train_gen_loss" in jsonl and '"nan"' in jsonl, jsonl
+    for line in jsonl.splitlines():
+        # bare NaN/Infinity tokens would be accepted by Python's lenient
+        # parser — parse_constant makes this loop actually strict
+        json.loads(line, parse_constant=lambda c: pytest.fail(
+            f"non-strict JSON constant {c!r} in {line!r}"))
 
     trainer2 = DCGANTrainer(cfg.replace(halt_on_nonfinite=False),
                             workdir=str(tmp_path / "keep"), mesh=mesh8)

@@ -1,6 +1,8 @@
 """End-to-end trainer tests on the 8-device virtual mesh: loss decreases, metrics
 accumulate, checkpoint/resume round-trips, plateau schedule fires."""
 
+import json
+
 import numpy as np
 import pytest
 
@@ -808,6 +810,22 @@ def test_halt_on_nonfinite_train_loss(tmp_path):
     with pytest.raises(TrainingDivergedError, match="resume from epoch 1"):
         tr.fit(poisoned, None, sample_shape=(32, 32, 1))
     tr.close()
+
+    # the diverged epoch's metrics were written to JSONL before the halt —
+    # forensics live in the metrics stream, not only the exception text
+    # (non-finite values serialized as strings so strict parsers survive)
+    jsonl = (tmp_path / "wd" / f"{cfg.name}.jsonl").read_text()
+    diverged = [json.loads(line) for line in jsonl.splitlines()
+                if '"epoch_train_loss"' in line and json.loads(line)["epoch"] == 2]
+    assert diverged, f"no epoch-2 epoch_train_ record in JSONL:\n{jsonl}"
+    assert not np.isfinite(float(diverged[-1]["epoch_train_loss"]))
+    assert "epoch_train_images_per_sec" in diverged[-1]
+
+    # the repo's own JSONL reader surfaces the stringified non-finite values
+    # as floats (diverged epochs appear in notebook plots, not dropped)
+    from deepvision_tpu.core.classify import load_metrics
+    hist = load_metrics(str(tmp_path / "wd"))
+    assert not np.isfinite(hist["epoch_train_loss"]["value"][-1])
 
     tr2 = Trainer(cfg.replace(halt_on_nonfinite=False),
                   workdir=str(tmp_path / "wd2"))
