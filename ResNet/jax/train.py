@@ -11,7 +11,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from deepvision_tpu.cli import run_classification
 
-MODELS = ["resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2"]
+# configs, not architectures: resnet50_tpu is the same resnet50 model under
+# the full large-batch pod recipe (see configs.py / README "ResNet-50 pod
+# recipe")
+MODELS = ["resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2",
+          "resnet50_tpu"]
 
 if __name__ == "__main__":
     run_classification("ResNet", MODELS)

@@ -91,6 +91,26 @@ for _name in ("resnet34", "resnet50", "resnet101", "resnet152", "resnet50v2"):
         data=_imagenet(),
     ))
 
+# -- ResNet-50 TPU north-star recipe (BASELINE.md: 75.3% top-1, ≤2h on a pod).
+#    The full large-batch recipe as ONE named config instead of scattered
+#    opt-in flags (Goyal et al. 2017; He et al. 2019 bag-of-tricks):
+#    cosine + 5-epoch warmup, linear LR scaling from base 256 (0.1@256 →
+#    3.2@8192 when launched with --batch-size 8192), label smoothing 0.1,
+#    no weight decay on BN scale/bias or conv/dense biases, EMA eval weights.
+#    Same model as `resnet50`; only the recipe differs. Default batch 1024
+#    (128/chip on a v5e-8); raise --batch-size to the pod's capacity — the
+#    LR, schedule, and divergence guard all scale with it. Pod playbook:
+#    README.md "ResNet-50 pod recipe".
+CONFIGS.register("resnet50_tpu", TrainConfig(
+    name="resnet50_tpu", model="resnet50", batch_size=1024, total_epochs=90,
+    optimizer=OptimizerConfig(name="momentum", learning_rate=0.1, momentum=0.9,
+                              weight_decay=1e-4, base_batch_size=256,
+                              no_decay_bn_bias=True),
+    schedule=ScheduleConfig(name="cosine", warmup_epochs=5),
+    label_smoothing=0.1, ema_decay=0.9999,
+    data=_imagenet(),
+))
+
 # -- MobileNet V1 (Howard 2017 §4: RMSprop, less wd on depthwise; simplified to
 #    the common cosine recipe; reference config `MobileNet/pytorch/train.py`) ---
 CONFIGS.register("mobilenet_v1", TrainConfig(

@@ -246,6 +246,32 @@ def test_compilation_cache_flag(tmp_path, monkeypatch):
     assert jax.config.jax_compilation_cache_dir is None
 
 
+def test_resnet50_tpu_recipe_config():
+    """The 75.3%/≤2h north-star recipe ships as ONE named config — every
+    large-batch lever on (VERDICT r1 item 4), not scattered opt-in flags."""
+    cfg = get_config("resnet50_tpu")
+    assert cfg.model == "resnet50"  # same architecture, pod recipe
+    assert cfg.schedule.name == "cosine" and cfg.schedule.warmup_epochs == 5
+    assert cfg.optimizer.base_batch_size == 256   # linear LR scaling: b8k→3.2
+    assert cfg.optimizer.no_decay_bn_bias is True
+    assert cfg.label_smoothing == 0.1
+    assert cfg.ema_decay == 0.9999
+    assert cfg.total_epochs == 90
+    assert cfg.batch_size % 8 == 0  # divides any pod's data axis
+
+
+@pytest.mark.slow
+def test_resnet50_tpu_synthetic_end_to_end(tmp_path):
+    """`train.py -m resnet50_tpu --synthetic` runs the full recipe (EMA,
+    no-decay mask, warmup cosine) end to end on the virtual mesh."""
+    result = run_classification(
+        "ResNet", ["resnet50", "resnet50_tpu"],
+        argv=["-m", "resnet50_tpu", "--synthetic", "--epochs", "1",
+              "--batch-size", "8", "--steps-per-epoch", "1",
+              "--workdir", str(tmp_path)])
+    assert "best_metric" in result
+
+
 @pytest.mark.slow
 def test_roofline_family_steps(capsys):
     """--family analyzes the detection/pose train steps (on-device label
