@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import centernet as cn_ops
+from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
 from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
@@ -35,9 +36,10 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
 
         def forward(params, images):
-            return state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"])
+            with mesh_lib.spatial_activation_constraints(mesh):
+                return state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=True, mutable=["batch_stats"])
 
         if remat:
             forward = jax.checkpoint(
@@ -73,9 +75,10 @@ def make_centernet_eval_step(*, num_classes: int, grid: int,
     def step(state, images, boxes, classes, valid):
         images = _normalize_input(images, input_norm, compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
-        outputs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False)
+        with mesh_lib.spatial_activation_constraints(mesh):
+            outputs = state.apply_fn(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images, train=False)
         comp = cn_ops.centernet_loss(outputs, targets)
         return {"loss": jnp.mean(comp["total"])}
 

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import yolo as yolo_ops
+from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
 from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
@@ -52,9 +53,10 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
 
         def forward(params, images):
-            return state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"])
+            with mesh_lib.spatial_activation_constraints(mesh):
+                return state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=True, mutable=["batch_stats"])
 
         if remat:
             forward = jax.checkpoint(
@@ -95,9 +97,10 @@ def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
         images = _normalize_input(images, input_norm, compute_dtype)
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
-        outputs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False, decode=False)
+        with mesh_lib.spatial_activation_constraints(mesh):
+            outputs = state.apply_fn(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images, train=False, decode=False)
         comp = yolo_ops.yolo_loss(y_trues, outputs, boxes, valid, num_classes)
         return {"loss": jnp.mean(comp["total"])}
 

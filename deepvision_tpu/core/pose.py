@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.heatmap import render_gaussian_heatmaps
+from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
 from .steps import _normalize_input, maybe_grad_norm
 from .trainer import LossWatchedTrainer
@@ -61,9 +62,10 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
                 kp_x, kp_y, visibility)
 
         def forward(params, images):
-            return state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"])
+            with mesh_lib.spatial_activation_constraints(mesh):
+                return state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=True, mutable=["batch_stats"])
 
         if remat:
             forward = jax.checkpoint(
@@ -99,9 +101,10 @@ def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
         labels = jax.vmap(
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
-        outputs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False)
+        with mesh_lib.spatial_activation_constraints(mesh):
+            outputs = state.apply_fn(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images, train=False)
         return {"loss": weighted_mse_loss(labels, outputs)}
 
     jit_kwargs = {}

@@ -125,11 +125,12 @@ def make_classification_train_step(
             lam = 1.0 - in_box.mean()  # exact fraction, kept f32
 
         def forward(params, images):
-            return state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"],
-                rngs={"dropout": step_rng},
-            )
+            with mesh_lib.spatial_activation_constraints(mesh):
+                return state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=True, mutable=["batch_stats"],
+                    rngs={"dropout": step_rng},
+                )
 
         if remat:
             forward = jax.checkpoint(
@@ -183,9 +184,10 @@ def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
             images = jax.lax.with_sharding_constraint(
                 images, mesh_lib.batch_sharding(mesh, images.ndim,
                                                 dim1=images.shape[1]))
-        outputs = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False)
+        with mesh_lib.spatial_activation_constraints(mesh):
+            outputs = state.apply_fn(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images, train=False)
         xent = losses.per_example_xent(outputs if not isinstance(outputs, (tuple, list))
                                        else outputs[0], labels)
         correct = losses.topk_correct(outputs, labels)

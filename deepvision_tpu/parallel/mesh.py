@@ -84,6 +84,23 @@ def has_spatial(mesh: Mesh) -> bool:
     return SPATIAL_AXIS in mesh.axis_names and mesh.shape[SPATIAL_AXIS] > 1
 
 
+# Spatial sharding floor: H is sharded over 'spatial' only while every shard
+# keeps at least this many rows. Below it the parallelism is all halo (a 3x3
+# conv's 1-row exchange IS the shard) and — worse — XLA's partitioner starts
+# flip-flopping between batch- and H-sharded layouts in conv/BN backwards,
+# logging "Involuntary full rematerialization" (a full replicate+repartition
+# of a gradient tensor every step). Empirically ≥4 rows/shard keeps the
+# ResNet-50 backward warning-clean on a (data, spatial) mesh; deep stages
+# whose maps shrink below the floor run batch-sharded only, which is also the
+# faster layout for them.
+MIN_SPATIAL_ROWS = 4
+
+
+def _spatial_divides(mesh: Mesh, h: int) -> bool:
+    sp = mesh.shape[SPATIAL_AXIS]
+    return h % sp == 0 and h // sp >= MIN_SPATIAL_ROWS
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 4,
                    dim1: Optional[int] = None) -> NamedSharding:
     """Shard the leading (batch) dim over 'data'; on a spatial mesh, 4-D
@@ -92,11 +109,12 @@ def batch_sharding(mesh: Mesh, ndim: int = 4,
 
     Only rank-4 arrays are treated as spatial: lower-rank batch tensors
     (labels, padded box lists (B,100,4)) have no height dim. `dim1` (the
-    actual H extent, when known) gates on divisibility so odd heights fall
-    back to replicated-H rather than failing at device_put."""
+    actual H extent, when known) gates on divisibility and the
+    MIN_SPATIAL_ROWS floor, so odd/tiny heights fall back to replicated-H
+    rather than failing at device_put or tripping the partitioner."""
     spec = [DATA_AXIS] + [None] * (ndim - 1)
     if ndim == 4 and has_spatial(mesh) and (
-            dim1 is None or dim1 % mesh.shape[SPATIAL_AXIS] == 0):
+            dim1 is None or _spatial_divides(mesh, dim1)):
         spec[1] = SPATIAL_AXIS
     return NamedSharding(mesh, P(*spec))
 
@@ -126,6 +144,41 @@ def shard_batch_pytree(mesh: Mesh, batch):
             return jax.make_array_from_process_local_data(sharding, x)
         return jax.device_put(x, sharding)
     return jax.tree_util.tree_map(_put, batch)
+
+
+def spatial_activation_constraints(mesh: Optional[Mesh]):
+    """Context manager for a model forward on a spatial mesh: pin every
+    rank-4 flax module output to (data, spatial|None, None, None).
+
+    Left to itself, GSPMD propagates the input's H-sharding into the deep
+    stages where feature maps have shrunk below MIN_SPATIAL_ROWS per shard,
+    then cannot represent the layout it wants in the conv/BN backward and
+    falls back to "Involuntary full rematerialization" — replicating a
+    gradient tensor and re-partitioning it every step. Intercepting every
+    module boundary makes the layout an explicit contract: H stays sharded
+    exactly while it's worth sharding, and the transition to batch-only
+    happens at a module edge the partitioner handles efficiently.
+
+    No-op (nullcontext) on non-spatial meshes — model-parallel layouts are
+    chosen by `param_sharding_rules` and need no activation pinning."""
+    import contextlib
+    if mesh is None or not has_spatial(mesh):
+        return contextlib.nullcontext()
+    import flax.linen as nn
+
+    def _constrain(x):
+        if not isinstance(x, jax.Array) or x.ndim != 4:
+            return x
+        # batch_sharding owns the spatial-layout policy (floor + divisibility)
+        return jax.lax.with_sharding_constraint(
+            x, batch_sharding(mesh, 4, dim1=x.shape[1]))
+
+    def interceptor(next_fun, args, kwargs, context):
+        out = next_fun(*args, **kwargs)
+        return jax.tree_util.tree_map(
+            _constrain, out, is_leaf=lambda v: isinstance(v, jax.Array))
+
+    return nn.intercept_methods(interceptor)
 
 
 def pad_to_multiple(n: int, k: int) -> int:

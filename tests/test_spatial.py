@@ -132,11 +132,105 @@ def test_make_mesh_rejects_bad_factorization():
         mesh_lib.make_mesh(spatial_parallel=3)
 
 
+def test_batch_sharding_respects_min_spatial_rows():
+    """H is sharded over 'spatial' only while every shard keeps
+    MIN_SPATIAL_ROWS rows — tiny maps fall back to batch-only (the layout
+    the partitioner handles without involuntary remats)."""
+    P = jax.sharding.PartitionSpec
+    mesh = _mesh_spatial()
+    floor = mesh_lib.MIN_SPATIAL_ROWS * mesh.shape["spatial"]
+    assert mesh_lib.batch_sharding(mesh, 4, dim1=floor).spec == \
+        P("data", "spatial", None, None)
+    assert mesh_lib.batch_sharding(mesh, 4, dim1=floor - 2).spec == \
+        P("data", None, None, None)
+
+
+class _DeepShrinkNet(nn.Module):
+    """Stride-2 conv+BN stack shrinking H 32→1: crosses the
+    MIN_SPATIAL_ROWS boundary, which is exactly where GSPMD used to emit
+    'Involuntary full rematerialization' in the backward."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        for feat in (8, 16, 32, 32, 32):
+            x = nn.Conv(feat, (3, 3), strides=(2, 2), padding="SAME",
+                        use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def test_spatial_train_step_no_involuntary_remat(capfd):
+    """One train step over feature maps shrinking past the spatial floor must
+    not log an SPMD involuntary-full-remat warning (VERDICT r1 item 2): the
+    activation constraints pin the H→batch sharding transition to a module
+    boundary the partitioner can handle."""
+    model = _DeepShrinkNet()
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(0).randn(8, 32, 32, 3).astype(np.float32)
+    y = (np.arange(8) % 10).astype(np.int32)
+    mesh = _mesh_spatial()
+    params, batch_stats = init_model(model, rng, jnp.zeros((2, 32, 32, 3)))
+    tx = build_optimizer(OptimizerConfig(name="momentum", learning_rate=0.1),
+                         ScheduleConfig(name="constant"), 10, 1)
+    state = TrainState.create(model.apply, params, tx, batch_stats)
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = steps.make_classification_train_step(
+        compute_dtype=jnp.float32, mesh=mesh, donate=False)
+    sharded = mesh_lib.shard_batch_pytree(mesh, (x, y))
+    capfd.readouterr()  # drop anything buffered before the compile
+    state, metrics = step(state, *sharded, rng)
+    jax.block_until_ready(state.params)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err
+    assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.slow
+def test_dryrun_meshes_warning_clean_resnet50(capfd):
+    """The driver's dryrun meshes — (data=4, model=2) and (data=4, spatial=2)
+    — run a full ResNet-50 train step with zero spmd_partitioner warnings."""
+    from deepvision_tpu.models import MODELS
+
+    model = MODELS.get("resnet50")(num_classes=100)
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(0).randn(16, 32, 32, 3).astype(np.float32)
+    y = (np.arange(16) % 100).astype(np.int32)
+    for mesh in (mesh_lib.make_mesh(model_parallel=2), _mesh_spatial()):
+        params, batch_stats = init_model(model, rng,
+                                         jnp.zeros((2, 32, 32, 3)))
+        tx = build_optimizer(
+            OptimizerConfig(name="momentum", learning_rate=0.1,
+                            weight_decay=1e-4),
+            ScheduleConfig(name="cosine"), 10, 10)
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        rules = mesh_lib.param_sharding_rules(mesh, state.params)
+        repl = mesh_lib.replicated(mesh)
+        state = state.replace(
+            params=jax.device_put(state.params, rules),
+            batch_stats=jax.device_put(state.batch_stats, repl),
+            opt_state=jax.device_put(state.opt_state, repl),
+            step=jax.device_put(state.step, repl))
+        step = steps.make_classification_train_step(
+            label_smoothing=0.1, compute_dtype=jnp.float32, mesh=mesh,
+            donate=False)
+        sharded = mesh_lib.shard_batch_pytree(mesh, (x, y))
+        capfd.readouterr()
+        state, metrics = step(state, *sharded, rng)
+        jax.block_until_ready(state.params)
+        err = capfd.readouterr().err
+        assert "spmd_partitioner" not in err, (dict(mesh.shape), err)
+        assert np.isfinite(float(metrics["loss"]))
+
+
 def test_yolo_spatial_train_step_matches_dp():
-    """Detection steps rely on input shardings (no explicit constraint): a
-    tiny YOLO train step on a (4,2,1) data+spatial mesh must land in the same
-    loss band as pure DP with matching global update magnitude — boxes
-    (B,100,4) stay batch-sharded (rank-3 rule) while images get H sharded."""
+    """A tiny YOLO train step on a (4,2,1) data+spatial mesh must land in the
+    same loss band as pure DP with matching global update magnitude — boxes
+    (B,100,4) stay batch-sharded (rank-3 rule) while images get H sharded and
+    activations are pinned at module boundaries by
+    spatial_activation_constraints."""
     from deepvision_tpu.core.detection import make_yolo_train_step
     from deepvision_tpu.models import MODELS
     from deepvision_tpu.ops.yolo import MAX_BOXES
