@@ -30,13 +30,17 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
     `remat=True` recomputes forward activations in backward (cf. steps.py);
     `input_norm=(mean, std)` normalizes raw [0,255] pixels on device."""
 
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
+    # the mesh combines spatial x model (measured once, outside the trace)
+
     def step(state, images, boxes, classes, valid, rng):
         del rng
         images = _normalize_input(images, input_norm, compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
+        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh):
+            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -53,6 +57,8 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
 
         (loss, (comp, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        grads = mesh_lib.rescale_overreduced_conv_grads(
+            grads, overreduced, grad_fix)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,

@@ -46,14 +46,18 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
     transfer, `--device-normalize`) and are normalized on device (steps.py).
     """
 
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
+    # the mesh combines spatial x model (measured once, outside the trace)
+
     def step(state, images, boxes, classes, valid, rng):
         del rng  # YOLO has no dropout; augmentation happens host-side
         images = _normalize_input(images, input_norm, compute_dtype)
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
+        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh):
+            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -72,6 +76,8 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
 
         (loss, (comp, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        grads = mesh_lib.rescale_overreduced_conv_grads(
+            grads, overreduced, grad_fix)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,

@@ -54,15 +54,19 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
     """
     h, w = heatmap_size
 
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
+    # the mesh combines spatial x model (measured once, outside the trace)
+
     def step(state, images, kp_x, kp_y, visibility, rng):
         del rng
         images = _normalize_input(images, input_norm, compute_dtype)
         labels = jax.vmap(
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
+        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh):
+            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -78,6 +82,8 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
 
         (loss, mutated), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        grads = mesh_lib.rescale_overreduced_conv_grads(
+            grads, overreduced, grad_fix)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss, **maybe_grad_norm(log_grad_norm, grads)}

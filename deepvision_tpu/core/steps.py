@@ -84,6 +84,8 @@ def make_classification_train_step(
     if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
         raise ValueError("mixup_alpha and cutmix_alpha are mutually exclusive")
     mixing = mixup_alpha > 0.0 or cutmix_alpha > 0.0
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
+    # the mesh combines spatial x model (measured once, outside the trace)
 
     def step(state: TrainState, images, labels, rng):
         images = _normalize_input(images, input_norm, compute_dtype)
@@ -124,8 +126,10 @@ def make_classification_train_step(
             images = jnp.where(in_box[None, :, :, None], images[perm], images)
             lam = 1.0 - in_box.mean()  # exact fraction, kept f32
 
+        overreduced: set = set()  # filled at trace time by the interceptor
+
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh):
+            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"],
@@ -151,6 +155,8 @@ def make_classification_train_step(
 
         (loss, (outputs, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
+        grads = mesh_lib.rescale_overreduced_conv_grads(
+            grads, overreduced, grad_fix)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss, **losses.topk_accuracies(outputs, labels),

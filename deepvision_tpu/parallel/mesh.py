@@ -43,16 +43,14 @@ def make_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if spatial_parallel > 1 and model_parallel > 1:
-        # XLA (jax 0.9.0) over-reduces replicated conv-kernel gradients by the
-        # model-axis size when activations are sharded on BOTH batch and a
-        # spatial dim of a mesh that also carries a model axis (verified: grads
-        # come back exactly model_parallel x too large; tests/test_spatial.py).
-        # Until that is fixed upstream, the combination is rejected rather than
-        # silently training at the wrong learning rate.
-        raise ValueError(
-            "spatial_parallel and model_parallel cannot both be >1 "
-            "(XLA GSPMD mis-reduces conv kernel grads on such meshes)")
+    # spatial_parallel and model_parallel MAY both be >1 ("big activation" AND
+    # "big param" together): XLA (jax 0.9.0) over-reduces replicated
+    # conv-kernel gradients by the model-axis size on such meshes when the
+    # conv's output is spatially sharded (b/433785288-adjacent GSPMD bug),
+    # and the train-step builders compensate — see
+    # `rescale_overreduced_conv_grads` + `conv_grad_overreduction_factor`
+    # (measured at runtime, so an upstream fix auto-disables the correction).
+    # Grad parity vs the single-device oracle: tests/test_spatial.py.
     if n % (model_parallel * spatial_parallel) != 0:
         raise ValueError(
             f"{n} devices not divisible by model_parallel={model_parallel} "
@@ -146,7 +144,8 @@ def shard_batch_pytree(mesh: Mesh, batch):
     return jax.tree_util.tree_map(_put, batch)
 
 
-def spatial_activation_constraints(mesh: Optional[Mesh]):
+def spatial_activation_constraints(mesh: Optional[Mesh],
+                                   record: Optional[set] = None):
     """Context manager for a model forward on a spatial mesh: pin every
     rank-4 flax module output to (data, spatial|None, None, None).
 
@@ -158,6 +157,12 @@ def spatial_activation_constraints(mesh: Optional[Mesh]):
     module boundary makes the layout an explicit contract: H stays sharded
     exactly while it's worth sharding, and the transition to batch-only
     happens at a module edge the partitioner handles efficiently.
+
+    `record` (a set, combined spatial×model meshes only): collects the module
+    path of every conv-like module (owns a rank-4 'kernel' param) whose
+    output gets pinned spatial-sharded — exactly the kernels whose gradients
+    XLA over-reduces by the model-axis size (see
+    `rescale_overreduced_conv_grads`). Filled at trace time.
 
     No-op (nullcontext) on non-spatial meshes — model-parallel layouts are
     chosen by `param_sharding_rules` and need no activation pinning."""
@@ -173,12 +178,121 @@ def spatial_activation_constraints(mesh: Optional[Mesh]):
         return jax.lax.with_sharding_constraint(
             x, batch_sharding(mesh, 4, dim1=x.shape[1]))
 
+    def _any_spatial_sharded(tree) -> bool:
+        return any(isinstance(v, jax.Array) and v.ndim == 4
+                   and _spatial_divides(mesh, v.shape[1])
+                   for v in jax.tree_util.tree_leaves(tree))
+
     def interceptor(next_fun, args, kwargs, context):
         out = next_fun(*args, **kwargs)
+        # Over-reduction (measured, see conv_grad_overreduction_factor) hits
+        # a conv kernel iff BOTH its input and its output carry the spatial
+        # sharding; a conv entered or exited below the floor computes its
+        # grad on replicated-H operands and is reduced correctly. (A conv
+        # fed through a non-module gap — resize/reshape — has no pinned
+        # input; GSPMD shards such a gap whenever H divides, which is what
+        # the H-divisibility test on the raw input argument predicts.)
+        if (record is not None and _any_spatial_sharded(args)
+                and _any_spatial_sharded(out)
+                and context.module.has_variable("params", "kernel")
+                and context.module.get_variable("params", "kernel").ndim == 4):
+            record.add(context.module.path)
         return jax.tree_util.tree_map(
             _constrain, out, is_leaf=lambda v: isinstance(v, jax.Array))
 
     return nn.intercept_methods(interceptor)
+
+
+def needs_conv_grad_fix(mesh: Optional[Mesh]) -> bool:
+    """True on combined spatial×model meshes — the layouts where XLA
+    over-reduces replicated conv-kernel grads (see
+    `conv_grad_overreduction_factor`)."""
+    return (mesh is not None and has_spatial(mesh)
+            and dict(mesh.shape).get(MODEL_AXIS, 1) > 1)
+
+
+_overreduction_cache: dict = {}
+
+
+def conv_grad_overreduction_factor(mesh: Mesh) -> float:
+    """Measure XLA's conv-kernel gradient over-reduction on this mesh.
+
+    On a combined (data, spatial, model) mesh, GSPMD (jax 0.9.0) reduces the
+    gradient of a REPLICATED conv kernel over the model axis too whenever the
+    conv's output is spatially sharded — each model shard already holds the
+    full gradient, so it comes back model_size× too large. Rather than
+    hard-coding the bug, a tiny probe conv measures the actual factor once
+    per mesh shape (cached): when a future XLA fixes the reduction, the probe
+    returns 1.0 and the correction in `rescale_overreduced_conv_grads`
+    disappears with it."""
+    if not needs_conv_grad_fix(mesh):
+        return 1.0
+    key = (tuple(sorted(mesh.shape.items())),
+           tuple(d.id for d in mesh.devices.flat))
+    if key in _overreduction_cache:
+        return _overreduction_cache[key]
+    import jax.numpy as jnp
+    from jax import lax
+
+    sp = mesh.shape[SPATIAL_AXIS]
+    h = sp * MIN_SPATIAL_ROWS  # smallest H the floor keeps spatial-sharded
+    batch = mesh.shape[DATA_AXIS]
+    x = jnp.linspace(-1.0, 1.0, batch * h * h * 2,
+                     dtype=jnp.float32).reshape(batch, h, h, 2)
+    k = jnp.linspace(-0.5, 0.5, 3 * 3 * 2 * 4,
+                     dtype=jnp.float32).reshape(3, 3, 2, 4)
+
+    def grad_of_kernel(x, k, constrain):
+        def f(k):
+            y = lax.conv_general_dilated(
+                x, k, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if constrain:
+                y = jax.lax.with_sharding_constraint(
+                    y, batch_sharding(mesh, 4, dim1=h))
+            return jnp.sum(y * y)
+        return jax.grad(f)(k)
+
+    oracle = jax.jit(grad_of_kernel, static_argnums=2)(x, k, False)
+    xs = jax.device_put(x, batch_sharding(mesh, 4, dim1=h))
+    ks = jax.device_put(k, replicated(mesh))
+    meshed = jax.jit(grad_of_kernel, static_argnums=2)(xs, ks, True)
+    import numpy as np_
+    o, m = np_.asarray(oracle).ravel(), np_.asarray(meshed).ravel()
+    nz = np_.abs(o) > 1e-6
+    measured = float(np_.median(m[nz] / o[nz]))
+    # snap to the nearest integer: the bug is an extra whole-axis psum, so
+    # real factors are 1 or the model-axis size — anything else means the
+    # probe itself broke (e.g. a future XLA sharding the probe grad some
+    # third way), and dividing grads by it would silently corrupt training
+    factor = float(round(measured))
+    if factor not in (1.0, float(mesh.shape[MODEL_AXIS])):
+        raise RuntimeError(
+            f"conv-grad over-reduction probe measured {measured:.4f} on mesh "
+            f"{dict(mesh.shape)} — expected 1 (fixed upstream) or "
+            f"{mesh.shape[MODEL_AXIS]} (known GSPMD bug). The XLA behavior "
+            f"has changed; re-verify tests/test_spatial.py's combined-mesh "
+            f"oracle before training on this mesh.")
+    _overreduction_cache[key] = factor
+    return factor
+
+
+def rescale_overreduced_conv_grads(grads, paths, factor: float):
+    """Divide the conv-kernel grads recorded by
+    `spatial_activation_constraints(record=...)` by the measured
+    over-reduction factor. No-op when factor == 1.0 (bug fixed upstream) or
+    nothing was recorded."""
+    if not paths or factor == 1.0:
+        return grads
+    from flax.core import FrozenDict, freeze, unfreeze
+    was_frozen = isinstance(grads, FrozenDict)
+    g = unfreeze(grads)
+    for path in paths:
+        node = g
+        for name in path:
+            node = node[name]
+        node["kernel"] = node["kernel"] / factor
+    return freeze(g) if was_frozen else g
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -43,13 +43,85 @@ def test_make_mesh_spatial_axes():
     assert not mesh_lib.has_spatial(mesh_lib.make_mesh())
 
 
-def test_make_mesh_rejects_spatial_plus_model():
-    """jax 0.9.0 GSPMD over-reduces replicated conv-kernel grads by exactly
-    model_parallel when activations are sharded on batch+H of a mesh that also
-    has a model axis (grads come back 2x on a (2,2,2) mesh) — the combination
-    is rejected until fixed upstream."""
-    with pytest.raises(ValueError, match="spatial_parallel and model_parallel"):
-        mesh_lib.make_mesh(spatial_parallel=2, model_parallel=2)
+def _mesh_combined():
+    return mesh_lib.make_mesh(spatial_parallel=2, model_parallel=2)
+
+
+def test_combined_mesh_allowed_and_probe_measures_factor():
+    """spatial×model meshes are now supported (VERDICT r1 item 6): jax 0.9.0
+    GSPMD over-reduces replicated conv-kernel grads by the model-axis size
+    when the conv's output is spatially sharded; the probe measures that
+    factor at runtime (so an upstream fix auto-disables the correction) and
+    pure spatial / pure model meshes need no fix."""
+    mesh = _mesh_combined()
+    assert dict(mesh.shape) == {"data": 2, "spatial": 2, "model": 2}
+    assert mesh_lib.needs_conv_grad_fix(mesh)
+    assert not mesh_lib.needs_conv_grad_fix(_mesh_spatial())
+    assert not mesh_lib.needs_conv_grad_fix(mesh_lib.make_mesh(model_parallel=2))
+    assert mesh_lib.conv_grad_overreduction_factor(_mesh_spatial()) == 1.0
+    # on current XLA the measured factor is the model-axis size; an upstream
+    # fix would legitimately turn this into 1.0 — accept either, but nothing
+    # else (anything in between means the probe itself is broken)
+    factor = mesh_lib.conv_grad_overreduction_factor(mesh)
+    assert factor in (1.0, float(mesh.shape["model"])), factor
+
+
+def test_combined_mesh_train_step_matches_dp_oracle():
+    """One train step on the (2,2,2) spatial×model mesh must produce the SAME
+    updated params as pure DP — the conv-grad correction undoes the GSPMD
+    over-reduction exactly, for both sharded-output convs (scaled) and
+    below-floor convs (untouched)."""
+
+    class HourglassLikeNet(nn.Module):
+        # Exercises every conv grad regime on the combined mesh: H 32→16→8→4
+        # (sharded-in/sharded-out convs: over-reduced; then below the floor:
+        # correct), a ConvTranspose 4→8 (replicated input, sharded output:
+        # NOT over-reduced — must not be rescaled), and a resize-gap conv
+        # (input through a non-module upsample).
+        @nn.compact
+        def __call__(self, x, train=True):
+            for feat in (8, 16, 16):
+                x = nn.Conv(feat, (3, 3), strides=(2, 2), padding="SAME",
+                            use_bias=False)(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+                x = nn.relu(x)
+            x = nn.ConvTranspose(16, (3, 3), strides=(2, 2),
+                                 padding="SAME", use_bias=False)(x)  # H 4→8
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            n, hh, ww, c = x.shape
+            x = jax.image.resize(x, (n, hh * 2, ww * 2, c), "nearest")  # →16
+            x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    model = HourglassLikeNet()
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(1).randn(8, 32, 32, 3).astype(np.float32)
+    y = (np.arange(8) % 10).astype(np.int32)
+
+    def one_step(mesh):
+        params, batch_stats = init_model(model, rng, jnp.zeros((2, 32, 32, 3)))
+        tx = build_optimizer(
+            OptimizerConfig(name="momentum", learning_rate=0.1),
+            ScheduleConfig(name="constant"), steps_per_epoch=10, total_epochs=1)
+        state = TrainState.create(model.apply, params, tx, batch_stats)
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
+        step = steps.make_classification_train_step(
+            compute_dtype=jnp.float32, mesh=mesh, donate=False)
+        sharded = mesh_lib.shard_batch_pytree(mesh, (x, y))
+        state, metrics = step(state, *sharded, rng)
+        return float(metrics["loss"]), state
+
+    loss_dp, state_dp = one_step(mesh_lib.make_mesh())
+    loss_cb, state_cb = one_step(_mesh_combined())
+    np.testing.assert_allclose(loss_dp, loss_cb, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_dp.params),
+                    jax.tree_util.tree_leaves(state_cb.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
 
 
 def test_batch_sharding_shards_height_on_spatial_mesh():
