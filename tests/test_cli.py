@@ -323,5 +323,12 @@ def test_bench_input_tool(capsys):
     out = run([])
     assert out["value"] > 0 and out["unit"] == "images/sec/host"
     assert "synthetic" in out["metric"]
+    assert out["cpu_cores"] >= 1 and out["per_core"] > 0
     out_u8 = run(["--device-normalize"])
     assert out_u8["value"] > 0 and "uint8" in out_u8["metric"]
+
+    # a passing floor is silent; an unreachable floor fails loudly with a
+    # remedy (the pod-preflight contract, docs/TUNING.md "Input pipeline")
+    run(["--floor", "0.001"])
+    with pytest.raises(SystemExit, match="below the --floor"):
+        run(["--floor", "1e12"])

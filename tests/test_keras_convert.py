@@ -79,21 +79,41 @@ def _build_keras_yolo(shape=(64, 64, 3)):
     return tf.keras.Model(inputs, (y_small, y_medium, y_large))
 
 
-def test_yolov3_h5_numerical_parity(tmp_path):
-    tf.random.set_seed(0)
-    km = _build_keras_yolo()
-    # randomize BN stats so the moving_* conversion is exercised
+def build_seeded_keras_yolo(shape=(64, 64, 3)):
+    """Deterministically-initialized tiny Keras YOLOv3 in the reference's
+    layer grammar. Keras 3 does NOT honor tf.random.set_seed for layer init
+    reproducibly across processes, so every weight (kernels, BN params AND
+    moving stats) is overwritten from a numpy RandomState keyed on the
+    weight's name — bit-identical weights in any process. Shared fixture
+    for the parity test here and the end-to-end detect golden test
+    (test_detect_golden.py)."""
+    import zlib
+    km = _build_keras_yolo(shape)
     for layer in km.layers:
-        if isinstance(layer, tf.keras.layers.BatchNormalization):
-            mean, var = layer.moving_mean, layer.moving_variance
-            mean.assign(tf.random.uniform(mean.shape, -0.5, 0.5, seed=1))
-            var.assign(tf.random.uniform(var.shape, 0.5, 2.0, seed=2))
-    # Write the LEGACY Keras-2 h5 layout the reference's TF2.1-era
-    # `save_weights` produced (per-layer groups, `<weight>:0` datasets) —
-    # Keras 3 in this environment can no longer write it itself.
+        for w in layer.weights:
+            path = getattr(w, "path", w.name)
+            # zlib.crc32 is stable across processes (str hash is salted)
+            rs = np.random.RandomState(zlib.crc32(path.encode()) % (2 ** 31))
+            name = path.rsplit("/", 1)[-1]
+            if name in ("gamma",):
+                w.assign(rs.uniform(0.7, 1.3, w.shape).astype(np.float32))
+            elif name == "moving_variance":
+                w.assign(rs.uniform(0.5, 2.0, w.shape).astype(np.float32))
+            elif name in ("beta", "bias", "moving_mean"):
+                w.assign(rs.uniform(-0.3, 0.3, w.shape).astype(np.float32))
+            else:  # conv kernels: He-normal (keeps signal through the stack)
+                fan = np.prod(w.shape[:-1])
+                w.assign((rs.randn(*w.shape)
+                          * np.sqrt(2.0 / fan)).astype(np.float32))
+    return km
+
+
+def write_legacy_h5(km, h5_path: str) -> None:
+    """Write the LEGACY Keras-2 h5 layout the reference's TF2.1-era
+    `save_weights` produced (per-layer groups, `<weight>:0` datasets) —
+    Keras 3 in this environment can no longer write it itself."""
     import h5py
-    h5 = str(tmp_path / "yolov3_best.h5")
-    with h5py.File(h5, "w") as f:
+    with h5py.File(h5_path, "w") as f:
         for layer in km.layers:
             if not layer.weights:
                 continue
@@ -106,6 +126,12 @@ def test_yolov3_h5_numerical_parity(tmp_path):
             g = f.create_group(layer.name).create_group(layer.name)
             for name, w in zip(names, layer.weights):
                 g.create_dataset(f"{name}:0", data=w.numpy())
+
+
+def test_yolov3_h5_numerical_parity(tmp_path):
+    km = build_seeded_keras_yolo()
+    h5 = str(tmp_path / "yolov3_best.h5")
+    write_legacy_h5(km, h5)
 
     weights = load_h5_weights(h5)
     params, batch_stats = convert_yolov3(weights, stage_blocks=STAGE_BLOCKS)

@@ -62,6 +62,12 @@ def main(argv=None):
                    help="emit raw uint8 (normalization deferred to the "
                         "device) — measure the before/after for the "
                         "--device-normalize training flag")
+    p.add_argument("--floor", type=float, default=None,
+                   help="fail (exit 1) when measured images/sec/host falls "
+                        "below this — wire into pod preflight so a "
+                        "misconfigured host pipeline is caught before it "
+                        "starves the chips (docs/TUNING.md for calibrated "
+                        "values)")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -87,15 +93,32 @@ def main(argv=None):
         images, _ = next(it)
         n += images.shape[0]
     dt = time.perf_counter() - t0
+    # affinity/cgroup-aware (what nproc reports in a restricted container) —
+    # os.cpu_count() would overstate cores and understate per_core exactly
+    # in the preflight setting this targets
+    cores = (len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+             else os.cpu_count() or 1)
+    rate = n / dt
     print(json.dumps({
         "metric": f"input_pipeline_images_per_sec(b{args.batch_size},"
                   f"{args.image_size}px,{'real' if args.data_dir else 'synthetic'}"
                   f"{',uint8' if args.device_normalize else ''})",
-        "value": round(n / dt, 1),
+        "value": round(rate, 1),
         "unit": "images/sec/host",
+        # tf.data JPEG decode scales ~linearly with cores (parallel
+        # interleave + map autotune): per-core is the portable number for
+        # sizing a pod host (TPU VMs have ~100-200 vCPUs)
+        "cpu_cores": cores,
+        "per_core": round(rate / cores, 1),
     }))
     if tmp:
         tmp.cleanup()
+    if args.floor is not None and rate < args.floor:
+        raise SystemExit(
+            f"input pipeline sustained {rate:.1f} img/s/host — below the "
+            f"--floor {args.floor:.1f}. The chips would starve: check core "
+            f"count ({cores} here), shard layout, and remote-storage "
+            f"throughput (docs/TUNING.md 'Input pipeline').")
 
 
 if __name__ == "__main__":
