@@ -79,6 +79,38 @@ def _build_keras_yolo(shape=(64, 64, 3)):
     return tf.keras.Model(inputs, (y_small, y_medium, y_large))
 
 
+def seed_keras_weights(km):
+    """Overwrite every weight of a Keras model from crc32-keyed numpy
+    streams: bit-identical weights in any process. (Keras 3 does NOT honor
+    tf.random.set_seed reproducibly across processes, so golden tests that
+    re-run the model in subprocesses must seed this way.)
+
+    Seeds are keyed on (enumeration index, role, shape) — NOT on the
+    variable path: auto-generated layer names embed Keras's process-global
+    counters (conv2d_37, ...), which depend on how many models earlier
+    tests built in the same process. The role is the path tail with the
+    Keras-2 ':0' suffix stripped, so gamma/moving_variance always hit their
+    positive ranges (a kernel-seeded negative moving_variance would NaN
+    every BN at inference)."""
+    import zlib
+    for i, w in enumerate(km.weights):
+        path = getattr(w, "path", w.name)
+        role = path.rsplit("/", 1)[-1].split(":")[0]
+        key = f"{i}:{role}:{tuple(int(d) for d in w.shape)}"
+        rs = np.random.RandomState(zlib.crc32(key.encode()) % (2 ** 31))
+        if role == "gamma":
+            w.assign(rs.uniform(0.7, 1.3, w.shape).astype(np.float32))
+        elif role == "moving_variance":
+            w.assign(rs.uniform(0.5, 2.0, w.shape).astype(np.float32))
+        elif role in ("beta", "bias", "moving_mean"):
+            w.assign(rs.uniform(-0.3, 0.3, w.shape).astype(np.float32))
+        else:  # conv/dense kernels: He-normal (keeps signal through depth)
+            fan = np.prod(w.shape[:-1])
+            w.assign((rs.randn(*w.shape)
+                      * np.sqrt(2.0 / fan)).astype(np.float32))
+    return km
+
+
 def build_seeded_keras_yolo(shape=(64, 64, 3)):
     """Deterministically-initialized tiny Keras YOLOv3 in the reference's
     layer grammar. Keras 3 does NOT honor tf.random.set_seed for layer init
@@ -87,25 +119,7 @@ def build_seeded_keras_yolo(shape=(64, 64, 3)):
     weight's name — bit-identical weights in any process. Shared fixture
     for the parity test here and the end-to-end detect golden test
     (test_detect_golden.py)."""
-    import zlib
-    km = _build_keras_yolo(shape)
-    for layer in km.layers:
-        for w in layer.weights:
-            path = getattr(w, "path", w.name)
-            # zlib.crc32 is stable across processes (str hash is salted)
-            rs = np.random.RandomState(zlib.crc32(path.encode()) % (2 ** 31))
-            name = path.rsplit("/", 1)[-1]
-            if name in ("gamma",):
-                w.assign(rs.uniform(0.7, 1.3, w.shape).astype(np.float32))
-            elif name == "moving_variance":
-                w.assign(rs.uniform(0.5, 2.0, w.shape).astype(np.float32))
-            elif name in ("beta", "bias", "moving_mean"):
-                w.assign(rs.uniform(-0.3, 0.3, w.shape).astype(np.float32))
-            else:  # conv kernels: He-normal (keeps signal through the stack)
-                fan = np.prod(w.shape[:-1])
-                w.assign((rs.randn(*w.shape)
-                          * np.sqrt(2.0 / fan)).astype(np.float32))
-    return km
+    return seed_keras_weights(_build_keras_yolo(shape))
 
 
 def write_legacy_h5(km, h5_path: str) -> None:

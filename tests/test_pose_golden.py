@@ -1,0 +1,102 @@
+"""End-to-end pose inference proof, the Hourglass analog of
+test_detect_golden.py: reference auto-named h5 → call-order import →
+checkpoint workdir → `Hourglass/jax/infer.py` CLI → heatmap peak decode →
+golden keypoints on the committed images.
+
+Seeded weights stand in for the reference's published checkpoint (zero
+egress; the numerical import parity against real Keras execution is pinned
+in test_order_convert.py). What this locks down is the demo-notebook role
+(`/root/reference/Hourglass/tensorflow/demo_hourglass_pose.ipynb`) through
+the real CLI: h5 → convert → restore → forward → decode_keypoints → stable
+(x, y, conf) per MPII joint.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from test_keras_convert import seed_keras_weights  # noqa: E402
+from test_order_convert import (  # noqa: E402
+    _build_reference_hourglass, _write_legacy_h5)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data", "detect")
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "detect",
+                      "golden_pose.json")
+POSE_LINE = re.compile(
+    r"^\s+(?P<joint>\w+)\s+x=(?P<x>-?[0-9.]+) y=(?P<y>-?[0-9.]+) "
+    r"conf=(?P<conf>-?[0-9.]+)")
+
+
+@pytest.mark.slow  # two hourglass XLA-CPU compiles (import + infer subprocess)
+def test_pose_infer_cli_golden(tmp_path):
+    import importlib.util
+
+    keras_model = seed_keras_weights(_build_reference_hourglass(1))
+    h5 = str(tmp_path / "hourglass_best.h5")
+    _write_legacy_h5(keras_model, h5)
+
+    workdir = str(tmp_path / "wd")
+    os.makedirs(workdir)
+    with open(os.path.join(workdir, "model_kwargs.json"), "w") as fp:
+        json.dump({"num_stack": 1, "num_residual": 1, "dtype": "float32"}, fp)
+
+    spec = importlib.util.spec_from_file_location(
+        "import_keras_tool2", os.path.join(os.path.dirname(__file__), "..",
+                                           "tools",
+                                           "import_keras_checkpoint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["-m", "hourglass104", "--h5", h5, "--workdir", workdir])
+
+    images = [os.path.join(DATA_DIR, f"img{i}.png") for i in range(2)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "Hourglass", "jax",
+                      "infer.py"),
+         "--workdir", workdir, "--image-size", "64",
+         "--conf-thresh=-1e9"] + images,  # = form: argparse reads -1e9 as a flag
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "no checkpoint found" not in proc.stdout
+
+    got, current = {}, None
+    for line in proc.stdout.splitlines():
+        if line.endswith(".png:"):
+            current = os.path.basename(line[:-1])
+            got[current] = []
+        else:
+            m = POSE_LINE.match(line)
+            if m and current:
+                got[current].append(
+                    {"joint": m.group("joint"),
+                     "x": float(m.group("x")), "y": float(m.group("y")),
+                     "conf": float(m.group("conf"))})
+    assert set(got) == {"img0.png", "img1.png"}, proc.stdout
+    assert all(len(v) == 16 for v in got.values()), proc.stdout  # MPII joints
+
+    if not os.path.exists(GOLDEN):  # bootstrap: write, then fail loudly
+        with open(GOLDEN, "w") as fp:
+            json.dump(got, fp, indent=1, sort_keys=True)
+        pytest.fail(f"golden file bootstrapped at {GOLDEN}; commit and re-run")
+
+    want = json.load(open(GOLDEN))
+    assert set(got) == set(want)
+    for img in sorted(want):
+        for g, w in zip(got[img], want[img]):
+            assert g["joint"] == w["joint"]
+            # peak argmax is grid-quantized (16x16 heatmap at 64px input):
+            # a flip to a neighboring cell would move x/y by 1/16=0.0625,
+            # so 0.03 both absorbs float jitter and catches cell flips
+            np.testing.assert_allclose([g["x"], g["y"]], [w["x"], w["y"]],
+                                       atol=0.03)
+            np.testing.assert_allclose(g["conf"], w["conf"],
+                                       rtol=5e-2, atol=0.05)
