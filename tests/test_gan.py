@@ -164,6 +164,38 @@ def test_cyclegan_train_batch_smoke(mesh8):
     trainer.close()
 
 
+def test_dcgan_spatial_mesh_step_warning_clean(tmp_path, capfd):
+    """Adversarial steps on a (data, spatial) mesh: images' H shards over
+    'spatial' through shard_batch_pytree, GSPMD partitions the conv/
+    conv-transpose stacks, and the two-optimizer step runs without any
+    spmd_partitioner involuntary-remat warning. (Combined spatial×model
+    meshes ARE rejected — mesh_lib.reject_combined_mesh — because these
+    steps carry no conv-grad over-reduction compensation.)"""
+    import pytest
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import DCGANTrainer
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(spatial_parallel=2)
+    cfg = get_config("dcgan").replace(batch_size=16, total_epochs=1)
+    trainer = DCGANTrainer(cfg, workdir=str(tmp_path / "sp"), mesh=mesh)
+    rs = np.random.RandomState(0)
+    images = rs.uniform(-1, 1, (16, 28, 28, 1)).astype(np.float32)
+    capfd.readouterr()
+    m = trainer.train_batch(images)
+    losses = {k: float(np.asarray(v)) for k, v in m.items()}
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err
+    assert all(np.isfinite(v) for v in losses.values()), losses
+    trainer.close()
+
+    with pytest.raises(ValueError, match="combined spatial x model"):
+        DCGANTrainer(cfg, workdir=str(tmp_path / "cb"),
+                     mesh=mesh_lib.make_mesh(spatial_parallel=2,
+                                             model_parallel=2))
+
+
 def test_gan_halt_on_nonfinite(mesh8, tmp_path):
     """A NaN batch halts the adversarial fit() with TrainingDivergedError
     (GAN collapse detection); halt_on_nonfinite=False trains through."""
