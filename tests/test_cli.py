@@ -301,6 +301,36 @@ def test_roofline_family_steps(capsys):
         mod.main(["-m", "yolov3", "--family", "yolo", "--eval"])
 
 
+def test_preflight_tool(tmp_path):
+    """tools/preflight.py: all four checks pass on the virtual mesh; an
+    unreachable input floor turns into one FAIL line + exit 1 while the
+    remaining checks still run."""
+    import json
+    import os
+    import subprocess
+
+    script = os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "preflight.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    base = [sys.executable, script, "--model", "lenet5", "--batch-size", "32",
+            "--input-steps", "3", "--workdir", str(tmp_path)]
+
+    ok = subprocess.run(base, capture_output=True, text=True, timeout=600,
+                        env=env, cwd=str(tmp_path))
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-1000:]
+    assert ok.stdout.count("PASS") == 4 and "FAIL" not in ok.stdout
+    assert json.loads(ok.stdout.strip().splitlines()[-1])["preflight"] == "pass"
+
+    bad = subprocess.run(base + ["--input-floor", "1e12"],
+                         capture_output=True, text=True, timeout=600, env=env,
+                         cwd=str(tmp_path))
+    assert bad.returncode == 1
+    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 3
+    assert json.loads(bad.stdout.strip().splitlines()[-1])["preflight"] == "fail"
+
+
 def test_bench_input_tool(capsys):
     """tools/bench_input.py: synthetic-shard mode produces a throughput line
     (the host-side budget check for SURVEY §7.2's hard part #1) in both

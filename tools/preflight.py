@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Pod preflight: validate a host is ready to train BEFORE burning pod-hours.
+
+    python tools/preflight.py [--model resnet50_tpu] [--data-dir DIR]
+        [--batch-size N] [--image-size S] [--input-floor IMG_PER_SEC]
+        [--workdir DIR]
+
+Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
+
+  devices     backend reachable, device count/platform, mesh construction
+  input       host tf.data throughput (real TFRecords when --data-dir is
+              given, synthetic JPEG shards otherwise) vs --input-floor
+  step        the model's jitted train step compiles and one synthetic
+              step returns a finite loss on the mesh
+  checkpoint  an Orbax save/restore roundtrip in the workdir's filesystem
+              (the pod's real checkpoint target when --workdir is given)
+
+Run it on every host of a slice (same command via --worker=all); a host
+that fails `input` will starve the chips, one that fails `checkpoint`
+will hang the collective save. docs/TUNING.md calibrates --input-floor
+(healthy: well above 200 img/s/core x cores).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+RESULTS = []
+
+
+def check(name: str):
+    def deco(fn):
+        def run(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                detail = fn(*args, **kwargs) or ""
+                ok = True
+            except (Exception, SystemExit) as e:  # SystemExit: bench_input's
+                # --floor failure raises it — must become a FAIL line, not
+                # kill the remaining checks
+                detail = f"{type(e).__name__}: {e}"
+                ok = False
+            dt = time.perf_counter() - t0
+            RESULTS.append(ok)
+            print(f"{'PASS' if ok else 'FAIL'} {name:10s} ({dt:.1f}s) "
+                  f"{detail}", flush=True)
+            return ok
+        return run
+    return deco
+
+
+@check("devices")
+def check_devices(args):
+    import jax
+
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    devices = jax.devices()
+    mesh = mesh_lib.make_mesh(model_parallel=args.model_parallel,
+                              spatial_parallel=args.spatial_parallel)
+    mesh_lib.check_batch_divisible(args.batch_size, mesh)
+    return (f"{len(devices)}x {devices[0].platform} "
+            f"mesh={dict(mesh.shape)} process "
+            f"{jax.process_index()}/{jax.process_count()}")
+
+
+@check("input")
+def check_input(args):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_input_preflight",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_input.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = ["--batch-size", str(args.batch_size),
+            "--image-size", str(args.image_size),
+            "--steps", str(args.input_steps)]
+    if args.data_dir:
+        argv += ["--data-dir", args.data_dir]
+    if args.input_floor is not None:
+        argv += ["--floor", str(args.input_floor)]
+    # bench_input prints its JSON line and raises SystemExit below the floor
+    mod.main(argv)
+    return f"floor={args.input_floor or 'unset'}"
+
+
+@check("step")
+def check_step(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+
+    cfg = get_config(args.model).replace(
+        batch_size=args.batch_size, model_parallel=args.model_parallel,
+        spatial_parallel=args.spatial_parallel)
+    # explicit temp workdir: workdir=None falls back to cfg.checkpoint_dir
+    # ("checkpoints" under the cwd) — preflight must not litter or fail on
+    # a read-only cwd
+    tmpdir = tempfile.TemporaryDirectory(prefix="preflight_step_")
+    trainer = Trainer(cfg, workdir=tmpdir.name)
+    trainer.init_state((args.image_size, args.image_size, 3))
+    rs = np.random.RandomState(0)
+    images = rs.randn(args.batch_size, args.image_size, args.image_size,
+                      3).astype(np.float32)
+    labels = rs.randint(0, cfg.data.num_classes,
+                        size=(args.batch_size,)).astype(np.int32)
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    batch = mesh_lib.shard_batch_pytree(trainer.mesh, (images, labels))
+    t0 = time.perf_counter()
+    state, metrics = trainer.train_step(trainer.state, *batch,
+                                        jax.random.PRNGKey(0))
+    loss = float(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+    trainer.state = state
+    if not np.isfinite(loss):
+        raise RuntimeError(f"non-finite loss {loss}")
+    # one more step for a steady-state time (compiled)
+    t0 = time.perf_counter()
+    state, metrics = trainer.train_step(trainer.state, *batch,
+                                        jax.random.PRNGKey(0))
+    float(metrics["loss"])
+    step_s = time.perf_counter() - t0
+    trainer.close()
+    tmpdir.cleanup()
+    return (f"model={cfg.model} loss={loss:.3f} compile={compile_s:.1f}s "
+            f"step={step_s * 1000:.0f}ms "
+            f"(~{args.batch_size / max(step_s, 1e-9):.0f} img/s)")
+
+
+@check("checkpoint")
+def check_checkpoint(args):
+    import numpy as np
+
+    from deepvision_tpu.core.checkpoint import CheckpointManager
+
+    import shutil
+
+    self_made = args.workdir is None
+    root = args.workdir or tempfile.mkdtemp(prefix="preflight_ckpt_")
+    path = os.path.join(root, "preflight_ckpt")
+    try:
+        payload = {"params": {"w": np.arange(8, dtype=np.float32)}}
+        mgr = CheckpointManager(path, keep=1, keep_best=False)
+        mgr.save(1, payload)
+        mgr.flush()
+        restored, _, epoch = mgr.restore(payload)
+        mgr.close()
+        if epoch != 1:
+            raise RuntimeError(f"restored epoch {epoch}, wanted 1")
+        np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                      payload["params"]["w"])
+    finally:
+        # remove the probe subdir; remove the root too only if we made it
+        shutil.rmtree(root if self_made else path, ignore_errors=True)
+    return f"orbax roundtrip in {root}"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Validate a host before a pod run (see module docstring).")
+    p.add_argument("--model", default="resnet50_tpu")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="default: small (64) on cpu, 224 on tpu")
+    p.add_argument("--data-dir", default=None,
+                   help="real train TFRecords for the input check")
+    p.add_argument("--input-floor", type=float, default=None,
+                   help="min img/s/host for the input check (TUNING.md)")
+    p.add_argument("--input-steps", type=int, default=20)
+    p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--spatial-parallel", type=int, default=1)
+    p.add_argument("--workdir", default=None,
+                   help="checkpoint roundtrip target (use the run's real "
+                        "workdir to validate its filesystem)")
+    args = p.parse_args(argv)
+
+    import jax
+    if args.image_size is None:
+        try:
+            platform = jax.devices()[0].platform
+        except RuntimeError:
+            platform = "none"
+        args.image_size = 224 if platform == "tpu" else 64
+
+    check_devices(args)
+    check_input(args)
+    check_step(args)
+    check_checkpoint(args)
+
+    ok = all(RESULTS)
+    print(json.dumps({"preflight": "pass" if ok else "fail",
+                      "checks_passed": sum(RESULTS),
+                      "checks_total": len(RESULTS)}))
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
