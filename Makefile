@@ -3,7 +3,10 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
           XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-all bench dryrun smoke
+.PHONY: test test-all bench dryrun smoke preflight
+
+preflight:   ## pod go/no-go: devices, input floor, train step, ckpt roundtrip
+	$(PY) tools/preflight.py
 
 test:        ## fast suite (slow-marked compiles excluded)
 	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q

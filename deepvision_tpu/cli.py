@@ -102,6 +102,11 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--cache-val", action="store_true",
                    help="cache the validation records in host RAM after the "
                         "first epoch (classification ImageNet TFRecords)")
+    p.add_argument("--steps-per-dispatch", type=_positive_int, default=None,
+                   help="run k train steps per host dispatch via a device-"
+                        "side lax.scan — amortizes dispatch latency "
+                        "(relayed TPUs, small steps); metrics surface as "
+                        "the k-step mean; incompatible with --accum-steps")
     p.add_argument("--prefetch-batches", type=_positive_int, default=None,
                    help="stage this many training batches ahead on device "
                         "from a producer thread (default 2; 1 disables)")
@@ -259,6 +264,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
             cfg.data, normalize_on_device=True))
     if getattr(args, "cache_val", False):
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, cache_val=True))
+    if args.steps_per_dispatch:
+        cfg = cfg.replace(steps_per_dispatch=args.steps_per_dispatch)
     if args.prefetch_batches:
         cfg = cfg.replace(prefetch_batches=args.prefetch_batches)
     if args.seed is not None:

@@ -107,7 +107,8 @@ class CenterNetTrainer(LossWatchedTrainer):
         self.train_step = make_centernet_train_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
-            input_norm=input_norm, log_grad_norm=config.log_grad_norm)
+            input_norm=input_norm, log_grad_norm=config.log_grad_norm,
+            donate=config.steps_per_dispatch == 1)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh,

@@ -160,6 +160,15 @@ class TrainConfig:
     # overlaps compute of batch i (parallel/prefetch.py). 1 disables the
     # thread (inline staging). HBM cost: up to this many extra batches.
     prefetch_batches: int = 2
+    # Device-side step batching: run k train steps per host dispatch via
+    # lax.scan (steps.make_multistep_train_step). Amortizes per-step
+    # dispatch/launch latency — the lever for dispatch-bound setups (relayed
+    # TPUs, tiny models, very fast chips); MaxText-style. Metrics surface
+    # once per dispatch as the k-step mean; EMA advances per scanned step
+    # (same cadence as k=1); incompatible with accum_steps > 1 (the scan
+    # would desync the EMA/accumulation alignment). HBM cost: k staged
+    # batches per dispatch.
+    steps_per_dispatch: int = 1
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -136,7 +136,8 @@ class PoseTrainer(LossWatchedTrainer):
         self.train_step = make_pose_train_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
             remat=config.remat, input_norm=input_norm,
-            log_grad_norm=config.log_grad_norm)
+            log_grad_norm=config.log_grad_norm,
+            donate=config.steps_per_dispatch == 1)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)

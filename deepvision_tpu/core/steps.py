@@ -173,6 +173,62 @@ def make_classification_train_step(
     return jax.jit(step, **jit_kwargs)
 
 
+def make_multistep_train_step(step_fn: Callable, k: int, n_batch_args: int,
+                              *, mesh: Optional[Mesh] = None,
+                              ema_decay: Optional[float] = None) -> Callable:
+    """Wrap any family's `(state, *batch, rng) -> (state, metrics)` step into
+    `(state, *k_batches_flat, rng)` running k steps per host dispatch via
+    `lax.scan` — one XLA launch instead of k (config.steps_per_dispatch).
+
+    Per-step dispatch latency is pure overhead the chip idles through; over
+    a relayed TPU it's the dominant cost of small steps (docs/TUNING.md
+    "How to time through a tunneled TPU"). The k host batches arrive as
+    flat args (k × n_batch_args arrays, already sharded like single
+    batches), are stacked on device — a layout-only concat, no resharding —
+    and scanned. Inner per-step RNG stays correct because every task step
+    folds `rng` with `state.step`, which advances inside the scan.
+
+    `ema_decay`: the Polyak update runs INSIDE the scan after each step, so
+    the averaging cadence is identical to k=1 (the trainer's external
+    per-dispatch EMA would decay k× too slowly). Returned metrics are the
+    mean over the k steps. Build the wrapped `step_fn` with donate=False —
+    its own donation cannot apply inside this trace; the wrapper donates
+    the state and the staged batches at the outer jit instead."""
+    if k < 2:
+        raise ValueError(f"steps_per_dispatch wrapper needs k >= 2, got {k}")
+
+    def multi(state, *args):
+        flat, rng = args[:-1], args[-1]
+        assert len(flat) == k * n_batch_args, (len(flat), k, n_batch_args)
+        stacked = tuple(
+            jnp.stack([flat[i * n_batch_args + j] for i in range(k)])
+            for j in range(n_batch_args))
+
+        from flax.core import FrozenDict, freeze
+        frozen_bs = isinstance(state.batch_stats, FrozenDict)
+
+        def body(st, xs):
+            st, metrics = step_fn(st, *xs, rng)
+            if frozen_bs and not isinstance(st.batch_stats, FrozenDict):
+                # flax's mutable apply hands batch_stats back as a plain
+                # dict; harmless under jit, but scan demands the carry
+                # keep the input's pytree TYPE
+                st = st.replace(batch_stats=freeze(st.batch_stats))
+            if ema_decay is not None:
+                from .train_state import ema_tree_update
+                st = st.replace(ema_params=ema_tree_update(
+                    ema_decay, st.ema_params, st.params))
+            return st, metrics
+
+        state, metrics = jax.lax.scan(body, state, stacked)
+        return state, jax.tree_util.tree_map(lambda m: m.mean(axis=0), metrics)
+
+    jit_kwargs = {"donate_argnums": tuple(range(0, 1 + k * n_batch_args))}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(multi, **jit_kwargs)
+
+
 def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
                                   mesh: Optional[Mesh] = None,
                                   input_norm: Optional[tuple] = None) -> Callable:

@@ -50,17 +50,24 @@ class TrainState:
         )
 
 
+def ema_tree_update(decay: float, ema_params, params):
+    """ema = d*ema + (1-d)*params — the ONE Polyak formula, shared by the
+    per-dispatch jitted update below and the in-scan update of
+    steps.make_multistep_train_step (so the k>1 path can never drift from
+    the k=1 semantics)."""
+    return jax.tree_util.tree_map(
+        lambda e, p: e * decay + (1.0 - decay) * p, ema_params, params)
+
+
 def make_ema_update(decay: float):
-    """Jitted `state -> state` Polyak update: ema = d*ema + (1-d)*params.
+    """Jitted `state -> state` Polyak update.
 
     Kept OUTSIDE the per-task train steps so every trainer (classification,
     detection, pose, centernet) gets EMA with no per-task wiring; the
     elementwise tree op is negligible next to a train step."""
     def f(state: TrainState) -> TrainState:
-        new_ema = jax.tree_util.tree_map(
-            lambda e, p: e * decay + (1.0 - decay) * p,
-            state.ema_params, state.params)
-        return state.replace(ema_params=new_ema)
+        return state.replace(
+            ema_params=ema_tree_update(decay, state.ema_params, state.params))
     return jax.jit(f, donate_argnums=0)
 
 

@@ -178,6 +178,11 @@ class Trainer:
         self.tx = build_optimizer(opt_cfg, config.schedule,
                                   self.steps_per_epoch, config.total_epochs)
 
+        if config.steps_per_dispatch > 1 and accum > 1:
+            raise ValueError(
+                "steps_per_dispatch > 1 is incompatible with accum_steps > 1 "
+                "(the device-side scan would desync the EMA/accumulation "
+                "cadence) — pick one lever")
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = ((config.data.mean, config.data.std)
                       if config.data.normalize_on_device else None)
@@ -186,7 +191,11 @@ class Trainer:
             compute_dtype=compute_dtype, mesh=self.mesh,
             remat=config.remat, mixup_alpha=config.mixup_alpha,
             cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
-            log_grad_norm=config.log_grad_norm)
+            log_grad_norm=config.log_grad_norm,
+            donate=config.steps_per_dispatch == 1)
+        # steps_per_dispatch > 1: built lazily on first epoch (train_epoch),
+        # AFTER subclasses have installed their family's train_step
+        self._multi_step = None
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm)
 
@@ -299,36 +308,81 @@ class Trainer:
         # while the device is idle between epochs).
         step0 = int(self.state.step)
         pending: list = []
+        weights: list = []  # steps behind each device_metrics entry (k or 1)
+        consumed = 0        # host-side count of steps dispatched this epoch
+        k = self.config.steps_per_dispatch
+        group: list = []    # staged batches awaiting a k-step dispatch
+
+        def record(metrics, n_steps, n_examples):
+            nonlocal consumed, n_img
+            prev = consumed
+            consumed += n_steps
+            n_img += n_examples
+            device_metrics.append(metrics)
+            weights.append(n_steps)
+            log_every = self.config.log_every_steps
+            if (consumed // log_every > prev // log_every
+                    and _is_main_process()):
+                # JSONL/TB writes are process-0-only, like checkpoints
+                # (SURVEY.md §5.8) — other hosts skip the device_get too
+                pending.append((step0 + consumed, metrics))
+                if len(pending) > 1:
+                    s, m = pending.pop(0)
+                    self.logger.log(s, jax.device_get(m), epoch=epoch,
+                                    prefix="train_", echo=True)
+
+        def run_single(batch):
+            self.state, metrics = self.train_step(self.state, *batch,
+                                                  step_rng)
+            if self.ema_update is not None:
+                self._micro_count += 1
+                if self._micro_count % self.config.optimizer.accum_steps == 0:
+                    self.state = self.ema_update(self.state)
+            record(metrics, 1, len(jax.tree_util.tree_leaves(batch)[0]))
+
         # each batch is any tuple of arrays with a leading batch dim —
         # (images, labels) for classification, (images, boxes, classes,
         # valid) for detection — forwarded positionally to the task's train
         # step. Staged to device ahead of consumption by a producer thread
         # (prefetch_batches > 1) so host->device transfer overlaps compute.
+        # With steps_per_dispatch > 1, k staged batches go to the device in
+        # ONE dispatch (lax.scan wrapper); a sub-k tail runs as single steps.
         staged = prefetch_to_device(self.mesh, data,
                                     self.config.prefetch_batches)
         try:
-            for i, batch in enumerate(staged):
-                self.state, metrics = self.train_step(self.state, *batch,
-                                                      step_rng)
-                if self.ema_update is not None:
-                    self._micro_count += 1
-                    if self._micro_count % self.config.optimizer.accum_steps == 0:
-                        self.state = self.ema_update(self.state)
-                device_metrics.append(metrics)
-                n_img += len(jax.tree_util.tree_leaves(batch)[0])
-                if ((i + 1) % self.config.log_every_steps == 0
-                        and _is_main_process()):
-                    # JSONL/TB writes are process-0-only, like checkpoints
-                    # (SURVEY.md §5.8) — other hosts skip the device_get too
-                    pending.append((step0 + i + 1, metrics))
-                    if len(pending) > 1:
-                        s, m = pending.pop(0)
-                        self.logger.log(s, jax.device_get(m), epoch=epoch,
-                                        prefix="train_", echo=True)
+            for batch in staged:
+                if k > 1:
+                    group.append(batch)
+                    if len(group) == k:
+                        if self._multi_step is None:
+                            # built here, not __init__: subclasses install
+                            # their family's train_step after the base ran
+                            self._multi_step = steps.make_multistep_train_step(
+                                self.train_step, k, len(batch),
+                                mesh=self.mesh,
+                                ema_decay=self.config.ema_decay)
+                        n_ex = sum(len(jax.tree_util.tree_leaves(b)[0])
+                                   for b in group)
+                        flat = [a for b in group for a in b]
+                        group = []
+                        try:
+                            self.state, metrics = self._multi_step(
+                                self.state, *flat, step_rng)
+                        finally:
+                            # a failing dispatch must not pin k staged
+                            # batches in the retained traceback frame
+                            flat = None
+                        record(metrics, k, n_ex)
+                else:
+                    run_single(batch)
+            for batch in group:  # tail shorter than k
+                run_single(batch)
+            group = []
         finally:
             # a step exception must release the producer's staged device
             # batches NOW (a retained traceback would otherwise pin them
             # exactly when a recovering driver needs the HBM back)
+            group = None
             staged.close()
         jax.block_until_ready(self.state.params)
         for s, m in pending:
@@ -336,9 +390,13 @@ class Trainer:
                             prefix="train_", echo=True)  # main process only
         dt = time.time() - t0
         if device_metrics:
-            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs).mean(),
-                                             *device_metrics)
-            out = {k: float(v) for k, v in jax.device_get(stacked).items()}
+            # step-weighted mean: a k-step dispatch's entry is already the
+            # mean of k steps, a tail single's the mean of 1
+            w = np.asarray(weights, np.float32)
+            w = w / w.sum()
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: (jnp.stack(xs) * w).sum(), *device_metrics)
+            out = {key: float(v) for key, v in jax.device_get(stacked).items()}
         else:
             out = {}
         out["images_per_sec"] = n_img / dt if dt > 0 else 0.0

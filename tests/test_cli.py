@@ -246,6 +246,17 @@ def test_compilation_cache_flag(tmp_path, monkeypatch):
     assert jax.config.jax_compilation_cache_dir is None
 
 
+def test_steps_per_dispatch_flag(tmp_path):
+    """--steps-per-dispatch k trains through the scanned multi-step path
+    (3 dispatches of 2 + no tail at 6 steps) and still checkpoints/evals."""
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "1", "--batch-size",
+              "16", "--steps-per-epoch", "6", "--steps-per-dispatch", "2",
+              "--workdir", str(tmp_path)])
+    assert "best_metric" in result
+
+
 def test_resnet50_tpu_recipe_config():
     """The 75.3%/≤2h north-star recipe ships as ONE named config — every
     large-batch lever on (VERDICT r1 item 4), not scattered opt-in flags."""

@@ -833,6 +833,54 @@ def test_halt_on_nonfinite_train_loss(tmp_path):
     tr2.close()
 
 
+def test_steps_per_dispatch_matches_single_step_training(tmp_path):
+    """k train steps scanned in one dispatch == the same k steps dispatched
+    singly: identical final params, EMA (same per-step cadence), and step
+    count — including a tail shorter than k (7 batches, k=3)."""
+    import jax
+
+    def run(k, workdir):
+        cfg = _config(tmp_path, total_epochs=1, ema_decay=0.9,
+                      steps_per_dispatch=k,
+                      data=DataConfig(dataset="synthetic", image_size=32,
+                                      num_classes=10, train_examples=32 * 7))
+        tr = Trainer(cfg, workdir=str(tmp_path / workdir))
+        tr.init_state((32, 32, 1))
+        data = lambda epoch: SyntheticClassification(  # noqa: E731
+            batch_size=32, image_size=32, channels=1, num_classes=10,
+            num_batches=7, seed=123)
+        metrics = tr.train_epoch(1, data(1))
+        state = tr.state
+        tr.close()
+        return metrics, state
+
+    m1, s1 = run(1, "k1")
+    m3, s3 = run(3, "k3")
+    assert int(s1.step) == int(s3.step) == 7
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.ema_params),
+                    jax.tree_util.tree_leaves(s3.ema_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # the step-weighted epoch mean agrees between groupings
+    np.testing.assert_allclose(m1["loss"], m3["loss"], rtol=1e-5)
+
+
+def test_steps_per_dispatch_rejects_accum():
+    from deepvision_tpu.core.config import DataConfig, OptimizerConfig
+    cfg = TrainConfig(
+        name="t", model="lenet5", batch_size=32, total_epochs=1,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3,
+                                  accum_steps=2),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10),
+        steps_per_dispatch=2, checkpoint_dir="unused")
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        Trainer(cfg, workdir=None)
+
+
 def test_log_grad_norm_metric(tmp_path):
     """log_grad_norm adds a positive `grad_norm` scalar to every family's
     train-step metrics; off by default."""

@@ -106,33 +106,38 @@ def check_step(args):
         spatial_parallel=args.spatial_parallel)
     # explicit temp workdir: workdir=None falls back to cfg.checkpoint_dir
     # ("checkpoints" under the cwd) — preflight must not litter or fail on
-    # a read-only cwd
+    # a read-only cwd. try/finally: a FAILed check must not leak the
+    # trainer's async checkpoint thread or the temp dir into later checks.
     tmpdir = tempfile.TemporaryDirectory(prefix="preflight_step_")
-    trainer = Trainer(cfg, workdir=tmpdir.name)
-    trainer.init_state((args.image_size, args.image_size, 3))
-    rs = np.random.RandomState(0)
-    images = rs.randn(args.batch_size, args.image_size, args.image_size,
-                      3).astype(np.float32)
-    labels = rs.randint(0, cfg.data.num_classes,
-                        size=(args.batch_size,)).astype(np.int32)
-    from deepvision_tpu.parallel import mesh as mesh_lib
-    batch = mesh_lib.shard_batch_pytree(trainer.mesh, (images, labels))
-    t0 = time.perf_counter()
-    state, metrics = trainer.train_step(trainer.state, *batch,
-                                        jax.random.PRNGKey(0))
-    loss = float(metrics["loss"])
-    compile_s = time.perf_counter() - t0
-    trainer.state = state
-    if not np.isfinite(loss):
-        raise RuntimeError(f"non-finite loss {loss}")
-    # one more step for a steady-state time (compiled)
-    t0 = time.perf_counter()
-    state, metrics = trainer.train_step(trainer.state, *batch,
-                                        jax.random.PRNGKey(0))
-    float(metrics["loss"])
-    step_s = time.perf_counter() - t0
-    trainer.close()
-    tmpdir.cleanup()
+    trainer = None
+    try:
+        trainer = Trainer(cfg, workdir=tmpdir.name)
+        trainer.init_state((args.image_size, args.image_size, 3))
+        rs = np.random.RandomState(0)
+        images = rs.randn(args.batch_size, args.image_size, args.image_size,
+                          3).astype(np.float32)
+        labels = rs.randint(0, cfg.data.num_classes,
+                            size=(args.batch_size,)).astype(np.int32)
+        from deepvision_tpu.parallel import mesh as mesh_lib
+        batch = mesh_lib.shard_batch_pytree(trainer.mesh, (images, labels))
+        t0 = time.perf_counter()
+        state, metrics = trainer.train_step(trainer.state, *batch,
+                                            jax.random.PRNGKey(0))
+        loss = float(metrics["loss"])
+        compile_s = time.perf_counter() - t0
+        trainer.state = state
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss}")
+        # one more step for a steady-state time (compiled)
+        t0 = time.perf_counter()
+        state, metrics = trainer.train_step(trainer.state, *batch,
+                                            jax.random.PRNGKey(0))
+        float(metrics["loss"])
+        step_s = time.perf_counter() - t0
+    finally:
+        if trainer is not None:
+            trainer.close()
+        tmpdir.cleanup()
     return (f"model={cfg.model} loss={loss:.3f} compile={compile_s:.1f}s "
             f"step={step_s * 1000:.0f}ms "
             f"(~{args.batch_size / max(step_s, 1e-9):.0f} img/s)")
@@ -146,9 +151,17 @@ def check_checkpoint(args):
 
     import shutil
 
+    import socket
+
+    import jax
+
     self_made = args.workdir is None
     root = args.workdir or tempfile.mkdtemp(prefix="preflight_ckpt_")
-    path = os.path.join(root, "preflight_ckpt")
+    # per-host probe dir: preflight runs on EVERY host of a slice, often
+    # against one shared workdir filesystem — a fixed path would race
+    # (host A's rmtree landing mid-save of host B → spurious FAIL)
+    path = os.path.join(root, f"preflight_ckpt_{socket.gethostname()}"
+                              f"_{jax.process_index()}_{os.getpid()}")
     try:
         payload = {"params": {"w": np.arange(8, dtype=np.float32)}}
         mgr = CheckpointManager(path, keep=1, keep_best=False)
