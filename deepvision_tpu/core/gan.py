@@ -66,6 +66,21 @@ class AdversarialTrainer:
     gen_state: TrainState
     disc_state: TrainState
 
+    @staticmethod
+    def _validate_config(config: TrainConfig) -> None:
+        """First line of every subclass __init__ — config errors knowable
+        without building anything must fail before model init / device_put /
+        the conv-grad probes."""
+        if getattr(config, "steps_per_dispatch", 1) > 1:
+            # the shared TrainConfig field reaches library users even though
+            # the GAN CLIs never set it — fail loud (like accum_steps'
+            # incompatibility guard) instead of silently dispatching 1 step
+            raise ValueError(
+                "steps_per_dispatch > 1 is not supported by adversarial "
+                "trainers: the CycleGAN step round-trips through the host "
+                "ImagePool between the two jitted phases, and DCGAN keeps "
+                "one dispatch per step for the same two-optimizer shape")
+
     def _init_logging(self, config: TrainConfig, workdir: str):
         self.config = config
         self.logger = MetricsLogger(workdir, name=config.name)
@@ -157,34 +172,52 @@ def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
     Both gradient sets are computed against the pre-update parameters (the
     two-tape semantics of `DCGAN/tensorflow/main.py:59-71`); XLA CSEs the shared
     generator forward.
+
+    Combined spatial×model meshes are supported: each network's forward runs
+    under `spatial_activation_constraints` with its OWN record set (module
+    paths are relative to each `apply`'s root, so the two networks' records
+    must not mix), and each gradient set is rescaled by the probe-measured
+    conv-grad over-reduction factor (`mesh_lib.conv_grad_overreduction_factor`)
+    — the same compensation the supervised steps carry (core/steps.py).
     """
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
 
     def step(gen_state: TrainState, disc_state: TrainState, images, rng):
         rng = jax.random.fold_in(rng, gen_state.step)
         rng_z, rng_d1, rng_d2, rng_d3 = jax.random.split(rng, 4)
         noise = jax.random.normal(rng_z, (images.shape[0], noise_dim))
+        g_rec: set = set()  # filled at trace time by the interceptor
+        d_rec: set = set()
 
         def gen_loss_fn(gp):
-            fake, mut = gen_apply(
-                {"params": gp, "batch_stats": gen_state.batch_stats},
-                noise, train=True, mutable=["batch_stats"])
-            fake_logits = disc_apply(
-                {"params": disc_state.params}, fake, train=True,
-                rngs={"dropout": rng_d1})
+            with mesh_lib.spatial_activation_constraints(mesh, g_rec):
+                fake, mut = gen_apply(
+                    {"params": gp, "batch_stats": gen_state.batch_stats},
+                    noise, train=True, mutable=["batch_stats"])
+            # disc params are constants here — pin activations, record nothing
+            with mesh_lib.spatial_activation_constraints(mesh):
+                fake_logits = disc_apply(
+                    {"params": disc_state.params}, fake, train=True,
+                    rngs={"dropout": rng_d1})
             return _bce_logits(fake_logits, 1.0), (fake, mut)
 
         (g_loss, (fake, g_mut)), g_grads = jax.value_and_grad(
             gen_loss_fn, has_aux=True)(gen_state.params)
+        g_grads = mesh_lib.rescale_overreduced_conv_grads(
+            g_grads, g_rec, grad_fix)
 
         def disc_loss_fn(dp):
-            real_logits = disc_apply({"params": dp}, images, train=True,
-                                     rngs={"dropout": rng_d2})
-            fake_logits = disc_apply({"params": dp},
-                                     jax.lax.stop_gradient(fake), train=True,
-                                     rngs={"dropout": rng_d3})
+            with mesh_lib.spatial_activation_constraints(mesh, d_rec):
+                real_logits = disc_apply({"params": dp}, images, train=True,
+                                         rngs={"dropout": rng_d2})
+                fake_logits = disc_apply({"params": dp},
+                                         jax.lax.stop_gradient(fake),
+                                         train=True, rngs={"dropout": rng_d3})
             return _bce_logits(real_logits, 1.0) + _bce_logits(fake_logits, 0.0)
 
         d_loss, d_grads = jax.value_and_grad(disc_loss_fn)(disc_state.params)
+        d_grads = mesh_lib.rescale_overreduced_conv_grads(
+            d_grads, d_rec, grad_fix)
 
         new_gen = gen_state.apply_gradients(g_grads).replace(
             batch_stats=g_mut.get("batch_stats", gen_state.batch_stats))
@@ -205,9 +238,9 @@ class DCGANTrainer(AdversarialTrainer):
     def __init__(self, config: TrainConfig, workdir: str = "runs/dcgan",
                  mesh=None, noise_dim: int = 100):
         from ..models.gan import DCGANDiscriminator, DCGANGenerator
+        self._validate_config(config)
         self.noise_dim = noise_dim
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
-        mesh_lib.reject_combined_mesh(self.mesh, "adversarial trainers")
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = DCGANGenerator(noise_dim=noise_dim)
         self.discriminator = DCGANDiscriminator()
@@ -268,17 +301,25 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
     Returns (gen_state, disc_batch_stats, fake_a2b, fake_b2a, metrics) — the
     discriminator forward passes run train=True (keras side-effect parity), so
     their mutated batch_stats are threaded back to the caller.
+
+    Combined spatial×model meshes: each named generator records its own
+    sharded-conv module paths (paths are relative to one `gen_apply` root,
+    and grads live under gparams[name]), and its grad subtree is rescaled by
+    the probe-measured over-reduction factor — see make_dcgan_train_step.
     """
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
 
     def step(gen_state: TrainState, disc_state: TrainState, real_a, real_b):
+        recs = {"a2b": set(), "b2a": set()}  # filled at trace time
 
         def loss_fn(gparams):
             bs = dict(gen_state.batch_stats)
 
             def g(name, x):
-                y, mut = gen_apply(
-                    {"params": gparams[name], "batch_stats": bs[name]},
-                    x, train=True, mutable=["batch_stats"])
+                with mesh_lib.spatial_activation_constraints(mesh, recs[name]):
+                    y, mut = gen_apply(
+                        {"params": gparams[name], "batch_stats": bs[name]},
+                        x, train=True, mutable=["batch_stats"])
                 bs[name] = mut["batch_stats"]
                 return y
 
@@ -292,9 +333,12 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
             dbs = dict(disc_state.batch_stats)
 
             def d(name, x):
-                y, mut = disc_apply(
-                    {"params": disc_state.params[name], "batch_stats": dbs[name]},
-                    x, train=True, mutable=["batch_stats"])
+                # disc params are constants in this phase: pin, record nothing
+                with mesh_lib.spatial_activation_constraints(mesh):
+                    y, mut = disc_apply(
+                        {"params": disc_state.params[name],
+                         "batch_stats": dbs[name]},
+                        x, train=True, mutable=["batch_stats"])
                 dbs[name] = mut["batch_stats"]
                 return y
 
@@ -317,6 +361,8 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
 
         (_, (bs, dbs, fake_a2b, fake_b2a, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(gen_state.params)
+        grads = {name: mesh_lib.rescale_overreduced_conv_grads(
+            grads[name], recs[name], grad_fix) for name in grads}
         new_gen = gen_state.apply_gradients(grads).replace(batch_stats=bs)
         return new_gen, dbs, fake_a2b, fake_b2a, metrics
 
@@ -330,17 +376,21 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
 
 def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None) -> Callable:
     """Discriminator phase (`train.py:207-246`): (real+fake)/2 LSGAN per domain,
-    one optimizer over both discriminators. Fakes come from the host ImagePool."""
+    one optimizer over both discriminators. Fakes come from the host ImagePool.
+    Combined-mesh conv-grad compensation as in make_cyclegan_generator_step."""
+    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
 
     def step(disc_state: TrainState, real_a, real_b, fake_a2b, fake_b2a):
+        recs = {"a": set(), "b": set()}  # filled at trace time
 
         def loss_fn(dparams):
             bs = dict(disc_state.batch_stats)
 
             def d(name, x):
-                y, mut = disc_apply(
-                    {"params": dparams[name], "batch_stats": bs[name]},
-                    x, train=True, mutable=["batch_stats"])
+                with mesh_lib.spatial_activation_constraints(mesh, recs[name]):
+                    y, mut = disc_apply(
+                        {"params": dparams[name], "batch_stats": bs[name]},
+                        x, train=True, mutable=["batch_stats"])
                 bs[name] = mut["batch_stats"]
                 return y
 
@@ -355,6 +405,8 @@ def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None) -> Callabl
 
         (_, (bs, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(disc_state.params)
+        grads = {name: mesh_lib.rescale_overreduced_conv_grads(
+            grads[name], recs[name], grad_fix) for name in grads}
         new_disc = disc_state.apply_gradients(grads).replace(batch_stats=bs)
         return new_disc, metrics
 
@@ -375,8 +427,8 @@ class CycleGANTrainer(AdversarialTrainer):
         (`CycleGAN/tensorflow/train.py:108-129` counts total_batches before
         building LinearDecay); defaults to config.data.train_examples / batch."""
         from ..models.gan import CycleGANGenerator, PatchGANDiscriminator
+        self._validate_config(config)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
-        mesh_lib.reject_combined_mesh(self.mesh, "adversarial trainers")
         mesh_lib.check_batch_divisible(config.batch_size, self.mesh)
         self.generator = CycleGANGenerator(n_blocks=n_blocks)
         self.discriminator = PatchGANDiscriminator()

@@ -61,15 +61,16 @@ def divergence_halt(config, ckpt, epoch: int, what: str,
 def fit_and_close(trainer, *args, **kwargs):
     """`trainer.fit(...)` then `close()`, with the entry-point divergence UX:
     a TrainingDivergedError becomes a one-line remedy + nonzero exit instead
-    of a traceback, and close() still runs first so buffered JSONL/TB metrics
-    survive. Shared by the CLI and the GAN mains so the UX can't drift."""
+    of a traceback. close() runs in a finally so buffered JSONL/TB metrics
+    survive EVERY mid-fit exception (Ctrl-C, an OSError, a step failure) —
+    those are exactly the runs whose forensics matter. Shared by the CLI and
+    the GAN mains so the UX can't drift."""
     try:
-        result = trainer.fit(*args, **kwargs)
+        return trainer.fit(*args, **kwargs)
     except TrainingDivergedError as e:
-        trainer.close()
         raise SystemExit(f"error: {e}")
-    trainer.close()
-    return result
+    finally:
+        trainer.close()
 
 
 def _accepts_kwarg(ctor, name: str) -> bool:

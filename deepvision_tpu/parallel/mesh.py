@@ -158,11 +158,14 @@ def spatial_activation_constraints(mesh: Optional[Mesh],
     exactly while it's worth sharding, and the transition to batch-only
     happens at a module edge the partitioner handles efficiently.
 
-    `record` (a set, combined spatial×model meshes only): collects the module
-    path of every conv-like module (owns a rank-4 'kernel' param) whose
-    output gets pinned spatial-sharded — exactly the kernels whose gradients
-    XLA over-reduces by the model-axis size (see
-    `rescale_overreduced_conv_grads`). Filled at trace time.
+    `record` (a set, combined spatial×model meshes only): collects
+    `(module_path, kind)` for every conv-like module (owns a rank-4 'kernel'
+    param) whose output gets pinned spatial-sharded — exactly the kernels
+    whose gradients XLA over-reduces by the model-axis size (see
+    `rescale_overreduced_conv_grads`). `kind` distinguishes ConvTranspose
+    from regular convs because the over-reduction factor is probed per
+    primitive family (`conv_grad_overreduction_factor`). Filled at trace
+    time.
 
     No-op (nullcontext) on non-spatial meshes — model-parallel layouts are
     chosen by `param_sharding_rules` and need no activation pinning."""
@@ -196,7 +199,10 @@ def spatial_activation_constraints(mesh: Optional[Mesh],
                 and _any_spatial_sharded(out)
                 and context.module.has_variable("params", "kernel")
                 and context.module.get_variable("params", "kernel").ndim == 4):
-            record.add(context.module.path)
+            kind = ("conv_transpose"
+                    if isinstance(context.module, nn.ConvTranspose)
+                    else "conv")
+            record.add((context.module.path, kind))
         return jax.tree_util.tree_map(
             _constrain, out, is_leaf=lambda v: isinstance(v, jax.Array))
 
@@ -211,32 +217,38 @@ def needs_conv_grad_fix(mesh: Optional[Mesh]) -> bool:
             and dict(mesh.shape).get(MODEL_AXIS, 1) > 1)
 
 
-def reject_combined_mesh(mesh: Mesh, what: str) -> None:
-    """Raise for trainers whose steps carry no conv-grad over-reduction
-    compensation — training on a combined spatial×model mesh there would
-    silently run conv kernels at model_size× the intended LR."""
-    if needs_conv_grad_fix(mesh):
-        raise ValueError(
-            f"combined spatial x model meshes are not supported by the "
-            f"{what}; use a (data[, spatial]) or (data, model) mesh")
-
-
 _overreduction_cache: dict = {}
 
 
-def conv_grad_overreduction_factor(mesh: Mesh) -> float:
-    """Measure XLA's conv-kernel gradient over-reduction on this mesh.
+NO_CONV_GRAD_FIX = {"conv": 1.0, "conv_transpose": 1.0}
+
+
+def conv_grad_overreduction_factor(mesh: Optional[Mesh]) -> dict:
+    """Measure XLA's conv-kernel gradient over-reduction on this mesh,
+    per primitive family: `{"conv": factor, "conv_transpose": factor}`.
 
     On a combined (data, spatial, model) mesh, GSPMD (jax 0.9.0) reduces the
     gradient of a REPLICATED conv kernel over the model axis too whenever the
     conv's output is spatially sharded — each model shard already holds the
     full gradient, so it comes back model_size× too large. Rather than
-    hard-coding the bug, a tiny probe conv measures the actual factor once
-    per mesh shape (cached): when a future XLA fixes the reduction, the probe
-    returns 1.0 and the correction in `rescale_overreduced_conv_grads`
-    disappears with it."""
-    if not needs_conv_grad_fix(mesh):
-        return 1.0
+    hard-coding the bug, tiny probes measure the actual factor once per mesh
+    shape (cached): when a future XLA fixes the reduction, the probes return
+    1.0 and the correction in `rescale_overreduced_conv_grads` disappears
+    with it.
+
+    Probed archetypes (one per way the partitioner can treat the backward):
+    a stride-1 conv; a stride-2 conv (the downsampling family — most of the
+    kernels actually recorded in practice; its kernel-grad lowers through an
+    rhs-dilated backward), a grouped conv (feature_group_count, the depthwise
+    family) and a dilated conv, all three REQUIRED to match the stride-1
+    conv's factor — the rescale classifies every nn.Conv under "conv", so a
+    variant with a different factor would silently mistrain and must raise
+    instead; and a stride-2 ConvTranspose (the upsampling family:
+    Hourglass/GAN decoders), measured separately because
+    `lax.conv_transpose` lowers through a different (lhs-dilated)
+    backward."""
+    if mesh is None or not needs_conv_grad_fix(mesh):
+        return dict(NO_CONV_GRAD_FIX)
     key = (tuple(sorted(mesh.shape.items())),
            tuple(d.id for d in mesh.devices.flat))
     if key in _overreduction_cache:
@@ -251,67 +263,119 @@ def conv_grad_overreduction_factor(mesh: Mesh) -> float:
     batch = mesh.shape[DATA_AXIS]
     model_size = mesh.shape[MODEL_AXIS]
     out_ch = 2 * model_size  # divisible, so the O-sharded probe is valid
-    x = jnp.linspace(-1.0, 1.0, batch * h * h * 2,
-                     dtype=jnp.float32).reshape(batch, h, h, 2)
-    k = jnp.linspace(-0.5, 0.5, 3 * 3 * 2 * out_ch,
-                     dtype=jnp.float32).reshape(3, 3, 2, out_ch)
+    dn = ("NHWC", "HWIO", "NHWC")
 
-    def grad_of_kernel(x, k, constrain):
-        def f(k):
-            y = lax.conv_general_dilated(
-                x, k, window_strides=(1, 1), padding="SAME",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            if constrain:
-                y = jax.lax.with_sharding_constraint(
-                    y, batch_sharding(mesh, 4, dim1=h))
-            return jnp.sum(y * y)
-        return jax.grad(f)(k)
+    def probe(what, op, in_ch, out_h, k_in=None, in_h=None,
+              check_sharded_layout=True):
+        """Median grad ratio (sharded run / unsharded oracle) for one conv
+        archetype, measured for both kernel layouts the train steps produce:
+        replicated (the common case) and model-sharded via
+        param_sharding_rules (large kernels). The rescale is only valid if
+        they agree — a layout-dependent factor would corrupt exactly one
+        class of kernels, so disagreement raises. `check_sharded_layout=False`
+        measures the replicated layout only — used by the grouped/dilated
+        family guards, where the O-sharded grouped probe would itself trip an
+        involuntary-remat fallback (pure probe noise) and the plain-conv
+        probe already covers layout agreement."""
+        k_in = in_ch if k_in is None else k_in  # in_ch // groups for grouped
+        in_h = h if in_h is None else in_h  # 2h for the strided probe, so
+        x = jnp.linspace(-1.0, 1.0,          # its output stays above the floor
+                         batch * in_h * in_h * in_ch,
+                         dtype=jnp.float32).reshape(batch, in_h, in_h, in_ch)
+        k = jnp.linspace(-0.5, 0.5, 3 * 3 * k_in * out_ch,
+                         dtype=jnp.float32).reshape(3, 3, k_in, out_ch)
 
-    oracle = np_.asarray(jax.jit(grad_of_kernel, static_argnums=2)(x, k, False))
-    xs = jax.device_put(x, batch_sharding(mesh, 4, dim1=h))
-    nz = np_.abs(oracle) > 1e-6
+        def grad_of_kernel(x, k, constrain):
+            def f(k):
+                y = op(x, k)
+                if constrain:
+                    y = jax.lax.with_sharding_constraint(
+                        y, batch_sharding(mesh, 4, dim1=out_h))
+                return jnp.sum(y * y)
+            return jax.grad(f)(k)
 
-    def measure(kernel_sharding):
-        ks = jax.device_put(k, kernel_sharding)
-        m = np_.asarray(jax.jit(grad_of_kernel, static_argnums=2)(xs, ks, True))
-        return float(np_.median(m.ravel()[nz.ravel()] / oracle.ravel()[nz.ravel()]))
+        oracle = np_.asarray(jax.jit(grad_of_kernel,
+                                     static_argnums=2)(x, k, False))
+        xs = jax.device_put(x, batch_sharding(mesh, 4, dim1=in_h))
+        nz = np_.abs(oracle) > 1e-6
 
-    # measure BOTH kernel layouts the train steps produce: replicated (the
-    # common case) and model-sharded via param_sharding_rules (large
-    # kernels). On current XLA both come back model_size x; the rescale is
-    # only valid if they agree — a layout-dependent factor would corrupt
-    # exactly one class of kernels, so it raises instead.
-    measured_repl = measure(replicated(mesh))
-    measured_shrd = measure(NamedSharding(mesh, P(None, None, None, MODEL_AXIS)))
-    # snap to the nearest integer: the bug is an extra whole-axis psum, so
-    # real factors are 1 or the model-axis size — anything else means the
-    # probe itself broke (e.g. a future XLA sharding the probe grad some
-    # third way), and dividing grads by it would silently corrupt training
-    factor = float(round(measured_repl))
-    if factor not in (1.0, float(model_size)) or \
-            round(measured_shrd) != factor:
-        raise RuntimeError(
-            f"conv-grad over-reduction probe measured {measured_repl:.4f} "
-            f"(replicated kernel) / {measured_shrd:.4f} (model-sharded "
-            f"kernel) on mesh {dict(mesh.shape)} — expected both 1 (fixed "
-            f"upstream) or both {model_size} (known GSPMD bug). The XLA "
-            f"behavior has changed; re-verify tests/test_spatial.py's "
-            f"combined-mesh oracle before training on this mesh.")
-    _overreduction_cache[key] = factor
-    return factor
+        def measure(kernel_sharding):
+            ks = jax.device_put(k, kernel_sharding)
+            m = np_.asarray(jax.jit(grad_of_kernel,
+                                    static_argnums=2)(xs, ks, True))
+            return float(np_.median(
+                m.ravel()[nz.ravel()] / oracle.ravel()[nz.ravel()]))
+
+        measured_repl = measure(replicated(mesh))
+        measured_shrd = (measure(
+            NamedSharding(mesh, P(None, None, None, MODEL_AXIS)))
+            if check_sharded_layout else measured_repl)
+        # snap to the nearest integer: the bug is an extra whole-axis psum,
+        # so real factors are 1 or the model-axis size — anything else means
+        # the probe itself broke (e.g. a future XLA sharding the probe grad
+        # some third way), and dividing grads by it would corrupt training
+        factor = float(round(measured_repl))
+        if factor not in (1.0, float(model_size)) or \
+                round(measured_shrd) != factor:
+            raise RuntimeError(
+                f"{what} grad over-reduction probe measured "
+                f"{measured_repl:.4f} (replicated kernel) / "
+                f"{measured_shrd:.4f} (model-sharded kernel) on mesh "
+                f"{dict(mesh.shape)} — expected both 1 (fixed upstream) or "
+                f"both {model_size} (known GSPMD bug). The XLA behavior has "
+                f"changed; re-verify tests/test_spatial.py's combined-mesh "
+                f"oracle before training on this mesh.")
+        return factor
+
+    def conv(x, k, **kw):
+        return lax.conv_general_dilated(
+            x, k, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=dn, **kw)
+
+    f_conv = probe("conv", conv, in_ch=2, out_h=h)
+    for what, op, k_in, in_h, check_sharded in (
+            # strided: full layout check — real networks model-shard big
+            # downsampling kernels, and its O-sharded probe is remat-clean
+            ("strided-conv",
+             lambda x, k: lax.conv_general_dilated(
+                 x, k, window_strides=(2, 2), padding="SAME",
+                 dimension_numbers=dn), 2, 2 * h, True),
+            ("grouped-conv",
+             lambda x, k: conv(x, k, feature_group_count=2), 1, None, False),
+            ("dilated-conv",
+             lambda x, k: conv(x, k, rhs_dilation=(2, 2)), 2, None, False)):
+        f = probe(what, op, in_ch=2, out_h=h, k_in=k_in, in_h=in_h,
+                  check_sharded_layout=check_sharded)
+        if f != f_conv:
+            raise RuntimeError(
+                f"{what} grad over-reduction factor {f} != plain conv's "
+                f"{f_conv} on mesh {dict(mesh.shape)}: the uniform 'conv' "
+                f"rescale class would mistrain these kernels. Do not train "
+                f"on this mesh until the rescale distinguishes them.")
+    f_ct = probe(
+        "conv_transpose",
+        lambda x, k: lax.conv_transpose(x, k, strides=(2, 2), padding="SAME",
+                                        dimension_numbers=dn),
+        in_ch=2, out_h=2 * h)
+    factors = {"conv": f_conv, "conv_transpose": f_ct}
+    _overreduction_cache[key] = factors
+    return factors
 
 
-def rescale_overreduced_conv_grads(grads, paths, factor: float):
+def rescale_overreduced_conv_grads(grads, records, factors: dict):
     """Divide the conv-kernel grads recorded by
-    `spatial_activation_constraints(record=...)` by the measured
-    over-reduction factor. No-op when factor == 1.0 (bug fixed upstream) or
-    nothing was recorded."""
-    if not paths or factor == 1.0:
+    `spatial_activation_constraints(record=...)` — entries are
+    `(module_path, kind)` — by the factor measured for that kind. No-op when
+    every factor is 1.0 (bug fixed upstream) or nothing was recorded."""
+    if not records or all(f == 1.0 for f in factors.values()):
         return grads
     from flax.core import FrozenDict, freeze, unfreeze
     was_frozen = isinstance(grads, FrozenDict)
     g = unfreeze(grads)
-    for path in paths:
+    for path, kind in records:
+        factor = factors[kind]
+        if factor == 1.0:
+            continue
         node = g
         for name in path:
             node = node[name]

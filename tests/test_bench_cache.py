@@ -1,0 +1,53 @@
+"""bench.py cache self-authentication (round-2 VERDICT item 1).
+
+Only `_save_cache` writes `cache_written_by`; a cache record lacking it was
+seeded by hand, and `_load_cache` must disclose that as provenance="seeded"
+so the official record can never again pass a doc claim off as a measurement.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "CACHE_PATH", str(tmp_path / "BENCH_CACHE.json"))
+    return mod
+
+
+def test_seeded_record_is_marked(bench, tmp_path):
+    """A hand-written cache entry (no cache_written_by) loads with
+    provenance=seeded."""
+    rec = {"metric": "m", "value": 2353.0, "unit": "images/sec/chip",
+           "vs_baseline": 10.23, "platform": "tpu",
+           "measured_at": "2026-07-30T00:00:00Z"}
+    with open(bench.CACHE_PATH, "w") as fp:
+        json.dump(rec, fp)
+    loaded = bench._load_cache()
+    assert loaded["provenance"] == "seeded"
+
+
+def test_bench_written_record_is_authenticated(bench):
+    """A record persisted by _save_cache round-trips with cache_written_by
+    and WITHOUT the seeded marker."""
+    rec = {"metric": "m", "value": 2353.0, "unit": "images/sec/chip",
+           "vs_baseline": 10.23, "platform": "tpu",
+           "device_kind": "TPU v5e", "jax_version": "0.0-test",
+           "timed_steps": 20}
+    bench._save_cache(rec)
+    loaded = bench._load_cache()
+    assert "provenance" not in loaded
+    assert loaded["cache_written_by"]["program"] == "bench.py"
+    assert loaded["cache_written_by"]["device_kind"] == "TPU v5e"
+    assert loaded["cache_written_by"]["timed_steps"] == 20
+
+
+def test_non_tpu_cache_rejected(bench):
+    bench._save_cache({"metric": "m", "value": 1.0, "platform": "cpu"})
+    assert bench._load_cache() is None

@@ -108,3 +108,38 @@ def test_remat_step_matches_plain_step(mesh8):
         results[False][0], results[True][0])
     np.testing.assert_allclose(results[False][1]["loss"],
                                results[True][1]["loss"], rtol=1e-6)
+
+
+def test_fit_and_close_closes_on_any_exception():
+    """close() must run for EVERY mid-fit exception, not just divergence —
+    an interrupted run's buffered JSONL/TB forensics are exactly the ones
+    worth flushing (round-2 ADVICE)."""
+    import pytest
+
+    from deepvision_tpu.core.trainer import (TrainingDivergedError,
+                                             fit_and_close)
+
+    class FakeTrainer:
+        def __init__(self, exc=None):
+            self.closed = False
+            self.exc = exc
+
+        def fit(self):
+            if self.exc is not None:
+                raise self.exc
+            return {"ok": 1}
+
+        def close(self):
+            self.closed = True
+
+    t = FakeTrainer()
+    assert fit_and_close(t) == {"ok": 1}
+    assert t.closed
+
+    for exc, expected in ((KeyboardInterrupt(), KeyboardInterrupt),
+                          (OSError("disk"), OSError),
+                          (TrainingDivergedError("nan"), SystemExit)):
+        t = FakeTrainer(exc)
+        with pytest.raises(expected):
+            fit_and_close(t)
+        assert t.closed, type(exc).__name__

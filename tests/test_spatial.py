@@ -58,12 +58,17 @@ def test_combined_mesh_allowed_and_probe_measures_factor():
     assert mesh_lib.needs_conv_grad_fix(mesh)
     assert not mesh_lib.needs_conv_grad_fix(_mesh_spatial())
     assert not mesh_lib.needs_conv_grad_fix(mesh_lib.make_mesh(model_parallel=2))
-    assert mesh_lib.conv_grad_overreduction_factor(_mesh_spatial()) == 1.0
+    assert mesh_lib.conv_grad_overreduction_factor(_mesh_spatial()) == \
+        mesh_lib.NO_CONV_GRAD_FIX
     # on current XLA the measured factor is the model-axis size; an upstream
     # fix would legitimately turn this into 1.0 — accept either, but nothing
-    # else (anything in between means the probe itself is broken)
-    factor = mesh_lib.conv_grad_overreduction_factor(mesh)
-    assert factor in (1.0, float(mesh.shape["model"])), factor
+    # else (anything in between means the probe itself is broken). Probed
+    # per primitive family: ConvTranspose lowers through a different
+    # backward, so its factor is measured, not assumed (round-2 ADVICE).
+    factors = mesh_lib.conv_grad_overreduction_factor(mesh)
+    assert set(factors) == {"conv", "conv_transpose"}
+    for kind, factor in factors.items():
+        assert factor in (1.0, float(mesh.shape["model"])), (kind, factor)
 
 
 def test_combined_mesh_train_step_matches_dp_oracle():
@@ -76,8 +81,10 @@ def test_combined_mesh_train_step_matches_dp_oracle():
         # Exercises every conv grad regime on the combined mesh: H 32→16→8→4
         # (sharded-in/sharded-out convs: over-reduced; then below the floor:
         # correct), a ConvTranspose 4→8 (replicated input, sharded output:
-        # NOT over-reduced — must not be rescaled), and a resize-gap conv
-        # (input through a non-module upsample).
+        # NOT over-reduced — must not be rescaled), a ConvTranspose 8→16
+        # (sharded input AND output: the recorded-transpose path, rescaled
+        # by the probe's conv_transpose factor — round-2 ADVICE coverage),
+        # and a resize-gap conv (input through a non-module upsample).
         @nn.compact
         def __call__(self, x, train=True):
             for feat in (8, 16, 16):
@@ -89,8 +96,12 @@ def test_combined_mesh_train_step_matches_dp_oracle():
                                  padding="SAME", use_bias=False)(x)  # H 4→8
             x = nn.BatchNorm(use_running_average=not train)(x)
             x = nn.relu(x)
+            x = nn.ConvTranspose(16, (3, 3), strides=(2, 2),
+                                 padding="SAME", use_bias=False)(x)  # H 8→16
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
             n, hh, ww, c = x.shape
-            x = jax.image.resize(x, (n, hh * 2, ww * 2, c), "nearest")  # →16
+            x = jax.image.resize(x, (n, hh, ww, c), "nearest")  # module gap
             x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
             x = nn.BatchNorm(use_running_average=not train)(x)
             x = nn.relu(x)

@@ -72,23 +72,32 @@ def check_devices(args):
 
 @check("input")
 def check_input(args):
-    import importlib.util
+    import subprocess
 
-    spec = importlib.util.spec_from_file_location(
-        "bench_input_preflight",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "bench_input.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    argv = ["--batch-size", str(args.batch_size),
+    argv = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_input.py"),
+            "--batch-size", str(args.batch_size),
             "--image-size", str(args.image_size),
             "--steps", str(args.input_steps)]
     if args.data_dir:
         argv += ["--data-dir", args.data_dir]
     if args.input_floor is not None:
         argv += ["--floor", str(args.input_floor)]
-    # bench_input prints its JSON line and raises SystemExit below the floor
-    mod.main(argv)
+    # subprocess, NOT in-process, with JAX_PLATFORMS forced to cpu: the input
+    # benchmark is a host tf.data measurement and must neither mutate this
+    # process's backend selection for the later device checks (round-2
+    # ADVICE) nor touch a relayed TPU backend inherited from the session env
+    # (which can wedge for minutes). Below-floor exits nonzero → FAIL line.
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(argv, capture_output=True, text=True, env=child_env,
+                          timeout=900)
+    sys.stdout.write(proc.stdout)
+    if proc.returncode != 0:
+        lines = proc.stderr.strip().splitlines() if proc.stderr else []
+        raise RuntimeError(f"bench_input exited {proc.returncode}: "
+                           f"{lines[-1] if lines else '(no stderr)'}")
     return f"floor={args.input_floor or 'unset'}"
 
 
