@@ -3,10 +3,17 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
           XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-all bench dryrun smoke preflight
+.PHONY: test test-all bench dryrun smoke preflight preflight-record
 
 preflight:   ## pod go/no-go: devices, input floor, train step, ckpt roundtrip
 	$(PY) tools/preflight.py
+
+ROUND ?= 0
+preflight-record: ## run preflight on the virtual mesh, record PREFLIGHT_r$(ROUND).txt
+	{ echo "# preflight transcript, round $(ROUND) ($$(date -u +%Y-%m-%dT%H:%M:%SZ))"; \
+	  echo "# env: JAX_PLATFORMS=cpu, 8 virtual devices (axon tunnel not assumed up)"; \
+	  env $(CPU_ENV) $(PY) tools/preflight.py --batch-size 64 --image-size 64; } \
+	  > PREFLIGHT_r$(ROUND).txt; s=$$?; cat PREFLIGHT_r$(ROUND).txt; exit $$s
 
 test:        ## fast suite (slow-marked compiles excluded)
 	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q

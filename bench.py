@@ -96,6 +96,19 @@ def worker() -> None:
         state, metrics = train_step(state, *sharded, rng)
     float(metrics["loss"])
 
+    # optional XProf capture (the MFU attack path): a few post-warmup steps
+    # traced inside the same killable worker, so a tunnel wedge mid-capture
+    # can't hang the orchestrator
+    profile_dir = os.environ.get("DEEPVISION_BENCH_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+        try:
+            for _ in range(3):
+                state, metrics = train_step(state, *sharded, rng)
+            float(metrics["loss"])
+        finally:
+            jax.profiler.stop_trace()
+
     def timed(n):
         nonlocal state
         t0 = time.perf_counter()

@@ -51,6 +51,20 @@ class MetricsLogger:
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             self._jsonl = open(os.path.join(log_dir, f"{name}.jsonl"), "a")
+            # provenance header: a committed run log must say what hardware
+            # produced it (the role the reference's training logs fill with
+            # their console preamble, `ResNet/pytorch/logs/*.log`)
+            dev = jax.devices()[0]
+            self._jsonl.write(json.dumps({"meta": {
+                "platform": dev.platform,
+                "device_kind": dev.device_kind,
+                "n_devices": jax.device_count(),
+                "process": f"{jax.process_index()}/{jax.process_count()}",
+                "jax_version": jax.__version__,
+                "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+            }}) + "\n")
+            self._jsonl.flush()
         self._t0 = time.time()
 
     def log(self, step: int, metrics: Dict[str, float], epoch: Optional[int] = None,

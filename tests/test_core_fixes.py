@@ -143,3 +143,20 @@ def test_fit_and_close_closes_on_any_exception():
         with pytest.raises(expected):
             fit_and_close(t)
         assert t.closed, type(exc).__name__
+
+
+def test_metrics_jsonl_provenance_header(tmp_path):
+    """The first JSONL line is a meta record naming the platform/device that
+    produced the run — committed run artifacts must be self-describing."""
+    import json
+
+    from deepvision_tpu.core.metrics import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path), name="prov", tensorboard=False)
+    logger.log(1, {"loss": 1.0}, epoch=1, echo=False)
+    logger.close()
+    lines = (tmp_path / "prov.jsonl").read_text().strip().splitlines()
+    meta = json.loads(lines[0])["meta"]
+    assert meta["platform"] == "cpu" and meta["n_devices"] == 8
+    assert "device_kind" in meta and "jax_version" in meta
+    assert json.loads(lines[1])["loss"] == 1.0

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""XLA-flag sweep over the benchmark worker — the MFU attack harness.
+
+Runs `bench.py --worker` once per XLA_FLAGS combination, each in its own
+killable subprocess with a timeout (the axon TPU relay can wedge, not error
+— same defense as bench.py itself), and ranks the surviving measurements.
+One command turns a reachable-chip window into a measured flag table:
+
+    python tools/bench_sweep.py                     # curated TPU combos
+    python tools/bench_sweep.py --flags-file my.txt # one combo per line
+    JAX_PLATFORMS=cpu python tools/bench_sweep.py --timeout 900  # harness test
+
+Output: one JSON line per combo on stdout as results land (combo, value,
+img/s), then a final `{"sweep": ...}` summary line ranking all combos;
+`--out` additionally persists the full list. Flags are APPENDED to any
+XLA_FLAGS already in the environment, so virtual-device setups compose.
+
+The curated list targets the round-2 MFU decomposition (docs/TUNING.md
+"attack map": backward-pass memory traffic dominates): scheduler and
+fusion behavior knobs, not collective knobs (single-chip benchmark).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _run_worker  # noqa: E402  (the killable-worker runner)
+
+# Curated combos, cheapest-to-try first. Each entry: (label, flags).
+DEFAULT_COMBOS = [
+    ("baseline", ""),
+    # overlap host/compute scheduling of independent HLOs
+    ("latency-hiding-scheduler",
+     "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    # larger scoped vmem lets bigger fusions stay on-chip (v5e has 128MiB
+    # CMEM-class vmem; default budget is conservative)
+    ("vmem-64M", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem-96M", "--xla_tpu_scoped_vmem_limit_kib=98304"),
+    # cheaper counter-based RNG lowering (dropout/mixup paths)
+    ("rng-unsafe", "--xla_tpu_spmd_rng_bit_generator_unsafe=true"),
+    ("lhs+vmem-64M",
+     "--xla_tpu_enable_latency_hiding_scheduler=true "
+     "--xla_tpu_scoped_vmem_limit_kib=65536"),
+]
+
+
+def run_combo(flags: str, timeout_s: float):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+    # each combo must compile fresh — a flag that only changes the executable
+    # would otherwise be served the baseline's cached binary
+    env["DEEPVISION_COMPILATION_CACHE"] = "off"
+    return _run_worker(env, timeout_s)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-combo wall clock (compile included)")
+    p.add_argument("--flags-file", default=None,
+                   help="file of XLA flag combos, one per line ('# label' "
+                        "comments name the next combo)")
+    p.add_argument("--out", default=None, help="write full results JSON here")
+    args = p.parse_args(argv)
+
+    combos = DEFAULT_COMBOS
+    if args.flags_file:
+        # baseline always runs first: the summary's best_vs_baseline needs it
+        combos, label = [("baseline", "")], None
+        with open(args.flags_file) as fp:
+            for raw in fp:
+                line = raw.strip()
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    label = line.lstrip("# ")
+                    continue
+                combos.append((label or line, line))
+                label = None
+
+    results = []
+    for label, flags in combos:
+        t0 = time.monotonic()
+        rec = run_combo(flags, args.timeout)
+        took = time.monotonic() - t0
+        row = {"combo": label, "flags": flags, "seconds": round(took, 1)}
+        if rec is None:
+            row["value"] = None  # timeout / crash — itself a result
+        else:
+            row.update(value=rec["value"], unit=rec["unit"],
+                       platform=rec["platform"])
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # rank only rows from the baseline's platform: a mid-sweep TPU-plugin
+    # failure silently degrades one combo to CPU, and a ~100x-lower CPU
+    # number must not be compared against TPU rows (the confusion bench.py's
+    # cache goes out of its way to prevent)
+    ok = [r for r in results if r.get("value")]
+    base_platform = next((r["platform"] for r in ok
+                          if r["combo"] == "baseline"),
+                         ok[0]["platform"] if ok else None)
+    dropped = [r["combo"] for r in ok if r["platform"] != base_platform]
+    if dropped:
+        print(f"warning: dropping cross-platform rows {dropped} "
+              f"(!= {base_platform})", file=sys.stderr)
+    ranked = sorted((r for r in ok if r["platform"] == base_platform),
+                    key=lambda r: -r["value"])
+    summary = {"sweep": [
+        {"combo": r["combo"], "value": r["value"], "platform": r["platform"]}
+        for r in ranked]}
+    if ranked:
+        base = next((r["value"] for r in ranked
+                     if r["combo"] == "baseline"), None)
+        if base:
+            summary["best_vs_baseline"] = round(ranked[0]["value"] / base, 3)
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(results, fp, indent=1)
+            fp.write("\n")
+
+
+if __name__ == "__main__":
+    main()
