@@ -145,12 +145,15 @@ def worker() -> None:
 # orchestrator
 # ---------------------------------------------------------------------------
 
-def _run_worker(env: dict, timeout_s: float):
-    """Run `bench.py --worker` in its own session; return the parsed JSON
-    record or None. killpg reaps tunnel helper processes on timeout."""
+def _run_worker(env: dict, timeout_s: float, argv=None):
+    """Run a measurement worker (default: `bench.py --worker`) in its own
+    session; return the parsed JSON record or None. killpg reaps tunnel
+    helper processes on timeout. `argv` lets other benchmark orchestrators
+    (tools/bench_sweep.py, tools/bench_dispatch.py) reuse the same
+    wedge-proof runner for their own workers."""
     import signal
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--worker"],
+        argv or [sys.executable, os.path.abspath(__file__), "--worker"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         text=True, start_new_session=True)
     try:

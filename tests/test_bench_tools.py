@@ -1,0 +1,47 @@
+"""Cheap logic tests for the benchmark orchestration tools (no workers
+spawned — the worker paths are exercised by running the tools themselves;
+see docs/TUNING.md's on-chip procedure)."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_flags_file_parse(tmp_path):
+    f = tmp_path / "flags.txt"
+    f.write_text("# fast-math-off\n"
+                 "--xla_cpu_enable_fast_math=false\n"
+                 "\n"
+                 "--xla_foo=1 --xla_bar=2\n")
+    combos = _load("bench_sweep").parse_flags_file(str(f))
+    assert combos[0] == ("baseline", "")  # always prepended
+    assert combos[1] == ("fast-math-off", "--xla_cpu_enable_fast_math=false")
+    # unlabeled line: the flags string doubles as the label
+    assert combos[2] == ("--xla_foo=1 --xla_bar=2", "--xla_foo=1 --xla_bar=2")
+
+
+def test_sweep_default_combos_include_baseline():
+    combos = _load("bench_sweep").DEFAULT_COMBOS
+    assert combos[0] == ("baseline", "")
+    assert len({label for label, _ in combos}) == len(combos)  # unique labels
+
+
+def test_dispatch_rejects_indivisible_steps():
+    """--steps must be divisible by every --spd value (a sub-k tail would
+    silently run as single steps and skew the comparison)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bench_dispatch.py"),
+         "--spd", "1,5", "--steps", "48"],
+        capture_output=True, text=True)
+    assert proc.returncode != 0
+    assert "not divisible" in proc.stderr

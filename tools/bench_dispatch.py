@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Dispatch-lever benchmark: measure --steps-per-dispatch / --prefetch-batches.
+
+Round-2 shipped both levers semantics-tested but unmeasured (VERDICT r2
+item 4); this tool produces the missing TUNING.md knob-8 table. Each
+(steps_per_dispatch, prefetch_batches) combo trains the REAL Trainer on
+synthetic data — the lever's value includes the trainer loop and the
+prefetch thread, so a bare-step microbench would flatter it — in its own
+killable subprocess (the axon relay can wedge; same defense as bench.py).
+
+    python tools/bench_dispatch.py                  # full default grid
+    python tools/bench_dispatch.py --spd 1,4,16 --prefetch 2
+    JAX_PLATFORMS=cpu python tools/bench_dispatch.py --steps 8  # harness test
+
+Per-combo output: one JSON line with the steady-state epoch's img/s (epoch 1
+pays the compile; epoch 2 is reported). Final line ranks the grid. Note for
+CPU harness runs: a scanned k-step ResNet-50 is a multi-minute XLA-CPU
+compile — large --spd values need the full --timeout even at --steps 8 (on
+TPU the same compile is tens of seconds).
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _run_worker  # noqa: E402  (the killable-worker runner)
+
+
+def worker(spd: int, prefetch: int, steps: int) -> None:
+    import tempfile
+
+    import jax
+
+    from deepvision_tpu.cli import setup_compilation_cache
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.synthetic import SyntheticClassification
+
+    setup_compilation_cache(os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                           "auto"))
+    platform = jax.devices()[0].platform
+    batch = 256 if platform == "tpu" else 32
+    size = 224 if platform == "tpu" else 64
+
+    cfg = get_config("resnet50").replace(
+        name="bench_dispatch", batch_size=batch, total_epochs=2,
+        steps_per_dispatch=spd, prefetch_batches=prefetch)
+    import dataclasses
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, dataset="synthetic", image_size=size,
+        train_examples=steps * batch, val_examples=0))
+    workdir = tempfile.mkdtemp(prefix="bench_dispatch_")
+    trainer = Trainer(cfg, workdir=workdir)
+    trainer.init_state((size, size, 3))
+
+    def data(epoch):
+        return SyntheticClassification(batch, size, 3, cfg.data.num_classes,
+                                       num_batches=steps, seed=epoch)
+
+    img_per_sec = None
+    for epoch in (1, 2):  # epoch 1 compiles; epoch 2 is the measurement
+        t0 = time.perf_counter()
+        trainer.train_epoch(epoch, data(epoch))
+        dt = time.perf_counter() - t0
+        img_per_sec = steps * batch / dt
+    trainer.close()
+    print(json.dumps({
+        "metric": f"resnet50_dispatch(b{batch},{size}px,{platform},"
+                  f"spd{spd},pf{prefetch})",
+        "value": round(img_per_sec, 2), "unit": "images/sec",
+        "platform": platform, "steps_per_dispatch": spd,
+        "prefetch_batches": prefetch, "steps": steps,
+    }))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--spd", default="1,4,16",
+                   help="steps_per_dispatch values, comma-separated")
+    p.add_argument("--prefetch", default="1,2,4",
+                   help="prefetch_batches values, comma-separated")
+    p.add_argument("--steps", type=int, default=48,
+                   help="steps per epoch (divisible by every --spd value)")
+    p.add_argument("--timeout", type=float, default=1500.0)
+    p.add_argument("--out", default=None)
+    p.add_argument("--worker", nargs=3, type=int, metavar=("SPD", "PF", "N"),
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.worker:
+        return worker(*args.worker)
+
+    spds = [int(v) for v in args.spd.split(",")]
+    prefetches = [int(v) for v in args.prefetch.split(",")]
+    for spd in spds:
+        if args.steps % spd:
+            p.error(f"--steps {args.steps} not divisible by spd {spd}")
+
+    results = []
+    for spd, pf in itertools.product(spds, prefetches):
+        rec = _run_worker(
+            dict(os.environ), args.timeout,
+            argv=[sys.executable, os.path.abspath(__file__),
+                  "--worker", str(spd), str(pf), str(args.steps)])
+        row = rec or {"value": None, "steps_per_dispatch": spd,
+                      "prefetch_batches": pf}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # rank only rows from the first successful row's platform: a mid-grid
+    # TPU-plugin/tunnel failure degrades later workers to CPU, and ranking
+    # ~100x-slower CPU rows against TPU rows would attribute the platform
+    # difference to the lever (same policy as tools/bench_sweep.py)
+    ok = [r for r in results if r.get("value")]
+    base_platform = ok[0]["platform"] if ok else None
+    dropped = [(r["steps_per_dispatch"], r["prefetch_batches"])
+               for r in ok if r["platform"] != base_platform]
+    if dropped:
+        print(f"warning: dropping cross-platform rows {dropped} "
+              f"(!= {base_platform})", file=sys.stderr)
+    summary = {"grid": sorted(
+        ({"spd": r["steps_per_dispatch"], "prefetch": r["prefetch_batches"],
+          "value": r["value"], "platform": r["platform"]}
+         for r in ok if r["platform"] == base_platform),
+        key=lambda r: -r["value"])}
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(results, fp, indent=1)
+            fp.write("\n")
+
+
+if __name__ == "__main__":
+    main()

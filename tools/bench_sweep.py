@@ -50,6 +50,24 @@ DEFAULT_COMBOS = [
 ]
 
 
+def parse_flags_file(path: str):
+    """One combo per non-comment line; a '# label' comment names the next
+    combo. Baseline is always prepended — the summary's vs-baseline ratio
+    needs it."""
+    combos, label = [("baseline", "")], None
+    with open(path) as fp:
+        for raw in fp:
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                label = line.lstrip("# ")
+                continue
+            combos.append((label or line, line))
+            label = None
+    return combos
+
+
 def run_combo(flags: str, timeout_s: float):
     env = dict(os.environ)
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
@@ -69,20 +87,8 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="write full results JSON here")
     args = p.parse_args(argv)
 
-    combos = DEFAULT_COMBOS
-    if args.flags_file:
-        # baseline always runs first: the summary's best_vs_baseline needs it
-        combos, label = [("baseline", "")], None
-        with open(args.flags_file) as fp:
-            for raw in fp:
-                line = raw.strip()
-                if not line:
-                    continue
-                if line.startswith("#"):
-                    label = line.lstrip("# ")
-                    continue
-                combos.append((label or line, line))
-                label = None
+    combos = (parse_flags_file(args.flags_file) if args.flags_file
+              else DEFAULT_COMBOS)
 
     results = []
     for label, flags in combos:
