@@ -25,22 +25,19 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
                               compute_dtype=jnp.bfloat16, donate: bool = True,
                               mesh=None, remat: bool = False,
                               input_norm=None,
-                              log_grad_norm: bool = False) -> Callable:
+                              log_grad_norm: bool = False,
+                         grad_correction=None) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
     `remat=True` recomputes forward activations in backward (cf. steps.py);
     `input_norm=(mean, std)` normalizes raw [0,255] pixels on device."""
-
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
-    # the mesh combines spatial x model (measured once, outside the trace)
 
     def step(state, images, boxes, classes, valid, rng):
         del rng
         images = _normalize_input(images, input_norm, compute_dtype)
         targets = cn_ops.encode_labels(boxes, classes, valid, grid, num_classes)
-        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -57,8 +54,7 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
 
         (loss, (comp, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        grads = mesh_lib.rescale_overreduced_conv_grads(
-            grads, overreduced, grad_fix)
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,
@@ -104,15 +100,21 @@ class CenterNetTrainer(LossWatchedTrainer):
         grid = config.data.image_size // 4  # output stride 4
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self.train_step = make_centernet_train_step(
+        self._step_factory = lambda m, corr: make_centernet_train_step(
             num_classes=config.data.num_classes, grid=grid,
-            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
+            compute_dtype=compute_dtype, mesh=m, remat=config.remat,
             input_norm=input_norm, log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1)
+            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
+
+    def _calibration_batch(self, sample_shape):
+        from .detection import boxes_calibration_batch
+        return boxes_calibration_batch(self.config, sample_shape,
+                                       self._calibration_batch_size())
 
 
 def make_centernet_predict_step(*, compute_dtype=jnp.bfloat16,

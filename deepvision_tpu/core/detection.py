@@ -33,10 +33,31 @@ def yolo_grid_sizes(image_size: int) -> Sequence[int]:
     return (image_size // 8, image_size // 16, image_size // 32)
 
 
+def boxes_calibration_batch(config, sample_shape, batch_size: int):
+    """Synthetic (images, boxes, classes, valid) batch for combined-mesh grad
+    calibration — the padded-GT layout shared by the YOLO and CenterNet
+    steps (`ops/yolo.py::MAX_BOXES`)."""
+    import numpy as np
+
+    from ..ops.yolo import MAX_BOXES
+    rs = np.random.RandomState(0)
+    b = batch_size
+    images = (rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
+              if config.data.normalize_on_device
+              else rs.rand(b, *sample_shape).astype(np.float32))
+    boxes = np.zeros((b, MAX_BOXES, 4), np.float32)
+    boxes[:, 0] = [0.2, 0.2, 0.6, 0.6]
+    classes = np.zeros((b, MAX_BOXES), np.int32)
+    valid = np.zeros((b, MAX_BOXES), np.float32)
+    valid[:, 0] = 1.0
+    return (images, boxes, classes, valid)
+
+
 def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
                          mesh=None, remat: bool = False,
-                         input_norm=None, log_grad_norm: bool = False) -> Callable:
+                         input_norm=None, log_grad_norm: bool = False,
+                         grad_correction=None) -> Callable:
     """(state, images, boxes, classes, valid, rng) -> (state, metrics).
 
     boxes: (B, N, 4) normalized corner ground truth padded to N=MAX_BOXES;
@@ -46,18 +67,14 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
     transfer, `--device-normalize`) and are normalized on device (steps.py).
     """
 
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
-    # the mesh combines spatial x model (measured once, outside the trace)
-
     def step(state, images, boxes, classes, valid, rng):
         del rng  # YOLO has no dropout; augmentation happens host-side
         images = _normalize_input(images, input_norm, compute_dtype)
         classes_onehot = jax.nn.one_hot(classes, num_classes, dtype=jnp.float32)
         y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid, grid_sizes)
-        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -76,8 +93,7 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
 
         (loss, (comp, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        grads = mesh_lib.rescale_overreduced_conv_grads(
-            grads, overreduced, grad_fix)
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss,
@@ -187,12 +203,17 @@ class DetectionTrainer(LossWatchedTrainer):
         grids = yolo_grid_sizes(config.data.image_size)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self.train_step = make_yolo_train_step(
+        self._step_factory = lambda m, corr: make_yolo_train_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
-            compute_dtype=compute_dtype, mesh=self.mesh, remat=config.remat,
+            compute_dtype=compute_dtype, mesh=m, remat=config.remat,
             input_norm=input_norm, log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1)
+            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
+
+    def _calibration_batch(self, sample_shape):
+        return boxes_calibration_batch(self.config, sample_shape,
+                                       self._calibration_batch_size())

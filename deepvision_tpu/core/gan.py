@@ -165,37 +165,31 @@ class AdversarialTrainer:
 # ---------------------------------------------------------------------------
 
 def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
-                          noise_dim: int, mesh=None,
-                          donate: bool = True) -> Callable:
+                          noise_dim: int, mesh=None, donate: bool = True,
+                          gen_grad_correction=None,
+                          disc_grad_correction=None) -> Callable:
     """(gen_state, disc_state, images, rng) -> (gen_state, disc_state, metrics).
 
     Both gradient sets are computed against the pre-update parameters (the
     two-tape semantics of `DCGAN/tensorflow/main.py:59-71`); XLA CSEs the shared
     generator forward.
 
-    Combined spatial×model meshes are supported: each network's forward runs
-    under `spatial_activation_constraints` with its OWN record set (module
-    paths are relative to each `apply`'s root, so the two networks' records
-    must not mix), and each gradient set is rescaled by the probe-measured
-    conv-grad over-reduction factor (`mesh_lib.conv_grad_overreduction_factor`)
-    — the same compensation the supervised steps carry (core/steps.py).
+    Combined spatial×model meshes: each network's gradients are divided by
+    its measured per-leaf over-reduction correction
+    (`mesh_lib.calibrate_grad_correction`; the trainer calibrates both and
+    rebuilds this step) — the same compensation the supervised steps carry.
     """
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
 
     def step(gen_state: TrainState, disc_state: TrainState, images, rng):
         rng = jax.random.fold_in(rng, gen_state.step)
         rng_z, rng_d1, rng_d2, rng_d3 = jax.random.split(rng, 4)
         noise = jax.random.normal(rng_z, (images.shape[0], noise_dim))
-        g_rec: set = set()  # filled at trace time by the interceptor
-        d_rec: set = set()
 
         def gen_loss_fn(gp):
-            with mesh_lib.spatial_activation_constraints(mesh, g_rec):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 fake, mut = gen_apply(
                     {"params": gp, "batch_stats": gen_state.batch_stats},
                     noise, train=True, mutable=["batch_stats"])
-            # disc params are constants here — pin activations, record nothing
-            with mesh_lib.spatial_activation_constraints(mesh):
                 fake_logits = disc_apply(
                     {"params": disc_state.params}, fake, train=True,
                     rngs={"dropout": rng_d1})
@@ -203,11 +197,10 @@ def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
 
         (g_loss, (fake, g_mut)), g_grads = jax.value_and_grad(
             gen_loss_fn, has_aux=True)(gen_state.params)
-        g_grads = mesh_lib.rescale_overreduced_conv_grads(
-            g_grads, g_rec, grad_fix)
+        g_grads = mesh_lib.apply_grad_correction(g_grads, gen_grad_correction)
 
         def disc_loss_fn(dp):
-            with mesh_lib.spatial_activation_constraints(mesh, d_rec):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 real_logits = disc_apply({"params": dp}, images, train=True,
                                          rngs={"dropout": rng_d2})
                 fake_logits = disc_apply({"params": dp},
@@ -216,8 +209,7 @@ def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
             return _bce_logits(real_logits, 1.0) + _bce_logits(fake_logits, 0.0)
 
         d_loss, d_grads = jax.value_and_grad(disc_loss_fn)(disc_state.params)
-        d_grads = mesh_lib.rescale_overreduced_conv_grads(
-            d_grads, d_rec, grad_fix)
+        d_grads = mesh_lib.apply_grad_correction(d_grads, disc_grad_correction)
 
         new_gen = gen_state.apply_gradients(g_grads).replace(
             batch_stats=g_mut.get("batch_stats", gen_state.batch_stats))
@@ -264,9 +256,40 @@ class DCGANTrainer(AdversarialTrainer):
             TrainState.create(self.discriminator.apply, d_params, tx_d, d_bs),
             repl)
 
-        self.train_step = make_dcgan_train_step(
+        step_factory = lambda m, gc, dc: make_dcgan_train_step(  # noqa: E731
             self.generator.apply, self.discriminator.apply, noise_dim,
-            mesh=self.mesh)
+            mesh=m, gen_grad_correction=gc, disc_grad_correction=dc)
+        self.train_step = step_factory(self.mesh, None, None)
+        if mesh_lib.needs_conv_grad_fix(self.mesh):
+            # measure both networks' per-leaf grad over-reduction in one
+            # paired run (the tuple pytree calibrates gen and disc together)
+            import optax
+
+            # pad so the batch also shards on the all-device DP oracle mesh
+            cal_b = mesh_lib.pad_to_multiple(
+                config.batch_size, len(self.mesh.devices.flat))
+            images = np.random.RandomState(0).uniform(
+                -1, 1, (cal_b, 28, 28, 1)).astype(np.float32)
+            g0 = jax.device_get(self.gen_state.params)
+            d0 = jax.device_get(self.disc_state.params)
+            gbs = jax.device_get(self.gen_state.batch_stats)
+            rng = jax.random.PRNGKey(0)
+
+            def run(m):
+                repl = mesh_lib.replicated(m)
+                gst = jax.device_put(TrainState.create(
+                    self.generator.apply, g0, optax.sgd(1.0), gbs), repl)
+                dst = jax.device_put(TrainState.create(
+                    self.discriminator.apply, d0, optax.sgd(1.0)), repl)
+                step = step_factory(m, None, None)
+                batch = mesh_lib.shard_batch_pytree(m, images)
+                gst, dst, _ = step(gst, dst, batch, rng)
+                return ((g0, d0), (jax.device_get(gst.params),
+                                   jax.device_get(dst.params)))
+
+            corr = mesh_lib.calibrate_grad_correction(run, self.mesh)
+            if corr is not None:
+                self.train_step = step_factory(self.mesh, corr[0], corr[1])
         self._init_logging(config, workdir)
 
     def train_batch(self, images) -> dict:
@@ -294,7 +317,7 @@ LAMBDA_ID = 5.0
 
 
 def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
-                                 mesh=None) -> Callable:
+                                 mesh=None, grad_correction=None) -> Callable:
     """Generator phase (`train.py:150-205`): one loss over both generators.
 
     gen_state.params = {"a2b": …, "b2a": …}; disc_state.params = {"a": …, "b": …}.
@@ -302,21 +325,18 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
     discriminator forward passes run train=True (keras side-effect parity), so
     their mutated batch_stats are threaded back to the caller.
 
-    Combined spatial×model meshes: each named generator records its own
-    sharded-conv module paths (paths are relative to one `gen_apply` root,
-    and grads live under gparams[name]), and its grad subtree is rescaled by
-    the probe-measured over-reduction factor — see make_dcgan_train_step.
+    `grad_correction` matches gen_state.params' {"a2b": …, "b2a": …} nesting
+    (calibrated per-leaf by the trainer on combined meshes) — see
+    make_dcgan_train_step.
     """
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
 
     def step(gen_state: TrainState, disc_state: TrainState, real_a, real_b):
-        recs = {"a2b": set(), "b2a": set()}  # filled at trace time
 
         def loss_fn(gparams):
             bs = dict(gen_state.batch_stats)
 
             def g(name, x):
-                with mesh_lib.spatial_activation_constraints(mesh, recs[name]):
+                with mesh_lib.spatial_activation_constraints(mesh):
                     y, mut = gen_apply(
                         {"params": gparams[name], "batch_stats": bs[name]},
                         x, train=True, mutable=["batch_stats"])
@@ -333,7 +353,6 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
             dbs = dict(disc_state.batch_stats)
 
             def d(name, x):
-                # disc params are constants in this phase: pin, record nothing
                 with mesh_lib.spatial_activation_constraints(mesh):
                     y, mut = disc_apply(
                         {"params": disc_state.params[name],
@@ -361,8 +380,7 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
 
         (_, (bs, dbs, fake_a2b, fake_b2a, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(gen_state.params)
-        grads = {name: mesh_lib.rescale_overreduced_conv_grads(
-            grads[name], recs[name], grad_fix) for name in grads}
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_gen = gen_state.apply_gradients(grads).replace(batch_stats=bs)
         return new_gen, dbs, fake_a2b, fake_b2a, metrics
 
@@ -374,20 +392,20 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
     return jax.jit(step, **jit_kwargs)
 
 
-def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None) -> Callable:
+def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None,
+                                     grad_correction=None) -> Callable:
     """Discriminator phase (`train.py:207-246`): (real+fake)/2 LSGAN per domain,
     one optimizer over both discriminators. Fakes come from the host ImagePool.
-    Combined-mesh conv-grad compensation as in make_cyclegan_generator_step."""
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)
+    `grad_correction` matches disc_state.params' {"a": …, "b": …} nesting —
+    combined-mesh compensation as in make_cyclegan_generator_step."""
 
     def step(disc_state: TrainState, real_a, real_b, fake_a2b, fake_b2a):
-        recs = {"a": set(), "b": set()}  # filled at trace time
 
         def loss_fn(dparams):
             bs = dict(disc_state.batch_stats)
 
             def d(name, x):
-                with mesh_lib.spatial_activation_constraints(mesh, recs[name]):
+                with mesh_lib.spatial_activation_constraints(mesh):
                     y, mut = disc_apply(
                         {"params": dparams[name], "batch_stats": bs[name]},
                         x, train=True, mutable=["batch_stats"])
@@ -405,8 +423,7 @@ def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None) -> Callabl
 
         (_, (bs, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(disc_state.params)
-        grads = {name: mesh_lib.rescale_overreduced_conv_grads(
-            grads[name], recs[name], grad_fix) for name in grads}
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_disc = disc_state.apply_gradients(grads).replace(batch_stats=bs)
         return new_disc, metrics
 
@@ -461,10 +478,67 @@ class CycleGANTrainer(AdversarialTrainer):
             self.generator.apply, self.discriminator.apply, mesh=self.mesh)
         self.disc_step = make_cyclegan_discriminator_step(
             self.discriminator.apply, mesh=self.mesh)
+        if mesh_lib.needs_conv_grad_fix(self.mesh):
+            self._calibrate(config, image_size)
         # one pool per fake stream (`train.py:55-56`)
         self.pool_a2b = ImagePool(pool_size, seed=config.seed)
         self.pool_b2a = ImagePool(pool_size, seed=config.seed + 1)
         self._init_logging(config, workdir)
+
+    def _calibrate(self, config: TrainConfig, image_size: int) -> None:
+        """Combined-mesh grad calibration for BOTH phases: each phase's
+        gradients live in its own optimizer, so each gets its own measured
+        per-leaf correction (mesh_lib.calibrate_grad_correction) and its
+        step is rebuilt with it."""
+        import optax
+        rs = np.random.RandomState(0)
+        # pad so the batch also shards on the all-device DP oracle mesh
+        cal_b = mesh_lib.pad_to_multiple(config.batch_size,
+                                         len(self.mesh.devices.flat))
+        shp = (cal_b, image_size, image_size, 3)
+        a = rs.uniform(-1, 1, shp).astype(np.float32)
+        b = rs.uniform(-1, 1, shp).astype(np.float32)
+        fa = rs.uniform(-1, 1, shp).astype(np.float32)
+        fb = rs.uniform(-1, 1, shp).astype(np.float32)
+        g0 = jax.device_get(self.gen_state.params)
+        d0 = jax.device_get(self.disc_state.params)
+        gbs = jax.device_get(self.gen_state.batch_stats)
+        dbs = jax.device_get(self.disc_state.batch_stats)
+
+        def states(m):
+            repl = mesh_lib.replicated(m)
+            gst = jax.device_put(TrainState.create(
+                self.generator.apply, g0, optax.sgd(1.0), gbs), repl)
+            dst = jax.device_put(TrainState.create(
+                self.discriminator.apply, d0, optax.sgd(1.0), dbs), repl)
+            return gst, dst
+
+        def run_gen(m):
+            gst, dst = states(m)
+            step = make_cyclegan_generator_step(
+                self.generator.apply, self.discriminator.apply, mesh=m)
+            ra, rb = mesh_lib.shard_batch_pytree(m, (a, b))
+            gst, *_ = step(gst, dst, ra, rb)
+            return g0, jax.device_get(gst.params)
+
+        def run_disc(m):
+            _, dst = states(m)
+            step = make_cyclegan_discriminator_step(
+                self.discriminator.apply, mesh=m)
+            ra, rb, sfa, sfb = mesh_lib.shard_batch_pytree(m, (a, b, fa, fb))
+            dst, _ = step(dst, ra, rb, sfa, sfb)
+            return d0, jax.device_get(dst.params)
+
+        gcorr = mesh_lib.calibrate_grad_correction(run_gen, self.mesh)
+        if gcorr is not None:
+            self.gen_step = make_cyclegan_generator_step(
+                self.generator.apply, self.discriminator.apply,
+                mesh=self.mesh, grad_correction=gcorr)
+        dcorr = mesh_lib.calibrate_grad_correction(run_disc, self.mesh)
+        if dcorr is not None:
+            self.disc_step = make_cyclegan_discriminator_step(
+                self.discriminator.apply, mesh=self.mesh,
+                grad_correction=dcorr)
 
     def train_batch(self, images_a: np.ndarray, images_b: np.ndarray) -> dict:
         """One eager-outer step: jitted gen phase → host pools → jitted disc

@@ -45,7 +45,8 @@ def weighted_mse_loss(labels: jnp.ndarray, outputs) -> jnp.ndarray:
 def make_pose_train_step(*, heatmap_size: Tuple[int, int],
                          compute_dtype=jnp.bfloat16, donate: bool = True,
                          mesh=None, remat: bool = False,
-                         input_norm=None, log_grad_norm: bool = False) -> Callable:
+                         input_norm=None, log_grad_norm: bool = False,
+                         grad_correction=None) -> Callable:
     """(state, images, kp_x, kp_y, visibility, rng) -> (state, metrics).
 
     kp_x/kp_y: (B, K) normalized keypoints; visibility: (B, K). `remat=True`
@@ -54,19 +55,15 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
     """
     h, w = heatmap_size
 
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
-    # the mesh combines spatial x model (measured once, outside the trace)
-
     def step(state, images, kp_x, kp_y, visibility, rng):
         del rng
         images = _normalize_input(images, input_norm, compute_dtype)
         labels = jax.vmap(
             lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
                 kp_x, kp_y, visibility)
-        overreduced: set = set()
 
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"])
@@ -82,8 +79,7 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
 
         (loss, mutated), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        grads = mesh_lib.rescale_overreduced_conv_grads(
-            grads, overreduced, grad_fix)
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss, **maybe_grad_norm(log_grad_norm, grads)}
@@ -133,11 +129,24 @@ class PoseTrainer(LossWatchedTrainer):
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self.train_step = make_pose_train_step(
-            heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
+        self._step_factory = lambda m, corr: make_pose_train_step(
+            heatmap_size=hm, compute_dtype=compute_dtype, mesh=m,
             remat=config.remat, input_norm=input_norm,
             log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1)
+            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
+
+    def _calibration_batch(self, sample_shape):
+        import numpy as np
+        rs = np.random.RandomState(0)
+        b, k = self._calibration_batch_size(), self.config.data.num_classes
+        images = (rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
+                  if self.config.data.normalize_on_device
+                  else rs.rand(b, *sample_shape).astype(np.float32))
+        kp_x = rs.rand(b, k).astype(np.float32)
+        kp_y = rs.rand(b, k).astype(np.float32)
+        visibility = np.ones((b, k), np.float32)
+        return (images, kp_x, kp_y, visibility)

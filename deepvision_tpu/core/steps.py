@@ -59,8 +59,15 @@ def make_classification_train_step(
     cutmix_alpha: float = 0.0,
     input_norm: Optional[tuple] = None,
     log_grad_norm: bool = False,
+    grad_correction=None,
 ) -> Callable:
     """Build a jitted `(state, images, labels, rng) -> (state, metrics)` step.
+
+    `grad_correction`: per-leaf divisor pytree from
+    `mesh_lib.calibrate_grad_correction` — required for correct training on
+    combined spatial×model meshes (the Trainer calibrates and rebuilds the
+    step automatically; direct users of this function on such meshes must do
+    the same, see tools/verify_mesh.py).
 
     `remat=True` wraps the forward in `jax.checkpoint`: activations are
     recomputed during the backward pass instead of living in HBM — the standard
@@ -84,8 +91,6 @@ def make_classification_train_step(
     if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
         raise ValueError("mixup_alpha and cutmix_alpha are mutually exclusive")
     mixing = mixup_alpha > 0.0 or cutmix_alpha > 0.0
-    grad_fix = mesh_lib.conv_grad_overreduction_factor(mesh)  # 1.0 unless
-    # the mesh combines spatial x model (measured once, outside the trace)
 
     def step(state: TrainState, images, labels, rng):
         images = _normalize_input(images, input_norm, compute_dtype)
@@ -126,10 +131,8 @@ def make_classification_train_step(
             images = jnp.where(in_box[None, :, :, None], images[perm], images)
             lam = 1.0 - in_box.mean()  # exact fraction, kept f32
 
-        overreduced: set = set()  # filled at trace time by the interceptor
-
         def forward(params, images):
-            with mesh_lib.spatial_activation_constraints(mesh, overreduced):
+            with mesh_lib.spatial_activation_constraints(mesh):
                 return state.apply_fn(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=True, mutable=["batch_stats"],
@@ -155,8 +158,7 @@ def make_classification_train_step(
 
         (loss, (outputs, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)
-        grads = mesh_lib.rescale_overreduced_conv_grads(
-            grads, overreduced, grad_fix)
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
         new_state = state.apply_gradients(grads).replace(
             batch_stats=mutated.get("batch_stats", state.batch_stats))
         metrics = {"loss": loss, **losses.topk_accuracies(outputs, labels),
@@ -193,7 +195,10 @@ def make_multistep_train_step(step_fn: Callable, k: int, n_batch_args: int,
     per-dispatch EMA would decay k× too slowly). Returned metrics are the
     mean over the k steps. Build the wrapped `step_fn` with donate=False —
     its own donation cannot apply inside this trace; the wrapper donates
-    the state and the staged batches at the outer jit instead."""
+    the state at the outer jit instead. The staged batches are NOT donated:
+    jax donation is output aliasing, and no output matches a batch buffer —
+    donating them buys nothing and makes every dispatch warn 'donated
+    buffers were not usable'."""
     if k < 2:
         raise ValueError(f"steps_per_dispatch wrapper needs k >= 2, got {k}")
 
@@ -223,7 +228,7 @@ def make_multistep_train_step(step_fn: Callable, k: int, n_batch_args: int,
         state, metrics = jax.lax.scan(body, state, stacked)
         return state, jax.tree_util.tree_map(lambda m: m.mean(axis=0), metrics)
 
-    jit_kwargs = {"donate_argnums": tuple(range(0, 1 + k * n_batch_args))}
+    jit_kwargs = {"donate_argnums": (0,)}
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
     return jax.jit(multi, **jit_kwargs)

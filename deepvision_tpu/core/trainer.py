@@ -187,13 +187,20 @@ class Trainer:
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = ((config.data.mean, config.data.std)
                       if config.data.normalize_on_device else None)
-        self.train_step = steps.make_classification_train_step(
+        # A FACTORY, not just a step: on combined spatial×model meshes the
+        # step must be rebuilt with the measured per-leaf grad correction
+        # (mesh_lib.calibrate_grad_correction, run in init_state) — and the
+        # calibration itself needs throwaway steps on other meshes.
+        # Subclasses that install their own train_step must also install
+        # the matching _step_factory (+ _calibration_batch).
+        self._step_factory = lambda m, corr: steps.make_classification_train_step(
             label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
-            compute_dtype=compute_dtype, mesh=self.mesh,
+            compute_dtype=compute_dtype, mesh=m,
             remat=config.remat, mixup_alpha=config.mixup_alpha,
             cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
             log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1)
+            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        self.train_step = self._step_factory(self.mesh, None)
         # steps_per_dispatch > 1: built lazily on first epoch (train_epoch),
         # AFTER subclasses have installed their family's train_step
         self._multi_step = None
@@ -264,7 +271,72 @@ class Trainer:
                   f"params={param_count(params):,} "
                   f"mesh={dict(self.mesh.shape)} "
                   f"steps/epoch={self.steps_per_epoch}", flush=True)
+        self._calibrate_grad_correction(sample_shape)
         return state
+
+    def _calibration_batch_size(self) -> int:
+        """Calibration batches shard on BOTH the target mesh and the
+        all-device DP oracle mesh — pad the configured batch up to the total
+        device count (a combined mesh's data axis is smaller than the device
+        count, so small valid batch sizes need not divide it)."""
+        return mesh_lib.pad_to_multiple(self.config.batch_size,
+                                        len(self.mesh.devices.flat))
+
+    def _calibration_batch(self, sample_shape):
+        """Synthetic batch matching this family's train_step contract, used
+        only to calibrate the combined-mesh grad correction. Subclasses with
+        different batch tuples override."""
+        rs = np.random.RandomState(0)
+        b = self._calibration_batch_size()
+        if self.config.data.normalize_on_device:
+            images = rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
+        else:
+            images = rs.randn(b, *sample_shape).astype(np.float32)
+        labels = rs.randint(0, self.config.data.num_classes,
+                            size=(b,)).astype(np.int32)
+        return (images, labels)
+
+    def _calibrate_grad_correction(self, sample_shape) -> None:
+        """On combined spatial×model meshes: measure the per-leaf gradient
+        over-reduction of THIS model at THIS resolution/batch (GSPMD's
+        spurious model-axis psum is per-op and context-dependent — see
+        mesh_lib.calibrate_grad_correction) and rebuild train_step with the
+        correction. Costs two extra compiles + two steps, once per init."""
+        if not mesh_lib.needs_conv_grad_fix(self.mesh):
+            return
+        import optax
+        batch = self._calibration_batch(sample_shape)
+        params0 = jax.device_get(self.state.params)
+        bs0 = jax.device_get(self.state.batch_stats)
+        rng = jax.random.PRNGKey(0)
+
+        def run(m):
+            # fresh sgd(1.0) state: update == -grad, so per-leaf update
+            # norms measure grad norms (the real optimizer may be adam,
+            # whose first step is scale-invariant and would hide the factor)
+            st = TrainState.create(self.model.apply, params0, optax.sgd(1.0),
+                                   bs0)
+            repl = mesh_lib.replicated(m)
+            st = st.replace(
+                params=jax.device_put(
+                    st.params, mesh_lib.param_sharding_rules(m, st.params)),
+                batch_stats=jax.device_put(st.batch_stats, repl),
+                opt_state=jax.device_put(st.opt_state, repl),
+                step=jax.device_put(st.step, repl))
+            step = self._step_factory(m, None)
+            sharded = mesh_lib.shard_batch_pytree(m, batch)
+            new_state, _ = step(st, *sharded, rng)
+            return params0, jax.device_get(new_state.params)
+
+        correction = mesh_lib.calibrate_grad_correction(run, self.mesh)
+        if correction is not None:
+            self.train_step = self._step_factory(self.mesh, correction)
+            self._multi_step = None  # rebuilt lazily from the corrected step
+            if _is_main_process():
+                n = sum(1 for f in jax.tree_util.tree_leaves(correction)
+                        if f != 1.0)
+                print(f"[{self.config.name}] combined-mesh grad calibration: "
+                      f"{n} param leaves corrected", flush=True)
 
     def resume(self, epoch: Optional[int] = None) -> Optional[int]:
         """Restore latest (or given) checkpoint — the `-c` / auto-resume UX

@@ -143,7 +143,8 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
     ds = ds.map(lambda s: preprocess(*parse_example(s, tf), image_size, training,
                                      tf, normalize_on_host=normalize_on_host,
                                      mean=mean, std=std),
-                num_parallel_calls=num_parallel_calls or AUTOTUNE)
+                num_parallel_calls=num_parallel_calls or AUTOTUNE,
+                deterministic=not training)
     ds = ds.batch(batch_size, drop_remainder=True)
     ds = ds.prefetch(AUTOTUNE)
     return ds

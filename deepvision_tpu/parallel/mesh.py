@@ -47,9 +47,8 @@ def make_mesh(
     # "big param" together): XLA (jax 0.9.0) over-reduces replicated
     # conv-kernel gradients by the model-axis size on such meshes when the
     # conv's output is spatially sharded (b/433785288-adjacent GSPMD bug),
-    # and the train-step builders compensate — see
-    # `rescale_overreduced_conv_grads` + `conv_grad_overreduction_factor`
-    # (measured at runtime, so an upstream fix auto-disables the correction).
+    # and the trainers compensate with a per-leaf MEASURED correction — see
+    # `calibrate_grad_correction` (so an upstream fix auto-disables it).
     # Grad parity vs the single-device oracle: tests/test_spatial.py.
     if n % (model_parallel * spatial_parallel) != 0:
         raise ValueError(
@@ -144,8 +143,7 @@ def shard_batch_pytree(mesh: Mesh, batch):
     return jax.tree_util.tree_map(_put, batch)
 
 
-def spatial_activation_constraints(mesh: Optional[Mesh],
-                                   record: Optional[set] = None):
+def spatial_activation_constraints(mesh: Optional[Mesh]):
     """Context manager for a model forward on a spatial mesh: pin every
     rank-4 flax module output to (data, spatial|None, None, None).
 
@@ -157,15 +155,6 @@ def spatial_activation_constraints(mesh: Optional[Mesh],
     module boundary makes the layout an explicit contract: H stays sharded
     exactly while it's worth sharding, and the transition to batch-only
     happens at a module edge the partitioner handles efficiently.
-
-    `record` (a set, combined spatial×model meshes only): collects
-    `(module_path, kind)` for every conv-like module (owns a rank-4 'kernel'
-    param) whose output gets pinned spatial-sharded — exactly the kernels
-    whose gradients XLA over-reduces by the model-axis size (see
-    `rescale_overreduced_conv_grads`). `kind` distinguishes ConvTranspose
-    from regular convs because the over-reduction factor is probed per
-    primitive family (`conv_grad_overreduction_factor`). Filled at trace
-    time.
 
     No-op (nullcontext) on non-spatial meshes — model-parallel layouts are
     chosen by `param_sharding_rules` and need no activation pinning."""
@@ -181,28 +170,8 @@ def spatial_activation_constraints(mesh: Optional[Mesh],
         return jax.lax.with_sharding_constraint(
             x, batch_sharding(mesh, 4, dim1=x.shape[1]))
 
-    def _any_spatial_sharded(tree) -> bool:
-        return any(isinstance(v, jax.Array) and v.ndim == 4
-                   and _spatial_divides(mesh, v.shape[1])
-                   for v in jax.tree_util.tree_leaves(tree))
-
     def interceptor(next_fun, args, kwargs, context):
         out = next_fun(*args, **kwargs)
-        # Over-reduction (measured, see conv_grad_overreduction_factor) hits
-        # a conv kernel iff BOTH its input and its output carry the spatial
-        # sharding; a conv entered or exited below the floor computes its
-        # grad on replicated-H operands and is reduced correctly. (A conv
-        # fed through a non-module gap — resize/reshape — has no pinned
-        # input; GSPMD shards such a gap whenever H divides, which is what
-        # the H-divisibility test on the raw input argument predicts.)
-        if (record is not None and _any_spatial_sharded(args)
-                and _any_spatial_sharded(out)
-                and context.module.has_variable("params", "kernel")
-                and context.module.get_variable("params", "kernel").ndim == 4):
-            kind = ("conv_transpose"
-                    if isinstance(context.module, nn.ConvTranspose)
-                    else "conv")
-            record.add((context.module.path, kind))
         return jax.tree_util.tree_map(
             _constrain, out, is_leaf=lambda v: isinstance(v, jax.Array))
 
@@ -212,175 +181,80 @@ def spatial_activation_constraints(mesh: Optional[Mesh],
 def needs_conv_grad_fix(mesh: Optional[Mesh]) -> bool:
     """True on combined spatial×model meshes — the layouts where XLA
     over-reduces replicated conv-kernel grads (see
-    `conv_grad_overreduction_factor`)."""
+    `calibrate_grad_correction`)."""
     return (mesh is not None and has_spatial(mesh)
             and dict(mesh.shape).get(MODEL_AXIS, 1) > 1)
 
 
-_overreduction_cache: dict = {}
-
-
-NO_CONV_GRAD_FIX = {"conv": 1.0, "conv_transpose": 1.0}
-
-
-def conv_grad_overreduction_factor(mesh: Optional[Mesh]) -> dict:
-    """Measure XLA's conv-kernel gradient over-reduction on this mesh,
-    per primitive family: `{"conv": factor, "conv_transpose": factor}`.
-
-    On a combined (data, spatial, model) mesh, GSPMD (jax 0.9.0) reduces the
-    gradient of a REPLICATED conv kernel over the model axis too whenever the
-    conv's output is spatially sharded — each model shard already holds the
-    full gradient, so it comes back model_size× too large. Rather than
-    hard-coding the bug, tiny probes measure the actual factor once per mesh
-    shape (cached): when a future XLA fixes the reduction, the probes return
-    1.0 and the correction in `rescale_overreduced_conv_grads` disappears
-    with it.
-
-    Probed archetypes (one per way the partitioner can treat the backward):
-    a stride-1 conv; a stride-2 conv (the downsampling family — most of the
-    kernels actually recorded in practice; its kernel-grad lowers through an
-    rhs-dilated backward), a grouped conv (feature_group_count, the depthwise
-    family) and a dilated conv, all three REQUIRED to match the stride-1
-    conv's factor — the rescale classifies every nn.Conv under "conv", so a
-    variant with a different factor would silently mistrain and must raise
-    instead; and a stride-2 ConvTranspose (the upsampling family:
-    Hourglass/GAN decoders), measured separately because
-    `lax.conv_transpose` lowers through a different (lhs-dilated)
-    backward."""
-    if mesh is None or not needs_conv_grad_fix(mesh):
-        return dict(NO_CONV_GRAD_FIX)
-    key = (tuple(sorted(mesh.shape.items())),
-           tuple(d.id for d in mesh.devices.flat))
-    if key in _overreduction_cache:
-        return _overreduction_cache[key]
-    import jax.numpy as jnp
-    from jax import lax
-
-    import numpy as np_
-
-    sp = mesh.shape[SPATIAL_AXIS]
-    h = sp * MIN_SPATIAL_ROWS  # smallest H the floor keeps spatial-sharded
-    batch = mesh.shape[DATA_AXIS]
-    model_size = mesh.shape[MODEL_AXIS]
-    out_ch = 2 * model_size  # divisible, so the O-sharded probe is valid
-    dn = ("NHWC", "HWIO", "NHWC")
-
-    def probe(what, op, in_ch, out_h, k_in=None, in_h=None,
-              check_sharded_layout=True):
-        """Median grad ratio (sharded run / unsharded oracle) for one conv
-        archetype, measured for both kernel layouts the train steps produce:
-        replicated (the common case) and model-sharded via
-        param_sharding_rules (large kernels). The rescale is only valid if
-        they agree — a layout-dependent factor would corrupt exactly one
-        class of kernels, so disagreement raises. `check_sharded_layout=False`
-        measures the replicated layout only — used by the grouped/dilated
-        family guards, where the O-sharded grouped probe would itself trip an
-        involuntary-remat fallback (pure probe noise) and the plain-conv
-        probe already covers layout agreement."""
-        k_in = in_ch if k_in is None else k_in  # in_ch // groups for grouped
-        in_h = h if in_h is None else in_h  # 2h for the strided probe, so
-        x = jnp.linspace(-1.0, 1.0,          # its output stays above the floor
-                         batch * in_h * in_h * in_ch,
-                         dtype=jnp.float32).reshape(batch, in_h, in_h, in_ch)
-        k = jnp.linspace(-0.5, 0.5, 3 * 3 * k_in * out_ch,
-                         dtype=jnp.float32).reshape(3, 3, k_in, out_ch)
-
-        def grad_of_kernel(x, k, constrain):
-            def f(k):
-                y = op(x, k)
-                if constrain:
-                    y = jax.lax.with_sharding_constraint(
-                        y, batch_sharding(mesh, 4, dim1=out_h))
-                return jnp.sum(y * y)
-            return jax.grad(f)(k)
-
-        oracle = np_.asarray(jax.jit(grad_of_kernel,
-                                     static_argnums=2)(x, k, False))
-        xs = jax.device_put(x, batch_sharding(mesh, 4, dim1=in_h))
-        nz = np_.abs(oracle) > 1e-6
-
-        def measure(kernel_sharding):
-            ks = jax.device_put(k, kernel_sharding)
-            m = np_.asarray(jax.jit(grad_of_kernel,
-                                    static_argnums=2)(xs, ks, True))
-            return float(np_.median(
-                m.ravel()[nz.ravel()] / oracle.ravel()[nz.ravel()]))
-
-        measured_repl = measure(replicated(mesh))
-        measured_shrd = (measure(
-            NamedSharding(mesh, P(None, None, None, MODEL_AXIS)))
-            if check_sharded_layout else measured_repl)
-        # snap to the nearest integer: the bug is an extra whole-axis psum,
-        # so real factors are 1 or the model-axis size — anything else means
-        # the probe itself broke (e.g. a future XLA sharding the probe grad
-        # some third way), and dividing grads by it would corrupt training
-        factor = float(round(measured_repl))
-        if factor not in (1.0, float(model_size)) or \
-                round(measured_shrd) != factor:
-            raise RuntimeError(
-                f"{what} grad over-reduction probe measured "
-                f"{measured_repl:.4f} (replicated kernel) / "
-                f"{measured_shrd:.4f} (model-sharded kernel) on mesh "
-                f"{dict(mesh.shape)} — expected both 1 (fixed upstream) or "
-                f"both {model_size} (known GSPMD bug). The XLA behavior has "
-                f"changed; re-verify tests/test_spatial.py's combined-mesh "
-                f"oracle before training on this mesh.")
-        return factor
-
-    def conv(x, k, **kw):
-        return lax.conv_general_dilated(
-            x, k, window_strides=(1, 1), padding="SAME",
-            dimension_numbers=dn, **kw)
-
-    f_conv = probe("conv", conv, in_ch=2, out_h=h)
-    for what, op, k_in, in_h, check_sharded in (
-            # strided: full layout check — real networks model-shard big
-            # downsampling kernels, and its O-sharded probe is remat-clean
-            ("strided-conv",
-             lambda x, k: lax.conv_general_dilated(
-                 x, k, window_strides=(2, 2), padding="SAME",
-                 dimension_numbers=dn), 2, 2 * h, True),
-            ("grouped-conv",
-             lambda x, k: conv(x, k, feature_group_count=2), 1, None, False),
-            ("dilated-conv",
-             lambda x, k: conv(x, k, rhs_dilation=(2, 2)), 2, None, False)):
-        f = probe(what, op, in_ch=2, out_h=h, k_in=k_in, in_h=in_h,
-                  check_sharded_layout=check_sharded)
-        if f != f_conv:
-            raise RuntimeError(
-                f"{what} grad over-reduction factor {f} != plain conv's "
-                f"{f_conv} on mesh {dict(mesh.shape)}: the uniform 'conv' "
-                f"rescale class would mistrain these kernels. Do not train "
-                f"on this mesh until the rescale distinguishes them.")
-    f_ct = probe(
-        "conv_transpose",
-        lambda x, k: lax.conv_transpose(x, k, strides=(2, 2), padding="SAME",
-                                        dimension_numbers=dn),
-        in_ch=2, out_h=2 * h)
-    factors = {"conv": f_conv, "conv_transpose": f_ct}
-    _overreduction_cache[key] = factors
-    return factors
-
-
-def rescale_overreduced_conv_grads(grads, records, factors: dict):
-    """Divide the conv-kernel grads recorded by
-    `spatial_activation_constraints(record=...)` — entries are
-    `(module_path, kind)` — by the factor measured for that kind. No-op when
-    every factor is 1.0 (bug fixed upstream) or nothing was recorded."""
-    if not records or all(f == 1.0 for f in factors.values()):
+def apply_grad_correction(grads, correction):
+    """Divide each grad leaf by its measured over-reduction factor
+    (`calibrate_grad_correction`). No-op when correction is None. The
+    divisors are Python floats closed over at trace time — XLA folds the
+    (mostly 1.0) divisions away."""
+    if correction is None:
         return grads
-    from flax.core import FrozenDict, freeze, unfreeze
-    was_frozen = isinstance(grads, FrozenDict)
-    g = unfreeze(grads)
-    for path, kind in records:
-        factor = factors[kind]
-        if factor == 1.0:
-            continue
-        node = g
-        for name in path:
-            node = node[name]
-        node["kernel"] = node["kernel"] / factor
-    return freeze(g) if was_frozen else g
+    return jax.tree_util.tree_map(lambda g, f: g if f == 1.0 else g / f,
+                                  grads, correction)
+
+
+def calibrate_grad_correction(run_one_step, mesh: Mesh, *,
+                              norm_rtol: float = 0.2):
+    """MEASURE the per-leaf gradient over-reduction of an actual model on a
+    combined spatial×model mesh; return a per-leaf divisor pytree for
+    `apply_grad_correction` (None when no leaf needs correcting).
+
+    GSPMD (jax 0.9.0) inserts a spurious model-axis psum into SOME gradient
+    computations when activations are spatially sharded — and which ops are
+    hit is context-dependent: within one ResNet-50, seven of eight 1x1
+    bottleneck convs came back over-reduced and the eighth (`proj`) did not,
+    while an isolated 1x1 probe measured no over-reduction at all. No
+    archetype probe can predict that, so the correction is calibrated on the
+    WHOLE model: `run_one_step(m)` must run ONE seeded train step from an
+    identical init on mesh `m` with a LINEAR optimizer (update ∝ grad; sgd —
+    adam's first step is gradient-scale-invariant and would hide the factor)
+    and return `(init_params, updated_params)` pytrees. It is invoked twice:
+    on the pure-DP oracle mesh (same devices, no spatial axis — grads
+    provably correct, see tests/test_spatial.py) and on the target mesh,
+    uncorrected. Each leaf's update-norm ratio is snapped to {1, model_size};
+    anything in between (beyond norm_rtol, wide against the <=3% sync-BN
+    reassociation noise) means XLA's behavior changed shape — raise rather
+    than train wrong.
+
+    Cost: two extra step compiles + two steps, once per trainer init, only
+    on combined meshes. Caveat: the DP oracle replicates params, so models
+    that NEED model sharding to fit don't have a runnable oracle — true of
+    none of the vision models here."""
+    if not needs_conv_grad_fix(mesh):
+        return None
+    model_size = dict(mesh.shape)[MODEL_AXIS]
+    init_o, got_o = run_one_step(make_mesh(list(mesh.devices.flat)))
+    init_t, got_t = run_one_step(mesh)
+
+    changed = [False]
+
+    def leaf_factor(path, io, go, it, gt):
+        no = float(np.linalg.norm(np.asarray(go) - np.asarray(io)))
+        nt = float(np.linalg.norm(np.asarray(gt) - np.asarray(it)))
+        if no < 1e-8 and nt < 1e-8:
+            return 1.0  # untouched leaf (frozen / zero grad on both meshes)
+        r = nt / max(no, 1e-12)
+        snapped = min((1.0, float(model_size)), key=lambda c: abs(r - c))
+        if abs(r - snapped) > norm_rtol * snapped:
+            raise RuntimeError(
+                f"grad-correction calibration: leaf "
+                f"{jax.tree_util.keystr(path)} update-norm ratio {r:.3f} "
+                f"(target mesh {dict(mesh.shape)} / DP oracle) snaps to "
+                f"neither 1 nor model_size={model_size} within "
+                f"{norm_rtol:.0%} — XLA's partitioning behavior has changed "
+                f"shape; do not train on this mesh until "
+                f"tests/test_spatial.py's combined-mesh oracle is re-verified.")
+        if snapped != 1.0:
+            changed[0] = True
+        return snapped
+
+    correction = jax.tree_util.tree_map_with_path(
+        leaf_factor, init_o, got_o, init_t, got_t)
+    return correction if changed[0] else None
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -14,6 +14,10 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               step returns a finite loss on the mesh
   checkpoint  an Orbax save/restore roundtrip in the workdir's filesystem
               (the pod's real checkpoint target when --workdir is given)
+  mesh_parity (--verify-mesh only) one seeded train step on the requested
+              spatial/model mesh matches the pure-DP oracle per-leaf
+              (tools/verify_mesh.py — run before the first run on a new
+              mesh shape)
 
 Run it on every host of a slice (same command via --worker=all); a host
 that fails `input` will starve the chips, one that fails `checkpoint`
@@ -152,6 +156,43 @@ def check_step(args):
             f"(~{args.batch_size / max(step_s, 1e-9):.0f} img/s)")
 
 
+@check("mesh_parity")
+def check_mesh_parity(args):
+    import subprocess
+
+    import jax
+
+    from deepvision_tpu.configs import get_config
+
+    model = get_config(args.model).model  # config name -> registry model name
+    argv = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "verify_mesh.py"),
+            "-m", model,
+            "--spatial-parallel", str(args.spatial_parallel),
+            "--model-parallel", str(args.model_parallel)]
+    # CPU with virtual devices, NOT the parent's backend: preflight already
+    # holds the TPU in-process (check_devices/check_step), so a child trying
+    # to claim it would fail — and GSPMD partitioning (what mesh parity
+    # validates) is a compile-time property, the same on the virtual mesh.
+    n_dev = len(jax.devices())
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env["XLA_FLAGS"] = (
+        child_env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_dev}").strip()
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(argv, capture_output=True, text=True, env=child_env,
+                          timeout=1800)
+    if proc.returncode != 0:
+        # stderr carries the traceback; stdout the parity report — show both
+        lines = ((proc.stderr.strip() + "\n" + proc.stdout.strip())
+                 .strip().splitlines())
+        raise RuntimeError("; ".join(lines[-4:]) if lines else
+                           f"verify_mesh exited {proc.returncode}")
+    lines = proc.stdout.strip().splitlines()
+    return (lines[-1] if lines else "ok") + f" [cpu x{n_dev} virtual]"
+
+
 @check("checkpoint")
 def check_checkpoint(args):
     import numpy as np
@@ -205,6 +246,13 @@ def main(argv=None):
     p.add_argument("--workdir", default=None,
                    help="checkpoint roundtrip target (use the run's real "
                         "workdir to validate its filesystem)")
+    p.add_argument("--verify-mesh", action="store_true",
+                   help="also run tools/verify_mesh.py: one seeded train "
+                        "step on the requested mesh must match the pure-DP "
+                        "oracle per-leaf (adds a couple of compiles; "
+                        "recommended before the first run on a new "
+                        "spatial/model mesh shape). Classification configs "
+                        "only — like preflight's own step check")
     args = p.parse_args(argv)
 
     import jax
@@ -218,6 +266,8 @@ def main(argv=None):
     check_devices(args)
     check_input(args)
     check_step(args)
+    if args.verify_mesh:
+        check_mesh_parity(args)
     check_checkpoint(args)
 
     ok = all(RESULTS)
