@@ -455,3 +455,40 @@ def test_trainer_init_calibrates_on_combined_mesh(tmp_path):
     metrics = trainer.train_epoch(1, data)
     trainer.close()
     assert metrics and all(np.isfinite(v) for v in metrics.values()), metrics
+
+
+def test_calibrate_grad_correction_snapping_and_raise():
+    """Pure-logic contract of calibrate_grad_correction: ratios snap to
+    {1, model_size} within the tolerance, an in-between ratio raises (XLA
+    behavior changed shape — do not train), and an all-ones measurement
+    collapses to None."""
+    mesh = _mesh_combined()  # model_size = 2
+
+    def runner(factors):
+        """Fake run_one_step: target-mesh updates scaled per leaf."""
+        init = {"a": np.zeros(4, np.float32), "b": np.zeros(4, np.float32)}
+
+        def run(m):
+            scale = (factors if mesh_lib.needs_conv_grad_fix(m)
+                     else {"a": 1.0, "b": 1.0})
+            return init, {k: np.full(4, scale[k], np.float32)
+                          for k in init}
+        return run
+
+    corr = mesh_lib.calibrate_grad_correction(
+        runner({"a": 2.03, "b": 0.98}), mesh)  # noisy 2x and 1x
+    assert corr == {"a": 2.0, "b": 1.0}
+
+    assert mesh_lib.calibrate_grad_correction(
+        runner({"a": 1.01, "b": 0.99}), mesh) is None  # nothing to correct
+
+    with pytest.raises(RuntimeError, match="snaps to neither"):
+        mesh_lib.calibrate_grad_correction(runner({"a": 1.5, "b": 1.0}), mesh)
+
+
+def test_apply_grad_correction():
+    grads = {"w": jnp.ones(3), "v": jnp.full(3, 4.0)}
+    assert mesh_lib.apply_grad_correction(grads, None) is grads
+    out = mesh_lib.apply_grad_correction(grads, {"w": 1.0, "v": 2.0})
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["v"]), 2.0)
