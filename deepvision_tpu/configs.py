@@ -215,3 +215,28 @@ CONFIGS.register("objects_as_points", _CENTERNET.replace(
 
 def get_config(name: str) -> TrainConfig:
     return CONFIGS.get(name)
+
+
+# Adversarial configs use the two-network AdversarialTrainer machinery in
+# core/gan.py, not the supervised Trainer families below.
+GAN_CONFIGS = frozenset({"dcgan", "cyclegan"})
+
+
+def trainer_class_for_config(name: str):
+    """Supervised trainer family for a config name, used by the tools that
+    accept ANY config (tools/verify_mesh.py, tools/preflight.py). Returns
+    None for adversarial configs; unknown names default to the
+    classification Trainer — KEEP THIS MAPPING IN SYNC when registering a
+    new non-classification config (the per-family CLIs import their trainer
+    directly and will not catch the omission)."""
+    if name in GAN_CONFIGS:
+        return None
+    from .core.centernet import CenterNetTrainer
+    from .core.detection import DetectionTrainer
+    from .core.pose import PoseTrainer
+    from .core.trainer import Trainer
+    return {
+        "yolov3": DetectionTrainer, "yolov3_voc": DetectionTrainer,
+        "hourglass104": PoseTrainer,
+        "centernet": CenterNetTrainer, "objects_as_points": CenterNetTrainer,
+    }.get(name, Trainer)

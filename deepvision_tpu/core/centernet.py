@@ -111,10 +111,11 @@ class CenterNetTrainer(LossWatchedTrainer):
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
 
-    def _calibration_batch(self, sample_shape):
+    def _calibration_batch(self, sample_shape, seed: int = 0):
         from .detection import boxes_calibration_batch
         return boxes_calibration_batch(self.config, sample_shape,
-                                       self._calibration_batch_size())
+                                       self._calibration_batch_size(),
+                                       seed=seed)
 
 
 def make_centernet_predict_step(*, compute_dtype=jnp.bfloat16,

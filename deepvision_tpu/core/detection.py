@@ -33,14 +33,15 @@ def yolo_grid_sizes(image_size: int) -> Sequence[int]:
     return (image_size // 8, image_size // 16, image_size // 32)
 
 
-def boxes_calibration_batch(config, sample_shape, batch_size: int):
+def boxes_calibration_batch(config, sample_shape, batch_size: int,
+                            seed: int = 0):
     """Synthetic (images, boxes, classes, valid) batch for combined-mesh grad
     calibration — the padded-GT layout shared by the YOLO and CenterNet
     steps (`ops/yolo.py::MAX_BOXES`)."""
     import numpy as np
 
     from ..ops.yolo import MAX_BOXES
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(seed)
     b = batch_size
     images = (rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
               if config.data.normalize_on_device
@@ -214,6 +215,7 @@ class DetectionTrainer(LossWatchedTrainer):
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
 
-    def _calibration_batch(self, sample_shape):
+    def _calibration_batch(self, sample_shape, seed: int = 0):
         return boxes_calibration_batch(self.config, sample_shape,
-                                       self._calibration_batch_size())
+                                       self._calibration_batch_size(),
+                                       seed=seed)

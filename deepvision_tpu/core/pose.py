@@ -139,9 +139,9 @@ class PoseTrainer(LossWatchedTrainer):
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
 
-    def _calibration_batch(self, sample_shape):
+    def _calibration_batch(self, sample_shape, seed: int = 0):
         import numpy as np
-        rs = np.random.RandomState(0)
+        rs = np.random.RandomState(seed)
         b, k = self._calibration_batch_size(), self.config.data.num_classes
         images = (rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
                   if self.config.data.normalize_on_device

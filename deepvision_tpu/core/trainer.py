@@ -282,11 +282,12 @@ class Trainer:
         return mesh_lib.pad_to_multiple(self.config.batch_size,
                                         len(self.mesh.devices.flat))
 
-    def _calibration_batch(self, sample_shape):
+    def _calibration_batch(self, sample_shape, seed: int = 0):
         """Synthetic batch matching this family's train_step contract, used
-        only to calibrate the combined-mesh grad correction. Subclasses with
-        different batch tuples override."""
-        rs = np.random.RandomState(0)
+        to calibrate the combined-mesh grad correction (seed 0) and, with a
+        DIFFERENT seed, as independent data for tools/verify_mesh.py's
+        parity check. Subclasses with different batch tuples override."""
+        rs = np.random.RandomState(seed)
         b = self._calibration_batch_size()
         if self.config.data.normalize_on_device:
             images = rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)

@@ -108,15 +108,21 @@ def check_input(args):
 @check("step")
 def check_step(args):
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
 
+    trainer_cls = trainer_class_for_config(args.model)
+    if trainer_cls is None:
+        raise RuntimeError(
+            f"config {args.model!r} is adversarial — preflight the GAN "
+            f"mains with their own --synthetic smoke runs instead")
     cfg = get_config(args.model).replace(
         batch_size=args.batch_size, model_parallel=args.model_parallel,
         spatial_parallel=args.spatial_parallel)
+    import dataclasses
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data,
+                                               image_size=args.image_size))
     # explicit temp workdir: workdir=None falls back to cfg.checkpoint_dir
     # ("checkpoints" under the cwd) — preflight must not litter or fail on
     # a read-only cwd. try/finally: a FAILed check must not leak the
@@ -124,15 +130,16 @@ def check_step(args):
     tmpdir = tempfile.TemporaryDirectory(prefix="preflight_step_")
     trainer = None
     try:
-        trainer = Trainer(cfg, workdir=tmpdir.name)
-        trainer.init_state((args.image_size, args.image_size, 3))
-        rs = np.random.RandomState(0)
-        images = rs.randn(args.batch_size, args.image_size, args.image_size,
-                          3).astype(np.float32)
-        labels = rs.randint(0, cfg.data.num_classes,
-                            size=(args.batch_size,)).astype(np.int32)
+        trainer = trainer_cls(cfg, workdir=tmpdir.name)
+        sample_shape = (args.image_size, args.image_size, cfg.data.channels)
+        trainer.init_state(sample_shape)
+        # the family's own synthetic batch contract (images+labels / padded
+        # boxes / keypoints) — so detection/pose/CenterNet configs preflight
+        # through their REAL train step, not the classification one
+        batch = trainer._calibration_batch(sample_shape)
+        bsz = batch[0].shape[0]  # may exceed --batch-size (device padding)
         from deepvision_tpu.parallel import mesh as mesh_lib
-        batch = mesh_lib.shard_batch_pytree(trainer.mesh, (images, labels))
+        batch = mesh_lib.shard_batch_pytree(trainer.mesh, batch)
         t0 = time.perf_counter()
         state, metrics = trainer.train_step(trainer.state, *batch,
                                             jax.random.PRNGKey(0))
@@ -153,7 +160,7 @@ def check_step(args):
         tmpdir.cleanup()
     return (f"model={cfg.model} loss={loss:.3f} compile={compile_s:.1f}s "
             f"step={step_s * 1000:.0f}ms "
-            f"(~{args.batch_size / max(step_s, 1e-9):.0f} img/s)")
+            f"(~{bsz / max(step_s, 1e-9):.0f} img/s)")
 
 
 @check("mesh_parity")
@@ -162,13 +169,10 @@ def check_mesh_parity(args):
 
     import jax
 
-    from deepvision_tpu.configs import get_config
-
-    model = get_config(args.model).model  # config name -> registry model name
     argv = [sys.executable,
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "verify_mesh.py"),
-            "-m", model,
+            "-m", args.model,  # config name: selects the trainer family too
             "--spatial-parallel", str(args.spatial_parallel),
             "--model-parallel", str(args.model_parallel)]
     # CPU with virtual devices, NOT the parent's backend: preflight already
@@ -251,8 +255,8 @@ def main(argv=None):
                         "step on the requested mesh must match the pure-DP "
                         "oracle per-leaf (adds a couple of compiles; "
                         "recommended before the first run on a new "
-                        "spatial/model mesh shape). Classification configs "
-                        "only — like preflight's own step check")
+                        "spatial/model mesh shape). Runs the config's real "
+                        "trainer family (classification/YOLO/pose/CenterNet)")
     args = p.parse_args(argv)
 
     import jax
