@@ -9,6 +9,7 @@ completes.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepvision_tpu.ops import centernet as cn
 from deepvision_tpu.ops.yolo import MAX_BOXES
@@ -221,3 +222,28 @@ def test_detect_cli_tool(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "no checkpoint found" in out
     assert f"{img}: 5 detections" in out
+
+
+@pytest.mark.slow
+def test_centernet_refuses_combined_mesh(tmp_path):
+    """CenterNet's hourglass is genuinely mis-partitioned by GSPMD on
+    combined spatial×model meshes (stem-BN bias grad measured 486× the DP
+    oracle — no uniform rescale corrects that), so the init-time grad
+    calibration must REFUSE the mesh with the remedy named, instead of
+    training wrong. Pure-spatial and pure-model meshes are verified exact
+    (tools/verify_mesh.py, ARCHITECTURE.md support matrix)."""
+    import dataclasses
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.centernet import CenterNetTrainer
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    cfg = get_config("centernet").replace(batch_size=8, dtype="float32")
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, image_size=128))
+    mesh = mesh_lib.make_mesh(spatial_parallel=2, model_parallel=2)
+    trainer = CenterNetTrainer(cfg, mesh=mesh, workdir=str(tmp_path))
+    try:
+        with pytest.raises(RuntimeError, match="mis-partitions"):
+            trainer.init_state((128, 128, 3))
+    finally:
+        trainer.close()
