@@ -492,3 +492,32 @@ def test_apply_grad_correction():
     out = mesh_lib.apply_grad_correction(grads, {"w": 1.0, "v": 2.0})
     np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
     np.testing.assert_allclose(np.asarray(out["v"]), 2.0)
+
+
+@pytest.mark.slow
+def test_detection_and_pose_trainers_calibrate_on_combined_mesh(tmp_path):
+    """The remaining two supervised families on the combined (2,2,2) mesh:
+    init_state runs the grad calibration and one synthetic step trains
+    finite (resnet50's oracle parity and centernet's refusal are pinned
+    elsewhere; tools/verify_mesh.py reproduces the full measured matrix)."""
+    import dataclasses
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+
+    cases = [("yolov3_voc", 64), ("hourglass104", 128)]
+    mesh = _mesh_combined()
+    for name, size in cases:
+        cfg = get_config(name).replace(batch_size=8, dtype="float32")
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, image_size=size))
+        trainer_cls = trainer_class_for_config(name)
+        trainer = trainer_cls(cfg, mesh=mesh, workdir=str(tmp_path / name))
+        try:
+            shape = (size, size, cfg.data.channels)
+            trainer.init_state(shape)
+            batch = mesh_lib.shard_batch_pytree(
+                mesh, trainer._calibration_batch(shape, seed=3))
+            state, metrics = trainer.train_step(trainer.state, *batch,
+                                                jax.random.PRNGKey(0))
+            assert np.isfinite(float(np.asarray(metrics["loss"]))), name
+        finally:
+            trainer.close()
