@@ -104,6 +104,11 @@ class DataConfig:
 class TrainConfig:
     name: str = "model"
     model: str = "resnet50"
+    # Trainer family this config trains under: classification | detection |
+    # pose | centernet | gan. Carried on the config itself so generic tools
+    # (preflight, verify_mesh) resolve the right train step without a
+    # hand-maintained name→trainer map that can drift from the registry.
+    family: str = "classification"
     model_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     batch_size: int = 256           # global batch
     eval_batch_size: Optional[int] = None

@@ -53,19 +53,24 @@ class MetricsLogger:
             self._jsonl = open(os.path.join(log_dir, f"{name}.jsonl"), "a")
             # provenance header: a committed run log must say what hardware
             # produced it (the role the reference's training logs fill with
-            # their console preamble, `ResNet/pytorch/logs/*.log`)
-            dev = jax.devices()[0]
-            self._jsonl.write(json.dumps({"meta": {
-                "platform": dev.platform,
-                "device_kind": dev.device_kind,
-                "n_devices": jax.device_count(),
-                "process": f"{jax.process_index()}/{jax.process_count()}",
-                "jax_version": jax.__version__,
-                "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                            time.gmtime()),
-            }}) + "\n")
-            self._jsonl.flush()
+            # their console preamble, `ResNet/pytorch/logs/*.log`). Written
+            # only when the file is new/empty so auto-resumed runs keep the
+            # "first line is the meta header" contract (runs/README.md).
+            if self._jsonl.tell() == 0:
+                dev = jax.devices()[0]
+                self._write_meta_header(dev)
         self._t0 = time.time()
+
+    def _write_meta_header(self, dev):
+        self._jsonl.write(json.dumps({"meta": {
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "n_devices": jax.device_count(),
+            "process": f"{jax.process_index()}/{jax.process_count()}",
+            "jax_version": jax.__version__,
+            "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }}) + "\n")
+        self._jsonl.flush()
 
     def log(self, step: int, metrics: Dict[str, float], epoch: Optional[int] = None,
             prefix: str = "", echo: bool = True):

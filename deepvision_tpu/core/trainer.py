@@ -274,11 +274,17 @@ class Trainer:
         self._calibrate_grad_correction(sample_shape)
         return state
 
+    _calibration_batch_size_override: Optional[int] = None
+
     def _calibration_batch_size(self) -> int:
         """Calibration batches shard on BOTH the target mesh and the
         all-device DP oracle mesh — pad the configured batch up to the total
         device count (a combined mesh's data axis is smaller than the device
-        count, so small valid batch sizes need not divide it)."""
+        count, so small valid batch sizes need not divide it). The padded
+        shape can differ from production; `_calibrate_grad_correction`
+        re-verifies at the real batch via the override."""
+        if self._calibration_batch_size_override is not None:
+            return self._calibration_batch_size_override
         return mesh_lib.pad_to_multiple(self.config.batch_size,
                                         len(self.mesh.devices.flat))
 
@@ -305,29 +311,13 @@ class Trainer:
         correction. Costs two extra compiles + two steps, once per init."""
         if not mesh_lib.needs_conv_grad_fix(self.mesh):
             return
-        import optax
         batch = self._calibration_batch(sample_shape)
         params0 = jax.device_get(self.state.params)
         bs0 = jax.device_get(self.state.batch_stats)
-        rng = jax.random.PRNGKey(0)
 
-        def run(m):
-            # fresh sgd(1.0) state: update == -grad, so per-leaf update
-            # norms measure grad norms (the real optimizer may be adam,
-            # whose first step is scale-invariant and would hide the factor)
-            st = TrainState.create(self.model.apply, params0, optax.sgd(1.0),
-                                   bs0)
-            repl = mesh_lib.replicated(m)
-            st = st.replace(
-                params=jax.device_put(
-                    st.params, mesh_lib.param_sharding_rules(m, st.params)),
-                batch_stats=jax.device_put(st.batch_stats, repl),
-                opt_state=jax.device_put(st.opt_state, repl),
-                step=jax.device_put(st.step, repl))
-            step = self._step_factory(m, None)
-            sharded = mesh_lib.shard_batch_pytree(m, batch)
-            new_state, _ = step(st, *sharded, rng)
-            return params0, jax.device_get(new_state.params)
+        def run(m, correction=None):
+            return self._run_calibration_step(m, batch, params0, bs0,
+                                              correction)
 
         correction = mesh_lib.calibrate_grad_correction(run, self.mesh)
         if correction is not None:
@@ -338,6 +328,71 @@ class Trainer:
                         if f != 1.0)
                 print(f"[{self.config.name}] combined-mesh grad calibration: "
                       f"{n} param leaves corrected", flush=True)
+            self._verify_correction_at_production_batch(
+                sample_shape, params0, bs0, correction)
+
+    def _run_calibration_step(self, m, batch, params0, bs0, correction=None):
+        """One seeded train step on mesh `m` from the given init with a fresh
+        sgd(1.0) state: update == -grad, so per-leaf update norms measure
+        grad norms (the real optimizer may be adam, whose first step is
+        gradient-scale-invariant and would hide a rescale bug). Returns
+        `(init_params, updated_params)` host pytrees."""
+        import optax
+        st = TrainState.create(self.model.apply, params0, optax.sgd(1.0), bs0)
+        repl = mesh_lib.replicated(m)
+        st = st.replace(
+            params=jax.device_put(
+                st.params, mesh_lib.param_sharding_rules(m, st.params)),
+            batch_stats=jax.device_put(st.batch_stats, repl),
+            opt_state=jax.device_put(st.opt_state, repl),
+            step=jax.device_put(st.step, repl))
+        step = self._step_factory(m, correction)
+        sharded = mesh_lib.shard_batch_pytree(m, batch)
+        new_state, _ = step(st, *sharded, jax.random.PRNGKey(0))
+        return params0, jax.device_get(new_state.params)
+
+    def _verify_correction_at_production_batch(self, sample_shape, params0,
+                                               bs0, correction) -> None:
+        """Calibration runs at a batch padded up to the total device count,
+        which can differ from the production batch when batch_size is only
+        divisible by the data axis. GSPMD's spurious psum is context-
+        dependent ('THIS resolution/batch'), so measured factors might not
+        transfer: run one CORRECTED step at the real batch shape on the
+        target mesh and cross-check per-leaf update norms against a
+        same-batch DP oracle restricted to data-axis-many devices. Costs two
+        extra compiles, only when the padded shape differs."""
+        b_real = self.config.batch_size
+        data_axis = dict(self.mesh.shape)[mesh_lib.DATA_AXIS]
+        if (b_real == self._calibration_batch_size()
+                or b_real % data_axis != 0):
+            return  # calibration already at production shape / unshardable
+        if jax.process_count() > 1:
+            # the restricted DP oracle below holds only process-0 devices;
+            # other processes could not address it. Residual risk documented:
+            # on pods, calibration ran at the padded batch only.
+            if _is_main_process():
+                print(f"[{self.config.name}] grad correction: production-"
+                      f"batch verify skipped on multi-process runs "
+                      f"(calibrated at padded batch "
+                      f"{self._calibration_batch_size()})", flush=True)
+            return
+        self._calibration_batch_size_override = b_real
+        try:
+            batch = self._calibration_batch(sample_shape)
+        finally:
+            self._calibration_batch_size_override = None
+        oracle_mesh = mesh_lib.make_mesh(
+            list(self.mesh.devices.flat)[:data_axis])
+        oracle = self._run_calibration_step(oracle_mesh, batch, params0, bs0)
+        target = self._run_calibration_step(self.mesh, batch, params0, bs0,
+                                            correction)
+        mesh_lib.verify_update_parity(
+            oracle, target,
+            context=(f" (corrected step at production batch {b_real} on "
+                     f"mesh {dict(self.mesh.shape)})"))
+        if _is_main_process():
+            print(f"[{self.config.name}] grad correction verified at "
+                  f"production batch {b_real}", flush=True)
 
     def resume(self, epoch: Optional[int] = None) -> Optional[int]:
         """Restore latest (or given) checkpoint — the `-c` / auto-resume UX

@@ -230,24 +230,16 @@ def calibrate_grad_correction(run_one_step, mesh: Mesh, *,
     init_o, got_o = run_one_step(make_mesh(list(mesh.devices.flat)))
     init_t, got_t = run_one_step(mesh)
 
-    flat_io, treedef = jax.tree_util.tree_flatten_with_path(init_o)
-    rows = []
-    for (path, io), go, it, gt in zip(flat_io,
-                                      jax.tree_util.tree_leaves(got_o),
-                                      jax.tree_util.tree_leaves(init_t),
-                                      jax.tree_util.tree_leaves(got_t)):
-        no = float(np.linalg.norm(np.asarray(go) - np.asarray(io)))
-        nt = float(np.linalg.norm(np.asarray(gt) - np.asarray(it)))
-        rows.append((path, no, nt))
+    rows, treedef, global_no = _update_norm_rows(
+        init_o, got_o, init_t, got_t, what="grad-correction calibration")
+    if global_no == 0.0:
+        return None  # fully frozen / zero-grad model: nothing to correct
     # significance floor: a leaf contributing <0.1% of the global update
     # norm (<1e-6 of the squared update) is a near-cancelling sum whose
     # ratio is dominated by float reassociation across layouts (hourglass
     # biases measured 10-55% off at norms 1e-8..1e-3 while every weight
     # matched) — and a factor error there could not affect training
     # measurably anyway. Skipped unless ONE side blows past the floor.
-    global_no = float(np.sqrt(sum(no * no for _, no, _ in rows)))
-    if global_no == 0.0:
-        return None  # fully frozen / zero-grad model: nothing to correct
     floor = 1e-3 * global_no
     changed = False
     factors = []
@@ -274,6 +266,59 @@ def calibrate_grad_correction(run_one_step, mesh: Mesh, *,
     if not changed:
         return None
     return jax.tree_util.tree_unflatten(treedef, factors)
+
+
+def _update_norm_rows(init_o, got_o, init_t, got_t, *, what: str):
+    """Shared core of calibrate/verify: structure-check the four pytrees
+    (positional zips silently truncate on mismatch — fail loudly instead),
+    then per-leaf oracle/target update norms + the global oracle norm."""
+    flat_io, treedef = jax.tree_util.tree_flatten_with_path(init_o)
+    for name, tree in (("got_oracle", got_o), ("init_target", init_t),
+                       ("got_target", got_t)):
+        td = jax.tree_util.tree_structure(tree)
+        if td != treedef:
+            raise RuntimeError(
+                f"{what}: {name} pytree structure differs from init_oracle "
+                f"({td} vs {treedef}); per-leaf ratios would be misaligned")
+    rows = []
+    for (path, io), go, it, gt in zip(flat_io,
+                                      jax.tree_util.tree_leaves(got_o),
+                                      jax.tree_util.tree_leaves(init_t),
+                                      jax.tree_util.tree_leaves(got_t)):
+        no = float(np.linalg.norm(np.asarray(go) - np.asarray(io)))
+        nt = float(np.linalg.norm(np.asarray(gt) - np.asarray(it)))
+        rows.append((path, no, nt))
+    global_no = float(np.sqrt(sum(no * no for _, no, _ in rows)))
+    return rows, treedef, global_no
+
+
+def verify_update_parity(oracle_pair, target_pair, *, norm_rtol: float = 0.2,
+                         context: str = "") -> None:
+    """Cross-check one train step on two meshes by per-leaf update norms.
+
+    Each pair is `(init_params, updated_params)` from an identical init and
+    batch under a LINEAR optimizer (update ∝ grad). Leaves below the same
+    significance floor `calibrate_grad_correction` uses are skipped (their
+    ratios are float-reassociation noise). Raises RuntimeError when any
+    significant leaf's norm ratio leaves [1-rtol, 1+rtol] — used after
+    calibration to confirm the measured factors transfer to the production
+    batch shape (GSPMD's spurious psum is context-dependent)."""
+    init_o, got_o = oracle_pair
+    init_t, got_t = target_pair
+    rows, _, global_no = _update_norm_rows(
+        init_o, got_o, init_t, got_t, what=f"verify_update_parity{context}")
+    if global_no == 0.0:
+        return
+    floor = 1e-3 * global_no
+    for path, no, nt in rows:
+        if no < floor and nt < floor:
+            continue
+        r = nt / max(no, 1e-12)
+        if abs(r - 1.0) > norm_rtol:
+            raise RuntimeError(
+                f"update-norm parity{context}: leaf "
+                f"{jax.tree_util.keystr(path)} ratio {r:.3f} (target/oracle "
+                f"norms {nt:.3g}/{no:.3g}) outside 1±{norm_rtol:.0%}")
 
 
 def pad_to_multiple(n: int, k: int) -> int:
