@@ -1,0 +1,26 @@
+#!/bin/bash
+# Fetch the MNIST idx image+label files into Datasets/MNIST/dataset/ — the
+# layout the reference's loader documents (`/root/reference/Datasets/MNIST/
+# DATASET.md`) and `deepvision_tpu/data/mnist.py` parses. Needs network
+# access; in a zero-egress environment use the bundled-digits gate instead
+# (`python LeNet/jax/train.py -m lenet5_digits`).
+#
+# After fetching, the real-data accuracy tests activate:
+#   python -m pytest tests/test_real_data.py -m slow
+# and real-MNIST training works out of the box:
+#   python LeNet/jax/train.py -m lenet5 --data-dir Datasets/MNIST/dataset
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p dataset
+# yann.lecun.com throttles/403s anonymous pulls; the GCS mirror is the
+# canonical stable source.
+BASE="https://storage.googleapis.com/cvdf-datasets/mnist"
+for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+         t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+    if [ ! -f "dataset/$f" ]; then
+        echo "fetching $f"
+        curl -fsSL "$BASE/$f.gz" | gunzip > "dataset/$f.tmp"
+        mv "dataset/$f.tmp" "dataset/$f"
+    fi
+done
+echo "done: $(ls dataset | wc -l) files in $(pwd)/dataset"

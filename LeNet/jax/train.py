@@ -11,7 +11,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from deepvision_tpu.cli import run_classification
 
-MODELS = ["lenet5"]
+MODELS = ["lenet5", "lenet5_digits"]
 
 if __name__ == "__main__":
     run_classification("LeNet", MODELS)

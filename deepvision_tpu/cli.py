@@ -323,6 +323,29 @@ def _no_train_data(epoch):
     raise RuntimeError("training data was not built (--eval-only)")
 
 
+def _array_pair_fns(cfg, args, *, train_xy, test_xy):
+    """(train_fn, val_fn) over in-memory (images, labels) arrays — the shared
+    shape of the mnist/digits pipelines. train_xy=None (--eval-only) installs
+    the _no_train_data guard."""
+    from .data.mnist import MnistBatches
+    test_x, test_y = test_xy
+    if train_xy is None:
+        train_fn = _no_train_data
+    else:
+        train_x, train_y = train_xy
+
+        def train_fn(epoch):
+            return MnistBatches(train_x, train_y, cfg.batch_size,
+                                shuffle=True, seed=epoch)
+
+    def val_fn(epoch):
+        return MnistBatches(test_x, test_y,
+                            cfg.eval_batch_size or cfg.batch_size,
+                            shuffle=False, drop_remainder=False)
+
+    return train_fn, val_fn
+
+
 def _synthetic_data(cfg, make_batches: Callable):
     """Shared synthetic train/val factories: `make_batches(steps, seed)`."""
     n_batches = max(1, cfg.data.train_examples // cfg.batch_size)
@@ -347,22 +370,20 @@ def _classification_data(cfg, args):
             cfg.batch_size, data.image_size, data.channels, data.num_classes,
             steps, seed=seed))
     elif data.dataset == "mnist":
-        from .data.mnist import MnistBatches, load_split
+        from .data.mnist import load_split
         data_dir = args.data_dir or data.data_dir or "dataset/mnist"
-        test_x, test_y = load_split(data_dir, "test")
+        train_fn, val_fn = _array_pair_fns(
+            cfg, args,
+            train_xy=(None if getattr(args, "eval_only", False)
+                      else load_split(data_dir, "train")),
+            test_xy=load_split(data_dir, "test"))
+    elif data.dataset == "digits":
+        from .data.digits import load_splits
+        train_xy, test_xy = load_splits(data.image_size)
         if getattr(args, "eval_only", False):
-            train_fn = _no_train_data
-        else:
-            train_x, train_y = load_split(data_dir, "train")
-
-            def train_fn(epoch):
-                return MnistBatches(train_x, train_y, cfg.batch_size,
-                                    shuffle=True, seed=epoch)
-
-        def val_fn(epoch):
-            return MnistBatches(test_x, test_y,
-                                cfg.eval_batch_size or cfg.batch_size,
-                                shuffle=False, drop_remainder=False)
+            train_xy = None
+        train_fn, val_fn = _array_pair_fns(cfg, args, train_xy=train_xy,
+                                           test_xy=test_xy)
     elif data.dataset == "imagenet":
         from .data import imagenet as inet
 

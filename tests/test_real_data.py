@@ -1,0 +1,130 @@
+"""Real-data accuracy gates (VERDICT r3 items 2-4).
+
+The reference publishes trained accuracy for LeNet on MNIST — 99.07% top-1
+(`LeNet/pytorch/README.md:47`), 98.58% for the TF flavor
+(`LeNet/tensorflow/README.md:41`) — and this repo's synthetic golden runs
+never touched real pixels. Three gates close that:
+
+1. `test_digits_lenet_accuracy` — always runnable offline: the unchanged
+   lenet5 model trained on scikit-learn's bundled REAL handwritten scans
+   (data/digits.py) must clear 97% val top-1. The committed full-recipe
+   artifact lives in runs/r04_lenet5_digits.
+2. `test_real_mnist_lenet_accuracy` — activates once the MNIST idx images
+   are fetched (`Datasets/MNIST/fetch_mnist.sh`); asserts the reference's
+   own 98.5% bar through the production mnist pipeline.
+3. `test_torch_import_reproduces_eval_accuracy` — the importer loop end to
+   end at digits scale: train the REFERENCE's LeNet architecture in torch on
+   real data, import the .pth via tools/import_torch_checkpoint.py, and the
+   restored model's accuracy through our evaluator must match torch's.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST_DIR = os.path.join(REPO, "Datasets", "MNIST", "dataset")
+_MNIST_FILES = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+
+
+def _have_mnist() -> bool:
+    return all(os.path.exists(os.path.join(MNIST_DIR, f)) or
+               os.path.exists(os.path.join(MNIST_DIR, f + ".gz"))
+               for f in _MNIST_FILES)
+
+
+@pytest.mark.slow
+def test_digits_lenet_accuracy(tmp_path):
+    """Real scanned digits through the full production path (config registry,
+    input pipeline, jitted train step, plateau schedule, checkpointing) must
+    reach 97% — the offline real-data gate."""
+    from deepvision_tpu.cli import run_classification
+
+    result = run_classification(
+        "LeNet", ["lenet5_digits"],
+        argv=["-m", "lenet5_digits", "--epochs", "25",
+              "--workdir", str(tmp_path)])
+    assert result["best_metric"] >= 0.97, result
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _have_mnist(),
+                    reason="MNIST idx images not fetched (run "
+                           "Datasets/MNIST/fetch_mnist.sh; needs network)")
+def test_real_mnist_lenet_accuracy(tmp_path):
+    """The reference's own bar on the real thing: >=98.5% val top-1
+    (LeNet/tensorflow/README.md:41 reports 98.58%)."""
+    from deepvision_tpu.cli import run_classification
+
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--epochs", "12", "--data-dir", MNIST_DIR,
+              "--workdir", str(tmp_path)])
+    assert result["best_metric"] >= 0.985, result
+
+
+@pytest.mark.slow
+def test_torch_import_reproduces_eval_accuracy(tmp_path):
+    """Import->model->eval end to end on real data: a torch-trained
+    reference-architecture LeNet checkpoint, run through
+    tools/import_torch_checkpoint.py and our evaluator, must reproduce the
+    accuracy torch itself measures (VERDICT r3 missing item 3, proven at
+    digits scale pending ImageNet access)."""
+    import torch
+    import torch.nn as tnn
+
+    from deepvision_tpu.data.digits import load_splits
+
+    (tr_x, tr_y), (te_x, te_y) = load_splits()
+
+    torch.manual_seed(0)
+    model = tnn.Sequential()
+    model.features = tnn.Sequential(
+        tnn.Conv2d(1, 6, 5), tnn.Tanh(), tnn.AvgPool2d(2), tnn.Tanh(),
+        tnn.Conv2d(6, 16, 5), tnn.Tanh(), tnn.AvgPool2d(2), tnn.Tanh(),
+        tnn.Conv2d(16, 120, 5), tnn.Tanh())
+    model.classifier = tnn.Sequential(
+        tnn.Linear(120, 84), tnn.Tanh(), tnn.Linear(84, 10))
+
+    def forward(x):
+        h = model.features(x)
+        return model.classifier(h.flatten(1))
+
+    x = torch.from_numpy(tr_x.transpose(0, 3, 1, 2).copy())
+    y = torch.from_numpy(tr_y.astype(np.int64))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = tnn.CrossEntropyLoss()
+    for epoch in range(20):
+        perm = torch.randperm(len(y))
+        for i in range(0, len(y) - 127, 128):
+            sel = perm[i:i + 128]
+            opt.zero_grad()
+            loss = loss_fn(forward(x[sel]), y[sel])
+            loss.backward()
+            opt.step()
+    with torch.no_grad():
+        logits = forward(torch.from_numpy(te_x.transpose(0, 3, 1, 2).copy()))
+        torch_top1 = float((logits.argmax(1).numpy() == te_y).mean())
+    assert torch_top1 >= 0.9, f"torch baseline failed to train: {torch_top1}"
+
+    ckpt_path = str(tmp_path / "lenet5_digits.pth")
+    torch.save({"model": model.state_dict(), "epoch": 7}, ckpt_path)
+
+    from tools.import_torch_checkpoint import main as import_main
+    workdir = str(tmp_path / "imported")
+    import_main(["-m", "lenet5", "--torch-ckpt", ckpt_path,
+                 "--workdir", workdir])
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.mnist import MnistBatches
+
+    trainer = Trainer(get_config("lenet5_digits"), workdir=workdir)
+    trainer.init_state((32, 32, 1))
+    assert trainer.resume() is not None, "imported checkpoint not restorable"
+    result = trainer.evaluate(MnistBatches(te_x, te_y, 128, shuffle=False,
+                                           drop_remainder=False))
+    trainer.close()
+    assert abs(result["top1"] - torch_top1) < 5e-3, (result, torch_top1)
