@@ -94,6 +94,13 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="mesh 'spatial' axis size: shard activations along "
                         "image height (context parallelism; GSPMD "
                         "halo-exchanges the convs)")
+    p.add_argument("--spatial-backend", choices=["gspmd", "shard_map"],
+                   default=None,
+                   help="who owns the spatial partitioning semantics: the "
+                        "XLA partitioner ('gspmd', default) or explicit "
+                        "shard_map collectives ('shard_map': exact on "
+                        "combined spatial x model meshes, no calibration; "
+                        "ResNet/CenterNet)")
     p.add_argument("--device-normalize", action="store_true",
                    help="ship raw uint8 pixels to the device and normalize "
                         "inside the jitted step (4x less host->device "
@@ -274,6 +281,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(model_parallel=args.model_parallel)
     if args.spatial_parallel:
         cfg = cfg.replace(spatial_parallel=args.spatial_parallel)
+    if getattr(args, "spatial_backend", None):
+        cfg = cfg.replace(spatial_backend=args.spatial_backend)
     if args.synthetic:
         n_batches = args.steps_per_epoch or SYNTH_STEPS_DEFAULT
         synth = dict(dataset="synthetic",

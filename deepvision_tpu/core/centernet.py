@@ -100,16 +100,40 @@ class CenterNetTrainer(LossWatchedTrainer):
         grid = config.data.image_size // 4  # output stride 4
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self._step_factory = lambda m, corr: make_centernet_train_step(
-            num_classes=config.data.num_classes, grid=grid,
-            compute_dtype=compute_dtype, mesh=m, remat=config.remat,
-            input_norm=input_norm, log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        if self._use_shardmap_spatial():
+            # CenterNet is the family whose combined spatial x model mesh the
+            # GSPMD path REFUSES (calibration finds ~500x stem-BN grads,
+            # PARITY.md §2.8) — the owned-collectives step makes it trainable
+            from ..parallel import spatial_shard
+            if config.remat:
+                raise ValueError("spatial_backend='shard_map' does not "
+                                 "support remat yet")
+            self._step_factory = (
+                lambda m, corr: spatial_shard
+                .make_shardmap_centernet_train_step(
+                    num_classes=config.data.num_classes, grid=grid,
+                    compute_dtype=compute_dtype, mesh=m,
+                    input_norm=input_norm,
+                    log_grad_norm=config.log_grad_norm,
+                    donate=config.steps_per_dispatch == 1))
+        else:
+            self._step_factory = lambda m, corr: make_centernet_train_step(
+                num_classes=config.data.num_classes, grid=grid,
+                compute_dtype=compute_dtype, mesh=m, remat=config.remat,
+                input_norm=input_norm, log_grad_norm=config.log_grad_norm,
+                donate=config.steps_per_dispatch == 1, grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
+
+    def _use_shardmap_spatial(self) -> bool:
+        # unlike the base (classification-only check), CenterNet has its own
+        # shard_map step — opting in also skips the calibration that refuses
+        # this family's combined meshes
+        return (self.config.spatial_backend == "shard_map"
+                and mesh_lib.has_spatial(self.mesh))
 
     def _calibration_batch(self, sample_shape, seed: int = 0):
         from .detection import boxes_calibration_batch

@@ -130,6 +130,14 @@ class TrainConfig:
     spatial_parallel: int = 1       # shard activations along H over a 'spatial'
                                     # mesh axis (context parallelism for big
                                     # resolutions; GSPMD halo-exchanges convs)
+    # Who owns the spatial-partitioning semantics when spatial_parallel > 1:
+    # "gspmd" (default) lets the XLA partitioner insert the halo exchanges —
+    # exact on (data, spatial) meshes, but combined spatial x model meshes
+    # need the measured grad calibration and some models are refused;
+    # "shard_map" uses explicit collectives (parallel/spatial_shard.py):
+    # ppermute halos, synced BN, one controlled psum — exact on combined
+    # meshes with NO calibration step (supported: ResNet family, CenterNet).
+    spatial_backend: str = "gspmd"
     remat: bool = False             # jax.checkpoint the forward: recompute
                                     # activations in backward, trading ~1/3 more
                                     # FLOPs for HBM (big batches / deep stacks)

@@ -201,6 +201,7 @@ class DetectionTrainer(LossWatchedTrainer):
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
+        self._reject_shardmap_backend("detection")
         grids = yolo_grid_sizes(config.data.image_size)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None

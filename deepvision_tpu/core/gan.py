@@ -71,6 +71,15 @@ class AdversarialTrainer:
         """First line of every subclass __init__ — config errors knowable
         without building anything must fail before model init / device_put /
         the conv-grad probes."""
+        if (getattr(config, "spatial_backend", "gspmd") == "shard_map"
+                and config.spatial_parallel > 1):
+            # consistent with the supervised trainers: the backend choice
+            # only matters when a spatial axis exists; spatial_parallel==1
+            # configs train identically either way and are accepted
+            raise ValueError(
+                "spatial_backend='shard_map' is not implemented for "
+                "adversarial trainers; GAN combined meshes use the measured "
+                "grad calibration (gspmd backend)")
         if getattr(config, "steps_per_dispatch", 1) > 1:
             # the shared TrainConfig field reaches library users even though
             # the GAN CLIs never set it — fail loud (like accum_steps'

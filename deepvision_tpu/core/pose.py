@@ -126,6 +126,7 @@ class PoseTrainer(LossWatchedTrainer):
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
+        self._reject_shardmap_backend("pose")
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None

@@ -193,13 +193,40 @@ class Trainer:
         # calibration itself needs throwaway steps on other meshes.
         # Subclasses that install their own train_step must also install
         # the matching _step_factory (+ _calibration_batch).
-        self._step_factory = lambda m, corr: steps.make_classification_train_step(
-            label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
-            compute_dtype=compute_dtype, mesh=m,
-            remat=config.remat, mixup_alpha=config.mixup_alpha,
-            cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
-            log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        if config.spatial_backend not in ("gspmd", "shard_map"):
+            raise ValueError(
+                f"unknown spatial_backend {config.spatial_backend!r}; "
+                f"expected 'gspmd' or 'shard_map'")
+        if type(self) is Trainer and self._use_shardmap_spatial():
+            # owned-semantics spatial path: explicit halo/psum collectives,
+            # exact on combined spatial x model meshes with NO calibration
+            # (parallel/spatial_shard.py; VERDICT r3 item 7). The explicit
+            # type check keeps subclasses that OVERRIDE _use_shardmap_spatial
+            # (CenterNetTrainer) from running this classification-specific
+            # branch during base __init__ — they install their own factory.
+            from ..parallel import spatial_shard
+            if config.remat or config.mixup_alpha > 0 or config.cutmix_alpha > 0:
+                raise ValueError(
+                    "spatial_backend='shard_map' does not support remat/"
+                    "mixup/cutmix yet; use the gspmd backend for those")
+            transition = spatial_shard.default_transition(self.model)
+            self._step_factory = (
+                lambda m, corr: spatial_shard
+                .make_shardmap_classification_train_step(
+                    mesh=m, transition=transition,
+                    label_smoothing=config.label_smoothing,
+                    aux_weight=config.aux_loss_weight,
+                    compute_dtype=compute_dtype, input_norm=input_norm,
+                    log_grad_norm=config.log_grad_norm,
+                    donate=config.steps_per_dispatch == 1))
+        else:
+            self._step_factory = lambda m, corr: steps.make_classification_train_step(
+                label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
+                compute_dtype=compute_dtype, mesh=m,
+                remat=config.remat, mixup_alpha=config.mixup_alpha,
+                cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
+                log_grad_norm=config.log_grad_norm,
+                donate=config.steps_per_dispatch == 1, grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         # steps_per_dispatch > 1: built lazily on first epoch (train_epoch),
         # AFTER subclasses have installed their family's train_step
@@ -235,6 +262,23 @@ class Trainer:
             self._set_watch("loss", "min")
         else:
             self._set_watch("top1", "max")
+
+    def _use_shardmap_spatial(self) -> bool:
+        """True when this trainer's spatial semantics are owned by
+        parallel/spatial_shard.py instead of GSPMD (config.spatial_backend).
+        Only the classification Trainer implements the shard_map step so
+        far; subclasses call _reject_shardmap_backend."""
+        return (self.config.spatial_backend == "shard_map"
+                and mesh_lib.has_spatial(self.mesh)
+                and type(self) is Trainer)
+
+    def _reject_shardmap_backend(self, family: str) -> None:
+        if (self.config.spatial_backend == "shard_map"
+                and mesh_lib.has_spatial(self.mesh)):
+            raise NotImplementedError(
+                f"spatial_backend='shard_map' is not implemented for the "
+                f"{family} trainer yet; use the gspmd backend (exact on "
+                f"(data, spatial) meshes; combined meshes calibrate)")
 
     def _set_watch(self, key: str, mode: str):
         """Set the watched metric + direction and (re)build the checkpoint
@@ -309,6 +353,9 @@ class Trainer:
         spurious model-axis psum is per-op and context-dependent — see
         mesh_lib.calibrate_grad_correction) and rebuild train_step with the
         correction. Costs two extra compiles + two steps, once per init."""
+        if self._use_shardmap_spatial():
+            return  # owned collectives: grads exact by construction, no
+                    # GSPMD spatial partitioning to calibrate around
         if not mesh_lib.needs_conv_grad_fix(self.mesh):
             return
         batch = self._calibration_batch(sample_shape)
@@ -554,14 +601,21 @@ class Trainer:
         return self.state
 
     def evaluate(self, data: Iterable) -> dict:
-        """Masked eval: partial final batches are zero-padded up to a multiple of the
-        data axis; padded rows carry mask 0 and don't affect the metric sums."""
+        """Masked eval: partial batches are zero-padded up to the LARGEST
+        padded batch seen so far in this pass (a running max, so the usual
+        full-then-final-partial stream compiles exactly one shape) — a
+        varying final batch would otherwise cost one extra XLA compile per
+        distinct shape. Padded rows carry mask 0 and don't affect the metric
+        sums. Shape-stability is pinned by
+        tests/test_real_data.py::test_eval_partial_batch_single_compile."""
         eval_state = self.eval_state()
         data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
         sums: dict = {}
+        target = 0
         for images, labels in data:
             n = len(labels)
-            padded = mesh_lib.pad_to_multiple(n, data_axis)
+            target = max(target, mesh_lib.pad_to_multiple(n, data_axis))
+            padded = target
             mask = np.zeros((padded,), np.float32)
             mask[:n] = 1.0
             if padded != n:

@@ -104,38 +104,53 @@ def encode_labels(boxes, classes, valid, grid: int,
         boxes, classes, valid)
 
 
-def focal_loss(pred_logits: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+def focal_loss(pred_logits: jnp.ndarray, target: jnp.ndarray,
+               axis_name=None) -> jnp.ndarray:
     """Penalty-reduced pixelwise focal loss (paper eq. 1), per example (B,).
 
     Normalized by the number of centers (target == 1 pixels), min 1.
+    `axis_name`: mesh axis holding the rest of each example's rows (spatial
+    shard_map path) — sums and center counts psum over it so the per-example
+    normalization stays global.
     """
     p = jax.nn.sigmoid(pred_logits.astype(jnp.float32))
     p = jnp.clip(p, 1e-6, 1.0 - 1e-6)
     pos = (target >= 1.0 - 1e-6).astype(jnp.float32)
     pos_loss = pos * ((1 - p) ** 2) * jnp.log(p)
     neg_loss = (1 - pos) * ((1 - target) ** 4) * (p ** 2) * jnp.log(1 - p)
-    n_pos = jnp.maximum(jnp.sum(pos, axis=(1, 2, 3)), 1.0)
-    return -jnp.sum(pos_loss + neg_loss, axis=(1, 2, 3)) / n_pos
+    s = jnp.sum(pos_loss + neg_loss, axis=(1, 2, 3))
+    n_pos = jnp.sum(pos, axis=(1, 2, 3))
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+        n_pos = jax.lax.psum(n_pos, axis_name)
+    return -s / jnp.maximum(n_pos, 1.0)
 
 
 def masked_l1_loss(pred: jnp.ndarray, target: jnp.ndarray,
-                   mask: jnp.ndarray) -> jnp.ndarray:
+                   mask: jnp.ndarray, axis_name=None) -> jnp.ndarray:
     """L1 at center cells only, normalized by center count, per example (B,)."""
-    diff = jnp.abs(pred.astype(jnp.float32) - target) * mask[..., None]
-    n = jnp.maximum(jnp.sum(mask, axis=(1, 2)), 1.0)
-    return jnp.sum(diff, axis=(1, 2, 3)) / n
+    diff = jnp.sum(jnp.abs(pred.astype(jnp.float32) - target)
+                   * mask[..., None], axis=(1, 2, 3))
+    n = jnp.sum(mask, axis=(1, 2))
+    if axis_name is not None:
+        diff = jax.lax.psum(diff, axis_name)
+        n = jax.lax.psum(n, axis_name)
+    return diff / jnp.maximum(n, 1.0)
 
 
 def centernet_loss(outputs: Sequence[Dict[str, jnp.ndarray]],
-                   targets: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Sum per-stack losses (intermediate supervision) → dict of (B,)."""
+                   targets: Dict[str, jnp.ndarray],
+                   axis_name=None) -> Dict[str, jnp.ndarray]:
+    """Sum per-stack losses (intermediate supervision) → dict of (B,).
+    `axis_name` threads to the per-example sums (spatial shard_map path)."""
     hm = size = off = 0.0
     for out in outputs:
-        hm = hm + focal_loss(out["heatmap"], targets["heatmap"])
+        hm = hm + focal_loss(out["heatmap"], targets["heatmap"],
+                             axis_name=axis_name)
         size = size + masked_l1_loss(out["size"], targets["size"],
-                                     targets["mask"])
+                                     targets["mask"], axis_name=axis_name)
         off = off + masked_l1_loss(out["offset"], targets["offset"],
-                                   targets["mask"])
+                                   targets["mask"], axis_name=axis_name)
     total = hm + SIZE_LOSS_WEIGHT * size + OFFSET_LOSS_WEIGHT * off
     return {"heatmap": hm, "size": size, "offset": off, "total": total}
 

@@ -1,0 +1,516 @@
+"""Owned-semantics spatial partitioning: shard_map + explicit collectives.
+
+The GSPMD spatial path (`mesh.py`, `spatial_activation_constraints`) lets the
+XLA partitioner insert halo exchanges — exact on (data, spatial) meshes, but
+on combined spatial×model meshes GSPMD (jax 0.9.0) inserts a spurious
+model-axis psum into SOME conv gradients, forcing the measured
+`calibrate_grad_correction` workaround, and CenterNet's combined mesh had to
+be refused outright (stem-BN grad ~500x off — PARITY.md §2.8).
+
+This module OWNS the spatial semantics instead, so correctness stops
+depending on the partitioner's per-model behavior (VERDICT r3 item 7):
+
+- the train step runs under `jax.shard_map` with MANUAL ('data', 'spatial')
+  axes and the 'model' axis left automatic — GSPMD still shards the big
+  params (tensor parallelism), but it never sees a spatially-sharded conv,
+  which is exactly the context that triggers its mis-partitioning;
+- convolutions exchange kernel halos explicitly via `lax.ppermute`
+  (zero boundaries = SAME semantics; -inf refill for max_pool);
+- BatchNorm statistics psum over ('data', 'spatial') — flax's own
+  `_compute_stats(axis_name=...)` math, so numerics match the oracle;
+- at a topologically safe block boundary (`transition`), one
+  `lax.all_to_all` converts spatial parallelism into extra data parallelism
+  (H gathers, the batch splits — the sequence-parallel -> data-parallel
+  handoff): no region of the network is ever compute-replicated, so the one
+  explicit `psum(grads) / n_ranks` is uniformly exact. No calibration step.
+
+Model code is untouched: a flax method interceptor recognizes `nn.Conv` /
+`nn.BatchNorm` calls on spatially-sharded activations and takes them over;
+`nn.max_pool` / `nn.avg_pool` (plain functions) are patched for the scope of
+the forward. Everything else (residual adds, reshapes, `jax.image.resize`
+nearest-x2 upsampling, 1x1 convs) is row-local and runs unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, SPATIAL_AXIS
+
+MANUAL_AXES = (DATA_AXIS, SPATIAL_AXIS)
+
+
+# -- geometry -------------------------------------------------------------------
+
+def _pair(v, default=1) -> Tuple[int, int]:
+    if v is None:
+        return (default, default)
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(v)
+
+
+def _same_pads(size: int, k: int, s: int) -> Tuple[int, int]:
+    """XLA SAME padding (jax lax.padtype_to_pads convention: extra on high)."""
+    out = -(-size // s)
+    total = max((out - 1) * s + k - size, 0)
+    return total // 2, total - total // 2
+
+
+def conv_pads(padding, h: int, w: int, kh: int, kw: int, sh: int, sw: int):
+    """Resolve an nn.Conv/pool `padding` attr to explicit ((hl,hh),(wl,wh))
+    using the GLOBAL height h (shard-local SAME pads would be wrong)."""
+    if padding == "SAME":
+        return _same_pads(h, kh, sh), _same_pads(w, kw, sw)
+    if padding == "VALID":
+        return (0, 0), (0, 0)
+    if isinstance(padding, int):
+        return (padding, padding), (padding, padding)
+    (hl, hh), (wl, wh) = padding
+    return (int(hl), int(hh)), (int(wl), int(wh))
+
+
+def halo_exchange(x, lo: int, hi: int, *, axis_name: str = SPATIAL_AXIS,
+                  sp: int, fill: float = 0.0):
+    """Concat `lo` rows from the previous spatial shard and `hi` rows from the
+    next onto x's H axis (axis 1). Boundary shards receive `fill` (ppermute's
+    missing entries are zeros — the SAME-conv zero pad; max_pool refills with
+    -inf). Negative lo/hi TRIM rows instead (a strided window that ends
+    before the shard does, e.g. 1x1 stride 2)."""
+    parts = []
+    if lo > 0:
+        prev = lax.ppermute(x[:, -lo:], axis_name,
+                            [(i, i + 1) for i in range(sp - 1)])
+        if fill != 0.0:
+            first = lax.axis_index(axis_name) == 0
+            prev = jnp.where(first, jnp.full_like(prev, fill), prev)
+        parts.append(prev)
+    start = -lo if lo < 0 else 0
+    stop = x.shape[1] + (hi if hi < 0 else 0)
+    parts.append(x[:, start:stop])
+    if hi > 0:
+        nxt = lax.ppermute(x[:, :hi], axis_name,
+                           [(i + 1, i) for i in range(sp - 1)])
+        if fill != 0.0:
+            last = lax.axis_index(axis_name) == sp - 1
+            nxt = jnp.where(last, jnp.full_like(nxt, fill), nxt)
+        parts.append(nxt)
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+def _check_valid_supported(what: str, padding, kh: int, sh: int):
+    """VALID windows with kernel > stride SHRINK the global H; the halo
+    machinery would instead fill boundary halos and emit full-height output,
+    silently diverging — refuse them (no supported model uses VALID)."""
+    if padding == "VALID" and kh > sh:
+        raise NotImplementedError(
+            f"spatial shard_map: {what} uses padding='VALID' with kernel "
+            f"{kh} > stride {sh}, which shrinks H at shard boundaries; "
+            f"only SAME/explicit paddings are supported on sharded rows")
+
+
+def _check_rows(what: str, rows: int, sh: int, sp: int):
+    if rows % sh != 0:
+        raise ValueError(
+            f"spatial shard_map: {what} sees {rows} rows/shard with H-stride "
+            f"{sh} (spatial={sp}); per-shard rows must be divisible by the "
+            f"stride. Place the all_to_all transition before this op or pick "
+            f"a resolution/spatial factor whose per-shard rows stay "
+            f"stride-aligned.")
+
+
+# -- op takeovers ---------------------------------------------------------------
+
+def _sharded_conv(mod, x, *, sp: int):
+    """Faithful nn.Conv on H-sharded NHWC input: explicit halo + VALID-in-H
+    `conv_general_dilated` with the module's own kernel/bias/dtype rules.
+    Cites the GSPMD alternative it replaces: mesh.py:46-52."""
+    import flax.linen as nn
+    from flax.linen.dtypes import promote_dtype
+
+    assert isinstance(mod, nn.Conv)
+    if mod.mask is not None:
+        raise NotImplementedError("masked conv under spatial shard_map")
+    kh, kw = _pair(mod.kernel_size)
+    sh, sw = _pair(mod.strides)
+    dh, dw = _pair(mod.kernel_dilation)
+    if (dh, dw) != (1, 1) or _pair(mod.input_dilation) != (1, 1):
+        raise NotImplementedError("dilated conv under spatial shard_map")
+    rows = x.shape[1]
+    _check_valid_supported(f"conv {mod.path}", mod.padding, kh, sh)
+    _check_rows(f"conv {mod.path}", rows, sh, sp)
+    (ph_lo, _), wpads = conv_pads(mod.padding, rows * sp, x.shape[2],
+                                  kh, kw, sh, sw)
+    lo, hi = ph_lo, kh - sh - ph_lo
+    x_aug = halo_exchange(x, lo, hi, sp=sp)
+
+    kernel = mod.variables["params"]["kernel"]
+    bias = mod.variables["params"].get("bias") if mod.use_bias else None
+    x_aug, kernel, bias = promote_dtype(x_aug, kernel, bias, dtype=mod.dtype)
+    out = lax.conv_general_dilated(
+        x_aug, kernel, window_strides=(sh, sw),
+        padding=[(0, 0), tuple(wpads)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=mod.feature_group_count,
+        precision=mod.precision)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _sync_batchnorm(mod, x, use_running_average: bool, axes):
+    """flax BatchNorm with statistics psummed over the manual mesh axes —
+    the module's own `_compute_stats`/`_normalize` math with axis_name set,
+    running averages updated via put_variable. Inside shard_map every rank
+    holds a disjoint slice of (batch x rows), so the pmean over the manual
+    axes IS the global batch statistic (sync-BN, steps.py:8-9)."""
+    from flax.linen.normalization import _compute_stats, _normalize
+
+    if use_running_average:
+        return None  # eval: stored stats, elementwise — local math is exact
+    feature_axes = (x.ndim - 1,)
+    reduction_axes = tuple(range(x.ndim - 1))
+    mean, var = _compute_stats(
+        x, reduction_axes, dtype=mod.dtype, axis_name=axes,
+        axis_index_groups=None, use_fast_variance=mod.use_fast_variance,
+        mask=None, force_float32_reductions=mod.force_float32_reductions)
+    if not mod.is_initializing():
+        ra_mean = mod.get_variable("batch_stats", "mean")
+        ra_var = mod.get_variable("batch_stats", "var")
+        mod.put_variable("batch_stats", "mean",
+                         mod.momentum * ra_mean + (1 - mod.momentum) * mean)
+        mod.put_variable("batch_stats", "var",
+                         mod.momentum * ra_var + (1 - mod.momentum) * var)
+    return _normalize(mod, x, mean, var, reduction_axes, feature_axes,
+                      mod.dtype, mod.param_dtype, mod.epsilon,
+                      mod.use_bias, mod.use_scale, mod.bias_init,
+                      mod.scale_init, mod.force_float32_reductions)
+
+
+class SpatialShardContext:
+    """Per-forward interception state for one shard_map body trace.
+
+    `sharded` starts True (H over 'spatial'); flips False at the `transition`
+    module, where one tiled all_to_all turns the spatial axis into extra
+    data parallelism (batch splits sp ways, H gathers). BatchNorm keeps the
+    ('data','spatial') psum in BOTH regimes — examples are spread over
+    exactly those axes either way, so the statistic is global."""
+
+    def __init__(self, *, sp: int, transition: Optional[str],
+                 axes=MANUAL_AXES):
+        self.sp = sp
+        self.transition = transition
+        self.axes = tuple(axes)      # manual mesh axes present (BN psums)
+        self.sharded = sp > 1
+
+    def assert_transition_consumed(self):
+        """Call after the forward: a transition name that matched no module
+        would leave H sharded through any trailing global reduction — wrong
+        results, no error. Raise instead of trusting the name."""
+        if self.transition is not None and self.sharded:
+            raise RuntimeError(
+                f"spatial shard_map: transition module "
+                f"{self.transition!r} was never reached during the forward "
+                f"— the all_to_all handoff did not fire, so the name does "
+                f"not match any top-level module of this model (check "
+                f"default_transition / the model's param tree)")
+
+    def _maybe_transition(self, mod, x):
+        if (self.sharded and self.transition is not None
+                and mod.path == (self.transition,)):
+            if x.shape[0] % self.sp != 0:
+                raise ValueError(
+                    f"spatial shard_map transition at {self.transition}: "
+                    f"per-rank batch {x.shape[0]} must be divisible by "
+                    f"spatial={self.sp} for the all_to_all handoff")
+            x = lax.all_to_all(x, SPATIAL_AXIS, split_axis=0, concat_axis=1,
+                               tiled=True)
+            self.sharded = False
+        return x
+
+    def interceptor(self, next_fun, args, kwargs, context):
+        import flax.linen as nn
+
+        mod = context.module
+        if (mod.is_initializing() or not args
+                or not isinstance(args[0], jax.Array) or args[0].ndim != 4):
+            return next_fun(*args, **kwargs)
+        x = args[0]
+        new_x = self._maybe_transition(mod, x)
+        if new_x is not x:
+            return next_fun(new_x, *args[1:], **kwargs)
+        if isinstance(mod, nn.BatchNorm):
+            ura = kwargs.get("use_running_average")
+            if ura is None and len(args) > 1:
+                ura = args[1]
+            if ura is None:
+                ura = mod.use_running_average
+            out = _sync_batchnorm(mod, x, bool(ura), self.axes)
+            return out if out is not None else next_fun(*args, **kwargs)
+        if self.sharded and isinstance(mod, nn.Conv):
+            return _sharded_conv(mod, x, sp=self.sp)
+        return next_fun(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def active(self):
+        """intercept_methods + max/avg_pool patches for one forward."""
+        import flax.linen as nn
+
+        orig_max, orig_avg = nn.max_pool, nn.avg_pool
+        ctx = self
+
+        def max_pool(inputs, window_shape, strides=None, padding="VALID"):
+            if not ctx.sharded or inputs.ndim != 4:
+                return orig_max(inputs, window_shape, strides, padding)
+            kh, kw = _pair(window_shape)
+            sh, sw = _pair(strides)
+            _check_valid_supported("max_pool", padding, kh, sh)
+            _check_rows("max_pool", inputs.shape[1], sh, ctx.sp)
+            (ph_lo, _), wpads = conv_pads(padding, inputs.shape[1] * ctx.sp,
+                                          inputs.shape[2], kh, kw, sh, sw)
+            lo, hi = ph_lo, kh - sh - ph_lo
+            x_aug = halo_exchange(inputs, lo, hi, sp=ctx.sp,
+                                  fill=float(jnp.finfo(inputs.dtype).min))
+            return orig_max(x_aug, (kh, kw), (sh, sw),
+                            [(0, 0), tuple(wpads)])
+
+        def avg_pool(inputs, window_shape, strides=None, padding="VALID",
+                     count_include_pad=True):
+            if not ctx.sharded or inputs.ndim != 4:
+                return orig_avg(inputs, window_shape, strides, padding,
+                                count_include_pad)
+            if not count_include_pad:
+                raise NotImplementedError(
+                    "avg_pool(count_include_pad=False) under spatial "
+                    "shard_map")
+            kh, kw = _pair(window_shape)
+            sh, sw = _pair(strides)
+            _check_valid_supported("avg_pool", padding, kh, sh)
+            _check_rows("avg_pool", inputs.shape[1], sh, ctx.sp)
+            (ph_lo, _), wpads = conv_pads(padding, inputs.shape[1] * ctx.sp,
+                                          inputs.shape[2], kh, kw, sh, sw)
+            lo, hi = ph_lo, kh - sh - ph_lo
+            x_aug = halo_exchange(inputs, lo, hi, sp=ctx.sp)  # zero pads
+            return orig_avg(x_aug, (kh, kw), (sh, sw),
+                            [(0, 0), tuple(wpads)], count_include_pad)
+
+        import flax
+        nn.max_pool = flax.linen.max_pool = max_pool
+        nn.avg_pool = flax.linen.avg_pool = avg_pool
+        try:
+            with nn.intercept_methods(self.interceptor):
+                yield
+        finally:
+            nn.max_pool = flax.linen.max_pool = orig_max
+            nn.avg_pool = flax.linen.avg_pool = orig_avg
+
+
+def default_transition(model) -> Optional[str]:
+    """The all_to_all plan for a model instance, or raise when this backend
+    has no plan for its topology (a model with mid-network flattens/global
+    reductions outside module boundaries would go silently wrong instead).
+
+    - ResNet family: entry of the last stage's first block (the global mean
+      at `resnet.py:159` needs gathered rows; last-stage strides can
+      misalign with per-shard rows).
+    - CenterNet (ObjectsAsPoints): fully convolutional (dense heads,
+      nearest-x2 upsampling — both row-local), so no transition: None keeps
+      H sharded end to end.
+    """
+    name = type(model).__name__
+    if name == "ResNet":
+        block = model.block
+        block_name = (block.__name__ if isinstance(block, type)
+                      else type(block).__name__)
+        return resnet_transition(model.stage_sizes, block_name)
+    if name == "ObjectsAsPoints":
+        return None
+    raise NotImplementedError(
+        f"spatial_backend='shard_map' has no transition plan for "
+        f"{name}; supported: ResNet family, CenterNet. Use the gspmd "
+        f"backend for this model.")
+
+
+def resnet_transition(stage_sizes: Sequence[int],
+                      block_name: str = "BottleneckBlock") -> str:
+    """The safe all_to_all point for the ResNet family: entry of the LAST
+    stage's first block (H there is at/below MIN_SPATIAL_ROWS x typical sp,
+    and block entry is outside any residual scope, so both branches of every
+    skip see the same regime)."""
+    return f"{block_name}_{sum(stage_sizes[:-1])}"
+
+
+# -- the owned-semantics train step --------------------------------------------
+
+def make_shardmap_classification_train_step(
+    *,
+    mesh: Mesh,
+    transition: Optional[str],
+    label_smoothing: float = 0.0,
+    aux_weight: float = 0.3,
+    compute_dtype=jnp.float32,
+    input_norm: Optional[tuple] = None,
+    log_grad_norm: bool = False,
+    donate: bool = True,
+):
+    """`(state, images, labels, rng) -> (state, metrics)` with the spatial
+    axis handled by THIS module's collectives instead of GSPMD (module
+    docstring). Drop-in for `steps.make_classification_train_step` on
+    spatial and combined spatial x model meshes — with NO grad_correction
+    argument: the explicit psum over ('data','spatial') divided by the rank
+    count is the entire cross-rank gradient story. The 'model' mesh axis (if
+    any) stays automatic, so `param_sharding_rules` tensor parallelism works
+    unchanged inside the body."""
+    from ..core import losses
+    from ..core.steps import _normalize_input, maybe_grad_norm
+
+    sp = dict(mesh.shape).get(SPATIAL_AXIS, 1)
+    dp = dict(mesh.shape)[DATA_AXIS]
+    n_ranks = sp * dp
+    axes = tuple(a for a in MANUAL_AXES if a in mesh.axis_names)
+
+    def step(state, images, labels, rng):
+        images = _normalize_input(images, input_norm, compute_dtype)
+        step_rng = jax.random.fold_in(rng, state.step)
+
+        def body(params, batch_stats, images, labels):
+            def loss_fn(p):
+                ctx = SpatialShardContext(sp=sp, transition=transition,
+                                          axes=axes)
+                with ctx.active():
+                    outputs, mutated = state.apply_fn(
+                        {"params": p, "batch_stats": batch_stats},
+                        images, train=True, mutable=["batch_stats"],
+                        rngs={"dropout": step_rng})
+                ctx.assert_transition_consumed()
+                loss = losses.classification_loss(
+                    outputs, labels, label_smoothing=label_smoothing,
+                    aux_weight=aux_weight)
+                return loss, (outputs, mutated)
+
+            (loss, (outputs, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # THE controlled psum (VERDICT r3 item 7): every rank computed a
+            # disjoint slice of the batch-x-rows work, so sum/n_ranks of the
+            # local grads of local mean losses is exactly the global-batch
+            # gradient — for every leaf, in both regimes, on any model.
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            metrics = {"loss": loss,
+                       **losses.topk_accuracies(outputs, labels)}
+            metrics = {k: lax.pmean(v, axes)
+                       for k, v in metrics.items()}
+            new_bs = mutated.get("batch_stats", batch_stats)
+            return grads, new_bs, metrics
+
+        spatial_in = P(DATA_AXIS, SPATIAL_AXIS if sp > 1 else None)
+        grads, new_bs, metrics = jax.shard_map(
+            body, mesh=mesh, axis_names=set(axes),
+            in_specs=(P(), P(), spatial_in, P((DATA_AXIS, SPATIAL_AXIS))
+                      if sp > 1 else P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(state.params, state.batch_stats, images, labels)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
+def make_shardmap_centernet_train_step(
+    *,
+    num_classes: int,
+    grid: int,
+    mesh: Mesh,
+    compute_dtype=jnp.bfloat16,
+    input_norm: Optional[tuple] = None,
+    log_grad_norm: bool = False,
+    donate: bool = True,
+):
+    """CenterNet `(state, images, boxes, classes, valid, rng)` step with
+    owned spatial semantics — the family whose combined spatial x model mesh
+    the GSPMD path REFUSES (stem-BN grad ~500x the oracle, PARITY.md §2.8;
+    mesh.py calibrate_grad_correction raises). The model is fully
+    convolutional, so H stays sharded end to end (transition=None): dense
+    targets are encoded per rank and row-sliced to the shard, the
+    per-example loss sums/center counts psum over 'spatial'
+    (ops/centernet.py axis_name), and grads psum over ('data','spatial')
+    divided by the rank count — the SAME uniform rule as the classification
+    step. (Each spatial rank computes the identical psum-normalized loss,
+    and jax transposes `psum` to `psum`, so every rank's local grad carries
+    an extra x-spatial factor from the summed cotangents; /n_ranks nets it
+    out. Verified leaf-exact vs the oracle in test_spatial_shardmap.py.)"""
+    from ..core.steps import _normalize_input, maybe_grad_norm
+    from ..ops import centernet as cn_ops
+
+    sp = dict(mesh.shape).get(SPATIAL_AXIS, 1)
+    dp = dict(mesh.shape)[DATA_AXIS]
+    n_ranks = sp * dp
+    axes = tuple(a for a in MANUAL_AXES if a in mesh.axis_names)
+    if sp > 1 and grid % sp != 0:
+        raise ValueError(f"centernet grid {grid} must divide spatial={sp}")
+
+    def step(state, images, boxes, classes, valid, rng):
+        del rng
+        images = _normalize_input(images, input_norm, compute_dtype)
+
+        def body(params, batch_stats, images, boxes, classes, valid):
+            targets = cn_ops.encode_labels(boxes, classes, valid, grid,
+                                           num_classes)
+            if sp > 1:
+                rows = grid // sp
+                start = lax.axis_index(SPATIAL_AXIS) * rows
+                targets = {k: lax.dynamic_slice_in_dim(v, start, rows, axis=1)
+                           for k, v in targets.items()}
+
+            def loss_fn(p):
+                ctx = SpatialShardContext(sp=sp, transition=None, axes=axes)
+                with ctx.active():
+                    outputs, mutated = state.apply_fn(
+                        {"params": p, "batch_stats": batch_stats},
+                        images, train=True, mutable=["batch_stats"])
+                comp = cn_ops.centernet_loss(
+                    outputs, targets,
+                    axis_name=SPATIAL_AXIS if sp > 1 else None)
+                return jnp.mean(comp["total"]), (comp, mutated)
+
+            (loss, (comp, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            metrics = {"loss": loss,
+                       **{f"{k}_loss": jnp.mean(v) for k, v in comp.items()
+                          if k != "total"}}
+            metrics = {k: lax.pmean(v, axes) for k, v in metrics.items()}
+            new_bs = mutated.get("batch_stats", batch_stats)
+            return grads, new_bs, metrics
+
+        spatial_in = P(DATA_AXIS, SPATIAL_AXIS if sp > 1 else None)
+        grads, new_bs, metrics = jax.shard_map(
+            body, mesh=mesh, axis_names=set(axes),
+            in_specs=(P(), P(), spatial_in, P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(state.params, state.batch_stats, images, boxes, classes, valid)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)

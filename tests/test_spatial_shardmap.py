@@ -1,0 +1,289 @@
+"""Owned-semantics spatial partitioning (parallel/spatial_shard.py).
+
+The bar (VERDICT r3 item 7): DP-oracle parity on a combined spatial x model
+mesh with NO calibration step — the explicit ppermute halos, synced BN, and
+one controlled psum replace GSPMD's partitioner (whose combined-mesh conv
+grads need measured correction, mesh.py calibrate_grad_correction).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepvision_tpu.parallel import mesh as mesh_lib
+from deepvision_tpu.parallel.spatial_shard import (
+    SpatialShardContext, conv_pads, default_transition, halo_exchange,
+    make_shardmap_classification_train_step, resnet_transition)
+
+
+def _mini_resnet():
+    from deepvision_tpu.models.resnet import BottleneckBlock, ResNet
+    return ResNet(stage_sizes=(1, 1, 1, 1), block=BottleneckBlock, width=8,
+                  num_classes=7, dtype=jnp.float32)
+
+
+def _combined_mesh():
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    return Mesh(devs, ("data", "spatial", "model"))
+
+
+class TestGeometry:
+    def test_same_pads_use_global_height(self):
+        # 3x3 stride 1 SAME on H=8: pads (1,1); halo lo=1 hi=1
+        assert conv_pads("SAME", 8, 8, 3, 3, 1, 1)[0] == (1, 1)
+        # 1x1 stride 2: no pads; hi = k - s - lo = -1 (trim)
+        assert conv_pads("SAME", 8, 8, 1, 1, 2, 2)[0] == (0, 0)
+        # 7x7 stride 2 explicit (3,3)
+        assert conv_pads([(3, 3), (3, 3)], 8, 8, 7, 7, 2, 2)[0] == (3, 3)
+
+    def test_halo_exchange_rows_and_boundaries(self):
+        mesh = _combined_mesh()
+
+        def body(x):
+            return halo_exchange(x, 1, 1, sp=2, fill=-7.0)
+
+        f = jax.shard_map(body, mesh=mesh, in_specs=P("data", "spatial"),
+                          out_specs=P("data", "spatial"),
+                          axis_names={"data", "spatial"}, check_vma=False)
+        x = jnp.broadcast_to(jnp.arange(4.0)[None, :, None], (2, 4, 1))
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", "spatial")))
+        out = np.asarray(jax.jit(f)(xs))[0, :, 0]
+        # shard0 rows: [fill, 0, 1, halo=2]; shard1: [halo=1, 2, 3, fill]
+        assert out.tolist() == [-7.0, 0.0, 1.0, 2.0, 1.0, 2.0, 3.0, -7.0]
+
+    def test_transition_plans(self):
+        model = _mini_resnet()
+        assert default_transition(model) == "BottleneckBlock_3"
+        assert resnet_transition((3, 4, 6, 3)) == "BottleneckBlock_13"
+        from deepvision_tpu.models import MODELS
+        cn = MODELS.get("centernet")(num_classes=4)
+        assert default_transition(cn) is None
+        with pytest.raises(NotImplementedError):
+            default_transition(MODELS.get("vgg16")(num_classes=4))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = _mini_resnet()
+    from deepvision_tpu.core.train_state import init_model
+    rng = jax.random.PRNGKey(0)
+    images = np.asarray(jax.random.normal(jax.random.PRNGKey(1),
+                                          (8, 64, 64, 3)), np.float32)
+    labels = (np.arange(8) % 7).astype(np.int32)
+    params, bstats = init_model(model, rng, jnp.zeros((2, 64, 64, 3)))
+    return model, params, bstats, images, labels
+
+
+def test_forward_parity_spatial_shardmap(setup):
+    """Logits and mutated batch_stats of the intercepted forward match the
+    plain single-device forward bit-tight."""
+    model, params, bstats, images, labels = setup
+    ref, ref_muts = model.apply({"params": params, "batch_stats": bstats},
+                                jnp.asarray(images), train=True,
+                                mutable=["batch_stats"])
+    mesh = _combined_mesh()
+
+    def body(p, bs, x):
+        ctx = SpatialShardContext(sp=2, transition="BottleneckBlock_3")
+        with ctx.active():
+            out, muts = model.apply({"params": p, "batch_stats": bs}, x,
+                                    train=True, mutable=["batch_stats"])
+        return out, muts["batch_stats"]
+
+    f = jax.shard_map(body, mesh=mesh, axis_names={"data", "spatial"},
+                      in_specs=(P(), P(), P("data", "spatial")),
+                      out_specs=(P(("data", "spatial")), P()),
+                      check_vma=False)
+    xs = jax.device_put(jnp.asarray(images),
+                        NamedSharding(mesh, P("data", "spatial")))
+    out, new_bs = jax.jit(f)(params, bstats, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(new_bs),
+                    jax.tree_util.tree_leaves(ref_muts["batch_stats"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_unmatched_transition_raises(setup):
+    """A transition name matching no module would silently leave H sharded
+    through the global mean — the step must refuse instead."""
+    from deepvision_tpu.core.train_state import TrainState
+
+    model, params, bstats, images, labels = setup
+    mesh = _combined_mesh()
+    st = TrainState.create(model.apply, params, optax.sgd(0.1), bstats)
+    st = st.replace(
+        params=jax.device_put(st.params, mesh_lib.replicated(mesh)),
+        batch_stats=jax.device_put(st.batch_stats,
+                                   mesh_lib.replicated(mesh)),
+        opt_state=jax.device_put(st.opt_state, mesh_lib.replicated(mesh)),
+        step=jax.device_put(st.step, mesh_lib.replicated(mesh)))
+    step = make_shardmap_classification_train_step(
+        mesh=mesh, transition="Bottleneck_13",  # wrong name for this model
+        compute_dtype=jnp.float32, donate=False)
+    batch = mesh_lib.shard_batch_pytree(mesh, (images, labels))
+    with pytest.raises(RuntimeError, match="never reached"):
+        step(st, *batch, jax.random.PRNGKey(0))
+
+
+def test_train_step_parity_combined_mesh_no_calibration(setup):
+    """THE bar: one momentum train step on the (2,2,2) combined mesh with
+    model-sharded params matches the single-device oracle step per-leaf —
+    loss identical, params allclose — with no grad correction anywhere."""
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.train_state import TrainState
+
+    model, params, bstats, images, labels = setup
+    tx = optax.sgd(0.1, momentum=0.9)
+    oracle_step = steps.make_classification_train_step(
+        label_smoothing=0.1, compute_dtype=jnp.float32, donate=False)
+    ost, om = oracle_step(
+        TrainState.create(model.apply, params, tx, bstats),
+        jnp.asarray(images), jnp.asarray(labels), jax.random.PRNGKey(2))
+
+    mesh = _combined_mesh()
+    st = TrainState.create(model.apply, params, tx, bstats)
+    rules = mesh_lib.param_sharding_rules(mesh, st.params,
+                                          min_size_to_shard=2 ** 10)
+    assert sum(1 for s in jax.tree_util.tree_leaves(rules)
+               if s.spec != P()) >= 8, "want real model-sharded params"
+    repl = mesh_lib.replicated(mesh)
+    st = st.replace(params=jax.device_put(st.params, rules),
+                    batch_stats=jax.device_put(st.batch_stats, repl),
+                    opt_state=jax.device_put(st.opt_state, repl),
+                    step=jax.device_put(st.step, repl))
+    sm_step = make_shardmap_classification_train_step(
+        mesh=mesh, transition="BottleneckBlock_3", label_smoothing=0.1,
+        compute_dtype=jnp.float32, donate=False)
+    batch = mesh_lib.shard_batch_pytree(mesh, (images, labels))
+    sst, sm = sm_step(st, *batch, jax.random.PRNGKey(2))
+    assert float(sm["loss"]) == pytest.approx(float(om["loss"]), abs=1e-6)
+    for (path, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(ost.params))[0],
+            jax.tree_util.tree_leaves(jax.device_get(sst.params))):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-6,
+            err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.slow
+def test_trainer_integration_shardmap_backend(tmp_path, capsys):
+    """Trainer wiring: spatial_backend='shard_map' on a combined mesh skips
+    calibration entirely and its sgd(1.0) step matches the all-device DP
+    oracle via the same verify_update_parity the calibrated path uses."""
+    from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                            ScheduleConfig, TrainConfig)
+    from deepvision_tpu.core.trainer import Trainer
+
+    cfg = TrainConfig(
+        name="smtest", model="resnet50", batch_size=8, total_epochs=1,
+        model_kwargs={"stage_sizes": (1, 1, 1, 1), "width": 8},
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.1),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=64, num_classes=7,
+                        train_examples=16),
+        dtype="float32", model_parallel=2, spatial_parallel=2,
+        spatial_backend="shard_map", checkpoint_dir=str(tmp_path))
+    tr = Trainer(cfg, workdir=str(tmp_path))
+    tr.init_state((64, 64, 3))
+    out = capsys.readouterr().out
+    assert "calibration" not in out, out
+
+    params0 = jax.device_get(tr.state.params)
+    bs0 = jax.device_get(tr.state.batch_stats)
+    batch = tr._calibration_batch((64, 64, 3))
+    oracle_mesh = mesh_lib.make_mesh(list(tr.mesh.devices.flat))
+    from deepvision_tpu.core import steps as steps_lib
+    import optax as _optax
+    from deepvision_tpu.core.train_state import TrainState as _TS
+
+    def run_oracle():
+        st = _TS.create(tr.model.apply, params0, _optax.sgd(1.0), bs0)
+        st = jax.device_put(st, mesh_lib.replicated(oracle_mesh))
+        step = steps_lib.make_classification_train_step(
+            label_smoothing=0.0, compute_dtype=jnp.float32,
+            mesh=oracle_mesh, donate=False)
+        sharded = mesh_lib.shard_batch_pytree(oracle_mesh, batch)
+        st, _ = step(st, *sharded, jax.random.PRNGKey(0))
+        return params0, jax.device_get(st.params)
+
+    target = tr._run_calibration_step(tr.mesh, batch, params0, bs0)
+    mesh_lib.verify_update_parity(run_oracle(), target, norm_rtol=0.05,
+                                  context=" (shard_map backend)")
+    tr.close()
+
+
+@pytest.mark.slow
+def test_centernet_combined_mesh_shardmap_parity(tmp_path):
+    """THE previously-refused mesh: CenterNet on (data,spatial,model) under
+    the gspmd backend fails calibration (~500x stem-BN grads, pinned in
+    test_spatial.py); the owned-collectives step matches the single-device
+    oracle per-leaf — trainable, no calibration."""
+    import optax
+    from deepvision_tpu.core.centernet import make_centernet_train_step
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+    from deepvision_tpu.parallel.spatial_shard import (
+        make_shardmap_centernet_train_step)
+
+    model = MODELS.get("centernet")(num_classes=4, num_stack=1, order=2,
+                                    width_mult=0.05, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    size, grid = 64, 16
+    rs = np.random.RandomState(0)
+    images = rs.rand(8, size, size, 3).astype(np.float32)
+    from deepvision_tpu.ops.yolo import MAX_BOXES
+    boxes = np.zeros((8, MAX_BOXES, 4), np.float32)
+    boxes[:, 0] = [0.2, 0.2, 0.6, 0.6]
+    boxes[:, 1] = [0.5, 0.4, 0.9, 0.8]
+    classes = np.zeros((8, MAX_BOXES), np.int32)
+    classes[:, 1] = 2
+    valid = np.zeros((8, MAX_BOXES), np.float32)
+    valid[:, :2] = 1.0
+
+    params, bstats = init_model(model, rng, jnp.zeros((2, size, size, 3)))
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    oracle_step = make_centernet_train_step(
+        num_classes=4, grid=grid, compute_dtype=jnp.float32, donate=False)
+    ost, om = oracle_step(
+        TrainState.create(model.apply, params, tx, bstats),
+        jnp.asarray(images), jnp.asarray(boxes), jnp.asarray(classes),
+        jnp.asarray(valid), jax.random.PRNGKey(2))
+
+    mesh = _combined_mesh()
+    st = TrainState.create(model.apply, params, tx, bstats)
+    rules = mesh_lib.param_sharding_rules(mesh, st.params,
+                                          min_size_to_shard=2 ** 10)
+    repl = mesh_lib.replicated(mesh)
+    st = st.replace(params=jax.device_put(st.params, rules),
+                    batch_stats=jax.device_put(st.batch_stats, repl),
+                    opt_state=jax.device_put(st.opt_state, repl),
+                    step=jax.device_put(st.step, repl))
+    sm_step = make_shardmap_centernet_train_step(
+        num_classes=4, grid=grid, mesh=mesh, compute_dtype=jnp.float32,
+        donate=False)
+    batch = mesh_lib.shard_batch_pytree(mesh, (images, boxes, classes, valid))
+    sst, sm = sm_step(st, *batch, jax.random.PRNGKey(2))
+    assert float(sm["loss"]) == pytest.approx(float(om["loss"]), rel=1e-5)
+    for (path, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(ost.params))[0],
+            jax.tree_util.tree_leaves(jax.device_get(sst.params))):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-3,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_subclass_trainers_reject_shardmap_backend(tmp_path):
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.detection import DetectionTrainer
+
+    cfg = get_config("yolov3").replace(
+        batch_size=8, spatial_parallel=2, spatial_backend="shard_map",
+        checkpoint_dir=str(tmp_path))
+    with pytest.raises(NotImplementedError, match="shard_map"):
+        DetectionTrainer(cfg, workdir=str(tmp_path))

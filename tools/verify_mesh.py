@@ -71,6 +71,11 @@ def main(argv=None):
                         "trainer family too")
     p.add_argument("--spatial-parallel", type=int, default=1)
     p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--spatial-backend", choices=["gspmd", "shard_map"],
+                   default="gspmd",
+                   help="spatial semantics owner on the TARGET mesh "
+                        "(parallel/spatial_shard.py for shard_map); the "
+                        "oracle mesh is pure DP either way")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--rtol", type=float, default=0.10,
@@ -94,6 +99,7 @@ def main(argv=None):
                 f"have their own DP-oracle parity tests (tests/test_gan.py)")
     cfg = get_config(args.model).replace(
         batch_size=args.batch_size, dtype="float32",
+        spatial_backend=args.spatial_backend,
         # momentum for grad-scale sensitivity; constant LR: one step only
         optimizer=OptimizerConfig(name="momentum", learning_rate=0.1),
         schedule=ScheduleConfig(name="constant"))
