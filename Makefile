@@ -3,7 +3,7 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
           XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-all bench dryrun smoke preflight preflight-record
+.PHONY: test test-all verify bench dryrun smoke preflight preflight-record
 
 preflight:   ## pod go/no-go: devices, input floor, train step, ckpt roundtrip
 	$(PY) tools/preflight.py
@@ -20,6 +20,16 @@ test:        ## fast suite (slow-marked compiles excluded)
 
 test-all:    ## everything, including slow XLA-CPU compiles
 	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q -m ""
+
+verify:      ## the heavy correctness evidence the default lane skips
+	## (VERDICT r3 item 6): real 2-process multihost, SIGKILL preemption
+	## resume, combined-mesh calibration smokes, shard_map parity, the
+	## real-data accuracy gates, the GAN quality gate — then the dryrun.
+	env $(CPU_ENV) $(PY) -m pytest -x -q -m "" \
+	    tests/test_multihost.py tests/test_preemption.py \
+	    tests/test_spatial.py tests/test_spatial_shardmap.py \
+	    tests/test_real_data.py tests/test_gan_quality.py
+	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 bench:       ## ResNet-50 step throughput (TPU if reachable, else CPU)
 	$(PY) bench.py

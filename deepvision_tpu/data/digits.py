@@ -31,6 +31,18 @@ def _upsample(images: np.ndarray, factor: int = 4) -> np.ndarray:
     return images.repeat(factor, axis=1).repeat(factor, axis=2)
 
 
+def load_raw(image_size: int = 32) -> Tuple[np.ndarray, np.ndarray]:
+    """All 1797 scans as (N, image_size, image_size, 1) float32 in [0, 1]
+    plus labels — the one place the sklearn load/scale/upsample happens
+    (the GAN quality gate consumes this form directly)."""
+    from sklearn.datasets import load_digits
+    bunch = load_digits()
+    images = bunch.images.astype(np.float32) / 16.0      # (1797, 8, 8) in [0,1]
+    labels = bunch.target.astype(np.int32)
+    images = _upsample(images, image_size // 8)[..., None]
+    return images, labels
+
+
 def load_splits(image_size: int = 32
                 ) -> Tuple[Tuple[np.ndarray, np.ndarray],
                            Tuple[np.ndarray, np.ndarray]]:
@@ -40,13 +52,10 @@ def load_splits(image_size: int = 32
     mean/std (the role MEAN/STD fill in `data/mnist.py`, computed rather
     than hard-coded because unlike MNIST there is no published constant).
     """
-    from sklearn.datasets import load_digits
-    bunch = load_digits()
-    images = bunch.images.astype(np.float32) / 16.0      # (1797, 8, 8) in [0,1]
-    labels = bunch.target.astype(np.int32)
+    images, labels = load_raw(image_size)
+    images = images[..., 0]
     order = np.random.RandomState(SPLIT_SEED).permutation(len(labels))
     images, labels = images[order], labels[order]
-    images = _upsample(images, image_size // 8)
     tr_x, te_x = images[:TRAIN_EXAMPLES], images[TRAIN_EXAMPLES:]
     tr_y, te_y = labels[:TRAIN_EXAMPLES], labels[TRAIN_EXAMPLES:]
     mean, std = float(tr_x.mean()), float(tr_x.std())

@@ -18,7 +18,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
+# Promoted out of the slow lane (VERDICT r3 item 6): the one REAL
+# 2-process run is default-suite evidence, ~1 min.
 def test_two_process_training_and_resume(tmp_path):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")

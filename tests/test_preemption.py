@@ -15,7 +15,8 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-@pytest.mark.slow
+# Promoted out of the slow lane (VERDICT r3 item 6): SIGKILL-resume is
+# default-suite evidence, ~1 min.
 def test_sigkill_mid_training_then_auto_resume(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
