@@ -45,3 +45,50 @@ def test_dispatch_rejects_indivisible_steps():
         capture_output=True, text=True)
     assert proc.returncode != 0
     assert "not divisible" in proc.stderr
+
+
+def test_trace_report_roofline_math(tmp_path):
+    """trace_report must aggregate only the device XLA-Ops lane and state the
+    binding roof from the trace's own flops/bytes counters."""
+    import gzip
+    import json
+
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+         "args": {"name": "Steps"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 9, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        # 2 device ops: 1 ms conv (1e9 flop, 1e6 B), 1 ms add (0 flop, 3e6 B)
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 0, "dur": 1000, "name": "conv",
+         "args": {"hlo_category": "convolution fusion", "model_flops": "1000000000",
+                  "raw_bytes_accessed": "1000000", "source": "a/resnet.py:1"}},
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 1000, "dur": 1000, "name": "add",
+         "args": {"hlo_category": "loop fusion", "model_flops": "0",
+                  "raw_bytes_accessed": "3000000", "source": "a/resnet.py:2"}},
+        {"ph": "X", "pid": 3, "tid": 1, "ts": 0, "dur": 2000, "name": "step"},
+        # host op on a lane also called "XLA Ops" must NOT be counted
+        {"ph": "X", "pid": 7, "tid": 9, "ts": 0, "dur": 99999, "name": "hostop",
+         "args": {"hlo_category": "loop fusion", "model_flops": "1",
+                  "raw_bytes_accessed": "1"}},
+    ]
+    path = tmp_path / "x.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    out = _load("trace_report").report(
+        str(path), peak_tflops=100.0, peak_gbs=800.0, as_json=True, top=5)
+    assert out["steps_observed"] == 1
+    assert out["device_op_time_ms"] == 2.0          # host lane excluded
+    assert out["achieved_tflops"] == 0.5            # 1e9 flop / 2 ms
+    assert out["achieved_hbm_gbs"] == 2.0           # 4e6 B / 2 ms
+    assert out["by_category_ms"] == {"convolution fusion": 1.0,
+                                     "loop fusion": 1.0}
+    # intensity 250 flop/B > balance point 125 -> compute-bound, ceiling 1.0
+    assert out["bound"] == "compute"
+    assert out["roofline_mfu_ceiling"] == 1.0

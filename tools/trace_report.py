@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Roofline report from a captured XProf trace — where a TPU step's time and
+HBM bytes actually go.
+
+The reference has no profiling surface at all (SURVEY.md §5.1); this tool
+closes the loop the other half of the observability stack opens:
+`DEEPVISION_BENCH_PROFILE_DIR=... python bench.py` (or any trainer's
+`--profile-dir`) captures a trace, and this script turns its
+`*.trace.json.gz` into the numbers that decide the next optimization —
+per-HLO-category time, achieved HBM bandwidth vs the chip's peak, achieved
+FLOP/s vs peak (MFU), arithmetic intensity vs the chip's balance point, and
+the top op sources by time. No TensorBoard needed, no deps beyond stdlib.
+
+    python tools/trace_report.py /tmp/xprof
+    python tools/trace_report.py /tmp/xprof --json     # machine-readable
+    python tools/trace_report.py trace.json.gz --peak-tflops 197 --peak-gbs 819
+
+The verdict line states which roof binds: if achieved GB/s is near peak and
+intensity is below the balance point, more MFU requires moving fewer bytes
+(dtype width, fusion-friendly model structure), not a better schedule.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+# bf16 peak TFLOP/s and HBM GB/s per chip, keyed by lowercased device kind
+KNOWN_CHIPS = {
+    "tpu v5 lite": (197.0, 819.0),
+    "tpu v4": (275.0, 1228.0),
+    "tpu v3": (123.0, 900.0),
+    "tpu v2": (46.0, 700.0),
+    "tpu v6 lite": (918.0, 1640.0),
+}
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                            recursive=True))
+    if not hits:
+        sys.exit(f"no *.trace.json.gz under {path}")
+    return hits[-1]  # latest capture
+
+
+def load_device_ops(trace_path: str):
+    """The XLA-Ops-lane events of the (single) TPU device in the trace."""
+    with gzip.open(trace_path, "rt") as f:
+        events = json.load(f)["traceEvents"]
+    device_pids = {e["pid"] for e in events
+                   if e.get("ph") == "M" and e.get("name") == "process_name"
+                   and "TPU" in e["args"].get("name", "")}
+    if len(device_pids) > 1:
+        # per-step / per-chip arithmetic below assumes one device; a
+        # multi-chip capture would silently report N-chips-summed numbers
+        sys.exit(f"trace contains {len(device_pids)} TPU devices; "
+                 "trace_report analyzes single-chip captures — profile one "
+                 "chip or split the trace")
+    op_lanes = {(e["pid"], e["tid"]) for e in events
+                if e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e["pid"] in device_pids
+                and e["args"].get("name") == "XLA Ops"}
+    step_lanes = {(e["pid"], e["tid"]) for e in events
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"
+                  and e["pid"] in device_pids
+                  and e["args"].get("name") == "Steps"}
+    ops = [e for e in events if e.get("ph") == "X"
+           and (e["pid"], e.get("tid")) in op_lanes]
+    steps = [e for e in events if e.get("ph") == "X"
+             and (e["pid"], e.get("tid")) in step_lanes]
+    return ops, steps
+
+
+def report(trace_path: str, peak_tflops: float, peak_gbs: float,
+           as_json: bool, top: int) -> dict:
+    ops, steps = load_device_ops(trace_path)
+    if not ops:
+        sys.exit("trace has no device XLA-Ops events (CPU-only capture?)")
+    total_us = sum(e.get("dur", 0) for e in ops)
+    flops = sum(int(e["args"].get("model_flops", 0) or 0)
+                for e in ops if "args" in e)
+    bytes_ = sum(int(e["args"].get("raw_bytes_accessed", 0) or 0)
+                 for e in ops if "args" in e)
+    by_cat = collections.Counter()
+    by_src = collections.Counter()
+    for e in ops:
+        a = e.get("args", {})
+        by_cat[a.get("hlo_category", "?")] += e.get("dur", 0)
+        src = a.get("source") or "?"
+        by_src[(a.get("hlo_category", "?"),
+                src.rsplit("/", 1)[-1])] += e.get("dur", 0)
+
+    secs = total_us * 1e-6
+    achieved_tflops = flops / secs / 1e12 if secs else 0.0
+    achieved_gbs = bytes_ / secs / 1e9 if secs else 0.0
+    intensity = flops / bytes_ if bytes_ else 0.0
+    balance = peak_tflops * 1e3 / peak_gbs  # FLOP/byte where the roofs cross
+    bw_bound = intensity < balance
+    # the fraction of FLOP peak the binding roof allows at this intensity:
+    # below the balance point the bandwidth roof caps FLOP/s at
+    # peak_gbs * intensity
+    roof_mfu = min(1.0, intensity / balance) if balance else 1.0
+    out = {
+        "trace": trace_path,
+        "device_op_time_ms": round(total_us / 1e3, 2),
+        "steps_observed": len(steps),
+        "model_tflop": round(flops / 1e12, 3),
+        "hbm_gbytes": round(bytes_ / 1e9, 2),
+        "achieved_tflops": round(achieved_tflops, 1),
+        "achieved_hbm_gbs": round(achieved_gbs, 1),
+        "mfu": round(achieved_tflops / peak_tflops, 3),
+        "hbm_utilization": round(achieved_gbs / peak_gbs, 3),
+        "arithmetic_intensity_flop_per_byte": round(intensity, 1),
+        "chip_balance_point_flop_per_byte": round(balance, 1),
+        "bound": "bandwidth" if bw_bound else "compute",
+        "roofline_mfu_ceiling": round(roof_mfu, 3),
+        "by_category_ms": {k: round(v / 1e3, 2)
+                           for k, v in by_cat.most_common()},
+        "top_sources_ms": [
+            {"category": c, "source": s, "ms": round(v / 1e3, 2)}
+            for (c, s), v in by_src.most_common(top)],
+    }
+    if as_json:
+        print(json.dumps(out))
+        return out
+    print(f"trace: {trace_path}")
+    print(f"device busy {out['device_op_time_ms']} ms over "
+          f"{out['steps_observed']} steps; {out['model_tflop']} TFLOP, "
+          f"{out['hbm_gbytes']} GB accessed")
+    print(f"achieved {out['achieved_tflops']} TFLOP/s "
+          f"({out['mfu']:.0%} of {peak_tflops:.0f} peak)  |  "
+          f"{out['achieved_hbm_gbs']} GB/s "
+          f"({out['hbm_utilization']:.0%} of {peak_gbs:.0f} peak)")
+    print(f"arithmetic intensity {intensity:.0f} FLOP/byte vs balance point "
+          f"{balance:.0f} -> {out['bound']}-bound; "
+          f"roofline MFU ceiling at this intensity ~{roof_mfu:.0%}")
+    print("\ntime by HLO category:")
+    for k, v in by_cat.most_common():
+        print(f"  {v/1e3:9.2f} ms  {100*v/total_us:5.1f}%  {k}")
+    print(f"\ntop {top} sources:")
+    for (c, s), v in by_src.most_common(top):
+        print(f"  {v/1e3:9.2f} ms  {c:24s} {s}")
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("trace", help="profile dir or *.trace.json.gz file")
+    p.add_argument("--peak-tflops", type=float, default=None)
+    p.add_argument("--peak-gbs", type=float, default=None)
+    p.add_argument("--chip", default="tpu v5 lite",
+                   help="known chip for default peaks: " +
+                        ", ".join(KNOWN_CHIPS))
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--top", type=int, default=12)
+    a = p.parse_args(argv)
+    if a.chip.lower() not in KNOWN_CHIPS and not (a.peak_tflops and a.peak_gbs):
+        p.error(f"unknown chip {a.chip!r} (known: {', '.join(KNOWN_CHIPS)}); "
+                "pass --peak-tflops AND --peak-gbs explicitly")
+    tf_peak, bw_peak = KNOWN_CHIPS.get(a.chip.lower(), (0.0, 0.0))
+    report(find_trace(a.trace),
+           a.peak_tflops or tf_peak, a.peak_gbs or bw_peak,
+           a.json, a.top)
+
+
+if __name__ == "__main__":
+    main()
