@@ -1,0 +1,93 @@
+"""Quantitative generator evaluation: Fréchet distance on classifier features.
+
+The reference judges its GANs entirely by eye — saved sample grids
+(`DCGAN/tensorflow/main.py:89-108`, `CycleGAN/tensorflow/train.py:335-343`)
+and no metric anywhere — so a silently degraded generator is invisible to it.
+This module gives the GAN family a number the way classification has top-1:
+the Fréchet distance (Heusel et al. 2017) between Gaussian fits of real and
+generated feature activations, with the feature extractor a parameter (the
+production gate uses the repo's own LeNet-5 penultimate layer on
+MNIST-shaped data; any classifier's embedding works).
+
+All math is numpy + eigendecompositions — no scipy.sqrtm, whose Schur-based
+result can go complex on near-singular products; the eigh route stays real,
+deterministic, and exact for the PSD inputs covariance matrices are.
+
+Scale caveat, measured (tests/test_gan_quality.py pins the evaluator, not a
+quality bar, on the offline digits set): on the 1797-scan UCI digits proxy
+a DCGAN cannot beat untrained-noise feature statistics — the set is ~33x
+smaller than the MNIST the reference's recipe assumes, and the trained
+generator's tight off-manifold cluster scores *worse* than broad random
+noise (measured round 4: trained ≈215-240 vs untrained ≈171, real-vs-real
+floor ≈2). Quality-bar assertions therefore live behind the real-MNIST
+fetch gate; offline CI pins trainer *behavior* (no collapse, no NaNs,
+moved-from-init) instead of sample quality.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+
+def gaussian_stats(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Mean vector and covariance of an (N, D) feature matrix, f64."""
+    f = np.asarray(features, dtype=np.float64)
+    if f.ndim != 2:
+        raise ValueError(f"features must be (N, D), got {f.shape}")
+    if f.shape[0] < 2:
+        raise ValueError("need at least 2 samples for a covariance")
+    return f.mean(axis=0), np.cov(f, rowvar=False)
+
+
+def _psd_sqrt(mat: np.ndarray) -> np.ndarray:
+    """Symmetric PSD square root via eigh; negative eigenvalues from
+    floating-point noise are clipped to zero."""
+    vals, vecs = np.linalg.eigh((mat + mat.T) / 2.0)
+    return (vecs * np.sqrt(np.clip(vals, 0.0, None))) @ vecs.T
+
+
+def frechet_distance(mu1: np.ndarray, cov1: np.ndarray,
+                     mu2: np.ndarray, cov2: np.ndarray) -> float:
+    """d² = |μ1-μ2|² + tr(C1 + C2 - 2·(C1^½ C2 C1^½)^½).
+
+    The symmetrized trace form equals the textbook tr·sqrt(C1·C2) for PSD
+    inputs but keeps every intermediate real and symmetric.
+    """
+    diff = np.asarray(mu1, np.float64) - np.asarray(mu2, np.float64)
+    s1 = _psd_sqrt(np.asarray(cov1, np.float64))
+    inner = _psd_sqrt(s1 @ np.asarray(cov2, np.float64) @ s1)
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2)
+                 - 2.0 * np.trace(inner))
+
+
+def frechet_from_features(real: np.ndarray, generated: np.ndarray) -> float:
+    """Fréchet distance between two (N, D) feature sets."""
+    return frechet_distance(*gaussian_stats(real), *gaussian_stats(generated))
+
+
+def lenet_feature_fn(params, image_size: int = 32) -> Callable[[np.ndarray],
+                                                               np.ndarray]:
+    """Penultimate-layer (f6, 84-dim) embedding of the repo's LeNet-5 —
+    the production feature extractor for MNIST-shaped GAN evaluation.
+    `params` is a trained LeNet-5 params pytree; images smaller than
+    `image_size` are symmetrically padded with -1 (the normalized
+    background the classifier was trained with)."""
+    from ..models.lenet import LeNet5
+
+    model = LeNet5(num_classes=10)
+
+    def features(images: np.ndarray) -> np.ndarray:
+        x = np.asarray(images, np.float32)
+        pad = image_size - x.shape[1]
+        if pad > 0:
+            lo, hi = pad // 2, pad - pad // 2
+            x = np.pad(x, ((0, 0), (lo, hi), (lo, hi), (0, 0)),
+                       constant_values=-1.0)
+        _, state = model.apply(
+            {"params": params}, x,
+            capture_intermediates=lambda mdl, _: mdl.name == "f6")
+        return np.asarray(state["intermediates"]["f6"]["__call__"][0])
+
+    return features

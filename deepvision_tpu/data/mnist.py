@@ -54,16 +54,23 @@ FILES = {
 }
 
 
-def load_split(data_dir: str, split: str) -> Tuple[np.ndarray, np.ndarray]:
+def load_raw_split(data_dir: str, split: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw uint8 (N,28,28) images + int32 labels for a split, resolving
+    bare-vs-.gz idx files — the shared load path under both the normalized
+    classification pipeline (`load_split`) and consumers that apply their
+    own scaling (the GAN gate's [-1,1], `tests/test_gan_quality.py`)."""
     img_name, lbl_name = FILES[split]
     img_path, lbl_path = os.path.join(data_dir, img_name), os.path.join(data_dir, lbl_name)
     if not os.path.exists(img_path) and os.path.exists(img_path + ".gz"):
         img_path += ".gz"
     if not os.path.exists(lbl_path) and os.path.exists(lbl_path + ".gz"):
         lbl_path += ".gz"
-    images = preprocess(read_idx_images(img_path))
-    labels = read_idx_labels(lbl_path).astype(np.int32)
-    return images, labels
+    return read_idx_images(img_path), read_idx_labels(lbl_path).astype(np.int32)
+
+
+def load_split(data_dir: str, split: str) -> Tuple[np.ndarray, np.ndarray]:
+    images, labels = load_raw_split(data_dir, split)
+    return preprocess(images), labels
 
 
 class MnistBatches:

@@ -1,0 +1,249 @@
+"""Quantitative GAN gates (VERDICT r3 weak item 6).
+
+The reference's GAN story is eyeball-only: sample grids every epoch and no
+metric anywhere (`DCGAN/tensorflow/main.py:89-108`) — nothing would catch a
+silently degraded generator. Three layers close that:
+
+1. Fréchet-distance evaluator (`core/eval_gan.py`) unit-pinned against
+   analytic cases — the *metric* is exact regardless of data scale.
+2. `test_dcgan_digits_behavior_pinned` — offline regression gate through the
+   production `DCGANTrainer` on REAL scanned digits: fixed seed, 2 epochs,
+   committed bands for the adversarial losses and the generator's output
+   statistics. Catches the silent failure modes (mode collapse to a
+   constant, dead/saturated generator, NaN step, un-trained params) without
+   claiming sample *quality* — measured round 4, a DCGAN cannot beat
+   untrained-noise feature statistics on a 1797-image set (trained FID
+   ≈215-240 vs untrained ≈171, real-vs-real floor ≈2; see
+   `core/eval_gan.py`'s scale caveat), so a quality bar here would pin
+   noise, not quality.
+3. `test_dcgan_real_mnist_fid_improves` — the quality bar itself, on the
+   data the reference's recipe actually assumes (60k MNIST), activating
+   once `Datasets/MNIST/fetch_mnist.sh` has run.
+
+Calibration evidence for the committed bands (fixed-seed run, round 4):
+untrained sample std 0.075, range ±0.42; after 2 epochs std 0.506, range
+-0.96..1.0, per-pixel-across-samples std 0.069, mean |Δpixel| from init
+0.411; final disc_loss 0.654, gen_loss 0.940.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST_DIR = os.path.join(REPO, "Datasets", "MNIST", "dataset")
+_MNIST_FILES = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+
+
+def _have_mnist() -> bool:
+    return all(os.path.exists(os.path.join(MNIST_DIR, f)) or
+               os.path.exists(os.path.join(MNIST_DIR, f + ".gz"))
+               for f in _MNIST_FILES)
+
+
+def _digits28():
+    """All 1797 real scans as 28x28 in [-1, 1] (GAN normalization,
+    `deepvision_tpu/data/gan.py`): crop the 32px upsample's 2px border."""
+    from deepvision_tpu.data.digits import load_raw
+
+    images, labels = load_raw(32)
+    return images[:, 2:30, 2:30, :] * 2.0 - 1.0, labels
+
+
+def _dcgan_config(name, epochs, n_examples, batch=64):
+    from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                            ScheduleConfig, TrainConfig)
+    return TrainConfig(
+        name=name, model="dcgan", family="gan", batch_size=batch,
+        total_epochs=epochs,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-4),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="digits", image_size=28, channels=1,
+                        num_classes=10, train_examples=n_examples),
+        dtype="float32", seed=0)
+
+
+# ---------------------------------------------------------------------------
+# 1. the metric itself
+# ---------------------------------------------------------------------------
+
+def test_frechet_identical_distributions_is_zero():
+    from deepvision_tpu.core.eval_gan import frechet_from_features
+
+    f = np.random.RandomState(0).randn(500, 16)
+    assert abs(frechet_from_features(f, f)) < 1e-9
+
+
+def test_frechet_mean_shift_is_squared_distance():
+    """Equal covariances: d² reduces to |μ1-μ2|² exactly."""
+    from deepvision_tpu.core.eval_gan import frechet_distance
+
+    rs = np.random.RandomState(1)
+    cov = np.cov(rs.randn(200, 8), rowvar=False)
+    mu = rs.randn(8)
+    shift = np.zeros(8)
+    shift[0] = 3.0
+    d = frechet_distance(mu, cov, mu + shift, cov)
+    assert abs(d - 9.0) < 1e-8
+
+
+def test_frechet_analytic_diagonal_case():
+    """Diagonal covariances: d² = Σ(σ1-σ2)² + |μ1-μ2|² in closed form."""
+    from deepvision_tpu.core.eval_gan import frechet_distance
+
+    mu1, mu2 = np.zeros(3), np.array([1.0, 0.0, 0.0])
+    c1 = np.diag([4.0, 1.0, 9.0])
+    c2 = np.diag([1.0, 1.0, 4.0])
+    expected = 1.0 + (2 - 1) ** 2 + 0.0 + (3 - 2) ** 2
+    assert abs(frechet_distance(mu1, c1, mu2, c2) - expected) < 1e-9
+
+
+def test_frechet_detects_covariance_collapse():
+    """A mode-collapsed generator (tiny covariance) must score far from the
+    real distribution even with a matching mean."""
+    from deepvision_tpu.core.eval_gan import frechet_from_features
+
+    rs = np.random.RandomState(2)
+    real = rs.randn(400, 12)
+    collapsed = 0.01 * rs.randn(400, 12)  # same mean, no spread
+    assert frechet_from_features(real, collapsed) > 5.0
+
+
+def test_lenet_feature_fn_shapes_and_padding():
+    import jax
+
+    from deepvision_tpu.core.eval_gan import lenet_feature_fn
+    from deepvision_tpu.models.lenet import LeNet5
+
+    params = LeNet5(num_classes=10).init(
+        jax.random.PRNGKey(0), np.zeros((2, 32, 32, 1), np.float32))["params"]
+    feats = lenet_feature_fn(params)
+    out = feats(np.zeros((5, 28, 28, 1), np.float32))  # pads 28->32
+    assert out.shape == (5, 84)
+    assert np.all(np.isfinite(out))
+
+
+# ---------------------------------------------------------------------------
+# 2. offline behavior pin through the production trainer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dcgan_digits_behavior_pinned(tmp_path):
+    import jax
+
+    from deepvision_tpu.core.gan import DCGANTrainer
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    x28, y = _digits28()
+    # one-device mesh: this gate pins *trainer behavior* (the mesh8 GAN
+    # mechanics are test_gan.py's job), and the 8-virtual-device CPU
+    # backend's collective rendezvous aborts under the deep async queues an
+    # unsynced GAN epoch builds on a 1-core host (measured round 4:
+    # rendezvous.cc 40s termination timeout)
+    one_dev = mesh_lib.make_mesh(devices=jax.devices()[:1])
+    trainer = DCGANTrainer(_dcgan_config("dcgan_pin", 2, len(y)),
+                           workdir=str(tmp_path), mesh=one_dev)
+    fake0 = trainer.generate(256, rng=jax.random.PRNGKey(7))
+
+    rs = np.random.RandomState(3)
+    last = {}
+    for _ in range(2):
+        order = rs.permutation(len(y))
+        for i in range(0, len(y) - 63, 64):
+            last = trainer.train_batch(x28[order[i:i + 64]])
+            jax.block_until_ready(last)  # bound the async dispatch queue
+    last = {k: float(v) for k, v in jax.device_get(last).items()}
+    fake1 = trainer.generate(256, rng=jax.random.PRNGKey(7))
+    trainer.close()
+
+    # adversarial equilibrium band (calibrated 0.654 / 0.940): a dead
+    # discriminator drives disc_loss -> 0, a dead generator gen_loss >> 3
+    assert np.isfinite(list(last.values())).all(), last
+    assert 0.2 < last["disc_loss"] < 1.5, last
+    assert 0.3 < last["gen_loss"] < 3.0, last
+    # the generator must actually train (calibrated mean |delta| 0.411)
+    assert np.abs(fake1 - fake0).mean() > 0.1, "params did not move"
+    # and use its dynamic range without saturating (calibrated std 0.506,
+    # mean 0.007): an all-background or all-ink generator fails both
+    assert fake1.std() > 0.25, f"saturated/dead output, std={fake1.std()}"
+    assert abs(float(fake1.mean())) < 0.5, f"mean drifted: {fake1.mean()}"
+    # distinct noise vectors must yield distinct samples (calibrated
+    # per-pixel-across-samples std 0.069; collapse-to-constant ~ 0)
+    per_pixel = float(np.std(np.asarray(fake1), axis=0).mean())
+    assert per_pixel > 0.02, f"mode collapse to constant: {per_pixel}"
+
+
+# ---------------------------------------------------------------------------
+# 3. the quality bar, on the data the recipe assumes (needs fetch_mnist.sh)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _have_mnist(),
+                    reason="MNIST idx images not fetched (run "
+                           "Datasets/MNIST/fetch_mnist.sh; needs network)")
+def test_dcgan_real_mnist_fid_improves(tmp_path):
+    """On real 60k MNIST, 3 production epochs must cut the LeNet-feature
+    Fréchet distance to well under the untrained generator's (the
+    reference's recipe trains 50, `DCGAN/tensorflow/main.py:13-16`)."""
+    import jax
+
+    from deepvision_tpu.core.eval_gan import (frechet_from_features,
+                                              lenet_feature_fn)
+    from deepvision_tpu.core.gan import DCGANTrainer
+    from deepvision_tpu.data.mnist import load_raw_split
+    from deepvision_tpu.models.lenet import LeNet5
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    import optax
+
+    raw, tr_y = load_raw_split(MNIST_DIR, "train")
+    # GAN normalization ([-1,1], `deepvision_tpu/data/gan.py:29`)
+    x28 = (raw.astype(np.float32) / 127.5 - 1.0)[..., None]
+
+    # quick feature classifier on the same data
+    model = LeNet5(num_classes=10)
+    pad = ((0, 0), (2, 2), (2, 2), (0, 0))
+    x32 = np.pad(x28, pad, constant_values=-1.0)
+    params = model.init(jax.random.PRNGKey(1), x32[:2])["params"]
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, bx, by):
+        def loss_fn(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply({"params": p}, bx), by).mean()
+        _, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(params, upd), opt
+
+    rs = np.random.RandomState(2)
+    for _ in range(2):
+        order = rs.permutation(len(tr_y))
+        for i in range(0, len(tr_y) - 255, 256):
+            sel = order[i:i + 256]
+            params, opt = step(params, opt, x32[sel],
+                               tr_y[sel].astype(np.int32))
+    feats = lenet_feature_fn(params)
+    real_sample = x28[rs.permutation(len(x28))[:2048]]
+
+    # one-device mesh + per-step sync: same rendezvous-abort avoidance as
+    # the offline pin test (the 8-virtual-device CPU backend aborts under
+    # hundreds of unsynced collective dispatches on a low-core host)
+    trainer = DCGANTrainer(_dcgan_config("dcgan_mnist_fid", 3, len(x28),
+                                         batch=256),
+                           workdir=str(tmp_path),
+                           mesh=mesh_lib.make_mesh(devices=jax.devices()[:1]))
+    fid_untrained = frechet_from_features(
+        feats(real_sample), feats(trainer.generate(1024,
+                                                   jax.random.PRNGKey(9))))
+    for _ in range(3):
+        order = rs.permutation(len(x28))
+        for i in range(0, len(x28) - 255, 256):
+            jax.block_until_ready(trainer.train_batch(x28[order[i:i + 256]]))
+    fid_trained = frechet_from_features(
+        feats(real_sample), feats(trainer.generate(1024,
+                                                   jax.random.PRNGKey(9))))
+    trainer.close()
+    assert fid_trained < 0.7 * fid_untrained, (fid_trained, fid_untrained)

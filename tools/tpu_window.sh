@@ -1,27 +1,36 @@
 #!/bin/bash
-# First-reachable-TPU-window playbook: run the ENTIRE round-3 measured-
-# evidence chain the moment the axon tunnel comes up, in priority order
-# (VERDICT r2 items 1-4). Every stage is wedge-proof: the python tools ride
-# bench.py's killable-worker runner, and the train stage runs in its own
-# process group with a hard group-kill watchdog.
+# First-reachable-TPU-window playbook: run the round's measured-evidence
+# chain the moment the axon tunnel comes up. Every stage is wedge-proof
+# (killable workers / own process group with a hard group-kill watchdog),
+# every artifact is skip-if-already-landed, and the stages run in VALUE
+# order — round-4 measurement: a tunnel window can be ~25 minutes long, so
+# the most-committable artifact must come first, not last.
 #
-#   bash tools/tpu_window.sh [OUT_DIR=/tmp/tpu_window]
+#   bash tools/tpu_window.sh [OUT_DIR=/tmp/tpu_window] [ROUND=r04]
 #
 # Stages (artifacts in OUT_DIR + the repo, for committing):
 #   1. bench.py + in-worker XProf   -> fresh BENCH_CACHE.json, OUT_DIR/xprof/
-#   2. tools/bench_sweep.py         -> OUT_DIR/SWEEP.json (MFU flag attack)
+#      + tools/trace_report.py      -> OUT_DIR/xprof_report.json (roofline)
+#   2. ResNet/jax/train.py synthetic-> runs/{ROUND}_resnet50_tpu/*.jsonl
+#      (committed-training-log role; --steps-per-dispatch 10 keeps host
+#      dispatches off the per-step path — relay dispatch latency is seconds)
 #   3. tools/bench_dispatch.py      -> OUT_DIR/DISPATCH.json (knob-8 table)
-#   4. ResNet/jax/train.py synthetic-> runs/r03_resnet50_tpu/*.jsonl artifact
+#   4. tools/bench_sweep.py         -> OUT_DIR/SWEEP.json (XLA flag attack;
+#      last because round-4 measured every non-baseline combo wedging the
+#      relay compile — see docs/TUNING.md)
 #
 # Exit 1: chip unreachable at the gate (stage 1) — nothing else ran.
 # Exit 2: gate passed but a later stage's artifact is missing (tunnel
-#         dropped mid-chain) — the partial evidence is kept.
+#         dropped mid-chain) — the partial evidence is kept; a re-run
+#         skips whatever already landed.
 # Exit 0: every artifact landed.
 # Either nonzero exit re-arms a retry loop:
 #   until bash tools/tpu_window.sh; do sleep 60; done
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/tpu_window}"
+ROUND="${2:-r04}"
+RUN_DIR="runs/${ROUND}_resnet50_tpu"
 mkdir -p "$OUT"
 
 run_bounded() {  # run_bounded SECONDS cmd... : own process group, hard kill
@@ -47,31 +56,47 @@ if ! grep -q '"platform": "tpu"' "$OUT/bench.json" || \
     exit 1
 fi
 echo "[tpu_window] FRESH TPU NUMBER LANDED: $(cat "$OUT/bench.json")" >&2
+python tools/trace_report.py "$OUT/xprof" --json \
+    > "$OUT/xprof_report.json" 2>/dev/null || true
 
-echo "[tpu_window] stage 2: XLA flag sweep" >&2
-python tools/bench_sweep.py --timeout 600 --out "$OUT/SWEEP.json" \
-    2>> "$OUT/bench.log" || true
+# Completeness predicates — `[ -s file ]` alone would let a partial artifact
+# from a dropped tunnel satisfy the skip check forever (a truncated training
+# log or an all-null grid is NOT landed evidence):
+train_done() {  # both epochs' val lines present in the JSONL
+    [ "$(grep -c '"val_' "$RUN_DIR/resnet50_tpu.jsonl" 2>/dev/null)" -ge 2 ]
+}
+grid_done() {  # $1=file $2=min numeric rows (baseline alone isn't a grid)
+    [ "$(grep -c '"value": [0-9]' "$1" 2>/dev/null)" -ge "$2" ]
+}
+
+echo "[tpu_window] stage 2: committed run artifact (200 synthetic steps)" >&2
+if ! train_done; then
+    rm -f "$RUN_DIR/resnet50_tpu.jsonl"   # partial log restarts clean
+    run_bounded 1800 python ResNet/jax/train.py -m resnet50_tpu --synthetic \
+        --batch-size 256 --epochs 2 --steps-per-epoch 100 \
+        --steps-per-dispatch 10 \
+        --workdir "$RUN_DIR" 2>> "$OUT/bench.log" || true
+fi
 
 echo "[tpu_window] stage 3: dispatch-lever grid" >&2
-python tools/bench_dispatch.py --timeout 900 --out "$OUT/DISPATCH.json" \
-    2>> "$OUT/bench.log" || true
+if ! grid_done "$OUT/DISPATCH.json" 1; then
+    python tools/bench_dispatch.py --timeout 900 --out "$OUT/DISPATCH.json" \
+        2>> "$OUT/bench.log" || true
+fi
 
-echo "[tpu_window] stage 4: committed run artifact (300 synthetic steps)" >&2
-run_bounded 1800 python ResNet/jax/train.py -m resnet50_tpu --synthetic \
-    --batch-size 256 --epochs 3 --steps-per-epoch 100 \
-    --workdir runs/r03_resnet50_tpu 2>> "$OUT/bench.log" || true
+echo "[tpu_window] stage 4: XLA flag sweep" >&2
+if ! grid_done "$OUT/SWEEP.json" 2; then
+    python tools/bench_sweep.py --timeout 600 --out "$OUT/SWEEP.json" \
+        2>> "$OUT/bench.log" || true
+fi
 
 missing=0
-for f in "$OUT/SWEEP.json" "$OUT/DISPATCH.json" \
-         runs/r03_resnet50_tpu/resnet50_tpu.jsonl; do
-    if [ ! -s "$f" ]; then
-        echo "[tpu_window] MISSING: $f (tunnel drop mid-chain?)" >&2
-        missing=1
-    fi
-done
+train_done || { echo "[tpu_window] MISSING: complete $RUN_DIR/resnet50_tpu.jsonl" >&2; missing=1; }
+grid_done "$OUT/DISPATCH.json" 1 || { echo "[tpu_window] MISSING: measured DISPATCH.json" >&2; missing=1; }
+grid_done "$OUT/SWEEP.json" 2 || { echo "[tpu_window] MISSING: measured SWEEP.json" >&2; missing=1; }
 if [ "$missing" -ne 0 ]; then
     echo "[tpu_window] partial chain — keep what landed, loop re-arms" >&2
     exit 2
 fi
 echo "[tpu_window] chain complete; artifacts in $OUT + BENCH_CACHE.json +" \
-     "runs/r03_resnet50_tpu — review and commit" >&2
+     "$RUN_DIR — review and commit" >&2
