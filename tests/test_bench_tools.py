@@ -92,3 +92,20 @@ def test_trace_report_roofline_math(tmp_path):
     # intensity 250 flop/B > balance point 125 -> compute-bound, ceiling 1.0
     assert out["bound"] == "compute"
     assert out["roofline_mfu_ceiling"] == 1.0
+
+
+def test_trace_report_reproduces_committed_roofline_artifact():
+    """The committed round-4 roofline REPORT.json must equal a fresh
+    trace_report run over the committed trace — the artifact can't drift
+    from the tool that claims to have produced it."""
+    import json
+
+    art = os.path.join(os.path.dirname(__file__), "..",
+                       "runs", "r04_resnet50_tpu_profile")
+    with open(os.path.join(art, "REPORT.json")) as f:
+        committed = json.load(f)
+    mod = _load("trace_report")
+    fresh = mod.report(mod.find_trace(art), peak_tflops=197.0, peak_gbs=819.0,
+                       as_json=True, top=12)
+    fresh["trace"] = committed["trace"]  # path differs by invocation cwd
+    assert fresh == committed

@@ -175,6 +175,71 @@ def test_dcgan_digits_behavior_pinned(tmp_path):
     assert per_pixel > 0.02, f"mode collapse to constant: {per_pixel}"
 
 
+@pytest.mark.slow
+def test_cyclegan_digits_behavior_pinned(tmp_path):
+    """CycleGAN's analog of the DCGAN pin: the production two-phase trainer
+    on a REAL unpaired domain pair — scanned digits vs their inverted-ink
+    versions (white-on-black vs black-on-white) at 64px. Fixed seed,
+    committed bands calibrated round 4: over 24 steps loss_gen_total
+    9.9 -> 5.1, cycle reconstruction error 0.79 -> 0.48, translated
+    outputs moved 0.42/pixel from the untrained generator's."""
+    import jax
+
+    from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                            ScheduleConfig, TrainConfig)
+    from deepvision_tpu.core.gan import CycleGANTrainer
+    from deepvision_tpu.data.digits import load_raw
+    from deepvision_tpu.parallel import mesh as mesh_lib
+
+    images, _ = load_raw(64)
+    dom_a = np.repeat(images * 2.0 - 1.0, 3, axis=-1).astype(np.float32)
+    dom_b = -dom_a[::-1]  # inverted ink, unpaired order
+
+    cfg = TrainConfig(
+        name="cyclegan_pin", model="cyclegan", family="gan",
+        batch_size=4, total_epochs=1,
+        optimizer=OptimizerConfig(name="adam", learning_rate=2e-4, beta1=0.5),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="digits", image_size=64, num_classes=0,
+                        train_examples=96),
+        dtype="float32", seed=0)
+    trainer = CycleGANTrainer(cfg, workdir=str(tmp_path), image_size=64,
+                              n_blocks=3, pool_size=8,
+                              mesh=mesh_lib.make_mesh(
+                                  devices=jax.devices()[:1]))
+
+    def cycle_err(a2b, x):
+        return float(np.abs(trainer.translate(a2b, "b2a") - x).mean())
+
+    probe = dom_a[:8]
+    translated0 = trainer.translate(probe, "a2b")
+    err0 = cycle_err(translated0, probe)
+
+    rs = np.random.RandomState(3)
+    last = {}
+    for _ in range(24):
+        ia = rs.randint(0, len(dom_a), 4)
+        ib = rs.randint(0, len(dom_b), 4)
+        # train_batch host-syncs every step already (the ImagePool round
+        # trip), so no explicit queue bounding is needed here
+        last = trainer.train_batch(dom_a[ia], dom_b[ib])
+    last = {k: float(v) for k, v in last.items()}
+    translated1 = trainer.translate(probe, "a2b")
+    err1 = cycle_err(translated1, probe)
+    moved = float(np.abs(translated1 - translated0).mean())
+    trainer.close()
+
+    assert np.isfinite(list(last.values())).all(), last
+    # calibrated 5.07 from ~9.9 at init; a dead generator phase stays high
+    assert last["loss_gen_total"] < 8.0, last
+    # calibrated 0.38; a collapsed discriminator drives this -> 0
+    assert 0.05 < last["loss_dis_total"] < 2.0, last
+    # the cycle must actually tighten (calibrated 0.61x) and the generator
+    # must leave its initialization (calibrated 0.42)
+    assert err1 < 0.8 * err0, (err1, err0)
+    assert moved > 0.1, moved
+
+
 # ---------------------------------------------------------------------------
 # 3. the quality bar, on the data the recipe assumes (needs fetch_mnist.sh)
 # ---------------------------------------------------------------------------
