@@ -1,8 +1,10 @@
 """Quantitative generator evaluation: Fréchet distance on classifier features.
 
-The reference judges its GANs entirely by eye — saved sample grids
-(`DCGAN/tensorflow/main.py:89-108`, `CycleGAN/tensorflow/train.py:335-343`)
-and no metric anywhere — so a silently degraded generator is invisible to it.
+The reference judges its GANs with no metric at all — its training loops
+emit only checkpoint saves and epoch-time prints
+(`DCGAN/tensorflow/main.py:75-85`, `CycleGAN/tensorflow/train.py:331`),
+with sample inspection left to the separate inference scripts — so a
+silently degraded generator is invisible to it.
 This module gives the GAN family a number the way classification has top-1:
 the Fréchet distance (Heusel et al. 2017) between Gaussian fits of real and
 generated feature activations, with the feature extractor a parameter (the
@@ -81,6 +83,13 @@ def lenet_feature_fn(params, image_size: int = 32) -> Callable[[np.ndarray],
     def features(images: np.ndarray) -> np.ndarray:
         x = np.asarray(images, np.float32)
         pad = image_size - x.shape[1]
+        if pad < 0:
+            raise ValueError(
+                f"lenet_feature_fn: images are {x.shape[1]}px but the "
+                f"feature extractor was built for {image_size}px — larger "
+                "inputs would hit LeNet with a receptive field it was never "
+                "trained on; resize the images or rebuild with a matching "
+                "image_size")
         if pad > 0:
             lo, hi = pad // 2, pad - pad // 2
             x = np.pad(x, ((0, 0), (lo, hi), (lo, hi), (0, 0)),

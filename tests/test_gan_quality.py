@@ -1,7 +1,8 @@
 """Quantitative GAN gates (VERDICT r3 weak item 6).
 
-The reference's GAN story is eyeball-only: sample grids every epoch and no
-metric anywhere (`DCGAN/tensorflow/main.py:89-108`) — nothing would catch a
+The reference's GAN story has no metric anywhere: its training loops emit
+only checkpoint saves and epoch-time prints
+(`DCGAN/tensorflow/main.py:75-85`) — nothing would catch a
 silently degraded generator. Three layers close that:
 
 1. Fréchet-distance evaluator (`core/eval_gan.py`) unit-pinned against

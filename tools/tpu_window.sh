@@ -62,11 +62,18 @@ python tools/trace_report.py "$OUT/xprof" --json \
 # Completeness predicates — `[ -s file ]` alone would let a partial artifact
 # from a dropped tunnel satisfy the skip check forever (a truncated training
 # log or an all-null grid is NOT landed evidence):
+count_matches() {  # $1=pattern $2=file -> match count; 0 for missing/empty
+    # (grep -c prints "0" AND exits 1 on zero matches, so `|| echo 0` would
+    # emit a second line; capture first, default only the missing-file case)
+    local c
+    c=$(grep -c "$1" "$2" 2>/dev/null)
+    echo "${c:-0}"
+}
 train_done() {  # both epochs' val lines present in the JSONL
-    [ "$(grep -c '"val_' "$RUN_DIR/resnet50_tpu.jsonl" 2>/dev/null)" -ge 2 ]
+    [ "$(count_matches '"val_' "$RUN_DIR/resnet50_tpu.jsonl")" -ge 2 ]
 }
 grid_done() {  # $1=file $2=min numeric rows (baseline alone isn't a grid)
-    [ "$(grep -c '"value": [0-9]' "$1" 2>/dev/null)" -ge "$2" ]
+    [ "$(count_matches '"value": [0-9]' "$1")" -ge "$2" ]
 }
 
 echo "[tpu_window] stage 2: committed run artifact (200 synthetic steps)" >&2

@@ -43,10 +43,10 @@ def find_trace(path: str) -> str:
     if os.path.isfile(path):
         return path
     hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
-                            recursive=True))
+                            recursive=True), key=os.path.getmtime)
     if not hits:
         sys.exit(f"no *.trace.json.gz under {path}")
-    return hits[-1]  # latest capture
+    return hits[-1]  # latest capture by mtime (filenames may be renamed)
 
 
 def load_device_ops(trace_path: str):
@@ -114,6 +114,12 @@ def report(trace_path: str, peak_tflops: float, peak_gbs: float,
         "hbm_gbytes": round(bytes_ / 1e9, 2),
         "achieved_tflops": round(achieved_tflops, 1),
         "achieved_hbm_gbs": round(achieved_gbs, 1),
+        # raw_bytes_accessed is XLA's cost-analysis estimate of bytes each
+        # fusion touches, not a hardware HBM counter — fusion-internal reuse
+        # or spills can make true DRAM traffic differ, so bandwidth-derived
+        # numbers below carry model-estimate uncertainty:
+        "bytes_source": "xla-cost-model (raw_bytes_accessed), not a "
+                        "hardware HBM counter",
         "mfu": round(achieved_tflops / peak_tflops, 3),
         "hbm_utilization": round(achieved_gbs / peak_gbs, 3),
         "arithmetic_intensity_flop_per_byte": round(intensity, 1),
@@ -136,7 +142,8 @@ def report(trace_path: str, peak_tflops: float, peak_gbs: float,
     print(f"achieved {out['achieved_tflops']} TFLOP/s "
           f"({out['mfu']:.0%} of {peak_tflops:.0f} peak)  |  "
           f"{out['achieved_hbm_gbs']} GB/s "
-          f"({out['hbm_utilization']:.0%} of {peak_gbs:.0f} peak)")
+          f"({out['hbm_utilization']:.0%} of {peak_gbs:.0f} peak; "
+          f"cost-model bytes, not a hardware counter)")
     print(f"arithmetic intensity {intensity:.0f} FLOP/byte vs balance point "
           f"{balance:.0f} -> {out['bound']}-bound; "
           f"roofline MFU ceiling at this intensity ~{roof_mfu:.0%}")
