@@ -71,7 +71,12 @@ def worker() -> None:
     batch = 256 if platform == "tpu" else 32  # per-chip ImageNet batch
     image_size = 224 if platform == "tpu" else 64
 
-    model = MODELS.get("resnet50")(num_classes=1000)
+    # variant lever for the HBM-traffic grid (tools/bench_traffic.py): extra
+    # model kwargs as JSON, e.g. '{"lowp_bn": true}'. Non-empty kwargs tag
+    # the metric name and the orchestrator skips the headline cache for them.
+    variant_kwargs = json.loads(
+        os.environ.get("DEEPVISION_BENCH_KWARGS") or "{}")
+    model = MODELS.get("resnet50")(num_classes=1000, **variant_kwargs)
     rng = jax.random.PRNGKey(0)
     params, batch_stats = init_model(model, rng,
                                      jnp.zeros((2, image_size, image_size, 3)))
@@ -124,10 +129,38 @@ def worker() -> None:
     if dt <= 0:  # degenerate timing (clock noise) — fall back to the long run
         dt, n_steps = t2, n2
 
+    # XLA cost-model bytes/step for the traffic grid (same caveat as
+    # trace_report: logical bytes, not a DRAM counter). The relay's failure
+    # mode is a HANG, not an exception, so a bare try/except can't protect
+    # the already-finished measurement — run the AOT query on a daemon
+    # thread with a bounded join and proceed without the number if it
+    # wedges (the process can then still print and exit).
+    cost_gb = None
+    if os.environ.get("DEEPVISION_BENCH_COST"):
+        import threading
+        box = {}
+
+        def _cost():
+            try:
+                ca = train_step.lower(state, *sharded, rng).compile() \
+                    .cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                box["gb"] = round(float(ca["bytes accessed"]) / 1e9, 2)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=_cost, daemon=True)
+        t.start()
+        t.join(timeout=120.0)
+        cost_gb = box.get("gb")
+
+    variant_tag = "".join(
+        f",{k}" for k, v in sorted(variant_kwargs.items()) if v)
     img_per_sec_per_chip = n_steps * batch / dt / n_dev
     print(json.dumps({
         "metric": f"resnet50_train_images_per_sec_per_chip"
-                  f"(b{batch},{image_size}px,{platform})",
+                  f"(b{batch},{image_size}px,{platform}{variant_tag})",
+        **({"cost_model_gb_per_step": cost_gb} if cost_gb else {}),
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec_per_chip / P100_BASELINE_IMG_PER_SEC,
@@ -218,9 +251,13 @@ def main() -> None:
         os.environ.get("BENCH_DEADLINE_SECS", "780"))
     env = dict(os.environ)
     cpu_requested = env.get("JAX_PLATFORMS") == "cpu"
-    # an explicit CPU request means "bench the CPU": never answer it with a
-    # cached TPU record
-    cache = None if cpu_requested else _load_cache()
+    # parse (not truthiness-test) the variant kwargs so '{}' means baseline
+    # here exactly as it does in the worker
+    variant = bool(json.loads(env.get("DEEPVISION_BENCH_KWARGS") or "{}"))
+    # an explicit CPU request means "bench the CPU", and a variant request
+    # means "bench THAT variant": neither may be answered with the cached
+    # headline (baseline) TPU record
+    cache = None if (cpu_requested or variant) else _load_cache()
     non_tpu_result = None  # a successful worker run on some other platform
 
     if not cpu_requested:
@@ -243,7 +280,10 @@ def main() -> None:
                 if rec.get("platform") == "tpu":
                     rec["measured_at"] = time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-                    _save_cache(rec)
+                    # the committed cache is the HEADLINE record — a variant
+                    # run (traffic grid) must not overwrite it
+                    if not variant:
+                        _save_cache(rec)
                     print(json.dumps(rec))
                     return
                 # a successful non-TPU run (no TPU plugin on this machine):

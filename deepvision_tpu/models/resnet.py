@@ -27,11 +27,19 @@ from .common import he_normal_fanout
 class _BN(nn.Module):
     scale_init: Callable = nn.initializers.ones
     relu: bool = True
+    # BatchNorm *computation* dtype for the normalize/scale/shift pass. The
+    # batch-stat reductions stay f32 regardless (flax `_compute_stats`
+    # force_float32_reductions), and scale/bias params + running stats stay
+    # f32 (param_dtype default), so checkpoints are dtype-identical either
+    # way — only the materialized normalize output changes width. f32 here
+    # is the parity default; the `lowp_bn` experiment passes the compute
+    # dtype to halve every BN round trip through HBM (docs/TUNING.md).
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
-                         dtype=jnp.float32, scale_init=self.scale_init)(x)
+                         dtype=self.dtype, scale_init=self.scale_init)(x)
         if self.relu:
             x = nn.relu(x)
         return x
@@ -50,23 +58,33 @@ class BasicBlock(nn.Module):
     # already match (`resnet34.py:116-128` downsample=True on block 0, incl.
     # the stride-1 64→64 conv2x stage) — required to import its checkpoints.
     always_project: bool = False
+    lowp_residual: bool = False  # HBM-traffic experiment A (docs/TUNING.md)
+    lowp_bn: bool = False        # HBM-traffic experiment B
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
                        dtype=self.dtype)
+        bn = partial(_BN, dtype=self.dtype if self.lowp_bn else jnp.float32)
+        join = self.dtype if (self.lowp_residual or self.lowp_bn) \
+            else jnp.float32
         residual = x
         # explicit pad 1: torch pad-1 geometry; SAME differs at stride 2
         y = conv(self.features, (3, 3), strides=self.strides,
                  padding=[(1, 1), (1, 1)])(x)
-        y = _BN()(y, train).astype(self.dtype)
+        y = bn()(y, train).astype(self.dtype)
         y = conv(self.features, (3, 3), padding=[(1, 1), (1, 1)])(y)
-        y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
+        y = bn(scale_init=nn.initializers.zeros, relu=False)(y, train)
         if self.always_project or residual.shape != y.shape:
             residual = conv(self.features, (1, 1), strides=self.strides,
                             name="proj")(residual)
-            residual = _BN(relu=False)(residual, train)
-        return nn.relu(y + residual).astype(self.dtype)
+            residual = bn(relu=False)(residual, train)
+        # join dtype: f32 add (the parity default — identity residuals are
+        # bf16 but the add promotes) vs compute-dtype add under the lowp
+        # experiments, which turns the relu(y+residual) epilogue bf16 —
+        # the r04 trace's 33.4ms f32 loop fusion (runs/r04_resnet50_tpu_profile)
+        return nn.relu(y.astype(join) + residual.astype(join)) \
+            .astype(self.dtype)
 
 
 class BottleneckBlock(nn.Module):
@@ -83,27 +101,35 @@ class BottleneckBlock(nn.Module):
     always_project: bool = False  # accepted for stage-policy uniformity with
                                   # BasicBlock; bottleneck first blocks always
                                   # change channels so this is normally moot
+    lowp_residual: bool = False  # HBM-traffic experiment A (docs/TUNING.md)
+    lowp_bn: bool = False        # HBM-traffic experiment B
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
                        dtype=self.dtype)
+        bn = partial(_BN, dtype=self.dtype if self.lowp_bn else jnp.float32)
+        join = self.dtype if (self.lowp_residual or self.lowp_bn) \
+            else jnp.float32
         out_features = self.features * self.expansion
         s1 = self.strides if self.stride_on_first else (1, 1)
         s2 = (1, 1) if self.stride_on_first else self.strides
         residual = x
         y = conv(self.features, (1, 1), strides=s1)(x)
-        y = _BN()(y, train).astype(self.dtype)
+        y = bn()(y, train).astype(self.dtype)
         y = conv(self.features, (3, 3), strides=s2,
                  padding=[(1, 1), (1, 1)])(y)  # torch pad-1 geometry
-        y = _BN()(y, train).astype(self.dtype)
+        y = bn()(y, train).astype(self.dtype)
         y = conv(out_features, (1, 1))(y)
-        y = _BN(scale_init=nn.initializers.zeros, relu=False)(y, train)
+        y = bn(scale_init=nn.initializers.zeros, relu=False)(y, train)
         if self.always_project or residual.shape != y.shape:
             residual = conv(out_features, (1, 1), strides=self.strides,
                             name="proj")(residual)
-            residual = _BN(relu=False)(residual, train)
-        return nn.relu(y + residual).astype(self.dtype)
+            residual = bn(relu=False)(residual, train)
+        # see BasicBlock on the join dtype (f32 parity default vs the lowp
+        # experiments' compute-dtype epilogue)
+        return nn.relu(y.astype(join) + residual.astype(join)) \
+            .astype(self.dtype)
 
 
 class ResNet(nn.Module):
@@ -126,6 +152,13 @@ class ResNet(nn.Module):
     # 8x8 kernel's phase decomposition (tests/test_models_classification.py).
     # The 4x4 kernel / (2,1) padding geometry is derived for block size 2,
     # which is the only block the 7x7/2 stem decomposes into — not a knob.
+    lowp_residual: bool = False  # HBM-traffic experiment A: compute-dtype
+    # residual join (the f32 relu(y+residual) loop fusion was 10.4% of the
+    # r04 step). Measured + numerics-gated in docs/TUNING.md; off for import
+    # parity.
+    lowp_bn: bool = False  # HBM-traffic experiment B: compute-dtype BN
+    # normalize output (stats/params/running-averages stay f32, so
+    # checkpoints are identical either way).
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -145,9 +178,14 @@ class ResNet(nn.Module):
                         padding=[(3, 3), (3, 3)],
                         use_bias=False, kernel_init=he_normal_fanout,
                         dtype=self.dtype, name="stem_conv")(x)
-        x = _BN()(x, train).astype(self.dtype)
+        x = _BN(dtype=self.dtype if self.lowp_bn else jnp.float32)(
+            x, train).astype(self.dtype)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         block_kwargs = {"stride_on_first": True} if self.stride_on_first else {}
+        if self.lowp_residual:
+            block_kwargs["lowp_residual"] = True
+        if self.lowp_bn:
+            block_kwargs["lowp_bn"] = True
         for i, num_blocks in enumerate(self.stage_sizes):
             for j in range(num_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -166,6 +204,12 @@ MODELS.register("resnet34", partial(ResNet, stage_sizes=(3, 4, 6, 3), block=Basi
 MODELS.register("resnet50", partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock))
 MODELS.register("resnet101", partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock))
 MODELS.register("resnet152", partial(ResNet, stage_sizes=(3, 8, 36, 3), block=BottleneckBlock))
+# HBM-lean flagship: same parameters/checkpoints as resnet50 (all f32 state),
+# bf16 BN-normalize outputs + bf16 residual joins — the measured traffic
+# experiments of docs/TUNING.md, addressable by name for bench/recipe use
+MODELS.register("resnet50_lean", partial(ResNet, stage_sizes=(3, 4, 6, 3),
+                                         block=BottleneckBlock,
+                                         lowp_residual=True, lowp_bn=True))
 
 
 class PreActBottleneck(nn.Module):

@@ -109,3 +109,38 @@ def test_trace_report_reproduces_committed_roofline_artifact():
                        as_json=True, top=12)
     fresh["trace"] = committed["trace"]  # path differs by invocation cwd
     assert fresh == committed
+
+
+def test_traffic_variants_baseline_first_and_lean_flags():
+    bt = _load("bench_traffic")
+    labels = [v for v, _ in bt.VARIANTS]
+    assert labels[0] == "baseline" and bt.VARIANTS[0][1] == {}
+    assert {"lowp_residual": True, "lowp_bn": True} in \
+        [kw for _, kw in bt.VARIANTS]
+
+
+def test_variant_kwargs_skip_headline_cache(tmp_path, monkeypatch):
+    """A traffic-grid variant run must never overwrite the committed
+    headline BENCH_CACHE.json (bench.py's cross-round provenance record)."""
+    import bench
+
+    monkeypatch.setattr(bench, "CACHE_PATH",
+                        str(tmp_path / "BENCH_CACHE.json"))
+    # conftest pins JAX_PLATFORMS=cpu for the suite; bench.main treats that
+    # as "bench the CPU" and skips the TPU/cache path this test exercises
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("DEEPVISION_BENCH_KWARGS", '{"lowp_bn": true}')
+    monkeypatch.setenv("BENCH_DEADLINE_SECS", "200")
+    fake = {"metric": "m(b256,224px,tpu,lowp_bn)", "value": 1.0,
+            "unit": "images/sec/chip", "platform": "tpu",
+            "device_kind": "x", "jax_version": "0", "timed_steps": 20}
+    monkeypatch.setattr(bench, "_run_worker",
+                        lambda env, t, argv=None: dict(fake))
+    bench.main()
+    assert not os.path.exists(bench.CACHE_PATH)
+
+    # '{}' parses to baseline — the worker treats it so, the orchestrator
+    # must too (tools/bench_traffic.py always json.dumps its kwargs)
+    monkeypatch.setenv("DEEPVISION_BENCH_KWARGS", "{}")
+    bench.main()
+    assert os.path.exists(bench.CACHE_PATH)

@@ -100,3 +100,83 @@ def test_space_to_depth_stem_exactly_reproduces_7x7_stem():
                          "batch_stats": v_ref["batch_stats"]}, x, train=False)
     np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_s2d),
                                rtol=1e-4, atol=1e-5)
+
+
+class TestLowpTrafficVariants:
+    """Numerics gates for the HBM-traffic experiments (docs/TUNING.md):
+    `lowp_residual` (compute-dtype residual join) and `lowp_bn`
+    (compute-dtype BN normalize output). The claims that make the variants
+    safe to measure/recommend: exact no-op at f32, checkpoint-identical
+    state, and bf16 error vs f32 truth comparable to the baseline bf16
+    model's own rounding error."""
+
+    KW = dict(stage_sizes=(1, 1), width=8, num_classes=5)
+
+    def _fwd(self, model, variables, x):
+        return np.asarray(model.apply(variables, x, train=False),
+                          np.float32)
+
+    def test_f32_noop_and_checkpoint_compat(self):
+        from deepvision_tpu.models.resnet import ResNet
+
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                        jnp.float32)
+        base = ResNet(**self.KW, dtype=jnp.float32)
+        lean = ResNet(**self.KW, dtype=jnp.float32,
+                      lowp_residual=True, lowp_bn=True)
+        v = base.init(jax.random.PRNGKey(0), x, train=False)
+        # at f32 compute dtype the flags select the same join/BN dtype ->
+        # bitwise-identical program
+        np.testing.assert_array_equal(self._fwd(base, v, x),
+                                      self._fwd(lean, v, x))
+        # state trees (params + running stats) are dtype- and
+        # shape-identical: a lean run can resume a baseline checkpoint and
+        # vice versa
+        v_lean = lean.init(jax.random.PRNGKey(0), x, train=False)
+        assert (jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), v)
+                == jax.tree_util.tree_map(lambda a: (a.shape, a.dtype),
+                                          v_lean))
+
+    def test_bf16_error_comparable_to_baseline_rounding(self):
+        from deepvision_tpu.models.resnet import ResNet
+
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 32, 32, 3),
+                        jnp.float32)
+        truth_m = ResNet(**self.KW, dtype=jnp.float32)
+        v = truth_m.init(jax.random.PRNGKey(0), x, train=False)
+        truth = self._fwd(truth_m, v, x)
+        scale = np.abs(truth).mean()
+
+        base = self._fwd(ResNet(**self.KW, dtype=jnp.bfloat16), v, x)
+        lean = self._fwd(ResNet(**self.KW, dtype=jnp.bfloat16,
+                                lowp_residual=True, lowp_bn=True), v, x)
+        err_base = np.abs(base - truth).mean() / scale
+        err_lean = np.abs(lean - truth).mean() / scale
+        # the lean variant adds rounding at the join/BN outputs; it must stay
+        # in the same error class as bf16 itself, not a new regime
+        assert err_lean <= 2.5 * err_base + 1e-3, (err_base, err_lean)
+
+    def test_bf16_lean_train_step_grads_finite_f32_state(self):
+        from deepvision_tpu.models.resnet import ResNet
+
+        model = ResNet(**self.KW, dtype=jnp.bfloat16,
+                       lowp_residual=True, lowp_bn=True)
+        x = jnp.asarray(np.random.RandomState(2).randn(4, 32, 32, 3),
+                        jnp.float32)
+        y = jnp.asarray([0, 1, 2, 3])
+        v = model.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss_fn(params):
+            out, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]}, x,
+                train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, out.shape[-1])
+            return -(onehot * jax.nn.log_softmax(out)).sum(-1).mean()
+
+        grads = jax.grad(loss_fn)(v["params"])
+        leaves = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(g, np.float32)).all()
+                   for g in leaves)
+        # grads must come back in the params' (f32) dtype so the optimizer
+        # state stays full precision
+        assert all(g.dtype == jnp.float32 for g in leaves)

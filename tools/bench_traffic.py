@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""HBM-traffic variant grid over the benchmark worker — the roofline attack.
+
+The round-4 trace (`runs/r04_resnet50_tpu_profile/REPORT.json`) says the
+ResNet-50 train step is bandwidth-bound at 85.4 cost-model GB/step, and
+names the attackable byte movers: the f32 `relu(y + residual)` loop fusion
+(33.4ms of 321ms, `models/resnet.py`) and the f32 BN normalize round trips.
+Scheduling knobs can't lift a bandwidth roof — only moving fewer bytes can.
+This grid measures exactly that: the `lowp_residual` / `lowp_bn` model
+flags (compute-dtype residual joins / BN outputs; all f32 state unchanged)
+against baseline, each variant in its own killable `bench.py --worker`
+subprocess (the axon relay wedge defense), with the XLA cost-model
+bytes/step recorded next to the throughput so the byte-count claim and the
+speed claim land together:
+
+    python tools/bench_traffic.py --out TRAFFIC.json
+    JAX_PLATFORMS=cpu python tools/bench_traffic.py   # harness test
+
+Output: one JSON row per variant as it lands, then a `{"traffic": ...}`
+summary ranking variants with vs-baseline throughput and byte ratios.
+Unlike bench_sweep's flag combos, each variant is a *different program*
+(different tensor widths), so the compilation cache stays ON — distinct
+cache keys, and retries after a tunnel flake skip the recompile.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _run_worker  # noqa: E402  (the killable-worker runner)
+
+# (label, model kwargs) — cheapest-to-decide first: the combined variant is
+# the recipe candidate; the singles attribute the win between the two levers.
+VARIANTS = [
+    ("baseline", {}),
+    ("lean", {"lowp_residual": True, "lowp_bn": True}),
+    ("lowp_bn", {"lowp_bn": True}),
+    ("lowp_residual", {"lowp_residual": True}),
+]
+
+
+def run_variant(kwargs: dict, timeout_s: float):
+    env = dict(os.environ)
+    env["DEEPVISION_BENCH_KWARGS"] = json.dumps(kwargs)
+    env["DEEPVISION_BENCH_COST"] = "1"
+    return _run_worker(env, timeout_s)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="per-variant wall clock (fresh compile included)")
+    p.add_argument("--out", default=None, help="write full results JSON here")
+    args = p.parse_args(argv)
+
+    results = []
+    for label, kwargs in VARIANTS:
+        t0 = time.monotonic()
+        rec = run_variant(kwargs, args.timeout)
+        took = time.monotonic() - t0
+        row = {"variant": label, "kwargs": kwargs, "seconds": round(took, 1)}
+        if rec is None:
+            row["value"] = None  # timeout / crash — itself a result
+        else:
+            row.update(value=rec["value"], unit=rec["unit"],
+                       platform=rec["platform"],
+                       cost_model_gb_per_step=rec.get(
+                           "cost_model_gb_per_step"))
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # same cross-platform guard as bench_sweep: a mid-grid plugin failure
+    # must not let a CPU row be ranked against TPU rows
+    ok = [r for r in results if r.get("value")]
+    base = next((r for r in ok if r["variant"] == "baseline"), None)
+    plat = base["platform"] if base else (ok[0]["platform"] if ok else None)
+    ranked = sorted((r for r in ok if r["platform"] == plat),
+                    key=lambda r: -r["value"])
+    summary = {"traffic": [
+        {"variant": r["variant"], "value": r["value"],
+         "gb_per_step": r.get("cost_model_gb_per_step"),
+         **({"vs_baseline": round(r["value"] / base["value"], 3)}
+            if base else {}),
+         **({"bytes_vs_baseline": round(r["cost_model_gb_per_step"] /
+                                        base["cost_model_gb_per_step"], 3)}
+            if base and base.get("cost_model_gb_per_step")
+            and r.get("cost_model_gb_per_step") else {})}
+        for r in ranked]}
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        # summary rides along as the last element so the ranked
+        # vs-baseline/bytes ratios survive an unattended retry loop whose
+        # stdout scrolled away (tpu_window.sh stage 4)
+        with open(args.out, "w") as fp:
+            json.dump(results + [summary], fp, indent=1)
+            fp.write("\n")
+
+
+if __name__ == "__main__":
+    main()

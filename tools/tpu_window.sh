@@ -15,7 +15,10 @@
 #      (committed-training-log role; --steps-per-dispatch 10 keeps host
 #      dispatches off the per-step path — relay dispatch latency is seconds)
 #   3. tools/bench_dispatch.py      -> OUT_DIR/DISPATCH.json (knob-8 table)
-#   4. tools/bench_sweep.py         -> OUT_DIR/SWEEP.json (XLA flag attack;
+#   4. tools/bench_traffic.py       -> OUT_DIR/TRAFFIC.json (the roofline
+#      attack: lowp_residual/lowp_bn variants + cost-model GB/step — the
+#      only lever that can LIFT a bandwidth-bound step, docs/TUNING.md)
+#   5. tools/bench_sweep.py         -> OUT_DIR/SWEEP.json (XLA flag attack;
 #      last because round-4 measured every non-baseline combo wedging the
 #      relay compile — see docs/TUNING.md)
 #
@@ -91,7 +94,13 @@ if ! grid_done "$OUT/DISPATCH.json" 1; then
         2>> "$OUT/bench.log" || true
 fi
 
-echo "[tpu_window] stage 4: XLA flag sweep" >&2
+echo "[tpu_window] stage 4: HBM-traffic variant grid" >&2
+if ! grid_done "$OUT/TRAFFIC.json" 2; then
+    python tools/bench_traffic.py --timeout 900 --out "$OUT/TRAFFIC.json" \
+        2>> "$OUT/bench.log" || true
+fi
+
+echo "[tpu_window] stage 5: XLA flag sweep" >&2
 if ! grid_done "$OUT/SWEEP.json" 2; then
     python tools/bench_sweep.py --timeout 600 --out "$OUT/SWEEP.json" \
         2>> "$OUT/bench.log" || true
@@ -100,6 +109,7 @@ fi
 missing=0
 train_done || { echo "[tpu_window] MISSING: complete $RUN_DIR/resnet50_tpu.jsonl" >&2; missing=1; }
 grid_done "$OUT/DISPATCH.json" 1 || { echo "[tpu_window] MISSING: measured DISPATCH.json" >&2; missing=1; }
+grid_done "$OUT/TRAFFIC.json" 2 || { echo "[tpu_window] MISSING: measured TRAFFIC.json" >&2; missing=1; }
 grid_done "$OUT/SWEEP.json" 2 || { echo "[tpu_window] MISSING: measured SWEEP.json" >&2; missing=1; }
 if [ "$missing" -ne 0 ]; then
     echo "[tpu_window] partial chain — keep what landed, loop re-arms" >&2
