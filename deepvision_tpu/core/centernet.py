@@ -94,6 +94,8 @@ class CenterNetTrainer(LossWatchedTrainer):
     """Uses the same padded-GT detection batches as DetectionTrainer; model
     construction and loss-watched eval come from the base."""
 
+    has_own_shardmap_step = True  # make_shardmap_centernet_train_step
+
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
@@ -105,9 +107,6 @@ class CenterNetTrainer(LossWatchedTrainer):
             # GSPMD path REFUSES (calibration finds ~500x stem-BN grads,
             # PARITY.md §2.8) — the owned-collectives step makes it trainable
             from ..parallel import spatial_shard
-            if config.remat:
-                raise ValueError("spatial_backend='shard_map' does not "
-                                 "support remat yet")
             self._step_factory = (
                 lambda m, corr: spatial_shard
                 .make_shardmap_centernet_train_step(
@@ -115,6 +114,7 @@ class CenterNetTrainer(LossWatchedTrainer):
                     compute_dtype=compute_dtype, mesh=m,
                     input_norm=input_norm,
                     log_grad_norm=config.log_grad_norm,
+                    remat=config.remat,
                     donate=config.steps_per_dispatch == 1))
         else:
             self._step_factory = lambda m, corr: make_centernet_train_step(
@@ -127,13 +127,6 @@ class CenterNetTrainer(LossWatchedTrainer):
             num_classes=config.data.num_classes, grid=grid,
             compute_dtype=compute_dtype, mesh=self.mesh,
             input_norm=input_norm)
-
-    def _use_shardmap_spatial(self) -> bool:
-        # unlike the base (classification-only check), CenterNet has its own
-        # shard_map step — opting in also skips the calibration that refuses
-        # this family's combined meshes
-        return (self.config.spatial_backend == "shard_map"
-                and mesh_lib.has_spatial(self.mesh))
 
     def _calibration_batch(self, sample_shape, seed: int = 0):
         from .detection import boxes_calibration_batch

@@ -122,19 +122,45 @@ class PoseTrainer(LossWatchedTrainer):
     workdir's pinned model_kwargs.json applies here like everywhere else."""
 
     num_classes_kwarg = "num_heatmap"  # pose models take num_heatmap
+    has_own_shardmap_step = True       # make_shardmap_pose_train_step
 
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
-        self._reject_shardmap_backend("pose")
         hm = (config.data.image_size // 4, config.data.image_size // 4)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self._step_factory = lambda m, corr: make_pose_train_step(
-            heatmap_size=hm, compute_dtype=compute_dtype, mesh=m,
-            remat=config.remat, input_norm=input_norm,
-            log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        if self._use_shardmap_spatial():
+            # StackedHourglass is fully convolutional, so the owned-
+            # collectives path keeps H sharded end to end (transition=None,
+            # parallel/spatial_shard.py) — exact on combined meshes with no
+            # calibration, same recipe as CenterNet. default_transition
+            # validates the model class: an arbitrary model= with
+            # non-row-local ops would otherwise train with silently wrong
+            # gradients, and a model needing an all_to_all handoff is not
+            # something the pose step implements.
+            from ..parallel import spatial_shard
+            transition = spatial_shard.default_transition(self.model)
+            if transition is not None:
+                raise NotImplementedError(
+                    f"spatial_backend='shard_map' pose training requires a "
+                    f"fully convolutional model (transition plan None); "
+                    f"{type(self.model).__name__} plans a handoff at "
+                    f"{transition!r}, which make_shardmap_pose_train_step "
+                    f"does not implement — use the gspmd backend")
+            self._step_factory = (
+                lambda m, corr: spatial_shard.make_shardmap_pose_train_step(
+                    heatmap_size=hm, compute_dtype=compute_dtype, mesh=m,
+                    input_norm=input_norm,
+                    log_grad_norm=config.log_grad_norm,
+                    remat=config.remat,
+                    donate=config.steps_per_dispatch == 1))
+        else:
+            self._step_factory = lambda m, corr: make_pose_train_step(
+                heatmap_size=hm, compute_dtype=compute_dtype, mesh=m,
+                remat=config.remat, input_norm=input_norm,
+                log_grad_norm=config.log_grad_norm,
+                donate=config.steps_per_dispatch == 1, grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,

@@ -205,9 +205,12 @@ class Trainer:
             # (CenterNetTrainer) from running this classification-specific
             # branch during base __init__ — they install their own factory.
             from ..parallel import spatial_shard
-            if config.remat or config.mixup_alpha > 0 or config.cutmix_alpha > 0:
+            if config.mixup_alpha > 0 or config.cutmix_alpha > 0:
+                # mixup's pixel blend is row-local, but CutMix's pasted box
+                # (and both variants' permutation of the batch axis) crosses
+                # the spatial shards — keep these on the gspmd backend
                 raise ValueError(
-                    "spatial_backend='shard_map' does not support remat/"
+                    "spatial_backend='shard_map' does not support "
                     "mixup/cutmix yet; use the gspmd backend for those")
             transition = spatial_shard.default_transition(self.model)
             self._step_factory = (
@@ -218,6 +221,7 @@ class Trainer:
                     aux_weight=config.aux_loss_weight,
                     compute_dtype=compute_dtype, input_norm=input_norm,
                     log_grad_norm=config.log_grad_norm,
+                    remat=config.remat,
                     donate=config.steps_per_dispatch == 1))
         else:
             self._step_factory = lambda m, corr: steps.make_classification_train_step(
@@ -263,14 +267,20 @@ class Trainer:
         else:
             self._set_watch("top1", "max")
 
+    # Families with their own owned-collectives step set this True
+    # (CenterNetTrainer, PoseTrainer) instead of re-implementing the
+    # opt-in predicate; families without one (detection, GAN) keep the
+    # default and call _reject_shardmap_backend in __init__.
+    has_own_shardmap_step = False
+
     def _use_shardmap_spatial(self) -> bool:
         """True when this trainer's spatial semantics are owned by
         parallel/spatial_shard.py instead of GSPMD (config.spatial_backend).
-        Only the classification Trainer implements the shard_map step so
-        far; subclasses call _reject_shardmap_backend."""
+        The classification step lives on Trainer itself, hence the exact
+        type check; subclasses opt in via has_own_shardmap_step."""
         return (self.config.spatial_backend == "shard_map"
                 and mesh_lib.has_spatial(self.mesh)
-                and type(self) is Trainer)
+                and (type(self) is Trainer or self.has_own_shardmap_step))
 
     def _reject_shardmap_backend(self, family: str) -> None:
         if (self.config.spatial_backend == "shard_map"

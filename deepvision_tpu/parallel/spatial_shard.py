@@ -323,6 +323,11 @@ def default_transition(model) -> Optional[str]:
     - CenterNet (ObjectsAsPoints): fully convolutional (dense heads,
       nearest-x2 upsampling — both row-local), so no transition: None keeps
       H sharded end to end.
+    - StackedHourglass: also fully convolutional — SAME convs, 2x2/2
+      maxpools (kernel == stride: no halo), nearest-x2 upsamples and
+      residual adds are all row-local, and the heatmap heads are 1x1 convs
+      — so None keeps H sharded end to end (the weighted-MSE loss is dense
+      and row-sliceable, make_shardmap_pose_train_step).
     """
     name = type(model).__name__
     if name == "ResNet":
@@ -330,12 +335,12 @@ def default_transition(model) -> Optional[str]:
         block_name = (block.__name__ if isinstance(block, type)
                       else type(block).__name__)
         return resnet_transition(model.stage_sizes, block_name)
-    if name == "ObjectsAsPoints":
+    if name in ("ObjectsAsPoints", "StackedHourglass"):
         return None
     raise NotImplementedError(
         f"spatial_backend='shard_map' has no transition plan for "
-        f"{name}; supported: ResNet family, CenterNet. Use the gspmd "
-        f"backend for this model.")
+        f"{name}; supported: ResNet family, CenterNet, StackedHourglass. "
+        f"Use the gspmd backend for this model.")
 
 
 def resnet_transition(stage_sizes: Sequence[int],
@@ -359,6 +364,7 @@ def make_shardmap_classification_train_step(
     input_norm: Optional[tuple] = None,
     log_grad_norm: bool = False,
     donate: bool = True,
+    remat: bool = False,
 ):
     """`(state, images, labels, rng) -> (state, metrics)` with the spatial
     axis handled by THIS module's collectives instead of GSPMD (module
@@ -367,7 +373,14 @@ def make_shardmap_classification_train_step(
     argument: the explicit psum over ('data','spatial') divided by the rank
     count is the entire cross-rank gradient story. The 'model' mesh axis (if
     any) stays automatic, so `param_sharding_rules` tensor parallelism works
-    unchanged inside the body."""
+    unchanged inside the body.
+
+    `remat=True` wraps the intercepted forward in `jax.checkpoint` (same
+    policy as steps.py): the backward re-runs the forward — including its
+    ppermute halos and BN psums, which jax replays inside the shard_map body
+    — instead of keeping activations in HBM. The context object is built
+    INSIDE the checkpointed function so the replay gets a fresh
+    sharded-regime state machine."""
     from ..core import losses
     from ..core.steps import _normalize_input, maybe_grad_norm
 
@@ -381,7 +394,7 @@ def make_shardmap_classification_train_step(
         step_rng = jax.random.fold_in(rng, state.step)
 
         def body(params, batch_stats, images, labels):
-            def loss_fn(p):
+            def forward(p, images):
                 ctx = SpatialShardContext(sp=sp, transition=transition,
                                           axes=axes)
                 with ctx.active():
@@ -390,6 +403,15 @@ def make_shardmap_classification_train_step(
                         images, train=True, mutable=["batch_stats"],
                         rngs={"dropout": step_rng})
                 ctx.assert_transition_consumed()
+                return outputs, mutated
+
+            if remat:
+                forward = jax.checkpoint(
+                    forward, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+
+            def loss_fn(p):
+                outputs, mutated = forward(p, images)
                 loss = losses.classification_loss(
                     outputs, labels, label_smoothing=label_smoothing,
                     aux_weight=aux_weight)
@@ -429,6 +451,97 @@ def make_shardmap_classification_train_step(
     return jax.jit(step, **jit_kwargs)
 
 
+def make_shardmap_pose_train_step(
+    *,
+    heatmap_size: Tuple[int, int],
+    mesh: Mesh,
+    compute_dtype=jnp.bfloat16,
+    input_norm: Optional[tuple] = None,
+    log_grad_norm: bool = False,
+    donate: bool = True,
+    remat: bool = False,
+):
+    """Stacked-Hourglass `(state, images, kp_x, kp_y, visibility, rng)` step
+    with owned spatial semantics. The model is fully convolutional
+    (default_transition: None — H stays sharded end to end), and the
+    foreground-weighted MSE (core/pose.py weighted_mse_loss, parity
+    `Hourglass/tensorflow/train.py:65-76`) is a dense per-pixel mean, so the
+    CenterNet recipe transfers wholesale: gaussian heatmap targets are
+    rendered per rank from its batch slice and row-sliced to the spatial
+    shard, each rank's loss is the mean over its disjoint (batch x rows)
+    slice, and the one controlled psum over ('data','spatial') / n_ranks is
+    exactly the global-batch gradient (equal slice sizes make the global
+    mean the mean of local means). Verified leaf-exact vs the single-device
+    oracle in test_spatial_shardmap.py."""
+    from ..core.pose import weighted_mse_loss
+    from ..core.steps import _normalize_input, maybe_grad_norm
+    from ..ops.heatmap import render_gaussian_heatmaps
+
+    h, w = heatmap_size
+    sp = dict(mesh.shape).get(SPATIAL_AXIS, 1)
+    dp = dict(mesh.shape)[DATA_AXIS]
+    n_ranks = sp * dp
+    axes = tuple(a for a in MANUAL_AXES if a in mesh.axis_names)
+    if sp > 1 and h % sp != 0:
+        raise ValueError(f"pose heatmap height {h} must be divisible by "
+                         f"spatial={sp}")
+
+    def step(state, images, kp_x, kp_y, visibility, rng):
+        del rng
+        images = _normalize_input(images, input_norm, compute_dtype)
+
+        def body(params, batch_stats, images, kp_x, kp_y, visibility):
+            labels = jax.vmap(
+                lambda x, y, v: render_gaussian_heatmaps(x, y, v, h, w))(
+                    kp_x, kp_y, visibility)
+            if sp > 1:
+                rows = h // sp
+                start = lax.axis_index(SPATIAL_AXIS) * rows
+                labels = lax.dynamic_slice_in_dim(labels, start, rows, axis=1)
+
+            def forward(p, images):
+                ctx = SpatialShardContext(sp=sp, transition=None, axes=axes)
+                with ctx.active():
+                    return state.apply_fn(
+                        {"params": p, "batch_stats": batch_stats},
+                        images, train=True, mutable=["batch_stats"])
+
+            if remat:
+                forward = jax.checkpoint(
+                    forward, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+
+            def loss_fn(p):
+                outputs, mutated = forward(p, images)
+                return weighted_mse_loss(labels, outputs), mutated
+
+            (loss, mutated), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            metrics = {"loss": lax.pmean(loss, axes)}
+            new_bs = mutated.get("batch_stats", batch_stats)
+            return grads, new_bs, metrics
+
+        spatial_in = P(DATA_AXIS, SPATIAL_AXIS if sp > 1 else None)
+        grads, new_bs, metrics = jax.shard_map(
+            body, mesh=mesh, axis_names=set(axes),
+            in_specs=(P(), P(), spatial_in, P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(state.params, state.batch_stats, images, kp_x, kp_y, visibility)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
 def make_shardmap_centernet_train_step(
     *,
     num_classes: int,
@@ -438,6 +551,7 @@ def make_shardmap_centernet_train_step(
     input_norm: Optional[tuple] = None,
     log_grad_norm: bool = False,
     donate: bool = True,
+    remat: bool = False,
 ):
     """CenterNet `(state, images, boxes, classes, valid, rng)` step with
     owned spatial semantics — the family whose combined spatial x model mesh
@@ -475,12 +589,20 @@ def make_shardmap_centernet_train_step(
                 targets = {k: lax.dynamic_slice_in_dim(v, start, rows, axis=1)
                            for k, v in targets.items()}
 
-            def loss_fn(p):
+            def forward(p, images):
                 ctx = SpatialShardContext(sp=sp, transition=None, axes=axes)
                 with ctx.active():
-                    outputs, mutated = state.apply_fn(
+                    return state.apply_fn(
                         {"params": p, "batch_stats": batch_stats},
                         images, train=True, mutable=["batch_stats"])
+
+            if remat:
+                forward = jax.checkpoint(
+                    forward, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+
+            def loss_fn(p):
+                outputs, mutated = forward(p, images)
                 comp = cn_ops.centernet_loss(
                     outputs, targets,
                     axis_name=SPATIAL_AXIS if sp > 1 else None)
