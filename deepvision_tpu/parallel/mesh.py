@@ -137,7 +137,11 @@ def shard_batch_pytree(mesh: Mesh, batch):
         x = np.asarray(x)
         dim1 = x.shape[1] if x.ndim > 1 else None
         sharding = batch_sharding(mesh, x.ndim, dim1=dim1)
-        if multiprocess:
+        # a fully-addressable mesh (e.g. the process-local calibration
+        # oracle on a pod, trainer._verify_correction_at_production_batch)
+        # holds a GLOBAL value this process owns outright — plain device_put,
+        # even on multi-process runs
+        if multiprocess and not sharding.is_fully_addressable:
             return jax.make_array_from_process_local_data(sharding, x)
         return jax.device_put(x, sharding)
     return jax.tree_util.tree_map(_put, batch)

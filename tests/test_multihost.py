@@ -55,3 +55,9 @@ def test_two_process_training_and_resume(tmp_path):
     assert resumes[0] == resumes[1] == "epoch=2 step=8", resumes
     spatial = [line(o, "MHSPATIAL").split(" ", 1)[1] for o in outs]
     assert spatial == ["guard-ok", "guard-ok"], spatial
+    # VERDICT r4 item 8: the combined-mesh production-batch calibration
+    # verify must RUN (not skip) across the process boundary — main process
+    # verifies against its local DP oracle, the other joins the collective
+    # corrected step
+    cal = sorted(line(o, "MHCALVERIFY").split(" ", 1)[1] for o in outs)
+    assert cal == ["joined", "verified"], cal
