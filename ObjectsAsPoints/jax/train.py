@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from deepvision_tpu.cli import run_centernet
 
-MODELS = ["centernet", "objects_as_points"]
+MODELS = ["centernet", "objects_as_points", "centernet_digits"]
 
 if __name__ == "__main__":
     run_centernet("ObjectsAsPoints", MODELS)

@@ -473,6 +473,31 @@ def _detection_data(cfg, args):
         return _synthetic_data(cfg, lambda steps, seed: det.synthetic_batches(
             batch_size=cfg.batch_size, image_size=data.image_size,
             num_classes=data.num_classes, steps=steps, seed=seed))
+    if data.dataset == "digits_detect":
+        # real scanned digits composed into detection scenes — the offline
+        # real-data detection gate (data/digits.py). Train scenes are
+        # re-composed FRESH each epoch (composition is free, and scene
+        # diversity — not scene repetition — is what makes the detector
+        # generalize to the held-out handwriting); the val set is pinned
+        # (seed 2, same identity ObjectsAsPoints/jax/evaluate.py rebuilds).
+        if data.normalize_on_device:
+            raise SystemExit("--device-normalize is incompatible with "
+                             "digits_detect (scenes are already float "
+                             "[-1,1], not raw pixels)")
+        from .data.digits import (detection_batches, detection_scenes,
+                                  scan_splits)
+        (tr_x, tr_y), (va_x, va_y) = scan_splits()
+        va = detection_scenes(va_x, va_y, n_scenes=data.val_examples,
+                              canvas=data.image_size, seed=2)
+
+        def _train(epoch):
+            tr = detection_scenes(tr_x, tr_y, n_scenes=data.train_examples,
+                                  canvas=data.image_size, seed=1000 + epoch)
+            return detection_batches(tr, batch_size=cfg.batch_size,
+                                     shuffle_seed=epoch)
+
+        return _train, lambda epoch: detection_batches(
+            va, batch_size=cfg.batch_size)
     if data.dataset != "detection":
         raise ValueError(f"detection families read 'detection' TFRecords, "
                          f"not dataset={data.dataset!r}")

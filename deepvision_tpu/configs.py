@@ -233,6 +233,21 @@ CONFIGS.register("centernet", _CENTERNET)
 # (own name → own runs/objects_as_points workdir, no checkpoint clobbering)
 CONFIGS.register("objects_as_points", _CENTERNET.replace(
     name="objects_as_points"))
+# -- CenterNet on real scanned-digit detection scenes (the zero-egress
+#    real-data DETECTION gate, data/digits.py::detection_splits — detection
+#    analog of lenet5_digits; the reference never published an mAP,
+#    `YOLO/tensorflow/README.md:29`. Tiny hourglass: 64px canvas -> 16px
+#    grid needs order<=4; width/stacks sized for a CPU-feasible committed
+#    run, runs/r05_centernet_digits_cpu) --------------------------------------
+CONFIGS.register("centernet_digits", _CENTERNET.replace(
+    name="centernet_digits", batch_size=32, total_epochs=30,
+    model_kwargs={"num_stack": 1, "order": 2, "width_mult": 0.25},
+    optimizer=OptimizerConfig(name="adam", learning_rate=5e-4),
+    schedule=ScheduleConfig(name="step", boundaries_epochs=(20, 26),
+                            decay_factor=0.1),
+    data=DataConfig(dataset="digits_detect", image_size=64, num_classes=10,
+                    train_examples=512, val_examples=128),
+))
 
 
 def get_config(name: str) -> TrainConfig:

@@ -158,3 +158,40 @@ def test_torch_import_reproduces_eval_accuracy(tmp_path):
                                            drop_remainder=False))
     trainer.close()
     assert abs(result["top1"] - torch_top1) < 5e-3, (result, torch_top1)
+
+
+def test_digits_detection_artifact_integrity():
+    """The committed real-data DETECTION record (VERDICT r4 item 7, offline
+    form — the reference never published an mAP at all,
+    `YOLO/tensorflow/README.md:29`): CenterNet trained on composed scenes of
+    the same real scans as the LeNet gate, evaluated on scenes built ONLY
+    from held-out handwriting. Pins the committed artifact's integrity and
+    quality bar; the run recipe is one command
+    (`ObjectsAsPoints/jax/train.py -m centernet_digits`)."""
+    import json
+
+    run_dir = os.path.join(REPO, "runs", "r05_centernet_digits_cpu")
+    jsonl = os.path.join(run_dir, "centernet_digits.jsonl")
+    eval_json = os.path.join(run_dir, "EVAL.json")
+    if not (os.path.exists(jsonl) and os.path.exists(eval_json)):
+        pytest.skip("r05 digits-detection artifact not committed yet")
+
+    with open(jsonl) as fp:
+        lines = [json.loads(ln) for ln in fp if ln.strip()]
+    meta = lines[0]["meta"]
+    assert meta["platform"] == "cpu", meta
+    assert meta["jax_version"], meta
+    val = [r for r in lines[1:] if "val_loss" in r]
+    assert len(val) >= 25, "expected a full multi-epoch training curve"
+    # the curve must actually LEARN: final val loss far below the first
+    assert val[-1]["val_loss"] < 0.5 * val[0]["val_loss"], (
+        val[0]["val_loss"], val[-1]["val_loss"])
+
+    with open(eval_json) as fp:
+        metrics = json.load(fp)
+    # quality bar on UNSEEN handwriting: non-overlapping quadrant scenes are
+    # an easy detection task, so a trained detector must clear a high bar —
+    # and the bar catches any silent eval/decode regression loudly
+    # (committed run measured mAP@0.5 = 0.982, COCO mAP = 0.825)
+    assert metrics["mAP@0.5"] >= 0.95, metrics
+    assert metrics["mAP"] >= 0.75, metrics
