@@ -15,7 +15,9 @@ preflight-record: ## run preflight on the virtual mesh, record PREFLIGHT_r$(ROUN
 	  env $(CPU_ENV) $(PY) tools/preflight.py --batch-size 64 --image-size 64; } \
 	  > PREFLIGHT_r$(ROUND).txt; s=$$?; cat PREFLIGHT_r$(ROUND).txt; exit $$s
 
-test:        ## fast suite (slow-marked compiles excluded)
+test:        ## fast suite (slow-marked excluded; warm XLA cache ~7 min on
+	## one core, cold ~15 — tests/conftest.py shares a persistent
+	## compilation cache at /tmp/deepvision-test-xla-cache)
 	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q
 
 test-all:    ## everything, including slow XLA-CPU compiles

@@ -143,20 +143,40 @@ def setup_compilation_cache(arg: str) -> None:
     earlier run in this process. An unwritable cache path degrades to no
     caching, never to a failed run."""
     import jax
+
+    def _reset_singleton():
+        # jax's persistent cache initializes lazily ONCE with the dir in
+        # effect at first use; a later jax.config.update alone is silently
+        # ignored. Changing (or disabling) the dir mid-process must reset
+        # the singleton or the switch is a no-op.
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as cc)
+            cc.reset_cache()
+        except Exception:
+            pass  # no cache initialized yet / API moved — config still set
+
     if arg == "off":
         jax.config.update("jax_compilation_cache_dir", None)
+        _reset_singleton()
         return
     path = (os.path.join(os.path.expanduser("~"), ".cache", "deepvision_tpu",
                          "xla") if arg == "auto" else arg)
     try:
         os.makedirs(path, exist_ok=True)
     except OSError as e:
+        # "degrades to no caching" means exactly that — also drop any cache
+        # enabled earlier in this process, or the bad path silently keeps
+        # reading/writing the old dir
         print(f"compilation cache disabled ({e})", flush=True)
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_singleton()
         return
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs",
         float(os.environ.get("DEEPVISION_CACHE_MIN_COMPILE_SECS", "1.0")))
+    _reset_singleton()
 
 
 def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,

@@ -7,10 +7,31 @@ import os
 # Force CPU: the session env presets JAX_PLATFORMS=axon (TPU-via-tunnel), which is
 # wrong for unit tests — override, don't setdefault.
 os.environ["JAX_PLATFORMS"] = "cpu"
-# CLI tests must not write compiled executables to the real ~/.cache (or mask
-# recompilation bugs with stale cross-run hits); tests that exercise the cache
-# pass an explicit --compilation-cache DIR, which overrides this default.
-os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", "off")
+# Suite-wide persistent XLA compilation cache (VERDICT r4 item 6: the default
+# lane's wall clock is dominated by recompiling the same tiny models every
+# run). The cache key is the full HLO + jax version + compile options, so a
+# hit can only ever return the binary for an IDENTICAL program — it cannot
+# mask a framework bug (those live in the Python that BUILDS the program).
+# The same dir feeds subprocess tests (CLI entrypoints, multihost workers)
+# through DEEPVISION_COMPILATION_CACHE; tests that exercise the cache
+# plumbing itself pass an explicit --compilation-cache DIR, which overrides
+# the env. Opt out with DEEPVISION_TEST_XLA_CACHE=off (e.g. to time real
+# compiles).
+_CACHE = os.environ.get(
+    "DEEPVISION_TEST_XLA_CACHE",
+    # per-uid path: a fixed world-shared /tmp dir would collide
+    # across users on a shared host (first owner wins, everyone
+    # else silently recompiles cold) and would execute cache
+    # entries any local user could seed
+    f"/tmp/deepvision-test-xla-cache-{os.getuid()}")
+if _CACHE != "off":
+    os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", _CACHE)
+    # subprocess tests (CLI entrypoints, multihost workers) read this env
+    # for their persistence threshold — without it their sub-second tiny-
+    # model compiles never land in the cache (cli.py default is 1.0s)
+    os.environ.setdefault("DEEPVISION_CACHE_MIN_COMPILE_SECS", "0")
+else:
+    os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -24,6 +45,11 @@ import pytest  # noqa: E402
 # can stall for minutes when the TPU tunnel is slow. Backends initialize lazily,
 # so overriding the already-imported config here still wins.
 jax.config.update("jax_platforms", "cpu")
+if _CACHE != "off":
+    jax.config.update("jax_compilation_cache_dir", _CACHE)
+    # default min-compile-time gate (1s) would skip many of the suite's
+    # small-but-numerous compiles; cache everything
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 @pytest.fixture(scope="session")

@@ -156,6 +156,9 @@ class TestLowpTrafficVariants:
         # in the same error class as bf16 itself, not a new regime
         assert err_lean <= 2.5 * err_base + 1e-3, (err_base, err_lean)
 
+    # slow lane: 20s grad compile; the f32-noop and bf16-error gates above
+    # are the cheap critical pins
+    @pytest.mark.slow
     def test_bf16_lean_train_step_grads_finite_f32_state(self):
         from deepvision_tpu.models.resnet import ResNet
 
