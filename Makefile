@@ -17,7 +17,8 @@ preflight-record: ## run preflight on the virtual mesh, record PREFLIGHT_r$(ROUN
 
 test:        ## fast suite (slow-marked excluded; warm XLA cache ~7 min on
 	## one core, cold ~15 — tests/conftest.py shares a persistent
-	## compilation cache at /tmp/deepvision-test-xla-cache)
+	## compilation cache at ~/.cache/deepvision_tpu/test-xla; opt out
+	## with DEEPVISION_TEST_XLA_CACHE=off)
 	env $(CPU_ENV) $(PY) -m pytest tests/ -x -q
 
 test-all:    ## everything, including slow XLA-CPU compiles

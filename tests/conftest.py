@@ -19,19 +19,22 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # compiles).
 _CACHE = os.environ.get(
     "DEEPVISION_TEST_XLA_CACHE",
-    # per-uid path: a fixed world-shared /tmp dir would collide
-    # across users on a shared host (first owner wins, everyone
-    # else silently recompiles cold) and would execute cache
-    # entries any local user could seed
-    f"/tmp/deepvision-test-xla-cache-{os.getuid()}")
+    # home-rooted, not /tmp: a predictable world-writable /tmp path could
+    # be pre-created and seeded with crafted executables by another local
+    # user (XLA deserializes and runs cache entries), and fixed paths
+    # collide across users
+    os.path.join(os.path.expanduser("~"), ".cache", "deepvision_tpu",
+                 "test-xla"))
+os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", _CACHE)
+# a pre-set DEEPVISION_COMPILATION_CACHE (e.g. 'off' for cold-timing runs)
+# wins for BOTH subprocess and in-process tests — the two lanes must never
+# split across different caches
+_CACHE = os.environ["DEEPVISION_COMPILATION_CACHE"]
 if _CACHE != "off":
-    os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", _CACHE)
     # subprocess tests (CLI entrypoints, multihost workers) read this env
     # for their persistence threshold — without it their sub-second tiny-
     # model compiles never land in the cache (cli.py default is 1.0s)
     os.environ.setdefault("DEEPVISION_CACHE_MIN_COMPILE_SECS", "0")
-else:
-    os.environ.setdefault("DEEPVISION_COMPILATION_CACHE", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
