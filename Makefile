@@ -2,6 +2,12 @@
 PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
           XLA_FLAGS=--xla_force_host_platform_device_count=8
+# the heavy-evidence files `make verify` runs in FULL (slow included); the
+# verify target's second command sweeps slow-marked tests everywhere else,
+# deriving its --ignore list from this variable so the two stay in sync
+VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
+               tests/test_spatial.py tests/test_spatial_shardmap.py \
+               tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench dryrun smoke preflight preflight-record
 
@@ -30,15 +36,9 @@ verify:      ## the heavy correctness evidence the default lane skips
 	## real-data accuracy gates, the GAN quality gate — plus every other
 	## slow-marked test (the r5 lane rebalance moved several integration
 	## tests there) — then the dryrun.
-	env $(CPU_ENV) $(PY) -m pytest -x -q -m "" \
-	    tests/test_multihost.py tests/test_preemption.py \
-	    tests/test_spatial.py tests/test_spatial_shardmap.py \
-	    tests/test_real_data.py tests/test_gan_quality.py
+	env $(CPU_ENV) $(PY) -m pytest -x -q -m "" $(VERIFY_FILES)
 	env $(CPU_ENV) $(PY) -m pytest -x -q -m slow tests/ \
-	    --ignore=tests/test_multihost.py --ignore=tests/test_preemption.py \
-	    --ignore=tests/test_spatial.py \
-	    --ignore=tests/test_spatial_shardmap.py \
-	    --ignore=tests/test_real_data.py --ignore=tests/test_gan_quality.py
+	    $(addprefix --ignore=,$(VERIFY_FILES))
 	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 bench:       ## ResNet-50 step throughput (TPU if reachable, else CPU)

@@ -198,18 +198,33 @@ class DetectionTrainer(LossWatchedTrainer):
     for both LR decay and save-best, `YOLO/tensorflow/train.py:244-247`). Model
     construction (num_classes/dtype kwargs) is inherited from the base."""
 
+    has_own_shardmap_step = True  # make_shardmap_yolo_train_step
+
     def __init__(self, config: TrainConfig, model=None, mesh=None,
                  workdir: Optional[str] = None):
         super().__init__(config, model=model, mesh=mesh, workdir=workdir)
-        self._reject_shardmap_backend("detection")
         grids = yolo_grid_sizes(config.data.image_size)
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = UNIT_RANGE_NORM if config.data.normalize_on_device else None
-        self._step_factory = lambda m, corr: make_yolo_train_step(
-            num_classes=config.data.num_classes, grid_sizes=grids,
-            compute_dtype=compute_dtype, mesh=m, remat=config.remat,
-            input_norm=input_norm, log_grad_norm=config.log_grad_norm,
-            donate=config.steps_per_dispatch == 1, grad_correction=corr)
+        if self._use_shardmap_spatial():
+            # owned collectives through the Darknet/FPN backbone with an
+            # all_gather head handoff (the YOLO loss is not row-local) —
+            # exact on combined meshes, no calibration
+            from ..parallel import spatial_shard
+            self._step_factory = (
+                lambda m, corr: spatial_shard.make_shardmap_yolo_train_step(
+                    num_classes=config.data.num_classes, grid_sizes=grids,
+                    compute_dtype=compute_dtype, mesh=m,
+                    input_norm=input_norm,
+                    log_grad_norm=config.log_grad_norm,
+                    remat=config.remat,
+                    donate=config.steps_per_dispatch == 1))
+        else:
+            self._step_factory = lambda m, corr: make_yolo_train_step(
+                num_classes=config.data.num_classes, grid_sizes=grids,
+                compute_dtype=compute_dtype, mesh=m, remat=config.remat,
+                input_norm=input_norm, log_grad_norm=config.log_grad_norm,
+                donate=config.steps_per_dispatch == 1, grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_yolo_eval_step(
             num_classes=config.data.num_classes, grid_sizes=grids,

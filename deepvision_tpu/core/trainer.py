@@ -268,9 +268,11 @@ class Trainer:
             self._set_watch("top1", "max")
 
     # Families with their own owned-collectives step set this True
-    # (CenterNetTrainer, PoseTrainer) instead of re-implementing the
-    # opt-in predicate; families without one (detection, GAN) keep the
-    # default and call _reject_shardmap_backend in __init__.
+    # (CenterNetTrainer, PoseTrainer, DetectionTrainer) instead of
+    # re-implementing the opt-in predicate; a family WITHOUT one must
+    # refuse the backend loudly at config-validation time with a
+    # ValueError (the adversarial trainers' _validate_config is the
+    # pattern) rather than training with silently wrong spatial semantics.
     has_own_shardmap_step = False
 
     def _use_shardmap_spatial(self) -> bool:
@@ -281,14 +283,6 @@ class Trainer:
         return (self.config.spatial_backend == "shard_map"
                 and mesh_lib.has_spatial(self.mesh)
                 and (type(self) is Trainer or self.has_own_shardmap_step))
-
-    def _reject_shardmap_backend(self, family: str) -> None:
-        if (self.config.spatial_backend == "shard_map"
-                and mesh_lib.has_spatial(self.mesh)):
-            raise NotImplementedError(
-                f"spatial_backend='shard_map' is not implemented for the "
-                f"{family} trainer yet; use the gspmd backend (exact on "
-                f"(data, spatial) meshes; combined meshes calibrate)")
 
     def _set_watch(self, key: str, mode: str):
         """Set the watched metric + direction and (re)build the checkpoint

@@ -451,6 +451,111 @@ def make_shardmap_classification_train_step(
     return jax.jit(step, **jit_kwargs)
 
 
+def make_shardmap_yolo_train_step(
+    *,
+    num_classes: int,
+    grid_sizes: Sequence[int],
+    mesh: Mesh,
+    compute_dtype=jnp.bfloat16,
+    input_norm: Optional[tuple] = None,
+    log_grad_norm: bool = False,
+    donate: bool = True,
+    remat: bool = False,
+):
+    """YOLO `(state, images, boxes, classes, valid, rng)` step with owned
+    spatial semantics — the fourth family on this backend.
+
+    YOLO's loss is NOT row-local (`ops/yolo.py`: cell offsets index the
+    global grid, and the ignore mask compares every predicted box against
+    the image's full ground truth), so the transition concept moves to the
+    HEAD boundary: Darknet-53 + the FPN — where all the FLOPs and big
+    activations live — run H-sharded end to end (SAME convs, stride-2
+    downsamples, nearest-x2 upsample + channel concat are all handled or
+    row-local), then ONE tiled `all_gather` per scale rebuilds the tiny
+    (B_local, g, g, 3, 5+C) heads on every spatial rank and the ORACLE's
+    own `yolo_loss` runs unchanged on full tensors. The loss is thereby
+    computed sp-times redundantly — O(g^2) work, noise next to the backbone
+    — and the duplication cancels exactly in the uniform psum/n_ranks rule:
+    all_gather transposes to reduce-scatter, so summing the sp identical
+    loss copies' grads over ('data','spatial') counts each data slice sp
+    times, and /(dp*sp) restores the global-batch mean. Verified against
+    the single-device oracle in test_spatial_shardmap.py."""
+    from ..core.steps import _normalize_input, maybe_grad_norm
+    from ..ops import yolo as yolo_ops
+
+    sp = dict(mesh.shape).get(SPATIAL_AXIS, 1)
+    dp = dict(mesh.shape)[DATA_AXIS]
+    n_ranks = sp * dp
+    axes = tuple(a for a in MANUAL_AXES if a in mesh.axis_names)
+    if sp > 1:
+        bad = [g for g in grid_sizes if g % sp != 0]
+        if bad:
+            raise ValueError(
+                f"yolo grids {bad} must be divisible by spatial={sp} "
+                f"(grid rows are H-sharded through the FPN)")
+
+    def step(state, images, boxes, classes, valid, rng):
+        del rng  # YOLO has no dropout; augmentation happens host-side
+        images = _normalize_input(images, input_norm, compute_dtype)
+
+        def body(params, batch_stats, images, boxes, classes, valid):
+            classes_onehot = jax.nn.one_hot(classes, num_classes,
+                                            dtype=jnp.float32)
+            y_trues = yolo_ops.encode_labels(classes_onehot, boxes, valid,
+                                             grid_sizes)
+
+            def forward(p, images):
+                ctx = SpatialShardContext(sp=sp, transition=None, axes=axes)
+                with ctx.active():
+                    return state.apply_fn(
+                        {"params": p, "batch_stats": batch_stats},
+                        images, train=True, mutable=["batch_stats"])
+
+            if remat:
+                forward = jax.checkpoint(
+                    forward, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+
+            def loss_fn(p):
+                outputs, mutated = forward(p, images)
+                if sp > 1:
+                    outputs = tuple(
+                        lax.all_gather(o, SPATIAL_AXIS, axis=1, tiled=True)
+                        for o in outputs)
+                comp = yolo_ops.yolo_loss(y_trues, outputs, boxes, valid,
+                                          num_classes)
+                return jnp.mean(comp["total"]), (comp, mutated)
+
+            (loss, (comp, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            metrics = {"loss": loss,
+                       **{f"{k}_loss": jnp.mean(v)
+                          for k, v in comp.items() if k != "total"}}
+            metrics = {k: lax.pmean(v, axes) for k, v in metrics.items()}
+            new_bs = mutated.get("batch_stats", batch_stats)
+            return grads, new_bs, metrics
+
+        spatial_in = P(DATA_AXIS, SPATIAL_AXIS if sp > 1 else None)
+        grads, new_bs, metrics = jax.shard_map(
+            body, mesh=mesh, axis_names=set(axes),
+            in_specs=(P(), P(), spatial_in, P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(state.params, state.batch_stats, images, boxes, classes, valid)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return jax.jit(step, **jit_kwargs)
+
+
 def make_shardmap_pose_train_step(
     *,
     heatmap_size: Tuple[int, int],

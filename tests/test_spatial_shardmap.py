@@ -298,15 +298,18 @@ def test_centernet_combined_mesh_shardmap_parity(tmp_path):
             err_msg=jax.tree_util.keystr(path))
 
 
-def test_subclass_trainers_reject_shardmap_backend(tmp_path):
+def test_adversarial_trainers_reject_shardmap_backend(tmp_path):
+    """Round 5 closed the supervised families (classification, CenterNet,
+    pose, YOLO); only the adversarial trainers still refuse — loudly, at
+    config-validation time."""
     from deepvision_tpu.configs import get_config
-    from deepvision_tpu.core.detection import DetectionTrainer
+    from deepvision_tpu.core.gan import DCGANTrainer
 
-    cfg = get_config("yolov3").replace(
-        batch_size=8, spatial_parallel=2, spatial_backend="shard_map",
+    cfg = get_config("dcgan").replace(
+        spatial_parallel=2, spatial_backend="shard_map",
         checkpoint_dir=str(tmp_path))
-    with pytest.raises(NotImplementedError, match="shard_map"):
-        DetectionTrainer(cfg, workdir=str(tmp_path))
+    with pytest.raises(ValueError, match="shard_map"):
+        DCGANTrainer(cfg, workdir=str(tmp_path))
 
 
 @pytest.mark.slow
@@ -450,4 +453,96 @@ def test_pose_shardmap_cheap_guards():
     assert default_transition(hg) is None
     with pytest.raises(ValueError, match="divisible by"):
         make_shardmap_pose_train_step(heatmap_size=(15, 16),
+                                      mesh=_combined_mesh())
+
+
+@pytest.mark.slow
+def test_yolo_combined_mesh_shardmap_parity():
+    """Round-5 family extension #2: YOLO under the owned-collectives
+    backend on the (2,2,2) combined mesh. The Darknet/FPN backbone runs
+    H-sharded; the heads are all_gathered and the ORACLE's own loss runs on
+    full tensors (the YOLO loss is not row-local — cell offsets index the
+    global grid and the ignore mask sees the image's full ground truth).
+    Loss must match the single-device oracle tightly; update norms to 12%
+    (same sync-BN reduction-order argument as the pose test — Darknet-53 at
+    test width is another deep stack of narrow BNs); remat leaf-exact
+    against the non-remat shard_map step."""
+    import optax
+    from deepvision_tpu.core.detection import make_yolo_train_step
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+    from deepvision_tpu.ops.yolo import MAX_BOXES
+    from deepvision_tpu.parallel.spatial_shard import (
+        make_shardmap_yolo_train_step)
+
+    model = MODELS.get("yolov3")(num_classes=3, width_mult=0.125,
+                                 dtype=jnp.float32)
+    batch, size = 8, 64
+    rs = np.random.RandomState(0)
+    images = rs.rand(batch, size, size, 3).astype(np.float32)
+    boxes = np.zeros((batch, MAX_BOXES, 4), np.float32)
+    boxes[:, 0] = [0.2, 0.2, 0.6, 0.6]
+    boxes[:, 1] = [0.55, 0.5, 0.9, 0.85]
+    classes = np.zeros((batch, MAX_BOXES), np.int32)
+    classes[:, 1] = 2
+    valid = np.zeros((batch, MAX_BOXES), np.float32)
+    valid[:, :2] = 1.0
+
+    rng = jax.random.PRNGKey(0)
+    params, bstats = init_model(model, rng, jnp.zeros((2, size, size, 3)))
+    tx = optax.sgd(1.0)  # update == -grad: norms measure grad norms
+
+    oracle_step = make_yolo_train_step(
+        num_classes=3, grid_sizes=(8, 4, 2), compute_dtype=jnp.float32,
+        donate=False)
+    ost, om = oracle_step(
+        TrainState.create(model.apply, params, tx, bstats),
+        jnp.asarray(images), jnp.asarray(boxes), jnp.asarray(classes),
+        jnp.asarray(valid), jax.random.PRNGKey(2))
+
+    mesh = _combined_mesh()
+    rules = mesh_lib.param_sharding_rules(mesh, params,
+                                          min_size_to_shard=2 ** 10)
+    repl = mesh_lib.replicated(mesh)
+
+    def placed_state():
+        st = TrainState.create(model.apply, params, tx, bstats)
+        return st.replace(params=jax.device_put(st.params, rules),
+                          batch_stats=jax.device_put(st.batch_stats, repl),
+                          opt_state=jax.device_put(st.opt_state, repl),
+                          step=jax.device_put(st.step, repl))
+
+    sm_step = make_shardmap_yolo_train_step(
+        num_classes=3, grid_sizes=(8, 4, 2), mesh=mesh,
+        compute_dtype=jnp.float32, donate=False)
+    b = mesh_lib.shard_batch_pytree(mesh, (images, boxes, classes, valid))
+    sst, sm = sm_step(placed_state(), *b, jax.random.PRNGKey(2))
+    assert float(sm["loss"]) == pytest.approx(float(om["loss"]), rel=1e-5)
+    p0 = jax.device_get(params)
+    mesh_lib.verify_update_parity(
+        (p0, jax.device_get(ost.params)), (p0, jax.device_get(sst.params)),
+        norm_rtol=0.12, context=" (yolo shard_map)")
+
+    rm_step = make_shardmap_yolo_train_step(
+        num_classes=3, grid_sizes=(8, 4, 2), mesh=mesh,
+        compute_dtype=jnp.float32, donate=False, remat=True)
+    rst, rmm = rm_step(placed_state(), *b, jax.random.PRNGKey(2))
+    assert float(rmm["loss"]) == pytest.approx(float(sm["loss"]), abs=1e-6)
+    for (path, a), bleaf in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(sst.params))[0],
+            jax.tree_util.tree_leaves(jax.device_get(rst.params))):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bleaf), atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_yolo_shardmap_cheap_guards():
+    """Fast-lane coverage for the YOLO extension: indivisible grids refused
+    at build time."""
+    from deepvision_tpu.parallel.spatial_shard import (
+        make_shardmap_yolo_train_step)
+
+    with pytest.raises(ValueError, match="divisible by spatial"):
+        make_shardmap_yolo_train_step(num_classes=3, grid_sizes=(8, 4, 3),
                                       mesh=_combined_mesh())
