@@ -328,6 +328,12 @@ def default_transition(model) -> Optional[str]:
       residual adds are all row-local, and the heatmap heads are 1x1 convs
       — so None keeps H sharded end to end (the weighted-MSE loss is dense
       and row-sliceable, make_shardmap_pose_train_step).
+    - MobileNetV1: the handoff fires at the entry of the 1024-wide final
+      stage (block11) — BEFORE its stride-2 depthwise conv, which at the
+      config's own 224px would otherwise see stride-misaligned per-shard
+      rows (7 rows/shard at sp=2) — so the last two blocks and the global
+      mean run on full-height rows (the exact analogue of the ResNet
+      plan's last-stage-entry rule).
     """
     name = type(model).__name__
     if name == "ResNet":
@@ -335,12 +341,16 @@ def default_transition(model) -> Optional[str]:
         block_name = (block.__name__ if isinstance(block, type)
                       else type(block).__name__)
         return resnet_transition(model.stage_sizes, block_name)
+    if name == "MobileNetV1":
+        from ..models.mobilenet import _V1_BODY
+        return f"block{len(_V1_BODY) - 2}"
     if name in ("ObjectsAsPoints", "StackedHourglass"):
         return None
     raise NotImplementedError(
         f"spatial_backend='shard_map' has no transition plan for "
-        f"{name}; supported: ResNet family, CenterNet, StackedHourglass. "
-        f"Use the gspmd backend for this model.")
+        f"{name}; supported: ResNet family, MobileNetV1, CenterNet, "
+        f"StackedHourglass (+ YOLO/pose via their trainers). Use the gspmd "
+        f"backend for this model.")
 
 
 def resnet_transition(stage_sizes: Sequence[int],

@@ -546,3 +546,85 @@ def test_yolo_shardmap_cheap_guards():
     with pytest.raises(ValueError, match="divisible by spatial"):
         make_shardmap_yolo_train_step(num_classes=3, grid_sizes=(8, 4, 3),
                                       mesh=_combined_mesh())
+
+
+@pytest.mark.slow
+def test_mobilenet_combined_mesh_shardmap_parity():
+    """Round-5 family extension #3: MobileNetV1 through the classification
+    shard_map step on the (2,2,2) combined mesh — depthwise convs take the
+    grouped-conv path of _sharded_conv, and the handoff at block12's entry
+    delivers full-height rows to the trailing global mean. Same norm-level
+    bar as pose/yolo (deep stack of narrow BNs), loss tight, remat
+    leaf-exact."""
+    from deepvision_tpu.core import steps
+    from deepvision_tpu.core.train_state import TrainState, init_model
+    from deepvision_tpu.models import MODELS
+
+    model = MODELS.get("mobilenet_v1")(num_classes=7, alpha=0.1,
+                                       dtype=jnp.float32)
+    # block11 = entry of the 1024-wide final stage, BEFORE its stride-2 dw
+    # conv: at the config's 224px with sp=2 a block12 handoff would leave
+    # that conv 7 rows/shard (stride-misaligned); verified by the 224px
+    # geometry check below
+    assert default_transition(model) == "block11"
+    rng = jax.random.PRNGKey(0)
+    rs = np.random.RandomState(3)
+    images = rs.rand(8, 64, 64, 3).astype(np.float32)
+    labels = (np.arange(8) % 7).astype(np.int32)
+    params, bstats = init_model(model, rng, jnp.zeros((2, 64, 64, 3)))
+    tx = optax.sgd(1.0)
+
+    oracle_step = steps.make_classification_train_step(
+        label_smoothing=0.1, compute_dtype=jnp.float32, donate=False)
+    ost, om = oracle_step(
+        TrainState.create(model.apply, params, tx, bstats),
+        jnp.asarray(images), jnp.asarray(labels), jax.random.PRNGKey(2))
+
+    mesh = _combined_mesh()
+    rules = mesh_lib.param_sharding_rules(mesh, params,
+                                          min_size_to_shard=2 ** 10)
+    repl = mesh_lib.replicated(mesh)
+
+    def placed_state():
+        st = TrainState.create(model.apply, params, tx, bstats)
+        return st.replace(params=jax.device_put(st.params, rules),
+                          batch_stats=jax.device_put(st.batch_stats, repl),
+                          opt_state=jax.device_put(st.opt_state, repl),
+                          step=jax.device_put(st.step, repl))
+
+    sm_step = make_shardmap_classification_train_step(
+        mesh=mesh, transition=default_transition(model),
+        label_smoothing=0.1, compute_dtype=jnp.float32, donate=False)
+    b = mesh_lib.shard_batch_pytree(mesh, (images, labels))
+    sst, sm = sm_step(placed_state(), *b, jax.random.PRNGKey(2))
+    assert float(sm["loss"]) == pytest.approx(float(om["loss"]), rel=1e-5)
+    p0 = jax.device_get(params)
+    mesh_lib.verify_update_parity(
+        (p0, jax.device_get(ost.params)), (p0, jax.device_get(sst.params)),
+        norm_rtol=0.12, context=" (mobilenet shard_map)")
+
+    rm_step = make_shardmap_classification_train_step(
+        mesh=mesh, transition=default_transition(model),
+        label_smoothing=0.1, compute_dtype=jnp.float32, donate=False,
+        remat=True)
+    rst, rmm = rm_step(placed_state(), *b, jax.random.PRNGKey(2))
+    assert float(rmm["loss"]) == pytest.approx(float(sm["loss"]), abs=1e-6)
+    for (path, a), bleaf in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(sst.params))[0],
+            jax.tree_util.tree_leaves(jax.device_get(rst.params))):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bleaf), atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
+
+    # 224px geometry (the production mobilenet_v1 resolution): every conv's
+    # per-shard rows must stay stride-aligned up to the handoff. Walk the
+    # plan symbolically instead of compiling a 224px model on CPU.
+    from deepvision_tpu.models.mobilenet import _V1_BODY
+    rows = 224 // 2  # global rows after the stride-2 stem
+    sp = 2
+    for i, (_, stride) in enumerate(_V1_BODY):
+        if f"block{i}" == default_transition(model):
+            break  # handoff: rows gathered, later strides run full-height
+        assert (rows // sp) % stride == 0, (i, rows, stride)
+        rows //= stride
