@@ -195,3 +195,35 @@ def test_digits_detection_artifact_integrity():
     # (committed run measured mAP@0.5 = 0.982, COCO mAP = 0.825)
     assert metrics["mAP@0.5"] >= 0.95, metrics
     assert metrics["mAP"] >= 0.75, metrics
+
+
+def test_detection_scene_composer_invariants():
+    """The ground truth the digits-detection gate trains against must be
+    trustworthy by construction: quadrant placement -> zero box overlap,
+    normalized corner boxes tight on the pasted digit, classes echo the
+    source scan labels, pixels span [-1, 1]."""
+    from deepvision_tpu.data.digits import detection_scenes, scan_splits
+
+    (tr_x, tr_y), _ = scan_splits()
+    scenes, boxes, classes, valid = detection_scenes(
+        tr_x, tr_y, n_scenes=16, canvas=64, digit_px=16, seed=7)
+    assert scenes.shape == (16, 64, 64, 3)
+    assert scenes.min() >= -1.0 and scenes.max() <= 1.0
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    for s in range(16):
+        bb = boxes[s][valid[s] > 0]
+        assert 1 <= len(bb) <= 4
+        # tight 16px boxes on a 64px canvas
+        np.testing.assert_allclose(bb[:, 2] - bb[:, 0], 0.25)
+        np.testing.assert_allclose(bb[:, 3] - bb[:, 1], 0.25)
+        for j in range(len(bb)):
+            for k in range(j + 1, len(bb)):
+                ix = max(0.0, min(bb[j][2], bb[k][2]) -
+                         max(bb[j][0], bb[k][0]))
+                iy = max(0.0, min(bb[j][3], bb[k][3]) -
+                         max(bb[j][1], bb[k][1]))
+                assert ix * iy == 0.0, (s, j, k)
+        cls = classes[s][valid[s] > 0]
+        assert ((cls >= 0) & (cls <= 9)).all()
+    with pytest.raises(ValueError, match="multiple of"):
+        detection_scenes(tr_x, tr_y, n_scenes=1, digit_px=12)

@@ -61,6 +61,10 @@ class TestGeometry:
         from deepvision_tpu.models import MODELS
         cn = MODELS.get("centernet")(num_classes=4)
         assert default_transition(cn) is None
+        mb = MODELS.get("mobilenet_v1")(num_classes=4)
+        assert default_transition(mb) == "block11"  # before the last
+        # stride-2 dw conv — the 224px geometry walk in the slow parity
+        # test derives why
         with pytest.raises(NotImplementedError):
             default_transition(MODELS.get("vgg16")(num_classes=4))
 
