@@ -144,3 +144,14 @@ def test_variant_kwargs_skip_headline_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("DEEPVISION_BENCH_KWARGS", "{}")
     bench.main()
     assert os.path.exists(bench.CACHE_PATH)
+
+
+def test_traffic_accounting_structure_and_prediction():
+    """The per-buffer accounting (TUNING.md table) must stay consistent
+    with the committed trace: coverage in a credible band and the lean
+    savings in the documented range."""
+    ta = _load("traffic_accounting")
+    out = ta.main(["--trace-gb", "85.4"])
+    assert 0.6 < out["baseline_gb"] / 85.4 < 1.0   # named-buffer coverage
+    saved = out["baseline_gb"] - out["lean_gb"]
+    assert 16.0 < saved < 20.0                      # GB the lowp flags remove
