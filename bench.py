@@ -55,8 +55,7 @@ def worker() -> None:
     # persistent XLA cache: retried workers (and re-benches after a tunnel
     # flake) skip the 20-40s TPU / minutes-long CPU first compile
     from deepvision_tpu.cli import setup_compilation_cache
-    setup_compilation_cache(os.environ.get("DEEPVISION_COMPILATION_CACHE",
-                                           "auto"))
+    setup_compilation_cache()
 
     from deepvision_tpu.core import steps
     from deepvision_tpu.core.config import OptimizerConfig, ScheduleConfig

@@ -135,14 +135,18 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     return p
 
 
-def setup_compilation_cache(arg: str) -> None:
+def setup_compilation_cache(arg: str = None) -> None:
     """Point JAX's persistent compilation cache at a durable directory, so a
     relaunched process (auto-resume after preemption — SURVEY.md §5.3 — or a
     second --eval-only run) reuses compiled executables instead of paying the
     first-compile latency again. 'off' also unsets a cache dir enabled by an
     earlier run in this process. An unwritable cache path degrades to no
-    caching, never to a failed run."""
+    caching, never to a failed run. arg=None (the non-CLI callers: bench.py,
+    bench_dispatch, dryrun_multichip) reads DEEPVISION_COMPILATION_CACHE
+    from the env, defaulting to 'auto' — ONE place owns that idiom."""
     import jax
+    if arg is None:
+        arg = os.environ.get("DEEPVISION_COMPILATION_CACHE", "auto")
 
     def _reset_singleton():
         # jax's persistent cache initializes lazily ONCE with the dir in

@@ -43,8 +43,7 @@ def worker(spd: int, prefetch: int, steps: int) -> None:
     from deepvision_tpu.core.trainer import Trainer
     from deepvision_tpu.data.synthetic import SyntheticClassification
 
-    setup_compilation_cache(os.environ.get("DEEPVISION_COMPILATION_CACHE",
-                                           "auto"))
+    setup_compilation_cache()
     platform = jax.devices()[0].platform
     batch = 256 if platform == "tpu" else 32
     size = 224 if platform == "tpu" else 64
