@@ -100,7 +100,8 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                         "XLA partitioner ('gspmd', default) or explicit "
                         "shard_map collectives ('shard_map': exact on "
                         "combined spatial x model meshes, no calibration; "
-                        "ResNet/CenterNet)")
+                        "ResNet family, MobileNet, CenterNet, Hourglass "
+                        "pose, YOLO)")
     p.add_argument("--device-normalize", action="store_true",
                    help="ship raw uint8 pixels to the device and normalize "
                         "inside the jitted step (4x less host->device "
