@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", default="yolov3",
-                   choices=["yolov3", "yolov3_voc"])
+                   choices=["yolov3", "yolov3_voc", "yolov3_digits"])
     p.add_argument("-c", "--checkpoint", default="latest",
                    help="epoch number or 'latest'")
     p.add_argument("--workdir", default=None)
@@ -29,6 +29,8 @@ def main(argv=None):
     p.add_argument("--synthetic", action="store_true",
                    help="evaluate on synthetic batches (smoke test)")
     p.add_argument("--max-batches", type=int, default=None)
+    p.add_argument("--out", default=None,
+                   help="also write the metrics dict as JSON (artifact use)")
     args = p.parse_args(argv)
 
     import itertools
@@ -49,6 +51,16 @@ def main(argv=None):
         from deepvision_tpu.data.detection import synthetic_batches
         batches = synthetic_batches(batch_size=4, image_size=size,
                                     num_classes=cfg.data.num_classes, steps=2)
+    elif cfg.data.dataset == "digits_detect":
+        # the real-scanned-digits detection gate (data/digits.py): held-out
+        # val scenes, same seed-2 identity the training CLI pins
+        from deepvision_tpu.data.digits import (detection_batches,
+                                                detection_scenes,
+                                                scan_splits)
+        _, (va_x, va_y) = scan_splits()
+        va = detection_scenes(va_x, va_y, n_scenes=cfg.data.val_examples,
+                              canvas=cfg.data.image_size, seed=2)
+        batches = detection_batches(va, batch_size=cfg.batch_size)
     else:
         from deepvision_tpu.data.detection import build_dataset
         data_dir = args.data_dir or cfg.data.data_dir or "dataset/tfrecords"
@@ -70,6 +82,12 @@ def main(argv=None):
     for k in sorted(metrics):
         if k.startswith("mAP"):
             print(f"{k}: {metrics[k]:.4f}")
+    if args.out:
+        import json
+        with open(args.out, "w") as fp:
+            json.dump({k: float(v) for k, v in metrics.items()}, fp,
+                      indent=1, sort_keys=True)
+            fp.write("\n")
     return metrics
 
 

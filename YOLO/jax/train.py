@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from deepvision_tpu.cli import run_detection
 
-MODELS = ["yolov3", "yolov3_voc"]
+MODELS = ["yolov3", "yolov3_voc", "yolov3_digits"]
 
 if __name__ == "__main__":
     run_detection("YOLO", MODELS)
