@@ -55,11 +55,9 @@ def main(argv=None):
         # the real-scanned-digits detection gate (data/digits.py): held-out
         # val scenes, same seed-2 identity the training CLI pins
         from deepvision_tpu.data.digits import (detection_batches,
-                                                detection_scenes,
-                                                scan_splits)
-        _, (va_x, va_y) = scan_splits()
-        va = detection_scenes(va_x, va_y, n_scenes=cfg.data.val_examples,
-                              canvas=cfg.data.image_size, seed=2)
+                                                detection_val_scenes)
+        va = detection_val_scenes(canvas=cfg.data.image_size,
+                                 n_scenes=cfg.data.val_examples)
         batches = detection_batches(va, batch_size=cfg.batch_size)
     else:
         from deepvision_tpu.data.detection import build_dataset

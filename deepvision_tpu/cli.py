@@ -510,10 +510,10 @@ def _detection_data(cfg, args):
                              "digits_detect (scenes are already float "
                              "[-1,1], not raw pixels)")
         from .data.digits import (detection_batches, detection_scenes,
-                                  scan_splits)
-        (tr_x, tr_y), (va_x, va_y) = scan_splits()
-        va = detection_scenes(va_x, va_y, n_scenes=data.val_examples,
-                              canvas=data.image_size, seed=2)
+                                  detection_val_scenes, scan_splits)
+        (tr_x, tr_y), _ = scan_splits()
+        va = detection_val_scenes(canvas=data.image_size,
+                                  n_scenes=data.val_examples)
 
         def _train(epoch):
             tr = detection_scenes(tr_x, tr_y, n_scenes=data.train_examples,

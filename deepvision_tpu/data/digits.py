@@ -151,6 +151,16 @@ def detection_splits(*, canvas: int = 64, digit_px: int = 16,
     return tr, va
 
 
+def detection_val_scenes(*, canvas: int, n_scenes: int):
+    """THE pinned validation scene set (seed 2, held-out scans only) — the
+    single owner of the identity that training validates against and both
+    family evaluators score (cli.py digits_detect, ObjectsAsPoints/ and
+    YOLO/jax/evaluate.py). Change it here or nowhere."""
+    _, (va_x, va_y) = scan_splits()
+    return detection_scenes(va_x, va_y, n_scenes=n_scenes, canvas=canvas,
+                            seed=2)
+
+
 def detection_batches(split: Tuple[np.ndarray, ...], *, batch_size: int,
                       shuffle_seed: int = None):
     """Iterate a detection-scene split in batches (drop-remainder, the
