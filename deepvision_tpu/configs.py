@@ -223,9 +223,9 @@ CONFIGS.register("yolov3_voc", TrainConfig(
 #    64px are (8, 4, 2).
 CONFIGS.register("yolov3_digits", TrainConfig(
     name="yolov3_digits", model="yolov3", family="detection", batch_size=32,
-    total_epochs=100,  # anchor-based heads need far more steps than
+    total_epochs=150,  # anchor-based heads need far more steps than
                        # CenterNet's focal head at this scene count
-    model_kwargs={"num_classes": 10, "width_mult": 0.125},
+    model_kwargs={"num_classes": 10, "width_mult": 0.25},
     optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
     schedule=ScheduleConfig(name="step", boundaries_epochs=(70, 90),
                             decay_factor=0.1),

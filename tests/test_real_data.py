@@ -231,13 +231,15 @@ def test_detection_scene_composer_invariants():
 
 def test_yolo_digits_artifact_integrity():
     """The YOLO half of the real-data detection record (VERDICT r4 item 7
-    named this family): tiny-width Darknet-53 through the full train->eval
-    loop on the same composed-scan scenes. This is a LEARNING-evidence bar,
-    not a quality bar — at width_mult 0.125 and 1.6k steps the anchor-based
-    head reaches mAP@0.5 = 0.43 on unseen handwriting (the committed run),
-    an order of magnitude above the anchor-scale-broken 64px setup (0.07,
-    see the yolov3_digits config comment) and far above chance; CenterNet
-    (mAP@0.5 = 0.982) is the quality gate."""
+    named this family): quarter-width Darknet-53 through the full
+    train->eval loop on the same composed-scan scenes, mAP@0.5 = 0.759 /
+    COCO mAP = 0.556 on unseen handwriting (committed run; ~109 epochs
+    before the flat-LR tail was cut). Two sizing lessons are part of the
+    record: at 64px canvas the 16px digits best-match the LARGE COCO anchor
+    and every label collapses onto the 2x2 grid (mAP 0.07 no matter how
+    long it trains — the yolov3_digits config comment has the analysis),
+    and width_mult 0.125 caps the same recipe at 0.43. CenterNet
+    (mAP@0.5 = 0.982) remains the stronger detector on these scenes."""
     import json
 
     run_dir = os.path.join(REPO, "runs", "r05_yolov3_digits_cpu")
@@ -256,4 +258,4 @@ def test_yolo_digits_artifact_integrity():
 
     with open(eval_json) as fp:
         metrics = json.load(fp)
-    assert metrics["mAP@0.5"] >= 0.35, metrics
+    assert metrics["mAP@0.5"] >= 0.70, metrics
