@@ -139,9 +139,16 @@ def test_variant_kwargs_skip_headline_cache(tmp_path, monkeypatch):
     bench.main()
     assert not os.path.exists(bench.CACHE_PATH)
 
-    # '{}' parses to baseline — the worker treats it so, the orchestrator
-    # must too (tools/bench_traffic.py always json.dumps its kwargs)
+    # '{}' is the traffic grid's plain-resnet50 baseline — since the
+    # headline is resnet50_lean, that too is a variant and must not
+    # write the headline cache (tools/bench_traffic.py always json.dumps
+    # its kwargs, so env-set-at-all is the variant signal)
     monkeypatch.setenv("DEEPVISION_BENCH_KWARGS", "{}")
+    bench.main()
+    assert not os.path.exists(bench.CACHE_PATH)
+
+    # only the headline path (env unset) persists the cache
+    monkeypatch.delenv("DEEPVISION_BENCH_KWARGS")
     bench.main()
     assert os.path.exists(bench.CACHE_PATH)
 

@@ -74,6 +74,12 @@ def run_combo(flags: str, timeout_s: float):
     # each combo must compile fresh — a flag that only changes the executable
     # would otherwise be served the baseline's cached binary
     env["DEEPVISION_COMPILATION_CACHE"] = "off"
+    # The sweep tunes the HEADLINE program (resnet50_lean since round 5) —
+    # drop any inherited variant request so every combo benches the same
+    # program the summary's `program` field claims. SWEEP.json files from
+    # r04/r05 measured plain resnet50; the field keeps cross-round flag
+    # comparisons from silently mixing programs.
+    env.pop("DEEPVISION_BENCH_KWARGS", None)
     return _run_worker(env, timeout_s)
 
 
@@ -120,7 +126,9 @@ def main(argv=None):
                     key=lambda r: -r["value"])
     summary = {"sweep": [
         {"combo": r["combo"], "value": r["value"], "platform": r["platform"]}
-        for r in ranked]}
+        for r in ranked],
+        "program": "headline (resnet50_lean since r05; plain resnet50 "
+                   "in r04/r05 SWEEP.json artifacts)"}
     if ranked:
         base = next((r["value"] for r in ranked
                      if r["combo"] == "baseline"), None)
@@ -128,8 +136,10 @@ def main(argv=None):
             summary["best_vs_baseline"] = round(ranked[0]["value"] / base, 3)
     print(json.dumps(summary), flush=True)
     if args.out:
+        # summary included so the artifact records which program was swept
+        # (bench_traffic.py writes results + summary for the same reason)
         with open(args.out, "w") as fp:
-            json.dump(results, fp, indent=1)
+            json.dump(results + [summary], fp, indent=1)
             fp.write("\n")
 
 
