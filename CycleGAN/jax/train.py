@@ -28,6 +28,11 @@ def main():
     p.add_argument("--steps-per-epoch", type=int, default=2)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--recover-on-divergence", type=int, default=None,
+                   metavar="N",
+                   help="roll back to the last committed checkpoint and "
+                        "retry (LR scaled down) up to N times when an "
+                        "epoch's metrics go non-finite (default 0: halt)")
     p.add_argument("--compilation-cache",
                    default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
                                           "auto"),
@@ -47,6 +52,8 @@ def main():
         cfg = cfg.replace(total_epochs=args.epochs)
     if args.batch_size:
         cfg = cfg.replace(batch_size=args.batch_size)
+    if args.recover_on_divergence is not None:
+        cfg = cfg.replace(recover_on_divergence=args.recover_on_divergence)
 
     image_size = 64 if args.synthetic else args.image_size
     workdir = args.workdir or (

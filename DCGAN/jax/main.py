@@ -25,6 +25,11 @@ def main():
                    help="steps per epoch in --synthetic mode")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
+    p.add_argument("--recover-on-divergence", type=int, default=None,
+                   metavar="N",
+                   help="roll back to the last committed checkpoint and "
+                        "retry (LR scaled down) up to N times when an "
+                        "epoch's metrics go non-finite (default 0: halt)")
     p.add_argument("--compilation-cache",
                    default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
                                           "auto"),
@@ -44,6 +49,8 @@ def main():
         cfg = cfg.replace(total_epochs=args.epochs)
     if args.batch_size:
         cfg = cfg.replace(batch_size=args.batch_size)
+    if args.recover_on_divergence is not None:
+        cfg = cfg.replace(recover_on_divergence=args.recover_on_divergence)
 
     trainer = DCGANTrainer(cfg, workdir=args.workdir)
     if args.resume:

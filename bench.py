@@ -267,13 +267,23 @@ def main() -> None:
     # fails fast with a readable error instead of burning the deadline on
     # workers whose identical crash is piped to DEVNULL. The key allowlist
     # mirrors tools/bench_traffic.py's VARIANTS — extend both together.
-    parsed_kwargs = json.loads(env.get("DEEPVISION_BENCH_KWARGS") or "{}")
     allowed = {"lowp_residual", "lowp_bn"}
+    bad_kwargs = SystemExit(
+        f"DEEPVISION_BENCH_KWARGS must be a JSON object with keys from "
+        f"{sorted(allowed)} and boolean values, got: "
+        f"{env.get('DEEPVISION_BENCH_KWARGS')!r}")
+    try:
+        parsed_kwargs = json.loads(env.get("DEEPVISION_BENCH_KWARGS") or "{}")
+    except json.JSONDecodeError:
+        # a missing quote must fail with the same readable message, not an
+        # uncaught decoder traceback
+        raise bad_kwargs from None
     if not isinstance(parsed_kwargs, dict) or \
-            not set(parsed_kwargs) <= allowed:
-        raise SystemExit(
-            f"DEEPVISION_BENCH_KWARGS must be a JSON object with keys from "
-            f"{sorted(allowed)}, got: {env['DEEPVISION_BENCH_KWARGS']!r}")
+            not set(parsed_kwargs) <= allowed or \
+            not all(isinstance(v, bool) for v in parsed_kwargs.values()):
+        # value types too: {"lowp_bn": [1]} is truthy and would silently
+        # configure the model while tagging the metric
+        raise bad_kwargs
     variant = bool(env.get("DEEPVISION_BENCH_KWARGS"))
     # an explicit CPU request means "bench the CPU", and a variant request
     # means "bench THAT variant": neither may be answered with the cached

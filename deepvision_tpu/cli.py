@@ -88,6 +88,24 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--auto-resume", action="store_true",
                    help="resume from the latest checkpoint if one exists "
                         "(preemption recovery; starts fresh otherwise)")
+    p.add_argument("--recover-on-divergence", type=int, default=None,
+                   metavar="N",
+                   help="when an epoch's loss goes non-finite, roll back to "
+                        "the last committed checkpoint, scale the LR down, "
+                        "and retry — up to N times before halting with the "
+                        "usual divergence error (default 0: halt only)")
+    p.add_argument("--watchdog-secs", type=float,
+                   default=os.environ.get("DEEPVISION_WATCHDOG_SECS"),
+                   metavar="S",
+                   help="in-process stall watchdog: abort (exit 70) with "
+                        "diagnostics when no train step completes for S "
+                        "seconds — set S above the first-step compile time; "
+                        "default off (env DEEPVISION_WATCHDOG_SECS)")
+    p.add_argument("--no-graceful-shutdown", action="store_true",
+                   help="disable the SIGTERM/SIGINT handler that commits a "
+                        "checkpoint and exits 0 on preemption (on by "
+                        "default; SIGKILL atomicity is unaffected either "
+                        "way)")
     p.add_argument("--model-parallel", type=int, default=None,
                    help="mesh 'model' axis size (shard big params / matmuls)")
     p.add_argument("--spatial-parallel", type=int, default=None,
@@ -302,6 +320,18 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(prefetch_batches=args.prefetch_batches)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
+    if args.recover_on_divergence is not None:
+        if args.recover_on_divergence < 0:
+            raise SystemExit(f"--recover-on-divergence must be >= 0, got "
+                             f"{args.recover_on_divergence}")
+        cfg = cfg.replace(recover_on_divergence=args.recover_on_divergence)
+    if args.watchdog_secs is not None:
+        secs = float(args.watchdog_secs)
+        if secs <= 0:
+            raise SystemExit(f"--watchdog-secs must be > 0, got {secs}")
+        cfg = cfg.replace(watchdog_secs=secs)
+    if args.no_graceful_shutdown:
+        cfg = cfg.replace(graceful_shutdown=False)
     if args.model_parallel:
         cfg = cfg.replace(model_parallel=args.model_parallel)
     if args.spatial_parallel:

@@ -17,24 +17,37 @@ import shutil
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from .resilience import RetryPolicy, call_with_retry
 from .train_state import TrainState
 
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, keep_best: bool = True,
-                 best_mode: str = "max", async_save: bool = True):
+                 best_mode: str = "max", async_save: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 on_retry=None, fault_injector=None):
         """`async_save=True` (SURVEY.md §5.4's async-save goal): `save()`
         kicks off the write in a background thread and training continues on
-        device; `restore()`/`close()` barrier on any in-flight save."""
+        device; `restore()`/`close()` barrier on any in-flight save.
+
+        `retry_policy` arms transient-I/O retry with backoff around save and
+        restore (flaky storage must cost a logged retry, not the run);
+        `on_retry(what, attempt, exc, delay)` is the trainers' logging hook,
+        and `fault_injector` (utils/faults.py) provides the deterministic
+        checkpoint-write failures the resilience tests inject."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
         self.keep_best = keep_best
         self.best_mode = best_mode
         self.async_save = async_save
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.on_retry = on_retry
+        self.fault_injector = fault_injector
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -67,17 +80,39 @@ class CheckpointManager:
     def save(self, epoch: int, state, host_state: Optional[Dict[str, Any]] = None,
              metric: Optional[float] = None):
         """Save at `epoch` (reference saves per-epoch with epoch in the payload,
-        ResNet/pytorch/train.py:417-428)."""
+        ResNet/pytorch/train.py:417-428). A transient OSError (real, or the
+        injector's) is retried with backoff under `retry_policy` before it is
+        allowed to kill the run."""
         payload = self._payload(state)
+        if self.async_save:
+            # Snapshot before backgrounding: the async writer keeps
+            # REFERENCES to these arrays while training continues, and the
+            # very next train step DONATES the live state's buffers — on
+            # backends where the host transfer is zero-copy (CPU) the write
+            # then serializes overwritten memory, i.e. a silently corrupt
+            # checkpoint (measured: a diverged epoch's NaNs landing in the
+            # PREVIOUS epoch's payload). One device-side, sharding-
+            # preserving copy per save severs the aliasing; the copy is
+            # owned by the writer alone and freed when the write commits.
+            payload = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                payload)
         metrics = {"best_metric": float(metric)} if metric is not None else None
-        self._mgr.save(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(payload),
-                host=ocp.args.JsonSave(host_state or {}),
-            ),
-            metrics=metrics,
-        )
+
+        def _save():
+            if self.fault_injector is not None:
+                self.fault_injector.before_checkpoint_save()
+            self._mgr.save(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(payload),
+                    host=ocp.args.JsonSave(host_state or {}),
+                ),
+                metrics=metrics,
+            )
+
+        call_with_retry(_save, self.retry_policy, what="ckpt_save",
+                        on_retry=self.on_retry)
         if not self.async_save:
             self._mgr.wait_until_finished()
 
@@ -110,7 +145,9 @@ class CheckpointManager:
             )
 
         try:
-            restored = _restore(template)
+            restored = call_with_retry(
+                lambda: _restore(template), self.retry_policy,
+                what="ckpt_restore", on_retry=self.on_retry)
         except ValueError as e:
             # Orbax requires template == on-disk structure; the EMA slot is
             # the one legitimately run-dependent key. Retry with it toggled:
@@ -134,7 +171,18 @@ class CheckpointManager:
                 # different architecture; the ORIGINAL error describes the
                 # user's real template, not the flipped one
                 raise e
-        payload = restored["state"]
+        # Donation safety: the arrays Orbax hands back can share buffers with
+        # its own deserialization machinery (and with the restore template);
+        # feeding them straight into a train step that DONATES its state
+        # frees those buffers out from under the other owner — measured on
+        # this repo's 8-virtual-device CPU mesh as heap corruption
+        # (malloc "corrupted double-linked list" / segfault) on the first
+        # post-restore step, the crash that made in-process resume-then-train
+        # flaky. One defensive sharding-preserving copy per restore (a rare
+        # path) severs the aliasing for every consumer.
+        payload = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            restored["state"])
         if isinstance(state, TrainState):
             ema = payload.get("ema_params")
             if ema is None:
@@ -142,7 +190,6 @@ class CheckpointManager:
                     # EMA enabled but the checkpoint predates it: start the
                     # average at a COPY of the restored params (aliasing them
                     # would make the train step donate the same buffer twice)
-                    import jax.numpy as jnp
                     ema = jax.tree_util.tree_map(jnp.copy, payload["params"])
                 else:
                     ema = state.ema_params

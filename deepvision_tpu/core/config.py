@@ -173,6 +173,29 @@ class TrainConfig:
     # overlaps compute of batch i (parallel/prefetch.py). 1 disables the
     # thread (inline staging). HBM cost: up to this many extra batches.
     prefetch_batches: int = 2
+    # Divergence auto-recovery (core/resilience.py): when an epoch's mean
+    # loss goes non-finite, instead of ONLY halting, roll back to the last
+    # committed checkpoint, scale the LR down by recovery_lr_factor, and
+    # retry — up to this many times per run, after which the existing
+    # TrainingDivergedError halt (with its resume hint) fires. 0 keeps the
+    # halt-only behavior. Requires halt_on_nonfinite (detection is the
+    # trigger) and at least one committed checkpoint to roll back to.
+    recover_on_divergence: int = 0
+    # Multiplied into the host-side LR scale on every divergence rollback
+    # (composes with the plateau schedule's scale; persists for the rest of
+    # the run — a blown-up run that needed a lower LR keeps it).
+    recovery_lr_factor: float = 0.5
+    # In-process step watchdog (resilience.StepWatchdog): abort with
+    # diagnostics (last step, last checkpoint epoch, prefetch queue depth +
+    # all-thread stacks) when no train step completes for this many seconds.
+    # None = off (the default — pytest's CPU compiles would trip any useful
+    # threshold); the CLI exposes --watchdog-secs / DEEPVISION_WATCHDOG_SECS.
+    watchdog_secs: Optional[float] = None
+    # Install SIGTERM/SIGINT handlers for the duration of fit(): finish the
+    # in-flight step, commit a synchronous checkpoint, exit 0 with the
+    # resume hint (resilience.GracefulShutdown). Complements — never
+    # replaces — the SIGKILL atomicity guarantee (tests/test_preemption.py).
+    graceful_shutdown: bool = True
     # Device-side step batching: run k train steps per host dispatch via
     # lax.scan (steps.make_multistep_train_step). Amortizes per-step
     # dispatch/launch latency — the lever for dispatch-bound setups (relayed

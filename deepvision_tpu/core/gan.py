@@ -31,11 +31,14 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..utils.faults import FaultInjector
 from ..utils.image_pool import ImagePool
 from .checkpoint import CheckpointManager
 from .config import TrainConfig
 from .metrics import MetricsLogger
-from .optim import build_optimizer
+from .optim import build_optimizer, set_lr_scale
+from .resilience import (GracefulShutdown, PreemptionExit, RetryPolicy,
+                         resilient_batches)
 from .train_state import TrainState, init_model
 
 
@@ -93,10 +96,35 @@ class AdversarialTrainer:
     def _init_logging(self, config: TrainConfig, workdir: str):
         self.config = config
         self.logger = MetricsLogger(workdir, name=config.name)
+        # same resilience plumbing as the supervised Trainer: env-driven
+        # fault injection, transient-I/O retry on checkpoint writes and the
+        # host data pull, graceful SIGTERM/SIGINT, divergence rollback
+        self.faults = FaultInjector.from_env()
+        self.retry_policy = RetryPolicy.from_env()
+        self._recovery_scale = 1.0
+        self._recoveries = 0
+        self._batch_count = 0
+        self._shutdown = None
         self.ckpt = CheckpointManager(workdir + "/ckpt",
                                       keep=config.keep_checkpoints,
-                                      keep_best=False)
+                                      keep_best=False,
+                                      retry_policy=self.retry_policy,
+                                      on_retry=self._log_retry,
+                                      fault_injector=(self.faults
+                                                      if self.faults.active
+                                                      else None))
         self.start_epoch = 1
+
+    def _log_retry(self, what: str, attempt: int, exc: BaseException,
+                   delay: float) -> None:
+        import sys
+        print(f"[{self.config.name}] transient {what} failure "
+              f"(attempt {attempt}/{self.retry_policy.max_retries}): {exc} — "
+              f"retrying in {delay:.2f}s", file=sys.stderr, flush=True)
+        if jax.process_index() == 0:
+            self.logger.log(self._batch_count,
+                            {f"{what}_retries": float(attempt)},
+                            prefix="resilience_", echo=False)
 
     def _payload(self):
         return {"gen": CheckpointManager._payload(self.gen_state),
@@ -114,54 +142,127 @@ class AdversarialTrainer:
     def train_batch(self, *batch) -> dict:
         raise NotImplementedError
 
+    def _train_one_epoch(self, epoch: int, train_data_fn, profiling) -> dict:
+        t0 = time.time()
+        step_metrics = []  # device arrays; fetched once at epoch end so a
+        if profiling:
+            jax.profiler.start_trace(profiling)
+        try:
+            batches = resilient_batches(
+                train_data_fn(epoch), self.retry_policy,
+                injector=self.faults if self.faults.active else None,
+                on_retry=self._log_retry)
+            for batch in batches:  # pool-free step stays async
+                if self._shutdown is not None and self._shutdown.requested:
+                    break  # in-flight step finishes; fit commits + exits 0
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+                step_metrics.append(self.train_batch(*batch))
+                self._batch_count += 1
+            if step_metrics:
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: float(np.mean(jax.device_get(jnp.stack(
+                        [jnp.asarray(x) for x in xs])))), *step_metrics)
+                metrics = dict(stacked)
+            else:
+                metrics = {}
+        finally:
+            # the metric fetch above synced the device; finally so a step
+            # failure still writes the captured trace
+            if profiling:
+                jax.profiler.stop_trace()
+        metrics["epoch_seconds"] = time.time() - t0
+        return metrics
+
+    def _recover_from_divergence(self, epoch: int) -> Optional[int]:
+        """GAN flavor of Trainer._recover_from_divergence: roll back BOTH
+        networks to the last committed {gen, disc} checkpoint and scale both
+        optimizers' LR down by recovery_lr_factor (persistently)."""
+        got = self.resume()
+        if got is None:
+            return None
+        self._recoveries += 1
+        self._recovery_scale *= self.config.recovery_lr_factor
+        self.gen_state = self.gen_state.replace(opt_state=set_lr_scale(
+            self.gen_state.opt_state, self._recovery_scale))
+        self.disc_state = self.disc_state.replace(opt_state=set_lr_scale(
+            self.disc_state.opt_state, self._recovery_scale))
+        if jax.process_index() == 0:
+            print(f"[{self.config.name}] divergence recovery "
+                  f"{self._recoveries}: epoch {epoch} diverged — rolled back "
+                  f"to epoch {got}, LR scale now {self._recovery_scale:g}",
+                  flush=True)
+            self.logger.log(
+                self._batch_count,
+                {"divergence_recoveries": float(self._recoveries),
+                 "lr_scale": self._recovery_scale},
+                epoch=epoch, prefix="resilience_", echo=False)
+        return got
+
     def fit(self, train_data_fn: Callable[[int], Iterable],
             total_epochs: Optional[int] = None, save_every: int = 2,
             profile_dir: Optional[str] = None) -> dict:
         """Epoch loop + save every 2 epochs (`DCGAN/tensorflow/main.py:81-83`,
         `CycleGAN/tensorflow/train.py:330-333`). `profile_dir` captures a
-        jax.profiler trace of the first trained epoch."""
+        jax.profiler trace of the first trained epoch.
+
+        Resilience (core/resilience.py, same contract as Trainer.fit):
+        SIGTERM/SIGINT commits a checkpoint and raises PreemptionExit
+        (fit_and_close → resume hint + exit 0); a non-finite epoch rolls
+        back and retries under config.recover_on_divergence; host data pulls
+        and checkpoint writes retry transient OSError with backoff."""
         total_epochs = total_epochs or self.config.total_epochs
         metrics = {}
-        for epoch in range(self.start_epoch, total_epochs + 1):
-            profiling = profile_dir and epoch == self.start_epoch
-            if profiling:
-                jax.profiler.start_trace(profile_dir)
-            t0 = time.time()
-            step_metrics = []  # device arrays; fetched once at epoch end so a
-            try:
-                for batch in train_data_fn(epoch):  # pool-free step stays async
-                    if not isinstance(batch, tuple):
-                        batch = (batch,)
-                    step_metrics.append(self.train_batch(*batch))
-                if step_metrics:
-                    stacked = jax.tree_util.tree_map(
-                        lambda *xs: float(np.mean(jax.device_get(jnp.stack(
-                            [jnp.asarray(x) for x in xs])))), *step_metrics)
-                    metrics = dict(stacked)
-                else:
-                    metrics = {}
-            finally:
-                # the metric fetch above synced the device; finally so a step
-                # failure still writes the captured trace
-                if profiling:
-                    jax.profiler.stop_trace()
-            metrics["epoch_seconds"] = time.time() - t0
-            # log BEFORE the divergence check: the diverged epoch's metrics
-            # (which loss went NaN, epoch time) belong in JSONL/TB, not only
-            # in the exception text (same ordering as Trainer.train_epoch)
-            self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
-                            echo=jax.process_index() == 0)
-            if self.config.halt_on_nonfinite and any(
-                    not np.isfinite(v) for v in metrics.values()):
-                # adversarial training collapses to NaN more readily than
-                # supervised (two coupled optimizers); same guard as
-                # Trainer.train_epoch, with this family's --resume UX
-                from .trainer import divergence_halt
-                divergence_halt(self.config, self.ckpt, epoch,
-                                f"mean metrics contain a non-finite value "
-                                f"({metrics})", resume_cmd="--resume")
-            if epoch % save_every == 0 or epoch == total_epochs:
-                self.ckpt.save(epoch, self._payload())
+        recoveries_left = self.config.recover_on_divergence
+        first_epoch = self.start_epoch
+        shutdown_cm = (GracefulShutdown() if self.config.graceful_shutdown
+                       else None)
+        if shutdown_cm is not None:
+            self._shutdown = shutdown_cm.__enter__()
+        try:
+            epoch = self.start_epoch
+            while epoch <= total_epochs:
+                profiling = (profile_dir if profile_dir
+                             and epoch == first_epoch else None)
+                metrics = self._train_one_epoch(epoch, train_data_fn,
+                                                profiling)
+                # log BEFORE the divergence check: the diverged epoch's
+                # metrics (which loss went NaN, epoch time) belong in
+                # JSONL/TB, not only in the exception text (same ordering as
+                # Trainer.train_epoch)
+                self.logger.log(epoch, metrics, epoch=epoch, prefix="train_",
+                                echo=jax.process_index() == 0)
+                if self._shutdown is not None and self._shutdown.requested:
+                    self.ckpt.save(epoch, self._payload())
+                    self.ckpt.flush()
+                    raise PreemptionExit(
+                        epoch,
+                        f"[{self.config.name}] graceful preemption: "
+                        f"checkpoint committed at epoch {epoch} — relaunch "
+                        f"with --resume to continue")
+                if self.config.halt_on_nonfinite and any(
+                        not np.isfinite(v) for v in metrics.values()):
+                    # adversarial training collapses to NaN more readily than
+                    # supervised (two coupled optimizers); same guard as
+                    # Trainer.train_epoch, with this family's --resume UX
+                    if recoveries_left > 0:
+                        rolled = self._recover_from_divergence(epoch)
+                        if rolled is not None:
+                            recoveries_left -= 1
+                            epoch = rolled + 1
+                            continue
+                    from .trainer import divergence_halt
+                    divergence_halt(self.config, self.ckpt, epoch,
+                                    f"mean metrics contain a non-finite "
+                                    f"value ({metrics})",
+                                    resume_cmd="--resume")
+                if epoch % save_every == 0 or epoch == total_epochs:
+                    self.ckpt.save(epoch, self._payload())
+                epoch += 1
+        finally:
+            self._shutdown = None
+            if shutdown_cm is not None:
+                shutdown_cm.__exit__(None, None, None)
         return metrics
 
     def close(self):

@@ -1,0 +1,255 @@
+"""Self-healing primitives for the training loops.
+
+The divergence guard in trainer.py detects a poisoned run; this module is
+what lets a run RECOVER instead of only halting loudly (the reference's sole
+gesture at any of this was skipping NaN val batches with a TODO,
+`Hourglass/tensorflow/train.py:126-130`). Four capabilities, shared by the
+supervised and adversarial trainers:
+
+- `RetryPolicy` / `call_with_retry`: bounded exponential backoff with jitter
+  around transient host I/O (checkpoint save/restore, data iteration) —
+  `OSError` is retried, everything else propagates untouched.
+- `resilient_batches`: wraps a host batch iterator with the retry policy and
+  the fault injector (utils/faults.py), so flaky storage mid-epoch costs a
+  logged retry, not the run.
+- `GracefulShutdown` + `PreemptionExit`: SIGTERM/SIGINT set a flag the step
+  loop polls; the trainer finishes the in-flight step, commits a synchronous
+  checkpoint, and exits 0 with the resume hint — complementing the
+  SIGKILL-atomicity guarantee (tests/test_preemption.py) with a path that
+  loses zero steps when the platform gives notice.
+- `StepWatchdog`: in-process monotonic stall detector — the external
+  `tools/tpu_window.sh` watchdog's job done from inside `fit`, with
+  diagnostics (last step, last checkpoint, prefetch depth) a process-group
+  kill could never print.
+
+Divergence auto-recovery itself (rollback + LR scale-down + bounded retry)
+lives in the trainers' fit loops — it needs the checkpoint manager and
+optimizer state — gated by `TrainConfig.recover_on_divergence`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded exponential backoff: attempt n sleeps
+    min(max_delay, base_delay * 2^(n-1)) * (1 + U[0,jitter]).
+    Jitter decorrelates a pod's hosts hammering recovered storage in
+    lockstep; the `rng` seed makes test schedules reproducible."""
+
+    max_retries: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 8.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    @classmethod
+    def from_env(cls, env=None, **overrides) -> "RetryPolicy":
+        """DEEPVISION_IO_RETRIES / DEEPVISION_IO_RETRY_DELAY override the
+        defaults (tests shrink the schedule; a pod job can raise it)."""
+        env = os.environ if env is None else env
+        kw = dict(overrides)
+        if "DEEPVISION_IO_RETRIES" in env:
+            kw["max_retries"] = int(env["DEEPVISION_IO_RETRIES"])
+        if "DEEPVISION_IO_RETRY_DELAY" in env:
+            kw["base_delay"] = float(env["DEEPVISION_IO_RETRY_DELAY"])
+        return cls(**kw)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
+        return d * (1.0 + rng.random() * self.jitter)
+
+
+def call_with_retry(fn: Callable, policy: RetryPolicy, *, what: str,
+                    on_retry: Optional[Callable] = None):
+    """Run `fn()`, retrying transient `OSError` (IOError is its py3 alias)
+    up to `policy.max_retries` times with backoff. `on_retry(what, attempt,
+    exc, delay)` fires before each sleep — the trainers log it to the
+    metrics stream so a flaky-storage epoch leaves forensics. The final
+    failure re-raises the last error unchanged."""
+    rng = random.Random(policy.seed)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            attempt += 1
+            if attempt > policy.max_retries:
+                raise
+            d = policy.delay(attempt, rng)
+            if on_retry is not None:
+                on_retry(what, attempt, e, d)
+            time.sleep(d)
+
+
+def resilient_batches(batches: Iterable, policy: RetryPolicy,
+                      injector=None,
+                      on_retry: Optional[Callable] = None) -> Iterator:
+    """Yield from a host batch iterator, retrying transient OSError from the
+    pull itself (tf.data readers surface flaky remote storage this way and
+    stay usable) and applying the fault injector's data hooks. The injected
+    fault fires BEFORE the pull, so no batch is ever dropped on retry."""
+    it = iter(batches)
+
+    def pull():
+        if injector is not None:
+            injector.before_batch()
+        return next(it)
+
+    while True:
+        try:
+            batch = call_with_retry(pull, policy, what="data_io",
+                                    on_retry=on_retry)
+        except StopIteration:
+            return
+        if injector is not None:
+            batch = injector.poison_batch(batch)
+        yield batch
+
+
+class PreemptionExit(Exception):
+    """Raised by fit() after a graceful-shutdown checkpoint is committed;
+    `fit_and_close` (and the GAN mains) convert it to a clean exit 0. Carries
+    the committed epoch for the resume hint."""
+
+    def __init__(self, epoch: int, message: str):
+        super().__init__(message)
+        self.epoch = epoch
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT → a polled flag, installed for the duration of fit().
+
+    The step loop checks `requested` between host dispatches: the in-flight
+    step finishes, the trainer commits a synchronous checkpoint, and the
+    process exits 0 — TPU-pod preemptions send SIGTERM with a grace window,
+    and losing an epoch to it is pure waste. A SECOND signal restores the
+    previous handlers and re-raises, so a stuck shutdown stays killable with
+    plain Ctrl-C Ctrl-C. Signal handlers only exist on the main thread;
+    elsewhere (library use under a thread pool) this degrades to an inert
+    flag that is never set."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = False
+        self._signum = None
+        self._previous = {}
+
+    def _handler(self, signum, frame):
+        if self.requested:  # second signal: get out of the way
+            self._restore()
+            raise KeyboardInterrupt
+        self.requested = True
+        self._signum = signum
+        print(f"[resilience] caught {signal.Signals(signum).name}: finishing "
+              f"the in-flight step, committing a checkpoint, then exiting 0 "
+              f"(signal again to abort immediately)",
+              file=sys.stderr, flush=True)
+
+    def __enter__(self) -> "GracefulShutdown":
+        try:
+            for s in self.SIGNALS:
+                self._previous[s] = signal.signal(s, self._handler)
+        except ValueError:  # not the main thread: flag stays inert
+            self._previous = {}
+        return self
+
+    def _restore(self):
+        for s, h in self._previous.items():
+            signal.signal(s, h)
+        self._previous = {}
+
+    def __exit__(self, *exc):
+        self._restore()
+        return False
+
+
+class StepWatchdog:
+    """Host-side stall detector: a daemon thread that aborts the process
+    when no `beat()` lands within `threshold` seconds (monotonic clock).
+
+    This brings `tools/tpu_window.sh`'s external mtime watchdog in-process:
+    the relay's failure mode is a silent wedge inside a dispatch, which no
+    epoch-level timeout sees until the window is gone. Before aborting it
+    prints the diagnostics an external kill never could — last host-side
+    step, last committed checkpoint epoch, prefetch queue depth — plus every
+    thread's stack (faulthandler), then `os._exit(EXIT_CODE)` so a wrapping
+    retry loop can relaunch with --auto-resume. Off unless a threshold is
+    configured (`--watchdog-secs` / DEEPVISION_WATCHDOG_SECS); in particular
+    it is NOT armed under pytest's in-process trainer tests, whose CPU
+    compile times would trip any useful threshold."""
+
+    EXIT_CODE = 70  # EX_SOFTWARE: distinguishable from the step's own errors
+
+    def __init__(self, threshold_secs: float,
+                 diagnostics: Optional[Callable[[], dict]] = None,
+                 name: str = "trainer",
+                 _abort: Optional[Callable] = None):
+        if threshold_secs <= 0:
+            raise ValueError(f"watchdog threshold must be > 0, "
+                             f"got {threshold_secs}")
+        self.threshold = threshold_secs
+        self.diagnostics = diagnostics
+        self.name = name
+        self._abort = _abort if _abort is not None else self._default_abort
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name=f"step-watchdog-{name}")
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def _watch(self) -> None:
+        poll = min(1.0, self.threshold / 4.0)
+        while not self._stop.wait(poll):
+            stalled = time.monotonic() - self._last
+            if stalled >= self.threshold:
+                self._dump(stalled)
+                self._abort()
+                return
+
+    def _dump(self, stalled: float) -> None:
+        info = {}
+        if self.diagnostics is not None:
+            try:
+                info = self.diagnostics()
+            except Exception as e:  # noqa: BLE001 — diagnostics must not
+                info = {"diagnostics_error": repr(e)}  # mask the stall report
+        detail = " ".join(f"{k}={v}" for k, v in info.items())
+        print(f"[watchdog:{self.name}] no step progress for {stalled:.0f}s "
+              f"(threshold {self.threshold:.0f}s) — aborting. {detail}",
+              file=sys.stderr, flush=True)
+        try:
+            import faulthandler
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:  # noqa: BLE001 — the abort still proceeds
+            pass
+
+    @classmethod
+    def _default_abort(cls) -> None:
+        # os._exit, not sys.exit: the whole point is that the main thread is
+        # wedged inside a dispatch and will never unwind an exception
+        os._exit(cls.EXIT_CODE)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "StepWatchdog":
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -10,9 +10,11 @@ thin wrappers that build a TrainConfig and call `Trainer.fit()`.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
+import sys
 import time
 from typing import Any, Callable, Iterable, Optional
 
@@ -25,10 +27,13 @@ from .checkpoint import CheckpointManager
 from .config import TrainConfig
 from .metrics import MeanAccumulator, MetricsLogger
 from .optim import build_optimizer, set_lr_scale
+from .resilience import (GracefulShutdown, PreemptionExit, RetryPolicy,
+                         StepWatchdog, resilient_batches)
 from .schedules import PlateauState
 from .train_state import TrainState, init_model, make_ema_update, param_count
 from ..parallel import mesh as mesh_lib
 from ..parallel.prefetch import prefetch_to_device
+from ..utils.faults import FaultInjector
 from ..models import MODELS  # importing ..models registers the whole zoo
 
 
@@ -64,11 +69,18 @@ def fit_and_close(trainer, *args, **kwargs):
     of a traceback. close() runs in a finally so buffered JSONL/TB metrics
     survive EVERY mid-fit exception (Ctrl-C, an OSError, a step failure) —
     those are exactly the runs whose forensics matter. Shared by the CLI and
-    the GAN mains so the UX can't drift."""
+    the GAN mains so the UX can't drift.
+
+    A PreemptionExit (SIGTERM/SIGINT observed, checkpoint committed —
+    resilience.GracefulShutdown) becomes the resume hint + exit 0: the
+    platform asked the process to leave and it left cleanly."""
     try:
         return trainer.fit(*args, **kwargs)
     except TrainingDivergedError as e:
         raise SystemExit(f"error: {e}")
+    except PreemptionExit as e:
+        print(str(e), flush=True)
+        raise SystemExit(0)
     finally:
         trainer.close()
 
@@ -254,6 +266,20 @@ class Trainer:
 
         self.logger = MetricsLogger(self.workdir, name=config.name)
 
+        # -- resilience state (core/resilience.py) --
+        # env-driven deterministic fault injection (utils/faults.py; inert
+        # when no DEEPVISION_FAULT_* is set) + transient-I/O retry policy
+        # shared by checkpoint save/restore and host data iteration
+        self.faults = FaultInjector.from_env()
+        self.retry_policy = RetryPolicy.from_env()
+        self._recovery_scale = 1.0   # product of recovery_lr_factor rollbacks
+        self._recoveries = 0
+        self._host_step = 0          # host-side step count (no device sync)
+        self._last_saved_epoch: Optional[int] = None
+        self._prefetcher = None      # live DevicePrefetcher during an epoch
+        self._watchdog: Optional[StepWatchdog] = None
+        self._shutdown: Optional[GracefulShutdown] = None
+
         self.rng = jax.random.PRNGKey(config.seed)
         self.state: Optional[TrainState] = None
         self.start_epoch = 1
@@ -292,7 +318,25 @@ class Trainer:
             self.ckpt.close()
         self.ckpt = CheckpointManager(
             self.workdir + "/ckpt", keep=self.config.keep_checkpoints,
-            keep_best=self.config.keep_best, best_mode=mode)
+            keep_best=self.config.keep_best, best_mode=mode,
+            retry_policy=self.retry_policy, on_retry=self._log_retry,
+            fault_injector=self.faults if self.faults.active else None)
+
+    def _log_retry(self, what: str, attempt: int, exc: BaseException,
+                   delay: float) -> None:
+        """Retry hook for transient-I/O backoff (checkpoint save/restore and
+        data iteration): every retry reaches stderr on every host and the
+        metrics stream on process 0 — a flaky-storage epoch must leave
+        forensics, not vanish into a silent sleep. May fire from the
+        prefetch producer thread; MetricsLogger's append+flush is safe for
+        that."""
+        print(f"[{self.config.name}] transient {what} failure "
+              f"(attempt {attempt}/{self.retry_policy.max_retries}): {exc} — "
+              f"retrying in {delay:.2f}s", file=sys.stderr, flush=True)
+        if _is_main_process() and getattr(self, "logger", None) is not None:
+            self.logger.log(self._host_step,
+                            {f"{what}_retries": float(attempt)},
+                            prefix="resilience_", echo=False)
 
     # -- state ------------------------------------------------------------
     def init_state(self, sample_shape) -> TrainState:
@@ -549,6 +593,9 @@ class Trainer:
             prev = consumed
             consumed += n_steps
             n_img += n_examples
+            self._host_step = step0 + consumed
+            if self._watchdog is not None:
+                self._watchdog.beat()
             device_metrics.append(metrics)
             weights.append(n_steps)
             log_every = self.config.log_every_steps
@@ -578,10 +625,29 @@ class Trainer:
         # (prefetch_batches > 1) so host->device transfer overlaps compute.
         # With steps_per_dispatch > 1, k staged batches go to the device in
         # ONE dispatch (lax.scan wrapper); a sub-k tail runs as single steps.
+        # The host pull is retry-wrapped (transient OSError from flaky
+        # storage backs off instead of killing the epoch) and carries the
+        # fault injector's deterministic failures when armed.
+        data = resilient_batches(
+            data, self.retry_policy,
+            injector=self.faults if self.faults.active else None,
+            on_retry=self._log_retry)
         staged = prefetch_to_device(self.mesh, data,
                                     self.config.prefetch_batches)
+        self._prefetcher = staged
+        if self._watchdog is not None:
+            self._watchdog.beat()
+
+        def _preempted() -> bool:
+            return self._shutdown is not None and self._shutdown.requested
+
         try:
             for batch in staged:
+                if _preempted():
+                    # finish-the-in-flight-step contract: the last dispatched
+                    # step completes on device; we just stop feeding new ones
+                    # and let fit() commit the checkpoint
+                    break
                 if k > 1:
                     group.append(batch)
                     if len(group) == k:
@@ -606,14 +672,16 @@ class Trainer:
                         record(metrics, k, n_ex)
                 else:
                     run_single(batch)
-            for batch in group:  # tail shorter than k
-                run_single(batch)
+            if not _preempted():
+                for batch in group:  # tail shorter than k
+                    run_single(batch)
             group = []
         finally:
             # a step exception must release the producer's staged device
             # batches NOW (a retained traceback would otherwise pin them
             # exactly when a recovering driver needs the HBM back)
             group = None
+            self._prefetcher = None
             staged.close()
         jax.block_until_ready(self.state.params)
         for s, m in pending:
@@ -725,59 +793,161 @@ class Trainer:
 
         watch_key, watch_mode = self.watch_key, self.watch_mode
         last_val = {}
-        for epoch in range(self.start_epoch, total_epochs + 1):
-            profiling = profile_dir and epoch == self.start_epoch
-            if profiling:
-                jax.profiler.start_trace(profile_dir)
-            try:
-                train_metrics = self.train_epoch(epoch, train_data_fn(epoch))
-            finally:
-                # train_epoch blocks on params → trace is complete; finally so
-                # a divergence halt (or any step failure) still writes the
-                # trace of the epoch the user most wants to inspect
+        recoveries_left = cfg.recover_on_divergence
+        first_epoch = self.start_epoch
+        with contextlib.ExitStack() as stack:
+            if cfg.graceful_shutdown:
+                # SIGTERM/SIGINT → finish the in-flight step, commit, exit 0
+                # (handlers restored when fit unwinds; inert off-main-thread)
+                self._shutdown = stack.enter_context(GracefulShutdown())
+            if cfg.watchdog_secs:
+                self._watchdog = stack.enter_context(StepWatchdog(
+                    cfg.watchdog_secs, diagnostics=self._watchdog_diagnostics,
+                    name=cfg.name))
+            stack.callback(self._clear_resilience_handles)
+
+            epoch = self.start_epoch
+            while epoch <= total_epochs:
+                if (self._shutdown is not None and self._shutdown.requested
+                        and self._last_saved_epoch is not None):
+                    # signal landed between epochs (eval/save window): the
+                    # last save already covers everything trained
+                    self._commit_preemption(self._last_saved_epoch)
+                profiling = profile_dir and epoch == first_epoch
                 if profiling:
-                    jax.profiler.stop_trace()
-            if _is_main_process():
-                self.logger.log(int(self.state.step), train_metrics, epoch=epoch,
-                                prefix="epoch_train_")
-            if val_data_fn is not None:
-                last_val = self.evaluate(val_data_fn(epoch))
+                    jax.profiler.start_trace(profile_dir)
+                try:
+                    train_metrics = self.train_epoch(epoch,
+                                                     train_data_fn(epoch))
+                except TrainingDivergedError:
+                    # bounded auto-recovery: roll back to the last committed
+                    # checkpoint, scale the LR down, retry the epoch — the
+                    # halt (with its resume hint) fires once the budget is
+                    # spent or there is nothing committed to roll back to
+                    if recoveries_left <= 0:
+                        raise
+                    rolled = self._recover_from_divergence(epoch)
+                    if rolled is None:
+                        raise
+                    recoveries_left -= 1
+                    epoch = rolled + 1
+                    continue
+                finally:
+                    # train_epoch blocks on params → trace is complete;
+                    # finally so a divergence halt (or any step failure)
+                    # still writes the trace of the epoch the user most
+                    # wants to inspect
+                    if profiling:
+                        jax.profiler.stop_trace()
                 if _is_main_process():
-                    self.logger.log(int(self.state.step), last_val, epoch=epoch,
-                                    prefix="val_")
-                # empty eval (e.g. all val batches dropped/skipped) must not
-                # register as a perfect 0.0 loss in min-mode
-                metric = last_val.get(
-                    watch_key, 0.0 if watch_mode == "max" else float("inf"))
-            else:
-                # no val set: watch the same key on train metrics so min-mode
-                # (loss-watching) plateau semantics stay correct
-                metric = train_metrics.get(
-                    watch_key, 0.0 if watch_mode == "max" else float("inf"))
+                    self.logger.log(int(self.state.step), train_metrics,
+                                    epoch=epoch, prefix="epoch_train_")
+                if self._shutdown is not None and self._shutdown.requested:
+                    # preempted mid-epoch: skip eval, commit what we have as
+                    # this epoch (partial — resume continues at epoch+1;
+                    # under a grace window every step kept beats a redo)
+                    self._save_epoch(epoch, metric=None)
+                    self._commit_preemption(epoch)
+                if val_data_fn is not None:
+                    last_val = self.evaluate(val_data_fn(epoch))
+                    if _is_main_process():
+                        self.logger.log(int(self.state.step), last_val,
+                                        epoch=epoch, prefix="val_")
+                    # empty eval (e.g. all val batches dropped/skipped) must
+                    # not register as a perfect 0.0 loss in min-mode
+                    metric = last_val.get(
+                        watch_key, 0.0 if watch_mode == "max" else float("inf"))
+                else:
+                    # no val set: watch the same key on train metrics so
+                    # min-mode (loss-watching) plateau semantics stay correct
+                    metric = train_metrics.get(
+                        watch_key, 0.0 if watch_mode == "max" else float("inf"))
 
-            if self.best_metric is None or (
-                    metric > self.best_metric if watch_mode == "max"
-                    else metric < self.best_metric):
-                self.best_metric = metric
+                if self.best_metric is None or (
+                        metric > self.best_metric if watch_mode == "max"
+                        else metric < self.best_metric):
+                    self.best_metric = metric
 
-            if self.plateau:
-                scale = self.plateau.update(metric)
-                self.state = self.state.replace(
-                    opt_state=set_lr_scale(self.state.opt_state, scale))
+                if self.plateau:
+                    scale = self.plateau.update(metric)
+                    self.state = self.state.replace(
+                        opt_state=set_lr_scale(
+                            self.state.opt_state,
+                            scale * self._recovery_scale))
 
-            # NOTE: Orbax save is a collective — every process must enter it
-            # (process 0 writes; the rest participate in the barrier).
-            host = {"best_metric": self.best_metric}
-            if self.plateau:
-                host["plateau"] = {"best": self.plateau.best,
-                                   "num_bad_epochs": self.plateau.num_bad_epochs,
-                                   "scale": self.plateau.scale}
-            self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+                self._save_epoch(epoch, metric=metric)
+                epoch += 1
         # fit returning means "training done": the last async save must be
         # committed, or a fresh Trainer on this workdir (library UX — the CLI
         # also calls close()) would resume from the previous epoch
         self.ckpt.flush()
         return {"best_metric": self.best_metric, **last_val}
+
+    def _clear_resilience_handles(self) -> None:
+        self._shutdown = None
+        self._watchdog = None
+
+    def _save_epoch(self, epoch: int, metric: Optional[float]) -> None:
+        # NOTE: Orbax save is a collective — every process must enter it
+        # (process 0 writes; the rest participate in the barrier).
+        host = {"best_metric": self.best_metric}
+        if self.plateau:
+            host["plateau"] = {"best": self.plateau.best,
+                               "num_bad_epochs": self.plateau.num_bad_epochs,
+                               "scale": self.plateau.scale}
+        self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+        self._last_saved_epoch = epoch
+
+    def _commit_preemption(self, epoch: int) -> None:
+        """Graceful-preemption tail: barrier until the checkpoint at `epoch`
+        is COMMITTED (synchronous — a SIGKILL follow-up must find it
+        restorable), then raise PreemptionExit; fit_and_close turns it into
+        the resume hint + exit 0."""
+        self.ckpt.flush()
+        if _is_main_process():
+            self.logger.log(self._host_step,
+                            {"preempted_at_epoch": float(epoch)},
+                            epoch=epoch, prefix="resilience_", echo=False)
+        raise PreemptionExit(
+            epoch,
+            f"[{self.config.name}] graceful preemption: checkpoint "
+            f"committed at epoch {epoch} — relaunch with --auto-resume "
+            f"(or -c latest) to continue")
+
+    def _recover_from_divergence(self, epoch: int) -> Optional[int]:
+        """Roll back to the last committed checkpoint and scale the LR down
+        by config.recovery_lr_factor (the scale persists for the rest of the
+        run and composes with the plateau schedule's own scale). Returns the
+        restored epoch, or None when nothing is committed yet."""
+        if self.ckpt.latest_epoch() is None:
+            return None
+        got = self.resume()  # restores state/plateau/best + prints the line
+        if got is None:
+            return None
+        self._recoveries += 1
+        self._recovery_scale *= self.config.recovery_lr_factor
+        base = self.plateau.scale if self.plateau else 1.0
+        self.state = self.state.replace(opt_state=set_lr_scale(
+            self.state.opt_state, base * self._recovery_scale))
+        if _is_main_process():
+            print(f"[{self.config.name}] divergence recovery "
+                  f"{self._recoveries}: epoch {epoch} diverged — rolled back "
+                  f"to epoch {got}, LR scale now {self._recovery_scale:g}",
+                  flush=True)
+            self.logger.log(
+                self._host_step,
+                {"divergence_recoveries": float(self._recoveries),
+                 "lr_scale": self._recovery_scale},
+                epoch=epoch, prefix="resilience_", echo=False)
+        return got
+
+    def _watchdog_diagnostics(self) -> dict:
+        pf = self._prefetcher
+        return {
+            "last_step": self._host_step,
+            "last_checkpoint_epoch": self._last_saved_epoch,
+            "prefetch_queue_depth": pf.queue_depth if pf is not None else None,
+        }
 
     def close(self):
         self.logger.close()

@@ -1,0 +1,114 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Every recovery path in core/resilience.py must be testable on CPU without a
+flaky TPU pod to provide the faults, so the injector fakes the three failure
+classes the north star's production runs actually see (ROADMAP.md; TPU-pod
+preemptions and flaky storage are routine at scale):
+
+- transient I/O errors from the host data pipeline,
+- a loss blow-up (NaN) at a known step,
+- checkpoint writes that fail transiently.
+
+Configuration is environment-driven so subprocess tests (CLI entrypoints)
+and in-process tests configure it the same way:
+
+    DEEPVISION_FAULT_DATA_IO_STEP=k[:count]  raise OSError before yielding
+                                             batch k (0-based, counted across
+                                             the whole process), `count` times
+                                             (default 1) — transient: retries
+                                             eventually succeed
+    DEEPVISION_FAULT_NAN_STEP=k              overwrite batch k's images with
+                                             NaN, so the step's loss goes
+                                             non-finite through the real
+                                             jitted program (one-shot)
+    DEEPVISION_FAULT_CKPT_SAVE_FAILS=M       raise OSError from the first M
+                                             checkpoint save() calls
+
+An unset environment yields an inert injector (`active` False) whose hooks
+are cheap no-ops — production runs pay two integer compares per batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _parse_step_count(raw: Optional[str]) -> Tuple[Optional[int], int]:
+    if not raw:
+        return None, 0
+    step, _, count = raw.partition(":")
+    return int(step), int(count) if count else 1
+
+
+class FaultInjector:
+    """Process-local fault state: counters advance as the hooks are called,
+    so a fault fires at a deterministic batch/save index and then clears —
+    the "transient" in transient error."""
+
+    def __init__(self, data_io_step: Optional[int] = None,
+                 data_io_count: int = 1,
+                 nan_step: Optional[int] = None,
+                 ckpt_save_fails: int = 0):
+        self.data_io_step = data_io_step
+        self.data_io_remaining = data_io_count if data_io_step is not None else 0
+        self.nan_step = nan_step
+        self.ckpt_save_fails = ckpt_save_fails
+        self._batch_index = 0   # advances once per batch PULLED (post-fault)
+        self._save_index = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultInjector":
+        env = os.environ if env is None else env
+        io_step, io_count = _parse_step_count(
+            env.get("DEEPVISION_FAULT_DATA_IO_STEP"))
+        nan_step, _ = _parse_step_count(env.get("DEEPVISION_FAULT_NAN_STEP"))
+        return cls(data_io_step=io_step, data_io_count=io_count,
+                   nan_step=nan_step,
+                   ckpt_save_fails=int(
+                       env.get("DEEPVISION_FAULT_CKPT_SAVE_FAILS", "0")))
+
+    @property
+    def active(self) -> bool:
+        return (self.data_io_step is not None or self.nan_step is not None
+                or self.ckpt_save_fails > 0)
+
+    # -- hooks -------------------------------------------------------------
+    def before_batch(self) -> None:
+        """Called before pulling the next batch from the source iterator.
+        Raises the configured transient OSError WITHOUT advancing the batch
+        index, so a retry faces the remaining fault budget and then pulls
+        the batch the source never lost."""
+        if (self.data_io_remaining > 0
+                and self._batch_index == self.data_io_step):
+            self.data_io_remaining -= 1
+            raise OSError(
+                f"injected transient I/O error at batch {self._batch_index} "
+                f"({self.data_io_remaining} more to come)")
+
+    def poison_batch(self, batch):
+        """Called with the pulled batch; advances the batch index. At the
+        configured step the FIRST array (images, by every family's batch
+        contract) is replaced with NaNs — the loss then blows up through the
+        real jitted step, exactly like a genuine divergence would."""
+        i = self._batch_index
+        self._batch_index += 1
+        if self.nan_step is None or i != self.nan_step:
+            return batch
+        self.nan_step = None  # one-shot: the retried epoch trains clean
+        batch = tuple(batch)
+        poisoned = np.full_like(np.asarray(batch[0], dtype=np.float32),
+                                np.nan)
+        return (poisoned,) + batch[1:]
+
+    def before_checkpoint_save(self) -> None:
+        """Called at the top of every checkpoint save; the first M calls
+        raise a transient OSError."""
+        i = self._save_index
+        self._save_index += 1
+        if i < self.ckpt_save_fails:
+            raise OSError(
+                f"injected transient checkpoint-write failure "
+                f"({i + 1}/{self.ckpt_save_fails})")

@@ -1,0 +1,253 @@
+"""Resilience subsystem (core/resilience.py + utils/faults.py): every
+recovery path runs deterministically on the 8-device virtual CPU mesh via
+the env-driven fault injector — divergence rollback, transient-I/O retry
+(checkpoint writes and host data pulls), graceful SIGTERM preemption, and
+the in-process step watchdog. The SIGKILL-atomicity guarantee stays pinned
+by tests/test_preemption.py; the graceful path here is additive."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                        ScheduleConfig, TrainConfig)
+from deepvision_tpu.data.synthetic import SyntheticClassification
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _config(tmp_path, **kw):
+    base = dict(
+        name="resil", model="lenet5",
+        batch_size=32, total_epochs=3,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        train_examples=32 * 2),
+        dtype="float32",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_every_steps=1,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(epoch):
+    return SyntheticClassification(batch_size=32, image_size=32, channels=1,
+                                   num_classes=10, num_batches=2, seed=epoch)
+
+
+def _trainer(tmp_path, monkeypatch, **cfg_kw):
+    """Fault env must be set via monkeypatch BEFORE this builds the Trainer
+    (FaultInjector.from_env is read in __init__)."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    from deepvision_tpu.core.trainer import Trainer
+    return Trainer(_config(tmp_path, **cfg_kw), workdir=str(tmp_path / "wd"))
+
+
+# -- divergence auto-recovery -------------------------------------------------
+
+def test_divergence_rollback_completes_run(tmp_path, monkeypatch):
+    """NaN injected at a known step (epoch 2's first batch) with a recovery
+    budget: training rolls back to epoch 1's checkpoint, scales the LR down,
+    retries, and COMPLETES — with the recovery event in the metrics stream."""
+    monkeypatch.setenv("DEEPVISION_FAULT_NAN_STEP", "2")  # batches 0,1 = ep 1
+    tr = _trainer(tmp_path, monkeypatch, recover_on_divergence=1)
+    result = tr.fit(_data, None, sample_shape=(32, 32, 1))
+    assert result["best_metric"] is not None
+    # epoch 1 (2 steps) + diverged epoch 2 (2 steps, rolled back to step 2)
+    # + retried epochs 2,3 (4 steps) -> final step count 6
+    assert int(tr.state.step) == 6
+    hist = tr.logger.history
+    assert hist["resilience_divergence_recoveries"]["value"] == [1.0]
+    assert hist["resilience_lr_scale"]["value"] == [0.5]
+    # the retried epochs trained clean: last epoch mean loss is finite
+    assert np.isfinite(hist["epoch_train_loss"]["value"][-1])
+    tr.close()
+
+
+def test_divergence_budget_spent_still_halts(tmp_path, monkeypatch):
+    """Recovery is bounded: with no checkpoint to roll back to (NaN in epoch
+    1), the existing actionable TrainingDivergedError fires unchanged."""
+    from deepvision_tpu.core.trainer import TrainingDivergedError
+    monkeypatch.setenv("DEEPVISION_FAULT_NAN_STEP", "0")
+    tr = _trainer(tmp_path, monkeypatch, recover_on_divergence=3)
+    with pytest.raises(TrainingDivergedError, match="diverged"):
+        tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+
+# -- transient-I/O retry ------------------------------------------------------
+
+def test_checkpoint_write_retry_then_success(tmp_path, monkeypatch):
+    """First M=2 checkpoint saves fail transiently (< default 3-retry
+    budget): the run succeeds anyway, the retries are logged, and the
+    checkpoint is committed and restorable."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_SAVE_FAILS", "2")
+    tr = _trainer(tmp_path, monkeypatch, total_epochs=1)
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    assert tr.ckpt.latest_epoch() == 1
+    assert tr.logger.history["resilience_ckpt_save_retries"]["value"] == [
+        1.0, 2.0]
+    tr.close()
+
+
+def test_checkpoint_write_retry_budget_exhausted(tmp_path, monkeypatch):
+    """More failures than the retry budget: the final OSError propagates
+    (bounded backoff, not an infinite loop)."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_SAVE_FAILS", "3")
+    monkeypatch.setenv("DEEPVISION_IO_RETRIES", "1")
+    tr = _trainer(tmp_path, monkeypatch, total_epochs=1)
+    with pytest.raises(OSError, match="injected transient checkpoint-write"):
+        tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+
+def test_data_io_retry_loses_no_batches(tmp_path, monkeypatch):
+    """Two transient I/O errors before batch 1: backoff retries pull the
+    batch the source never lost — every step still runs."""
+    monkeypatch.setenv("DEEPVISION_FAULT_DATA_IO_STEP", "1:2")
+    tr = _trainer(tmp_path, monkeypatch, total_epochs=2)
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    assert int(tr.state.step) == 4  # 2 epochs x 2 batches, none dropped
+    assert tr.logger.history["resilience_data_io_retries"]["value"] == [
+        1.0, 2.0]
+    tr.close()
+
+
+def test_retry_policy_bounded_backoff():
+    """Delays follow the capped exponential schedule (no sleep longer than
+    the schedule requires) and the budget re-raises the last error."""
+    import random
+
+    from deepvision_tpu.core.resilience import RetryPolicy, call_with_retry
+    p = RetryPolicy(max_retries=3, base_delay=0.01, max_delay=0.04, jitter=0.0)
+    rng = random.Random(0)
+    assert [p.delay(n, rng) for n in (1, 2, 3, 4)] == [
+        0.01, 0.02, 0.04, 0.04]
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retry(flaky, p, what="t") == "ok"
+    assert len(calls) == 3
+
+    def always():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        call_with_retry(always, p, what="t")
+
+
+# -- step watchdog ------------------------------------------------------------
+
+def test_step_watchdog_fires_on_stall_only(capsys):
+    from deepvision_tpu.core.resilience import StepWatchdog
+    fired = []
+    wd = StepWatchdog(0.4, diagnostics=lambda: {"last_step": 7,
+                                                "prefetch_queue_depth": 1},
+                      name="t", _abort=lambda: fired.append(True))
+    for _ in range(3):
+        time.sleep(0.15)
+        wd.beat()
+    assert not fired, "fired while beats were landing"
+    time.sleep(1.0)
+    wd.stop()
+    assert fired, "did not fire on a stall past the threshold"
+    err = capsys.readouterr().err
+    assert "last_step=7" in err and "prefetch_queue_depth=1" in err
+
+
+# -- graceful preemption ------------------------------------------------------
+
+def _committed_steps(ckpt_root):
+    # orbax finalizes by atomically renaming the tmp dir -> `<step>`, so a
+    # pure-digit directory name IS the commit marker (same predicate as
+    # tests/test_preemption.py)
+    if not ckpt_root.is_dir():
+        return []
+    return [int(d.name) for d in ckpt_root.iterdir()
+            if d.is_dir() and d.name.isdigit()]
+
+
+def test_sigterm_graceful_checkpoint_and_resume(tmp_path):
+    """SIGTERM mid-run: the process commits a checkpoint, prints the resume
+    hint, and exits 0; a relaunch with --auto-resume continues from it."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.join(REPO, "LeNet", "jax", "train.py"),
+           "-m", "lenet5", "--synthetic", "--epochs", "50",
+           "--steps-per-epoch", "2", "--batch-size", "16",
+           "--workdir", str(tmp_path), "--auto-resume"]
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if _committed_steps(tmp_path / "ckpt"):
+                break
+            time.sleep(1)
+        else:
+            pytest.fail("no committed checkpoint appeared within 420s")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    assert proc.returncode == 0, out[-2000:]
+    assert "graceful preemption: checkpoint committed at epoch" in out
+    assert "--auto-resume" in out  # the resume hint
+
+    relaunch = subprocess.run(
+        cmd[:cmd.index("50")] + ["3"] + cmd[cmd.index("50") + 1:],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert relaunch.returncode == 0, relaunch.stderr[-2000:]
+    assert "resumed from epoch" in relaunch.stdout
+
+
+# -- GAN trainer wiring -------------------------------------------------------
+
+def test_gan_divergence_rollback(tmp_path, monkeypatch):
+    """The adversarial loop shares the recovery contract: a NaN epoch rolls
+    BOTH networks back to the last {gen, disc} checkpoint and retries."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.gan import DCGANTrainer
+
+    cfg = get_config("dcgan").replace(
+        batch_size=16, total_epochs=4, recover_on_divergence=1)
+    tr = DCGANTrainer(cfg, workdir=str(tmp_path / "gan"))
+
+    rs = np.random.RandomState(0)
+    clean = rs.uniform(-1, 1, (16, 28, 28, 1)).astype(np.float32)
+
+    def train_fn(epoch):
+        # epoch 3's single batch is poisoned -> non-finite metrics; the
+        # rollback lands on the epoch-2 checkpoint (save_every=2) and the
+        # retried epoch 3 trains clean (dict tracks the one-shot fault)
+        if epoch == 3 and not train_fn.fired:
+            train_fn.fired = True
+            return [np.full_like(clean, np.nan)]
+        return [clean]
+
+    train_fn.fired = False
+    metrics = tr.fit(train_fn, save_every=2)
+    assert all(np.isfinite(v) for v in metrics.values())
+    assert tr._recoveries == 1
+    hist = tr.logger.history
+    assert hist["resilience_divergence_recoveries"]["value"] == [1.0]
+    tr.close()

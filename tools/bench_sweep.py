@@ -124,11 +124,14 @@ def main(argv=None):
               f"(!= {base_platform})", file=sys.stderr)
     ranked = sorted((r for r in ok if r["platform"] == base_platform),
                     key=lambda r: -r["value"])
+    # machine-readable: the program this sweep actually benched (the
+    # headline; see run_combo). Historical caveat — r04/r05 SWEEP.json
+    # artifacts measured plain resnet50 — lives in docs/TUNING.md, not in
+    # every future artifact.
     summary = {"sweep": [
         {"combo": r["combo"], "value": r["value"], "platform": r["platform"]}
         for r in ranked],
-        "program": "headline (resnet50_lean since r05; plain resnet50 "
-                   "in r04/r05 SWEEP.json artifacts)"}
+        "program": "resnet50_lean"}
     if ranked:
         base = next((r["value"] for r in ranked
                      if r["combo"] == "baseline"), None)

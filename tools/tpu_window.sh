@@ -40,9 +40,11 @@ run_bounded_progress() {  # SECONDS STALL_SECONDS PROGRESS_FILE cmd...
     # Like run_bounded, but also kills when PROGRESS_FILE's mtime stalls
     # STALL_SECONDS: the relay's failure mode is a hang, not an error, and
     # a hang must not burn the whole window before the later stages run.
-    # The long-running stage-2 trainer appends a JSONL line every ~75-120 s
-    # when healthy (measured round 5), so a 420 s stall is a wedge, while
-    # the hard cap can stay generous for the healthy-but-slow case.
+    # The caller picks STALL_SECONDS from its stage's measured healthy
+    # write cadence (stage 2 passes 900 s: JSONL lines land every ~75-120 s
+    # when healthy, but the epoch boundary went 355 s without one — see the
+    # stage-2 comment), while the hard cap stays generous for the
+    # healthy-but-slow case.
     local secs=$1 stall=$2 pfile=$3; shift 3
     setsid "$@" &
     local pg=$!
