@@ -9,7 +9,12 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_spatial.py tests/test_spatial_shardmap.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
-.PHONY: test test-all verify bench dryrun smoke preflight preflight-record
+.PHONY: test test-all verify bench dryrun smoke preflight preflight-record lint
+
+lint:        ## jaxlint: donation-aliasing / retrace / host-sync / trace
+	## hazards (docs/LINTING.md) over the framework, the tools, and the
+	## per-model entrypoints — exit 1 on any finding
+	$(PY) -m deepvision_tpu.lint deepvision_tpu tools $(wildcard */jax)
 
 preflight:   ## pod go/no-go: devices, input floor, train step, ckpt roundtrip
 	$(PY) tools/preflight.py

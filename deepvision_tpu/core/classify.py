@@ -110,6 +110,8 @@ class Classifier:
         @jax.jit
         def _logits(params, batch_stats, images):
             variables = {"params": params}
+            # dict-emptiness of the batch_stats PYTREE, not a tracer bool —
+            # static at trace time  # jaxlint: disable=TRC001
             if batch_stats:
                 variables["batch_stats"] = batch_stats
             return apply_fn(variables, images, train=False)

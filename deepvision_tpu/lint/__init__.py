@@ -1,0 +1,22 @@
+"""jaxlint — JAX-aware static analysis for deepvision_tpu.
+
+Catches the hazard classes this codebase pays for in pod-hours rather than
+tracebacks: use-after-donate aliasing (DON001, the PR 1 checkpoint bug
+class), per-call retraces (JIT001), hot-loop host syncs (SYNC001),
+side effects under trace (EFF001), and tracer bools (TRC001).
+
+CLI:      python -m deepvision_tpu.lint <paths> [--format json] [--select R,..]
+Library:  lint_paths([...]) -> [Finding]
+Suppress: `# jaxlint: disable=RULE` inline; `[tool.jaxlint]` in
+          pyproject.toml for path excludes. See docs/LINTING.md.
+
+Stdlib-only on purpose: it must run on hosts without jax and must never
+trigger backend init.
+"""
+
+from .cli import lint_paths, main
+from .framework import Config, Finding, load_config
+from .rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Config", "Finding", "lint_paths", "load_config",
+           "main"]

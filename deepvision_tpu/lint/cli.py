@@ -1,0 +1,169 @@
+"""jaxlint CLI: `python -m deepvision_tpu.lint <paths> [options]`.
+
+Exit codes (stable, for CI):
+  0 — clean
+  1 — findings reported
+  2 — usage error (no/unknown paths, bad flags, unreadable config)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from .donation import ProjectIndex
+from .framework import Config, Finding, Module, find_pyproject, load_config
+from .rules import ALL_RULES
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE = 0, 1, 2
+
+
+def collect_files(paths: Sequence[str], config: Config,
+                  root: str) -> List[str]:
+    files: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            # a file named explicitly is linted even if excluded — excludes
+            # govern directory walks, not direct requests (fixture debugging)
+            if path.endswith(".py"):
+                files.append(path)
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                    and not config.is_excluded(os.path.join(dirpath, d), root))
+                for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
+                    if fn.endswith(".py") and not config.is_excluded(full,
+                                                                     root):
+                        files.append(full)
+    return files
+
+
+def _lint(paths: Sequence[str], config: Optional[Config],
+          select: Optional[Sequence[str]],
+          root: Optional[str]) -> Tuple[List[Finding], int]:
+    if config is None:
+        pyproject = find_pyproject(os.path.abspath(paths[0]) if paths
+                                   else os.getcwd())
+        config = load_config(pyproject)
+        if root is None and pyproject:
+            root = os.path.dirname(pyproject)
+    root = root or os.getcwd()
+    files = collect_files(paths, config, root)
+
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            modules.append(Module.from_path(path))
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 1, e.offset or 1,
+                                    "SYNTAX", "error",
+                                    f"cannot parse file: {e.msg}"))
+
+    index = ProjectIndex().build(modules)
+    wanted = {r.upper() for r in select} if select else None
+    for module in modules:
+        for rule_id, (_, check, _doc) in ALL_RULES.items():
+            if wanted is not None and rule_id not in wanted:
+                continue
+            if not config.rule_enabled(rule_id):
+                continue
+            findings.extend(check(module, index, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, len(files)
+
+
+def lint_paths(paths: Sequence[str], config: Optional[Config] = None,
+               select: Optional[Sequence[str]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Library entry point: lint files/directories, return sorted findings.
+    `config=None` loads `[tool.jaxlint]` from the nearest pyproject.toml."""
+    return _lint(paths, config, select, root)[0]
+
+
+def _render_text(findings: List[Finding], n_files: int) -> str:
+    lines = [f.format() for f in findings]
+    if findings:
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items()))
+        lines.append(f"jaxlint: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''} ({summary})")
+    else:
+        lines.append(f"jaxlint: clean ({n_files} files)")
+    return "\n".join(lines)
+
+
+def _render_json(findings: List[Finding], n_files: int) -> str:
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_json() for f in findings],
+        "summary": {"files": n_files, "findings": len(findings),
+                    "by_rule": by_rule},
+    }, indent=2)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepvision_tpu.lint",
+        description="JAX-aware static analysis: donation-aliasing, retrace, "
+                    "host-sync, trace-side-effect, and tracer-bool hazards. "
+                    "Rules: " + "; ".join(
+                        f"{rid}: {doc}"
+                        for rid, (_, _, doc) in ALL_RULES.items()))
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--config", default=None,
+                        help="pyproject.toml to read [tool.jaxlint] from "
+                             "(default: nearest to the first path)")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_USAGE if e.code not in (0, None) else 0
+    if not args.paths:
+        print("usage error: at least one path is required", file=sys.stderr)
+        return EXIT_USAGE
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"usage error: no such path: {path}", file=sys.stderr)
+            return EXIT_USAGE
+    select = None
+    if args.select:
+        select = [r.strip().upper() for r in args.select.split(",")
+                  if r.strip()]
+        unknown = [r for r in select if r not in ALL_RULES]
+        if unknown:
+            print(f"usage error: unknown rule(s): {', '.join(unknown)}; "
+                  f"known: {', '.join(ALL_RULES)}", file=sys.stderr)
+            return EXIT_USAGE
+
+    config = root = None
+    if args.config is not None:
+        if not os.path.isfile(args.config):
+            print(f"usage error: config not found: {args.config}",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        config = load_config(args.config)
+        root = os.path.dirname(os.path.abspath(args.config))
+
+    findings, n_files = _lint(args.paths, config, select, root)
+    render = _render_json if args.format == "json" else _render_text
+    print(render(findings, n_files))
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Project-wide donation inference (pass 1 of the linter).
+
+Answers one question for pass 2's DON001: *which callables donate which
+arguments?* Three layers, matching how this codebase actually builds its
+jitted steps:
+
+  1. direct — `f = jax.jit(step, donate_argnums=(0,))`, including the
+     repo-wide `jit_kwargs` dict idiom:
+
+         jit_kwargs = {}
+         if donate:
+             jit_kwargs["donate_argnums"] = (0,)
+         return jax.jit(step, **jit_kwargs)
+
+  2. factories — a module-level function whose return value is a donating
+     `jax.jit(...)` (every `make_*_train_step` in core/ and
+     parallel/spatial_shard.py). Indexed by terminal name, project-wide:
+     `steps.make_classification_train_step(...)` at a call site in another
+     module resolves through this map.
+
+  3. instance attributes — `self.train_step = <factory>(...)` (possibly via
+     a lambda-valued `self._step_factory`), so method bodies calling
+     `self.train_step(...)` know argument 0 is donated.
+
+Donation inferred from a *conditionally* donating factory (`donate=...`)
+is treated as donating: call sites must be written donation-safe for the
+donating configuration regardless of the flag's value at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, NamedTuple, Optional, Tuple
+
+from .framework import Module, terminal_name, walk_scope
+
+JIT_FNS = {"jax.jit", "jax.pjit", "flax.nnx.jit", "nnx.jit"}
+
+
+class Donation(NamedTuple):
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+
+    def merge(self, other: "Donation") -> "Donation":
+        return Donation(tuple(sorted(set(self.argnums) | set(other.argnums))),
+                        tuple(sorted(set(self.argnames) | set(other.argnames))))
+
+
+def _const_positions(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate_argnums value: int or tuple/list of ints (constants only)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _const_names(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _dict_donations(scope: ast.AST) -> Dict[str, Donation]:
+    """Track `jit_kwargs`-style dicts in a scope: literal keys plus later
+    `d["donate_argnums"] = ...` subscript stores. Conservative: any donation
+    key ever set on the dict counts."""
+    dicts: Dict[str, Donation] = {}
+    for node in walk_scope(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and isinstance(node.value, ast.Dict):
+                don = Donation()
+                for key, val in zip(node.value.keys, node.value.values):
+                    if not isinstance(key, ast.Constant):
+                        continue
+                    if key.value == "donate_argnums":
+                        don = don.merge(
+                            Donation(argnums=_const_positions(val) or (0,)))
+                    elif key.value == "donate_argnames":
+                        don = don.merge(
+                            Donation(argnames=_const_names(val) or ()))
+                dicts[tgt.id] = don
+            elif (isinstance(tgt, ast.Subscript)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id in dicts
+                  and isinstance(tgt.slice, ast.Constant)):
+                if tgt.slice.value == "donate_argnums":
+                    dicts[tgt.value.id] = dicts[tgt.value.id].merge(
+                        Donation(argnums=_const_positions(node.value) or (0,)))
+                elif tgt.slice.value == "donate_argnames":
+                    dicts[tgt.value.id] = dicts[tgt.value.id].merge(
+                        Donation(argnames=_const_names(node.value) or ()))
+    return dicts
+
+
+def donating_jit_call(call: ast.Call, module: Module,
+                      dicts: Dict[str, Donation]) -> Optional[Donation]:
+    """Donation of a `jax.jit(...)` call, or None if it doesn't donate (or
+    isn't a jit call at all)."""
+    if module.resolve(call.func) not in JIT_FNS:
+        return None
+    don = Donation()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            don = don.merge(Donation(argnums=_const_positions(kw.value) or (0,)))
+        elif kw.arg == "donate_argnames":
+            don = don.merge(Donation(argnames=_const_names(kw.value) or ()))
+        elif kw.arg is None:  # **jit_kwargs
+            name = kw.value.id if isinstance(kw.value, ast.Name) else None
+            if name and name in dicts:
+                don = don.merge(dicts[name])
+    return don if (don.argnums or don.argnames) else None
+
+
+class ProjectIndex:
+    """Donation knowledge shared across every file of one lint invocation."""
+
+    def __init__(self) -> None:
+        # factory terminal name -> Donation of the jitted callable it returns
+        self.factories: Dict[str, Donation] = {}
+        # class name -> attr -> Donation (instance attrs holding jitted steps)
+        self.class_attrs: Dict[str, Dict[str, Donation]] = {}
+        # class name -> attr -> Donation (attrs holding *factories*, i.e.
+        # lambdas whose body calls a donating factory — `self._step_factory`)
+        self.attr_factories: Dict[str, Dict[str, Donation]] = {}
+        # module path -> top-level name -> Donation
+        self.module_names: Dict[str, Dict[str, Donation]] = {}
+
+    # -- building ------------------------------------------------------------
+    def build(self, modules: Iterable[Module]) -> "ProjectIndex":
+        modules = list(modules)
+        for module in modules:
+            self._collect_factories(module)
+        # attr assignments can reference factories from other modules and
+        # attr-factories assigned in other methods: a short fixpoint settles
+        # the `self._step_factory = lambda...` / `self.train_step =
+        # self._step_factory(...)` chain regardless of statement order.
+        for _ in range(3):
+            changed = False
+            for module in modules:
+                changed |= self._collect_attrs(module)
+            if not changed:
+                break
+        for module in modules:
+            self._collect_module_names(module)
+        return self
+
+    def _collect_factories(self, module: Module) -> None:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            dicts = _dict_donations(node)
+            for sub in walk_scope(node):
+                if isinstance(sub, ast.Return) and isinstance(sub.value,
+                                                              ast.Call):
+                    don = donating_jit_call(sub.value, module, dicts)
+                    if don:
+                        self.factories[node.name] = self.factories.get(
+                            node.name, Donation()).merge(don)
+
+    def _lambda_factory_donation(self, node: ast.AST,
+                                 module: Module) -> Optional[Donation]:
+        """`lambda ...: make_x_train_step(...)` -> that factory's donation."""
+        if isinstance(node, ast.Lambda) and isinstance(node.body, ast.Call):
+            name = terminal_name(node.body.func)
+            if name in self.factories:
+                return self.factories[name]
+        return None
+
+    def value_donation(self, node: ast.AST, module: Module,
+                       dicts: Dict[str, Donation],
+                       local_factories: Dict[str, Donation],
+                       cls_name: Optional[str] = None,
+                       self_arg: Optional[str] = None) -> Optional[Donation]:
+        """Donation of the callable an expression evaluates to, if any."""
+        if isinstance(node, ast.IfExp):
+            for branch in (node.body, node.orelse):
+                don = self.value_donation(branch, module, dicts,
+                                          local_factories, cls_name, self_arg)
+                if don:
+                    return don
+            return None
+        if not isinstance(node, ast.Call):
+            return None
+        don = donating_jit_call(node, module, dicts)
+        if don:
+            return don
+        name = terminal_name(node.func)
+        if name in local_factories:
+            return local_factories[name]
+        # self._step_factory(...) — attr known to hold a donating factory
+        if (cls_name and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == self_arg):
+            attr_don = self.attr_factories.get(cls_name, {}).get(node.func.attr)
+            if attr_don:
+                return attr_don
+        if name in self.factories:
+            return self.factories[name]
+        return None
+
+    def _collect_attrs(self, module: Module) -> bool:
+        changed = False
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if not method.args.args:
+                    continue
+                self_arg = method.args.args[0].arg
+                dicts = _dict_donations(method)
+                local_factories: Dict[str, Donation] = {}
+                for node in walk_scope(method):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    tgt = node.targets[0]
+                    lam = self._lambda_factory_donation(node.value, module)
+                    if isinstance(tgt, ast.Name) and lam:
+                        local_factories[tgt.id] = lam
+                        continue
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == self_arg):
+                        continue
+                    if lam:
+                        bucket = self.attr_factories.setdefault(cls.name, {})
+                        if bucket.get(tgt.attr) != lam:
+                            bucket[tgt.attr] = lam
+                            changed = True
+                        continue
+                    don = self.value_donation(node.value, module, dicts,
+                                              local_factories, cls.name,
+                                              self_arg)
+                    if don:
+                        bucket = self.class_attrs.setdefault(cls.name, {})
+                        merged = bucket.get(tgt.attr, Donation()).merge(don)
+                        if bucket.get(tgt.attr) != merged:
+                            bucket[tgt.attr] = merged
+                            changed = True
+        return changed
+
+    def _collect_module_names(self, module: Module) -> None:
+        names: Dict[str, Donation] = {}
+        dicts = _dict_donations(module.tree)
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                don = self.value_donation(node.value, module, dicts, {})
+                if don:
+                    names[node.targets[0].id] = don
+        if names:
+            self.module_names[module.path] = names

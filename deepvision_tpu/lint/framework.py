@@ -1,0 +1,340 @@
+"""jaxlint plumbing: findings, suppressions, config, and AST utilities.
+
+Everything here is stdlib-only — the linter must run (and run fast) on hosts
+with no jax installed, and importing jax would drag backend init into what is
+a pure source-level pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+# scopes that cut off name visibility / execution locality for our analyses
+SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- inline suppression ------------------------------------------------------
+# `# jaxlint: disable=DON001[,SYNC001]` on the flagged line suppresses those
+# rules there; `# jaxlint: disable-file=RULE` anywhere suppresses file-wide.
+_DIRECTIVE_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_*,\s]+)")
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for lineno, line in enumerate(source.splitlines(), 1):
+        m = _DIRECTIVE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            file_wide |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                # a comment-only directive line also covers the next line,
+                # so suppressions fit an 79-col style
+                per_line.setdefault(lineno + 1, set()).update(rules)
+    return per_line, file_wide
+
+
+# -- config ------------------------------------------------------------------
+@dataclasses.dataclass
+class Config:
+    """`[tool.jaxlint]` in pyproject.toml. All keys optional."""
+    exclude: Tuple[str, ...] = ()          # path globs / directory prefixes
+    disable: Tuple[str, ...] = ()          # rule ids disabled project-wide
+    hot_loop_callees: Tuple[str, ...] = () # extra callee names marking a loop hot
+    sync_allowed_guards: Tuple[str, ...] = ()  # extra guard-name patterns
+
+    def rule_enabled(self, rule_id: str) -> bool:
+        return rule_id.upper() not in {r.upper() for r in self.disable}
+
+    def is_excluded(self, path: str, root: str) -> bool:
+        rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+        for pat in self.exclude:
+            pat = pat.rstrip("/")
+            if (fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch(rel, pat + "/*")
+                    or rel == pat or rel.startswith(pat + "/")):
+                return True
+        return False
+
+
+def _split_inline_comment(line: str) -> str:
+    """Drop a trailing `# ...` comment, respecting simple quoted strings."""
+    quote = None
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def _parse_toml_value(text: str):
+    text = text.strip()
+    if text.startswith("[") and text.endswith("]"):
+        body = text[1:-1]
+        items, cur, quote = [], "", None
+        for ch in body:
+            if quote:
+                cur += ch
+                if ch == quote:
+                    quote = None
+            elif ch in ("'", '"'):
+                quote = ch
+                cur += ch
+            elif ch == ",":
+                items.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        if cur.strip():
+            items.append(cur)
+        return [_parse_toml_value(i) for i in items if i.strip()]
+    if (text.startswith('"') and text.endswith('"')) or (
+            text.startswith("'") and text.endswith("'")):
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def parse_tool_section(source: str, section: str = "tool.jaxlint") -> dict:
+    """Minimal TOML-subset reader for one `[section]` of pyproject.toml.
+
+    Python 3.10 has no stdlib tomllib and jaxlint adds no dependencies, so
+    this handles exactly what the section needs: string / bool / int values
+    and (possibly multi-line) arrays of strings. Unknown shapes are ignored.
+    """
+    out: dict = {}
+    in_section = False
+    pending_key: Optional[str] = None
+    pending_val = ""
+    for raw in source.splitlines():
+        line = _split_inline_comment(raw).rstrip()
+        stripped = line.strip()
+        if pending_key is not None:
+            pending_val += " " + stripped
+            if pending_val.count("[") <= pending_val.count("]"):
+                out[pending_key] = _parse_toml_value(pending_val)
+                pending_key, pending_val = None, ""
+            continue
+        if stripped.startswith("["):
+            in_section = stripped == f"[{section}]"
+            continue
+        if not in_section or "=" not in stripped:
+            continue
+        key, _, val = stripped.partition("=")
+        key, val = key.strip().strip('"').strip("'"), val.strip()
+        if val.count("[") > val.count("]"):
+            pending_key, pending_val = key, val
+            continue
+        out[key] = _parse_toml_value(val)
+    return out
+
+
+def find_pyproject(start: str) -> Optional[str]:
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        cand = os.path.join(cur, "pyproject.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def load_config(pyproject_path: Optional[str]) -> Config:
+    if not pyproject_path or not os.path.isfile(pyproject_path):
+        return Config()
+    with open(pyproject_path, encoding="utf-8") as fp:
+        raw = parse_tool_section(fp.read())
+
+    def strings(key) -> Tuple[str, ...]:
+        val = raw.get(key, [])
+        if isinstance(val, str):
+            val = [val]
+        return tuple(str(v) for v in val if isinstance(v, (str, int)))
+
+    return Config(exclude=strings("exclude"),
+                  disable=strings("disable"),
+                  hot_loop_callees=strings("hot-loop-callees"),
+                  sync_allowed_guards=strings("sync-allowed-guards"))
+
+
+# -- AST module context ------------------------------------------------------
+def dotted_parts(node: ast.AST) -> Optional[List[str]]:
+    """`a.b.c` -> ["a", "b", "c"]; None for anything not a pure name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def dotted_str(node: ast.AST) -> Optional[str]:
+    parts = dotted_parts(node)
+    return ".".join(parts) if parts else None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last segment of a callee: `steps.make_yolo_train_step` -> the latter."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_scope(scope: ast.AST) -> Iterator[ast.AST]:
+    """Yield nodes belonging to `scope`, NOT descending into nested function
+    scopes (the nested defs themselves are yielded, their bodies are not).
+    Comprehensions are treated as part of the enclosing scope."""
+    stack = list(ast.iter_child_nodes(scope))[::-1]
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, SCOPE_TYPES):
+            stack.extend(list(ast.iter_child_nodes(node))[::-1])
+
+
+class Module:
+    """One parsed file plus the cross-referencing helpers rules need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.aliases, self.import_roots = self._collect_aliases()
+        self.line_suppress, self.file_suppress = parse_suppressions(source)
+
+    @classmethod
+    def from_path(cls, path: str) -> "Module":
+        with open(path, encoding="utf-8") as fp:
+            return cls(path, fp.read())
+
+    def _collect_aliases(self) -> Tuple[Dict[str, str], Set[str]]:
+        aliases: Dict[str, str] = {}
+        roots: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    aliases[local] = a.name if a.asname else a.name.split(".")[0]
+                    roots.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    aliases[local] = f"{node.module}.{a.name}"
+                    roots.add(local)
+        return aliases, roots
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_scope(self, node: ast.AST) -> ast.AST:
+        for anc in self.ancestors(node):
+            if isinstance(anc, SCOPE_TYPES) or isinstance(anc, ast.Module):
+                return anc
+        return self.tree
+
+    def statement_of(self, node: ast.AST) -> ast.stmt:
+        cur = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.Module, *SCOPE_TYPES)) or isinstance(
+                    anc, (ast.If, ast.For, ast.While, ast.With, ast.Try)):
+                if isinstance(cur, ast.stmt):
+                    return cur
+            cur = anc
+        return cur if isinstance(cur, ast.stmt) else node  # pragma: no cover
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Callee dotted path with import aliases normalized
+        (`np.asarray` -> `numpy.asarray`, bare `jit` -> `jax.jit`)."""
+        parts = dotted_parts(node)
+        if not parts:
+            return None
+        mapped = self.aliases.get(parts[0])
+        if mapped:
+            parts = mapped.split(".") + parts[1:]
+        return ".".join(parts)
+
+    def iter_scopes(self) -> Iterator[ast.AST]:
+        yield self.tree
+        for node in ast.walk(self.tree):
+            if isinstance(node, SCOPE_TYPES):
+                yield node
+
+    def self_name(self, scope: ast.AST) -> Optional[Tuple[str, str]]:
+        """For a method (or a function nested in one), the instance-arg name
+        of the nearest method, plus its class name — (`self`, `Trainer`)."""
+        node = scope
+        while node is not None and not isinstance(node, ast.Module):
+            parent = self.parent(node)
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and isinstance(parent, ast.ClassDef) and node.args.args):
+                return node.args.args[0].arg, parent.name  # type: ignore
+            node = parent
+        return None
+
+    def finding(self, node: ast.AST, rule: str, severity: str,
+                message: str) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        if rule.upper() in self.file_suppress or "ALL" in self.file_suppress:
+            return None
+        on_line = self.line_suppress.get(line, set())
+        if rule.upper() in on_line or "ALL" in on_line:
+            return None
+        return Finding(self.path, line, getattr(node, "col_offset", 0) + 1,
+                       rule, severity, message)

@@ -1,0 +1,632 @@
+"""The jaxlint rules.
+
+Each rule is a function `(module, index, config) -> [Finding]`, registered in
+ALL_RULES. The rules are deliberately heuristic: they trade exhaustive
+soundness for zero-dependency, sub-second analysis that catches the hazard
+classes this codebase has actually been bitten by (see docs/LINTING.md for
+the per-rule rationale and the TPU cost of each hazard).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .donation import JIT_FNS, Donation, ProjectIndex, _dict_donations
+from .framework import (Config, Finding, Module, SCOPE_TYPES, SEVERITY_ERROR,
+                        SEVERITY_WARNING, dotted_str, terminal_name,
+                        walk_scope)
+
+Pos = Tuple[int, int]
+
+
+def _pos(node: ast.AST) -> Pos:
+    return (node.lineno, node.col_offset)
+
+
+def _end(node: ast.AST) -> Pos:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", node.col_offset))
+
+
+def _span_contains(outer: ast.AST, pos: Pos) -> bool:
+    return _pos(outer) <= pos <= _end(outer)
+
+
+# ---------------------------------------------------------------------------
+# DON001 — use-after-donate
+# ---------------------------------------------------------------------------
+
+def _assigned_names(target: ast.AST) -> Iterator[str]:
+    """Dotted names stored by an assignment target (tuples unpacked)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from _assigned_names(el)
+    elif isinstance(target, ast.Starred):
+        yield from _assigned_names(target.value)
+    else:
+        name = dotted_str(target)
+        if name:
+            yield name
+
+
+def _name_events(scope: ast.AST, module: Module,
+                 target: str) -> List[Tuple[Pos, str]]:
+    """(position, 'load'|'store') events for dotted name `target` in scope.
+    An AugAssign target is both: it reads the old buffer before storing."""
+    events: List[Tuple[Pos, str]] = []
+    for node in walk_scope(scope):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if dotted_str(node) != target:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Load):
+                events.append((_pos(node), "load"))
+            elif isinstance(ctx, (ast.Store, ast.Del)):
+                parent = module.parent(node)
+                if isinstance(parent, ast.AugAssign) and parent.target is node:
+                    events.append((_pos(node), "load"))
+                events.append((_pos(node), "store"))
+    events.sort()
+    return events
+
+
+def _gather_donating_callables(scope: ast.AST, module: Module,
+                               index: ProjectIndex) -> Dict[str, Donation]:
+    """Callables reachable in `scope` whose donation we know, keyed by the
+    exact call spelling (`step`, `self.train_step`, ...)."""
+    donating: Dict[str, Donation] = {}
+    # module-level donating names are visible inside functions
+    donating.update(index.module_names.get(module.path, {}))
+
+    ctx = module.self_name(scope)
+    cls_name = self_arg = None
+    if ctx:
+        self_arg, cls_name = ctx
+        for attr, don in index.class_attrs.get(cls_name, {}).items():
+            donating[f"{self_arg}.{attr}"] = don
+
+    dicts = _dict_donations(scope)
+    local_factories: Dict[str, Donation] = {}
+    for node in walk_scope(scope):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        lam = index._lambda_factory_donation(node.value, module)
+        if lam:
+            local_factories[tgt] = lam
+            continue
+        don = index.value_donation(node.value, module, dicts, local_factories,
+                                   cls_name, self_arg)
+        if don:
+            donating[tgt] = don
+        elif tgt in donating:
+            del donating[tgt]  # rebound to something unknown — stop tracking
+    return donating
+
+
+def _donated_arg_names(call: ast.Call, don: Donation) -> List[ast.AST]:
+    """The argument expressions donated at this call site, restricted to
+    plain dotted names we can track. A * unpacking shifts positions — bail
+    on positional donation past it."""
+    out: List[ast.AST] = []
+    star_at = next((i for i, a in enumerate(call.args)
+                    if isinstance(a, ast.Starred)), None)
+    for i in don.argnums:
+        if star_at is not None and i >= star_at:
+            break
+        if i < len(call.args) and dotted_str(call.args[i]):
+            out.append(call.args[i])
+    for name in don.argnames:
+        for kw in call.keywords:
+            if kw.arg == name and dotted_str(kw.value):
+                out.append(kw.value)
+    return out
+
+
+def check_don001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for scope in module.iter_scopes():
+        donating = _gather_donating_callables(scope, module, index)
+        if not donating:
+            continue
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            key = dotted_str(node.func)
+            don = donating.get(key) if key else None
+            if not don:
+                continue
+            for arg in _donated_arg_names(node, don):
+                f = _use_after_donate(scope, module, node, arg, key)
+                if f:
+                    findings.append(f)
+    return findings
+
+
+def _use_after_donate(scope: ast.AST, module: Module, call: ast.Call,
+                      arg: ast.AST, callee: str) -> Optional[Finding]:
+    target = dotted_str(arg)
+    events = _name_events(scope, module, target)
+    call_start, call_end = _pos(call), _end(call)
+
+    # the statement holding the call may itself rebind the donated name
+    # (`state, m = step(state, ...)`) — that store lands right after the call
+    stmt = module.statement_of(call)
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            if target in _assigned_names(t):
+                # just past the call's end, so the straight-line scan below
+                # sees the rebind before any later load
+                events.append(((call_end[0], call_end[1] + 1), "store"))
+    events.sort()
+
+    def report(load_pos: Pos) -> Optional[Finding]:
+        return module.finding(
+            _FakeNode(load_pos), "DON001", SEVERITY_ERROR,
+            f"'{target}' is read after being donated to '{callee}' — "
+            f"donation invalidates the argument's buffers (donate_argnums), "
+            f"so this read sees freed memory; rebind '{target}' to the "
+            f"result first (e.g. `{target} = {callee}({target}, ...)`) or "
+            f"drop the donation")
+
+    # straight-line: first load after the call with no intervening store
+    for pos, kind in events:
+        if pos <= call_end:
+            continue
+        if kind == "store":
+            break
+        return report(pos)
+
+    # loop wraparound: a load earlier in the enclosing loop body re-runs
+    # after the donating call on the next iteration; only a store somewhere
+    # in the loop makes that safe
+    loop = None
+    for anc in module.ancestors(call):
+        if isinstance(anc, (ast.For, ast.While)):
+            loop = anc
+            break
+        if isinstance(anc, SCOPE_TYPES):
+            break
+    if loop is not None:
+        loop_events = [(p, k) for p, k in events
+                       if _span_contains(loop, p)]
+        if not any(k == "store" for _, k in loop_events):
+            for pos, kind in loop_events:
+                if kind == "load" and pos < call_start \
+                        and not _span_contains(call, pos):
+                    return report(pos)
+    return None
+
+
+class _FakeNode:
+    """Position carrier for findings reported at a (line, col) rather than a
+    live AST node."""
+
+    def __init__(self, pos: Pos):
+        self.lineno, self.col_offset = pos
+
+
+# ---------------------------------------------------------------------------
+# JIT001 — jit built per-iteration / per-call
+# ---------------------------------------------------------------------------
+
+def check_jit001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parent = module.parent(node)
+        immediately_invoked = isinstance(parent, ast.Call) \
+            and parent.func is node
+        if module.resolve(node.func) in JIT_FNS and not immediately_invoked:
+            loop = _repeating_loop(module, node)
+            if loop is not None:
+                f = module.finding(
+                    node, "JIT001", SEVERITY_ERROR,
+                    "jax.jit called inside a loop: every iteration builds a "
+                    "fresh jitted callable and retraces/recompiles — hoist "
+                    "the jit to setup time (factory pattern, e.g. "
+                    "core/train_state.py:make_ema_update) and call the "
+                    "compiled function in the loop")
+                if f:
+                    findings.append(f)
+        if isinstance(node.func, ast.Call) \
+                and module.resolve(node.func.func) in JIT_FNS:
+            f = module.finding(
+                node, "JIT001", SEVERITY_ERROR,
+                "jit-and-call in one expression (`jax.jit(f)(...)`): the "
+                "jitted callable is discarded after the call, so every "
+                "invocation retraces — bind `jitted = jax.jit(f)` once and "
+                "reuse it")
+            if f:
+                findings.append(f)
+    return findings
+
+
+def _repeating_loop(module: Module, node: ast.AST) -> Optional[ast.AST]:
+    """Nearest For/While whose *repeated* part contains `node`, with no
+    function boundary in between (a def inside a loop only traces when
+    called — the immediate-invocation arm covers that)."""
+    cur = node
+    for anc in module.ancestors(node):
+        if isinstance(anc, SCOPE_TYPES):
+            return None
+        if isinstance(anc, ast.For) and cur is not anc.iter:
+            return anc  # body/orelse/target re-run; iter evaluates once
+        if isinstance(anc, ast.While):
+            return anc  # test AND body re-run every iteration
+        cur = anc
+    return None
+
+
+# ---------------------------------------------------------------------------
+# SYNC001 — host synchronization inside a hot training loop
+# ---------------------------------------------------------------------------
+
+_HOT_CALLEES = re.compile(r"^(train_step|multi_step|train_batch|step_fn)$")
+_SYNC_PATHS = {"jax.device_get"}
+_SYNC_NP = {"numpy.asarray", "numpy.array"}
+_GUARD_NAMES = re.compile(r"log|flush|every|interval|debug|verbose",
+                          re.IGNORECASE)
+
+
+def _loop_statements(loop: ast.AST) -> Iterator[ast.AST]:
+    """Nodes in the loop's repeated part, not descending into nested defs."""
+    for stmt in list(loop.body) + list(getattr(loop, "orelse", [])):
+        stack = [stmt]
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, SCOPE_TYPES):
+                stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_hot_loop(loop: ast.AST, config: Config) -> bool:
+    extra = [re.compile(p) for p in config.hot_loop_callees]
+    for n in _loop_statements(loop):
+        if isinstance(n, ast.Call):
+            name = terminal_name(n.func)
+            if not name:
+                continue
+            bare = name.lstrip("_")
+            if _HOT_CALLEES.match(bare) or any(p.search(name) for p in extra):
+                return True
+    return False
+
+
+def _sync_call_kind(node: ast.Call, module: Module) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+            and not node.args and not node.keywords:
+        return ".item()"
+    resolved = module.resolve(node.func)
+    if resolved in _SYNC_PATHS:
+        return resolved
+    if resolved in _SYNC_NP:
+        return resolved.replace("numpy.", "np.")
+    if isinstance(node.func, ast.Name) and node.func.id == "float" \
+            and len(node.args) == 1 \
+            and not isinstance(node.args[0], ast.Constant):
+        return "float()"
+    return None
+
+
+def _in_flush_guard(module: Module, node: ast.AST, loop: ast.AST,
+                    config: Config) -> bool:
+    """True when an ancestor `if` between node and the loop looks like a
+    periodic/metrics-flush gate: a modulo or floor-division in the test, or
+    a guard name like log_every."""
+    extra = [re.compile(p) for p in config.sync_allowed_guards]
+    for anc in module.ancestors(node):
+        if anc is loop:
+            break
+        if isinstance(anc, ast.If):
+            for sub in ast.walk(anc.test):
+                if isinstance(sub, ast.BinOp) and isinstance(
+                        sub.op, (ast.Mod, ast.FloorDiv)):
+                    return True
+                if isinstance(sub, ast.Name):
+                    if _GUARD_NAMES.search(sub.id) or any(
+                            p.search(sub.id) for p in extra):
+                        return True
+                if isinstance(sub, ast.Attribute):
+                    if _GUARD_NAMES.search(sub.attr) or any(
+                            p.search(sub.attr) for p in extra):
+                        return True
+    return False
+
+
+def check_sync001(module: Module, index: ProjectIndex,
+                  config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for loop in ast.walk(module.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # only the OUTERMOST hot loop reports, so nested loops don't double up
+        if any(isinstance(a, (ast.For, ast.While)) and _is_hot_loop(a, config)
+               for a in module.ancestors(loop)):
+            continue
+        if not _is_hot_loop(loop, config):
+            continue
+        for node in _loop_statements(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_call_kind(node, module)
+            if not kind:
+                continue
+            if _in_flush_guard(module, node, loop, config):
+                continue
+            f = module.finding(
+                node, "SYNC001", SEVERITY_WARNING,
+                f"{kind} inside a training loop blocks the host on the "
+                f"device every step, serializing dispatch with compute — "
+                f"keep metrics as device arrays and fetch them at epoch end "
+                f"or under a periodic `step % log_every` guard "
+                f"(core/trainer.py:train_epoch is the pattern)")
+            if f:
+                findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# traced-function discovery (shared by EFF001 / TRC001)
+# ---------------------------------------------------------------------------
+
+TRACE_FNS = JIT_FNS | {
+    "jax.grad", "jax.value_and_grad", "jax.jacfwd", "jax.jacrev",
+    "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.map", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+}
+
+
+def _find_local_def(module: Module, call: ast.AST,
+                    name: str) -> Optional[ast.AST]:
+    """FunctionDef named `name` in the scope chain enclosing `call`."""
+    scope = module.enclosing_scope(call)
+    while True:
+        for node in walk_scope(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                return node
+        if isinstance(scope, ast.Module):
+            return None
+        scope = module.enclosing_scope(scope)
+
+
+def traced_functions(module: Module) -> Set[ast.AST]:
+    """Function defs (and lambdas) that are traced: passed to a
+    jit/grad/vmap/scan/shard_map/pallas_call in this module, or decorated
+    with one (incl. `functools.partial(jax.jit, ...)`)."""
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and module.resolve(node.func) in TRACE_FNS:
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    fd = _find_local_def(module, node, arg.id)
+                    if fd is not None:
+                        traced.add(fd)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    if module.resolve(dec.func) == "functools.partial" \
+                            and dec.args:
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if module.resolve(target) in TRACE_FNS:
+                    traced.add(node)
+    return traced
+
+
+def _traced_closure(module: Module, traced: Set[ast.AST]) -> Set[ast.AST]:
+    """Traced defs plus every function nested inside one (their bodies all
+    run under the same trace)."""
+    out = set(traced)
+    for fn in traced:
+        for node in ast.walk(fn):
+            if isinstance(node, SCOPE_TYPES):
+                out.add(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# EFF001 — side effects under trace
+# ---------------------------------------------------------------------------
+
+def check_eff001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    closure = _traced_closure(module, traced_functions(module))
+    seen: Set[int] = set()
+    for fn in closure:
+        for node in walk_scope(fn):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            msg = None
+            if isinstance(node, ast.Global):
+                msg = ("`global` mutation inside a traced function runs at "
+                       "trace time only — it will NOT re-run per step once "
+                       "compiled; thread state through the function's "
+                       "arguments/outputs instead")
+            elif isinstance(node, ast.Call):
+                resolved = module.resolve(node.func)
+                if isinstance(node.func, ast.Name) and node.func.id == "print":
+                    msg = ("print() under trace fires once at trace time, "
+                           "then never again — use jax.debug.print for "
+                           "runtime values")
+                elif resolved and resolved.startswith("time.") \
+                        and resolved.split(".", 1)[1] in (
+                            "time", "perf_counter", "monotonic",
+                            "process_time", "sleep"):
+                    msg = (f"{resolved}() under trace is evaluated once at "
+                           f"trace time and baked into the compiled program "
+                           f"as a constant — time OUTSIDE the jitted "
+                           f"function (after jax.block_until_ready)")
+                elif resolved and resolved.startswith("numpy.random."):
+                    msg = (f"{resolved}() under trace draws host randomness "
+                           f"ONCE and bakes it in as a constant — every "
+                           f"compiled step reuses the same values; use "
+                           f"jax.random with a threaded key")
+                elif resolved and resolved.startswith("random.") \
+                        and "random" in module.import_roots:
+                    msg = (f"{resolved}() under trace is trace-time host "
+                           f"randomness baked in as a constant — use "
+                           f"jax.random with a threaded key")
+            if msg:
+                f = module.finding(node, "EFF001", SEVERITY_WARNING, msg)
+                if f:
+                    findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# TRC001 — concrete boolean on a likely tracer
+# ---------------------------------------------------------------------------
+
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+              "is_fully_replicated"}
+SAFE_CALLS = {"isinstance", "len", "hasattr", "type", "callable", "id",
+              "getattr", "repr", "str"}
+
+
+def _unsafe_tracer_use(module: Module, name: ast.AST,
+                       root: ast.AST) -> bool:
+    """Climb from a tainted Name toward `root`: uses that stay static at
+    trace time (shape/dtype inspection, isinstance, `is None`) are safe;
+    anything that produces a value dependent on the tracer's DATA is not."""
+    cur = name
+    while cur is not root:
+        parent = module.parent(cur)
+        if parent is None:
+            break
+        if isinstance(parent, ast.Attribute) and parent.value is cur \
+                and parent.attr in SAFE_ATTRS:
+            return False
+        if isinstance(parent, ast.Call):
+            in_args = cur in parent.args or any(
+                kw.value is cur for kw in parent.keywords)
+            if in_args:
+                fn = terminal_name(parent.func)
+                return fn not in SAFE_CALLS
+            if cur is parent.func:
+                return True  # calling a tracer-valued thing -> tracer result
+        if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            return False
+        cur = parent
+    return True
+
+
+def _expr_tainted(module: Module, expr: ast.AST, tainted: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in tainted \
+                and isinstance(node.ctx, ast.Load):
+            if _unsafe_tracer_use(module, node, expr):
+                return True
+    return False
+
+
+def _check_traced_fn(module: Module, fn: ast.AST,
+                     findings: List[Finding]) -> None:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return
+    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    tainted: Set[str] = set(params)
+    if args.vararg:
+        tainted.add(args.vararg.arg)
+
+    def visit(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, SCOPE_TYPES):
+                continue  # nested defs get their own _check_traced_fn pass
+            if isinstance(stmt, ast.Assign):
+                hot = _expr_tainted(module, stmt.value, tainted)
+                for t in stmt.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            (tainted.add if hot
+                             else tainted.discard)(sub.id)
+            elif isinstance(stmt, ast.AugAssign):
+                if isinstance(stmt.target, ast.Name) \
+                        and _expr_tainted(module, stmt.value, tainted):
+                    tainted.add(stmt.target.id)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                if _expr_tainted(module, stmt.test, tainted):
+                    kind = "while" if isinstance(stmt, ast.While) else "if"
+                    f = module.finding(
+                        stmt, "TRC001", SEVERITY_ERROR,
+                        f"`{kind}` on a value derived from a traced "
+                        f"function's arguments: under jit this is a tracer, "
+                        f"and bool(tracer) raises TracerBoolConversionError "
+                        f"(or silently freezes the branch with "
+                        f"static_argnums) — use jax.numpy.where / "
+                        f"jax.lax.cond / jax.lax.select instead")
+                    if f:
+                        findings.append(f)
+                visit(stmt.body)
+                visit(getattr(stmt, "orelse", []))
+                continue
+            elif isinstance(stmt, ast.For):
+                if isinstance(stmt.target, ast.Name):
+                    (tainted.add if _expr_tainted(module, stmt.iter, tainted)
+                     else tainted.discard)(stmt.target.id)
+                visit(stmt.body)
+                visit(stmt.orelse)
+                continue
+            elif isinstance(stmt, (ast.With, ast.Try)):
+                for field in ("body", "orelse", "finalbody"):
+                    visit(getattr(stmt, field, []))
+                for handler in getattr(stmt, "handlers", []):
+                    visit(handler.body)
+                continue
+
+    body = fn.body if isinstance(fn.body, list) else []  # Lambda: no stmts
+    visit(body)
+
+
+def check_trc001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _traced_closure(module, traced_functions(module)):
+        if isinstance(fn, ast.Lambda):
+            continue  # a lambda body has no if/while statements
+        _check_traced_fn(module, fn, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+ALL_RULES = {
+    "DON001": (SEVERITY_ERROR, check_don001,
+               "argument read again after being passed to a "
+               "donate_argnums-jitted callable"),
+    "JIT001": (SEVERITY_ERROR, check_jit001,
+               "jax.jit built inside a loop or invoked immediately "
+               "(per-call retrace)"),
+    "SYNC001": (SEVERITY_WARNING, check_sync001,
+                "host synchronization (.item()/float()/np.asarray/"
+                "jax.device_get) inside a hot training loop"),
+    "EFF001": (SEVERITY_WARNING, check_eff001,
+               "host side effect (print/time/np.random/global) inside a "
+               "traced function"),
+    "TRC001": (SEVERITY_ERROR, check_trc001,
+               "Python bool of a tracer-derived value (if/while under "
+               "trace)"),
+}

@@ -1,0 +1,10 @@
+"""DON001 near miss: the donated argument is rebound to the result before
+any further read — the canonical `state = step(state, ...)` training loop."""
+import jax
+
+
+def train(state, batches):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    for batch in batches:
+        state = step(state, batch)
+    return state

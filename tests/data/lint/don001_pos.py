@@ -1,0 +1,13 @@
+"""DON001 true positive: the donated argument is read after the call.
+
+`donate_argnums=(0,)` hands `state`'s buffers to XLA for reuse; the
+`state.mean()` afterwards reads freed memory (the PR 1 checkpoint bug
+class: async saves serializing donated buffers).
+"""
+import jax
+
+
+def train(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return new_state + state.mean()

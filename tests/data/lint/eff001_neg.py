@@ -1,0 +1,19 @@
+"""EFF001 near miss: jax.debug.print is the trace-safe print, and host
+timing is fine OUTSIDE the traced function (around block_until_ready)."""
+import time
+
+import jax
+
+
+def make_step():
+    def step(x):
+        jax.debug.print("step on {x}", x=x)
+        return x * 2
+
+    return jax.jit(step)
+
+
+def bench(step, x):
+    t0 = time.time()
+    jax.block_until_ready(step(x))
+    return time.time() - t0

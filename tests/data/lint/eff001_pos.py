@@ -1,0 +1,14 @@
+"""EFF001 true positive: print() and time.time() inside a jitted function
+run once at trace time and never again in the compiled program."""
+import time
+
+import jax
+
+
+def make_step():
+    def step(x):
+        print("step on", x)
+        t0 = time.time()
+        return x * t0
+
+    return jax.jit(step)

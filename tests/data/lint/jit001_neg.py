@@ -1,0 +1,15 @@
+"""JIT001 near miss: jit built once in a factory (a function body, but at
+setup time) and the compiled callable reused across the loop."""
+import jax
+
+
+def make_step():
+    def step(x):
+        return x * 2
+
+    return jax.jit(step)
+
+
+def train(batches):
+    step = make_step()
+    return [step(b) for b in batches]

@@ -1,0 +1,10 @@
+"""JIT001 true positive: `jax.jit` rebuilt (and hence retraced) on every
+loop iteration instead of once at setup."""
+import jax
+
+
+def train(batches):
+    out = []
+    for batch in batches:
+        out.append(jax.jit(lambda x: x * 2)(batch))
+    return out

@@ -1,0 +1,10 @@
+"""Inline suppression: a would-be DON001 acknowledged with a justification
+comment — the linter must stay silent here."""
+import jax
+
+
+def train(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    new_state = step(state, batch)
+    # reading `state` here is part of this fixture's contract
+    return new_state + state.mean()  # jaxlint: disable=DON001

@@ -1,0 +1,11 @@
+"""SYNC001 near miss: the only host sync sits under a periodic
+`i % log_every` flush guard — the allowed metrics-flush pattern."""
+
+
+def fit(train_step, state, batches, log_every=100):
+    last = None
+    for i, batch in enumerate(batches):
+        state, metrics = train_step(state, batch)
+        if i % log_every == 0:
+            last = float(metrics["loss"])
+    return state, last

@@ -1,0 +1,10 @@
+"""SYNC001 true positive: `float(...)` on a step output inside the training
+loop blocks the host on the device every iteration."""
+
+
+def fit(train_step, state, batches):
+    losses = []
+    for batch in batches:
+        state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return state, losses

@@ -1,0 +1,13 @@
+"""TRC001 true positive: `if` on a value computed from a jitted function's
+argument — a tracer bool, which raises at trace time."""
+import jax
+
+
+def make_step():
+    def step(x):
+        y = x - x.mean()
+        if y.sum() > 0:
+            return y
+        return -y
+
+    return jax.jit(step)

@@ -1,0 +1,200 @@
+"""jaxlint (deepvision_tpu/lint): fixture corpus + self-clean + CLI contract.
+
+Pure host-side tests — the linter is stdlib-only and never imports jax, so
+this file runs in milliseconds and carries no XLA compile cost.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from deepvision_tpu.lint import ALL_RULES, Config, lint_paths
+from deepvision_tpu.lint.cli import EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE, main
+from deepvision_tpu.lint.framework import parse_tool_section
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DATA = os.path.join(HERE, "data", "lint")
+
+
+def rules_in(*names):
+    paths = [os.path.join(DATA, n) for n in names]
+    return {f.rule for f in lint_paths(paths, config=Config())}
+
+
+# -- the per-rule fixture corpus --------------------------------------------
+
+@pytest.mark.parametrize("rule,pos,neg", [
+    ("DON001", "don001_pos.py", "don001_neg.py"),
+    ("JIT001", "jit001_pos.py", "jit001_neg.py"),
+    ("SYNC001", "sync001_pos.py", "sync001_neg.py"),
+    ("EFF001", "eff001_pos.py", "eff001_neg.py"),
+    ("TRC001", "trc001_pos.py", "trc001_neg.py"),
+])
+def test_rule_fires_on_positive_and_not_on_near_miss(rule, pos, neg):
+    assert rule in rules_in(pos), f"{rule} must fire on {pos}"
+    assert rules_in(neg) == set(), f"{neg} must stay clean"
+
+
+def test_don001_through_factory_and_attr_idiom():
+    """The repo's real step-building idiom: conditional jit_kwargs dict in a
+    make_* factory, bound to self.train_step, donated state read later."""
+    findings = lint_paths([os.path.join(DATA, "don001_factory_pos.py")],
+                          config=Config())
+    assert [f.rule for f in findings] == ["DON001"]
+    assert "self.state" in findings[0].message
+
+
+def test_inline_suppression():
+    assert rules_in("suppress.py") == set()
+
+
+def test_fixture_corpus_is_complete():
+    """Every rule in the registry has a pos/neg fixture pair on disk."""
+    have = set(os.listdir(DATA))
+    for rule in ALL_RULES:
+        stem = rule.lower()
+        assert f"{stem}_pos.py" in have and f"{stem}_neg.py" in have
+
+
+# -- self-clean: the linter's own verdict on the tree it ships in -----------
+
+def test_tree_is_clean():
+    """`python -m deepvision_tpu.lint deepvision_tpu tools` exits 0 — every
+    true positive was fixed and every deliberate exception suppressed with a
+    justification (docs/LINTING.md)."""
+    findings = lint_paths([os.path.join(REPO, "deepvision_tpu"),
+                           os.path.join(REPO, "tools")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_donation_index_sees_the_real_factories():
+    """Guards against the self-clean test passing vacuously: the donation
+    pass must resolve the per-family step factories and the trainer attrs
+    they're bound to, or DON001 has nothing to check."""
+    from deepvision_tpu.lint.cli import collect_files
+    from deepvision_tpu.lint.donation import ProjectIndex
+    from deepvision_tpu.lint.framework import Module
+    files = collect_files([os.path.join(REPO, "deepvision_tpu")], Config(),
+                          REPO)
+    idx = ProjectIndex().build([Module.from_path(f) for f in files])
+    for factory in ("make_classification_train_step", "make_yolo_train_step",
+                    "make_centernet_train_step", "make_pose_train_step",
+                    "make_dcgan_train_step", "make_multistep_train_step",
+                    "make_ema_update", "make_shardmap_yolo_train_step"):
+        assert factory in idx.factories, factory
+        assert 0 in idx.factories[factory].argnums
+    assert idx.factories["make_dcgan_train_step"].argnums == (0, 1)
+    assert 0 in idx.class_attrs["Trainer"]["train_step"].argnums
+    assert 0 in idx.class_attrs["CycleGANTrainer"]["gen_step"].argnums
+
+
+def test_planted_bug_in_real_trainer_is_caught(tmp_path):
+    """Mutation check: re-introducing the PR 1 bug class (reading self.state
+    after donating it to self.train_step) must trip DON001."""
+    src = textwrap.dedent("""\
+        import jax
+
+
+        def make_train_step(donate=True):
+            def step(state, batch):
+                return state + batch, {"loss": batch}
+            jit_kwargs = {}
+            if donate:
+                jit_kwargs["donate_argnums"] = (0,)
+            return jax.jit(step, **jit_kwargs)
+
+
+        class Trainer:
+            def __init__(self):
+                self._step_factory = lambda m: make_train_step()
+                self.train_step = self._step_factory(None)
+                self.state = 0
+
+            def train_epoch(self, batches):
+                for batch in batches:
+                    new_state, m = self.train_step(self.state, batch)
+                    leaves = jax.tree_util.tree_leaves(self.state)
+                    self.state = new_state
+                return leaves
+        """)
+    p = tmp_path / "mutant.py"
+    p.write_text(src)
+    findings = lint_paths([str(p)], config=Config())
+    assert [f.rule for f in findings] == ["DON001"]
+
+
+# -- CLI contract: exit codes, json, config ---------------------------------
+
+def test_cli_exit_codes(capsys):
+    assert main([os.path.join(DATA, "don001_pos.py")]) == EXIT_FINDINGS
+    assert main([os.path.join(DATA, "don001_neg.py")]) == EXIT_CLEAN
+    assert main([]) == EXIT_USAGE
+    assert main(["/no/such/path.py"]) == EXIT_USAGE
+    assert main(["--select", "NOPE", os.path.join(DATA, "suppress.py")]) \
+        == EXIT_USAGE
+    capsys.readouterr()
+
+
+def test_cli_json_format(capsys):
+    rc = main(["--format", "json", os.path.join(DATA, "sync001_pos.py")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_FINDINGS
+    assert out["summary"]["by_rule"] == {"SYNC001": 1}
+    (finding,) = out["findings"]
+    assert finding["rule"] == "SYNC001" and finding["line"] == 9
+    assert finding["severity"] == "warning"
+
+
+def test_cli_select(capsys):
+    # the DON001 file stays clean when only JIT001 is selected
+    rc = main(["--select", "JIT001", os.path.join(DATA, "don001_pos.py")])
+    assert rc == EXIT_CLEAN
+    capsys.readouterr()
+
+
+def test_syntax_error_is_a_finding(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == EXIT_FINDINGS and "SYNTAX" in out
+
+
+def test_pyproject_excludes_and_disable(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    vendored = pkg / "vendored"
+    vendored.mkdir(parents=True)
+    hazard = ("import jax\n\n\n"
+              "def f(s, b):\n"
+              "    step = jax.jit(lambda x, y: x, donate_argnums=(0,))\n"
+              "    out = step(s, b)\n"
+              "    return out + s\n")
+    (pkg / "mod.py").write_text(hazard)
+    (vendored / "third_party.py").write_text(hazard)
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.other]\nx = 1\n\n"
+        "[tool.jaxlint]\n"
+        'exclude = [\n    "pkg/vendored",\n]\n')
+    findings = lint_paths([str(pkg)])
+    assert {os.path.basename(f.path) for f in findings} == {"mod.py"}
+
+    # disable kills the rule project-wide
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.jaxlint]\ndisable = ["DON001"]\n')
+    assert lint_paths([str(pkg)]) == []
+
+
+def test_toml_subset_parser():
+    section = parse_tool_section(
+        '[tool.jaxlint]\n'
+        'exclude = ["a", "b/c"]  # trailing comment\n'
+        'disable = [\n  "DON001",\n  "JIT001",\n]\n'
+        'flag = true\n'
+        'n = 3\n'
+        '[tool.other]\nexclude = ["not-ours"]\n')
+    assert section["exclude"] == ["a", "b/c"]
+    assert section["disable"] == ["DON001", "JIT001"]
+    assert section["flag"] is True and section["n"] == 3

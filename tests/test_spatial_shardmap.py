@@ -50,6 +50,8 @@ class TestGeometry:
                           axis_names={"data", "spatial"}, check_vma=False)
         x = jnp.broadcast_to(jnp.arange(4.0)[None, :, None], (2, 4, 1))
         xs = jax.device_put(x, NamedSharding(mesh, P("data", "spatial")))
+        # one-shot jit-and-call: compiles exactly once in this test
+        # jaxlint: disable=JIT001
         out = np.asarray(jax.jit(f)(xs))[0, :, 0]
         # shard0 rows: [fill, 0, 1, halo=2]; shard1: [halo=1, 2, 3, fill]
         assert out.tolist() == [-7.0, 0.0, 1.0, 2.0, 1.0, 2.0, 3.0, -7.0]
@@ -103,6 +105,8 @@ def test_forward_parity_spatial_shardmap(setup):
                       check_vma=False)
     xs = jax.device_put(jnp.asarray(images),
                         NamedSharding(mesh, P("data", "spatial")))
+    # one-shot jit-and-call: compiles exactly once in this test
+    # jaxlint: disable=JIT001
     out, new_bs = jax.jit(f)(params, bstats, xs)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     for a, b in zip(jax.tree_util.tree_leaves(new_bs),

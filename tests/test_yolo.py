@@ -204,6 +204,8 @@ def test_yolo_loss_near_zero_for_perfect_prediction():
     gt_valid = jnp.asarray(valid)[None]
     grids = (8, 4, 2)
     y_trues = _jit_encode(onehot, gt_boxes, gt_valid, grids)
+    # three one-shot compiles (one per scale) — test-scale, not a hot path
+    # jaxlint: disable=JIT001
     y_preds = [jax.jit(_perfect_pred)(y_trues[i], ANCHORS_WH[3 * i:3 * i + 3])
                for i in range(3)]
     comp = _jit_loss(y_trues, tuple(y_preds), gt_boxes, gt_valid, num_classes)

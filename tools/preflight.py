@@ -7,6 +7,9 @@
 
 Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
 
+  lint        jaxlint static analysis over the framework + tools
+              (docs/LINTING.md): a donation-aliasing or host-sync hazard
+              must stop a launch BEFORE it burns pod-hours
   devices     backend reachable, device count/platform, mesh construction
   input       host tf.data throughput (real TFRecords when --data-dir is
               given, synthetic JPEG shards otherwise) vs --input-floor
@@ -58,6 +61,24 @@ def check(name: str):
             return ok
         return run
     return deco
+
+
+@check("lint")
+def check_lint(args):
+    # stdlib-only and jax-free, so it runs in milliseconds before any
+    # backend/device work — a dirty tree fails fastest
+    from deepvision_tpu.lint import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [os.path.join(repo, "deepvision_tpu"),
+               os.path.join(repo, "tools")]
+    findings = lint_paths(targets)
+    if findings:
+        head = "; ".join(f.format() for f in findings[:3])
+        raise RuntimeError(
+            f"{len(findings)} jaxlint finding(s) — fix or `# jaxlint: "
+            f"disable=RULE` with a justification before launching: {head}")
+    return "jaxlint clean (deepvision_tpu, tools)"
 
 
 @check("devices")
@@ -267,6 +288,7 @@ def main(argv=None):
             platform = "none"
         args.image_size = 224 if platform == "tpu" else 64
 
+    check_lint(args)
     check_devices(args)
     check_input(args)
     check_step(args)
