@@ -9,7 +9,8 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_spatial.py tests/test_spatial_shardmap.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
-.PHONY: test test-all verify bench dryrun smoke preflight preflight-record lint
+.PHONY: test test-all verify bench bench-serve dryrun smoke serve-smoke \
+        preflight preflight-record lint
 
 lint:        ## jaxlint: donation-aliasing / retrace / host-sync / trace
 	## hazards (docs/LINTING.md) over the framework, the tools, and the
@@ -48,6 +49,15 @@ verify:      ## the heavy correctness evidence the default lane skips
 
 bench:       ## ResNet-50 step throughput (TPU if reachable, else CPU)
 	$(PY) bench.py
+
+bench-serve: ## dynamic-batching serving throughput + latency vs the naive
+	## per-request dispatch loop (one JSON line; docs/SERVING.md)
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py
+
+serve-smoke: ## serving-stack smoke: bucketed AOT cache, micro-batcher,
+	## metrics, graceful drain — synthetic load, exit 0 on pass
+	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve -m lenet5 --smoke \
+	    --duration 2
 
 dryrun:      ## 8-virtual-device multichip compile/exec check
 	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

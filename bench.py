@@ -53,8 +53,12 @@ def worker() -> None:
     import numpy as np
 
     # persistent XLA cache: retried workers (and re-benches after a tunnel
-    # flake) skip the 20-40s TPU / minutes-long CPU first compile
-    from deepvision_tpu.cli import setup_compilation_cache
+    # flake) skip the 20-40s TPU / minutes-long CPU first compile. The
+    # hit/miss counts land in the printed record so a bench attempt that
+    # re-paid compile time says so (cache moved/disabled reads identically
+    # to "slow chip" otherwise).
+    from deepvision_tpu.cli import (compilation_cache_stats,
+                                    setup_compilation_cache)
     setup_compilation_cache()
 
     from deepvision_tpu.core import steps
@@ -180,6 +184,9 @@ def worker() -> None:
         "device_kind": jax.devices()[0].device_kind,
         "jax_version": jax.__version__,
         "timed_steps": n_steps,
+        # persistent-cache accounting for THIS worker run (hits mean the
+        # warmup compile above was served from disk, not re-paid)
+        "compile_cache": compilation_cache_stats(),
     }))
 
 
@@ -241,6 +248,9 @@ def _save_cache(rec: dict) -> None:
     # duplicated state): their presence there is what _load_cache trusts,
     # and a hand-seeded entry can't fabricate them plausibly
     rec = dict(rec)
+    # per-run compile-cache accounting is meaningless replayed as a stale
+    # record — drop it from the committed cache
+    rec.pop("compile_cache", None)
     rec["cache_written_by"] = {
         "program": "bench.py",
         "jax_version": rec.pop("jax_version", "unknown"),

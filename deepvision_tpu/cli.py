@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
+import sys
 from typing import Callable, Optional, Sequence, Tuple
 
 from .configs import CONFIGS, get_config
@@ -154,6 +155,61 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     return p
 
 
+# -- persistent-compile-cache accounting --------------------------------------
+# jax emits monitoring events per compile when the persistent cache is
+# consulted (/jax/compilation_cache/compile_requests_use_cache), per hit
+# (.../cache_hits) and a compile_time_saved_sec duration per hit. Counting
+# them makes repeat runs SAY whether they re-paid compile time — a silent
+# cache regression (moved dir, changed key) otherwise just reads as "the TPU
+# felt slow today" (the bench-attempt lesson this satellite exists for).
+_cache_counts = {"requests": 0, "hits": 0, "saved_s": 0.0}
+_cache_hooks_installed = False
+
+
+def install_cache_stats_hooks() -> None:
+    """Idempotently register the monitoring listeners behind
+    `compilation_cache_stats` and an at-exit one-line report (stderr, only
+    when at least one cache-consulting compile happened)."""
+    global _cache_hooks_installed
+    if _cache_hooks_installed:
+        return
+    _cache_hooks_installed = True
+    import atexit
+
+    from jax import monitoring
+
+    def _on_event(event, **kw):
+        if event == "/jax/compilation_cache/compile_requests_use_cache":
+            _cache_counts["requests"] += 1
+        elif event == "/jax/compilation_cache/cache_hits":
+            _cache_counts["hits"] += 1
+
+    def _on_duration(event, duration, **kw):
+        if event == "/jax/compilation_cache/compile_time_saved_sec":
+            _cache_counts["saved_s"] += duration
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    atexit.register(_report_cache_stats)
+
+
+def compilation_cache_stats() -> dict:
+    """{'hits','misses','time_saved_s'} since the hooks went in. A "miss" is
+    a cache-consulting compile that found no entry — including compiles under
+    the persistence threshold (they consult, miss, and are not written)."""
+    h, r = _cache_counts["hits"], _cache_counts["requests"]
+    return {"hits": h, "misses": max(0, r - h),
+            "time_saved_s": round(_cache_counts["saved_s"], 2)}
+
+
+def _report_cache_stats() -> None:
+    s = compilation_cache_stats()
+    if s["hits"] or s["misses"]:
+        print(f"[compile-cache] hits={s['hits']} misses={s['misses']} "
+              f"compile_time_saved={s['time_saved_s']}s", file=sys.stderr,
+              flush=True)
+
+
 def setup_compilation_cache(arg: str = None) -> None:
     """Point JAX's persistent compilation cache at a durable directory, so a
     relaunched process (auto-resume after preemption — SURVEY.md §5.3 — or a
@@ -200,6 +256,7 @@ def setup_compilation_cache(arg: str = None) -> None:
         "jax_persistent_cache_min_compile_time_secs",
         float(os.environ.get("DEEPVISION_CACHE_MIN_COMPILE_SECS", "1.0")))
     _reset_singleton()
+    install_cache_stats_hooks()
 
 
 def _tfrecord_data(build_dataset: Callable, cfg, args, default_dir: str,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -45,6 +46,11 @@ class MetricsLogger:
         self.log_dir = log_dir
         self.name = name
         self.history: Dict[str, Dict[str, list]] = {}
+        # one lock keeps interleaved JSONL lines whole: the trainers log from
+        # the fit thread (plus retry hooks off the prefetch producer), and
+        # the serving stack (serve/) flushes from its lifecycle thread while
+        # request threads read history
+        self._lock = threading.Lock()
         self._jsonl = None
         self._tb = None
         self._tb_pending = bool(log_dir) and tensorboard  # created on first log
@@ -75,40 +81,44 @@ class MetricsLogger:
     def log(self, step: int, metrics: Dict[str, float], epoch: Optional[int] = None,
             prefix: str = "", echo: bool = True):
         metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
-        for k, v in metrics.items():
-            h = self.history.setdefault(prefix + k, {"epochs": [], "value": []})
-            h["epochs"].append(epoch if epoch is not None else step)
-            h["value"].append(v)
-        rec = {"step": step, "epoch": epoch, "t": round(time.time() - self._t0, 3),
-               **{prefix + k: round(v, 6) for k, v in metrics.items()}}
-        if self._jsonl:
-            # json.dumps would emit bare NaN/Infinity tokens for non-finite
-            # values (invalid JSON — jq/pandas choke on exactly the diverged-
-            # epoch forensics lines); serialize them as strings instead
-            safe = {k: (v if not isinstance(v, float) or np.isfinite(v)
-                        else str(v))
-                    for k, v in rec.items()}
-            self._jsonl.write(json.dumps(safe, allow_nan=False) + "\n")
-            self._jsonl.flush()
-        if self._tb_pending:  # lazy: inference-only runs never pay the TF cost
-            self._tb_pending = False
-            self._tb = _make_tb_writer(os.path.join(self.log_dir, "tb",
-                                                    self.name))
-        if self._tb is not None:
-            with self._tb.as_default():
-                import tensorflow as tf
-                for k, v in metrics.items():
-                    tf.summary.scalar(prefix + k, v, step=step)
+        with self._lock:
+            for k, v in metrics.items():
+                h = self.history.setdefault(prefix + k, {"epochs": [], "value": []})
+                h["epochs"].append(epoch if epoch is not None else step)
+                h["value"].append(v)
+            rec = {"step": step, "epoch": epoch, "t": round(time.time() - self._t0, 3),
+                   **{prefix + k: round(v, 6) for k, v in metrics.items()}}
+            if self._jsonl:
+                # json.dumps would emit bare NaN/Infinity tokens for non-finite
+                # values (invalid JSON — jq/pandas choke on exactly the diverged-
+                # epoch forensics lines); serialize them as strings instead
+                safe = {k: (v if not isinstance(v, float) or np.isfinite(v)
+                            else str(v))
+                        for k, v in rec.items()}
+                self._jsonl.write(json.dumps(safe, allow_nan=False) + "\n")
+                self._jsonl.flush()
+            if self._tb_pending:  # lazy: inference-only runs never pay the TF cost
+                self._tb_pending = False
+                self._tb = _make_tb_writer(os.path.join(self.log_dir, "tb",
+                                                        self.name))
+            if self._tb is not None:
+                with self._tb.as_default():
+                    import tensorflow as tf
+                    for k, v in metrics.items():
+                        tf.summary.scalar(prefix + k, v, step=step)
         if echo:
             body = " ".join(f"{prefix + k}={v:.4f}" for k, v in metrics.items())
             ep = f"epoch {epoch} " if epoch is not None else ""
             print(f"[{self.name}] {ep}step {step}: {body}", flush=True)
 
     def close(self):
-        if self._jsonl:
-            self._jsonl.close()
-        if self._tb is not None:
-            self._tb.close()
+        with self._lock:
+            if self._jsonl:
+                self._jsonl.close()
+                self._jsonl = None
+            if self._tb is not None:
+                self._tb.close()
+                self._tb = None
 
 
 def _make_tb_writer(path: str):

@@ -127,7 +127,8 @@ class PreemptionExit(Exception):
 
 
 class GracefulShutdown:
-    """SIGTERM/SIGINT → a polled flag, installed for the duration of fit().
+    """SIGTERM/SIGINT → a polled flag, installed for the duration of fit()
+    (or a serving lifetime, serve/server.py).
 
     The step loop checks `requested` between host dispatches: the in-flight
     step finishes, the trainer commits a synchronous checkpoint, and the
@@ -136,14 +137,25 @@ class GracefulShutdown:
     previous handlers and re-raises, so a stuck shutdown stays killable with
     plain Ctrl-C Ctrl-C. Signal handlers only exist on the main thread;
     elsewhere (library use under a thread pool) this degrades to an inert
-    flag that is never set."""
+    flag that is never set.
+
+    `on_signal` (optional) fires once, after the flag is set, so loops that
+    WAIT rather than poll (the inference server's flush loop) can be woken
+    immediately — pass something async-signal-safe like `Event.set`.
+    `what` customizes the one-line announcement: the serving drain says
+    "finishing in-flight batches, rejecting new work" instead of the
+    trainer's checkpoint-commit contract."""
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self):
+    def __init__(self, on_signal: Optional[Callable[[], None]] = None,
+                 what: str = "finishing the in-flight step, committing a "
+                             "checkpoint, then exiting 0"):
         self.requested = False
         self._signum = None
         self._previous = {}
+        self._on_signal = on_signal
+        self._what = what
 
     def _handler(self, signum, frame):
         if self.requested:  # second signal: get out of the way
@@ -151,10 +163,14 @@ class GracefulShutdown:
             raise KeyboardInterrupt
         self.requested = True
         self._signum = signum
-        print(f"[resilience] caught {signal.Signals(signum).name}: finishing "
-              f"the in-flight step, committing a checkpoint, then exiting 0 "
-              f"(signal again to abort immediately)",
+        print(f"[resilience] caught {signal.Signals(signum).name}: "
+              f"{self._what} (signal again to abort immediately)",
               file=sys.stderr, flush=True)
+        if self._on_signal is not None:
+            try:
+                self._on_signal()
+            except Exception:  # noqa: BLE001 — a handler must never throw
+                pass
 
     def __enter__(self) -> "GracefulShutdown":
         try:

@@ -602,12 +602,21 @@ class Trainer:
             if (consumed // log_every > prev // log_every
                     and _is_main_process()):
                 # JSONL/TB writes are process-0-only, like checkpoints
-                # (SURVEY.md §5.8) — other hosts skip the device_get too
-                pending.append((step0 + consumed, metrics))
+                # (SURVEY.md §5.8) — other hosts skip the device_get too.
+                # The prefetch queue depth is sampled NOW (host-side int, no
+                # sync — the same value the watchdog dumps on a stall):
+                # depth 0 at the flush cadence means the input pipeline is
+                # starving the step loop, visible in logs instead of only
+                # in post-mortem stall dumps.
+                pf = self._prefetcher
+                pending.append((step0 + consumed, metrics,
+                                pf.queue_depth if pf is not None else 0))
                 if len(pending) > 1:
-                    s, m = pending.pop(0)
-                    self.logger.log(s, jax.device_get(m), epoch=epoch,
-                                    prefix="train_", echo=True)
+                    s, m, depth = pending.pop(0)
+                    self.logger.log(
+                        s, {**jax.device_get(m),
+                            "prefetch_queue_depth": depth},
+                        epoch=epoch, prefix="train_", echo=True)
 
         def run_single(batch):
             self.state, metrics = self.train_step(self.state, *batch,
@@ -684,9 +693,10 @@ class Trainer:
             self._prefetcher = None
             staged.close()
         jax.block_until_ready(self.state.params)
-        for s, m in pending:
-            self.logger.log(s, jax.device_get(m), epoch=epoch,
-                            prefix="train_", echo=True)  # main process only
+        for s, m, depth in pending:  # main process only
+            self.logger.log(s, {**jax.device_get(m),
+                                "prefetch_queue_depth": depth},
+                            epoch=epoch, prefix="train_", echo=True)
         dt = time.time() - t0
         if device_metrics:
             # step-weighted mean: a k-step dispatch's entry is already the

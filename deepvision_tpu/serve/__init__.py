@@ -1,0 +1,21 @@
+"""Serving subsystem: dynamic-batching inference over the model zoo.
+
+The training half of the stack (mesh-sharded steps, prefetch overlap,
+resilience) is built; this package is the other half — turning a trained
+checkpoint into something that takes traffic (docs/SERVING.md):
+
+- engine.PredictEngine: shape-bucketed AOT-compiled predict cache
+  (no per-request trace/compile; padding provably inert)
+- batcher.DynamicBatcher: thread-safe micro-batching with deadline +
+  max_batch flush, futures, and example-counted backpressure
+- metrics.ServingMetrics: p50/p99, padding waste, batch fill — flushed on
+  the trainer's MetricsLogger stream
+- server.InferenceServer: stdlib HTTP front-end + graceful SIGTERM drain
+  (core/resilience.GracefulShutdown contract, exit 0)
+- cli: `python -m deepvision_tpu.serve` (HTTP or --smoke)
+"""
+
+from .batcher import Draining, DynamicBatcher, Overloaded, RequestRejected  # noqa: F401
+from .engine import PredictEngine, pick_bucket  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .server import InferenceServer  # noqa: F401

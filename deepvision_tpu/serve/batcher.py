@@ -1,0 +1,208 @@
+"""Dynamic micro-batching: coalesce concurrent requests into one dispatch.
+
+The engine (serve/engine.py) kills retrace and per-shape compile; this
+module kills batch-of-1 utilization. Concurrent `submit()` calls land in a
+thread-safe queue; a single dispatcher thread coalesces them up to
+`max_batch` examples or until the OLDEST request's `max_delay_ms` deadline
+expires — whichever comes first — pads to the nearest bucket, runs one
+device dispatch, and scatters the per-request output slices back through
+`concurrent.futures.Future`s. One device program in flight at a time, by
+construction: the device is the serialization point anyway, and a single
+dispatcher keeps the queue discipline (and the latency accounting) exact.
+
+Backpressure is example-counted: once `max_queue_examples` are pending
+(queued + in the in-flight dispatch), `submit` raises `Overloaded` — load
+sheds at the door (HTTP 429) instead of building an unbounded latency queue.
+`drain()` is the graceful-shutdown half (used by serve/server.py under the
+resilience SIGTERM contract): new work is rejected with `Draining` (503),
+everything already accepted finishes, the dispatcher thread exits.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import List, Optional
+
+import numpy as np
+
+from .engine import PredictEngine, pick_bucket, tree_slice
+
+
+class RequestRejected(RuntimeError):
+    """Base: the request was NOT accepted — nothing partial happened."""
+
+
+class Overloaded(RequestRejected):
+    """Pending examples >= max_queue_examples — shed load upstream (429)."""
+
+
+class Draining(RequestRejected):
+    """Shutting down: in-flight batches finish, new work is rejected (503)."""
+
+
+class _Request:
+    __slots__ = ("images", "n", "future", "t_submit")
+
+    def __init__(self, images: np.ndarray):
+        self.images = images
+        self.n = images.shape[0]
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+def _settle(fut: Future, result=None, exc: Optional[BaseException] = None):
+    """Deliver ignoring client-side cancellation races."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass  # client cancelled/abandoned the future — nothing to deliver
+
+
+class DynamicBatcher:
+    """Thread-safe request queue + single dispatcher thread over an engine.
+
+    `submit(images) -> Future` accepts `(n, *example_shape)` with
+    `1 <= n <= max_batch` (or one bare example); the future resolves to the
+    output pytree sliced to exactly those n rows, in order.
+    """
+
+    def __init__(self, engine: PredictEngine, *,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: float = 5.0,
+                 max_queue_examples: int = 1024,
+                 metrics=None):
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        self.engine = engine
+        self.max_batch = min(int(max_batch or engine.max_batch),
+                             engine.max_batch)
+        self.max_delay = max_delay_ms / 1000.0
+        self.max_queue_examples = int(max_queue_examples)
+        self.metrics = metrics
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._pending = 0          # examples accepted, results not yet set
+        self._draining = False
+        self._carry: Optional[_Request] = None  # overflow of the last batch
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dynamic-batcher")
+        self._thread.start()
+
+    @property
+    def queue_depth(self) -> int:
+        """Examples accepted whose results are not yet delivered (queued +
+        in the in-flight dispatch) — the serving analog of the prefetcher's
+        queue_depth stall diagnostic."""
+        with self._lock:
+            return self._pending
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, images) -> Future:
+        x = self.engine._coerce(images)
+        n = x.shape[0]
+        if n > self.max_batch:
+            raise ValueError(
+                f"request of {n} examples exceeds max_batch="
+                f"{self.max_batch}; split client batches")
+        with self._lock:
+            if self._draining:
+                raise Draining(
+                    "server is draining: in-flight batches are finishing, "
+                    "new work is rejected — retry against another replica")
+            if self._pending + n > self.max_queue_examples:
+                raise Overloaded(
+                    f"queue full ({self._pending} examples pending, cap "
+                    f"{self.max_queue_examples}) — shed load or raise "
+                    f"max_queue_examples")
+            self._pending += n
+        req = _Request(x)
+        self._q.put(req)
+        return req.future
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            first = self._carry
+            self._carry = None
+            if first is None:
+                first = self._q.get()       # idle: block until work or stop
+            if first is None:               # stop sentinel (queue is FIFO:
+                break                       # everything accepted before it
+                                            # has already been dispatched)
+            batch: List[_Request] = [first]
+            total = first.n
+            deadline = first.t_submit + self.max_delay
+            while total < self.max_batch:
+                # Past the deadline, requests ALREADY queued still coalesce
+                # (get_nowait) — only waiting for future arrivals stops.
+                # Blocking-only here is the classic micro-batcher bug: under
+                # backlog the oldest request is always past its deadline, so
+                # every batch degenerates to size 1 exactly when batching
+                # matters most.
+                wait = deadline - time.monotonic()
+                try:
+                    nxt = (self._q.get(timeout=wait) if wait > 0
+                           else self._q.get_nowait())
+                except queue.Empty:
+                    break                   # deadline flush
+                if nxt is None:             # stop observed mid-collect:
+                    self._q.put(None)       # finish this batch, then exit
+                    break
+                if total + nxt.n > self.max_batch:
+                    self._carry = nxt       # first request of the NEXT batch
+                    break                   # max_batch flush
+                batch.append(nxt)
+                total += nxt.n
+            self._dispatch(batch, total)
+
+    def _dispatch(self, batch: List[_Request], total: int) -> None:
+        images = (batch[0].images if len(batch) == 1
+                  else np.concatenate([r.images for r in batch]))
+        t0 = time.monotonic()
+        try:
+            out = self.engine.predict(images)
+        except BaseException as e:  # noqa: BLE001 — must reach the futures,
+            with self._lock:        # not kill the dispatcher thread
+                self._pending -= total
+            for r in batch:
+                _settle(r.future, exc=e)
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._pending -= total
+        lo = 0
+        for r in batch:
+            _settle(r.future, tree_slice(out, lo, lo + r.n))
+            lo += r.n
+        if self.metrics is not None:
+            self.metrics.observe_batch(
+                n_real=total,
+                bucket=pick_bucket(total, self.engine.buckets),
+                dispatch_s=now - t0,
+                request_latencies_s=[now - r.t_submit for r in batch])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Reject new work, finish everything already accepted, stop the
+        dispatcher thread. Idempotent. True once the thread has exited."""
+        with self._lock:
+            self._draining = True
+        self._q.put(None)
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    close = drain

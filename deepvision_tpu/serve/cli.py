@@ -1,0 +1,175 @@
+"""`python -m deepvision_tpu.serve` — the serving entrypoint.
+
+Two modes over the same stack (engine → batcher → metrics → drain):
+
+    # HTTP serving (POST /predict, GET /healthz, GET /stats; SIGTERM drains)
+    python -m deepvision_tpu.serve -m resnet50 --workdir runs/resnet50
+
+    # self-driving synthetic load, one JSON summary line, exit 0
+    python -m deepvision_tpu.serve -m lenet5 --smoke
+
+The smoke mode is the `make serve-smoke` / CI surface: it proves the whole
+path (bucketed AOT compile cache, coalescing, padding, metrics, graceful
+drain) end to end without a client, and SIGTERM mid-smoke exercises the
+drain contract exactly like production (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..core.resilience import GracefulShutdown
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepvision_tpu.serve",
+        description="Dynamic-batching inference server over the model zoo "
+                    "(shape-bucketed AOT predict cache; docs/SERVING.md)")
+    p.add_argument("-m", "--model", default=None,
+                   help="registered config name (see --list-models)")
+    p.add_argument("-c", "--checkpoint", default=None,
+                   help="epoch number or 'latest' (needs --workdir)")
+    p.add_argument("--workdir", default=None,
+                   help="training workdir to restore weights from (EMA "
+                        "weights win when present); omit for random-weight "
+                        "smoke serving")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="serving resolution (default: the config's)")
+    p.add_argument("--buckets", default="1,8,32",
+                   help="comma-separated batch buckets compiled at startup "
+                        "(max-batch is appended; default 1,8,32)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="coalescing cap = largest bucket (default: largest "
+                        "of --buckets)")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="micro-batching deadline: a request waits at most "
+                        "this long for batch-mates (p99 floor; default 5)")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="backpressure: pending-example cap before submits "
+                        "are rejected with 429 (default 1024)")
+    p.add_argument("--port", type=int, default=8700)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--flush-every", type=float, default=10.0,
+                   help="seconds between periodic metric flushes")
+    p.add_argument("--smoke", action="store_true",
+                   help="drive synthetic in-process load instead of HTTP; "
+                        "print one JSON summary line and exit 0")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="--smoke load duration in seconds")
+    p.add_argument("--load-threads", type=int, default=8,
+                   help="--smoke concurrent synthetic clients")
+    p.add_argument("--list-models", action="store_true",
+                   help="list servable registered configs and exit")
+    p.add_argument("--compilation-cache",
+                   default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
+                                          "auto"),
+                   metavar="DIR|off",
+                   help="persistent XLA compilation cache for the bucket "
+                        "compiles (same contract as the training CLI)")
+    return p
+
+
+def _list_models() -> None:
+    from ..configs import CONFIGS
+    for name, cfg in CONFIGS.items():
+        servable = "-" if cfg.family == "gan" else "yes"
+        print(f"{name:24s} family={cfg.family:16s} model={cfg.model:16s} "
+              f"servable={servable}")
+
+
+def _smoke(server, duration: float, n_threads: int) -> dict:
+    """Closed-loop synthetic clients through the batcher; SIGTERM drains
+    early and still exits 0 (the production drain contract, minus HTTP)."""
+    import numpy as np
+
+    from .batcher import RequestRejected
+
+    eng = server.engine
+    stop = threading.Event()
+    errors: list = []
+
+    def client(i: int) -> None:
+        rs = np.random.RandomState(i)
+        n = 1 + i % min(4, eng.max_batch)  # mixed sizes: exercise buckets
+        x = rs.randn(n, *eng.example_shape).astype(eng.input_dtype)
+        while not stop.is_set():
+            try:
+                server.batcher.submit(x).result(timeout=120)
+            except RequestRejected:
+                return  # drain/overload reached this client — done
+            except Exception as e:  # noqa: BLE001 — smoke must report
+                errors.append(e)
+                return
+
+    with GracefulShutdown(on_signal=stop.set,
+                          what="finishing in-flight batches, rejecting new "
+                               "work, then exiting 0") as gs:
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        print(f"[serve:{eng.name}] ready: synthetic load x{n_threads} for "
+              f"{duration:g}s (SIGTERM drains early)", flush=True)
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline and not gs.requested:
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        snap = server.drain()
+    ok = not errors and snap.get("requests", 0) > 0
+    print(json.dumps({
+        "serve_smoke": "pass" if ok else "fail",
+        "model": eng.name,
+        "buckets": list(eng.buckets),
+        **{k: round(float(v), 4) for k, v in snap.items()},
+    }), flush=True)
+    if not ok:
+        raise SystemExit(f"serve smoke failed: {errors[:1]!r}" if errors
+                         else "serve smoke failed: no requests completed")
+    return snap
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_models:
+        _list_models()
+        return 0
+    if not args.model:
+        parser.error("-m/--model is required (see --list-models)")
+
+    from ..cli import setup_compilation_cache
+    setup_compilation_cache(args.compilation_cache)
+
+    from .engine import PredictEngine
+    from .server import InferenceServer
+
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    except ValueError:
+        raise SystemExit(f"--buckets must be comma-separated ints, got "
+                         f"{args.buckets!r}")
+    engine = PredictEngine.from_config(
+        args.model, workdir=args.workdir, checkpoint=args.checkpoint,
+        image_size=args.image_size, buckets=buckets,
+        max_batch=args.max_batch)
+    engine.warmup()
+    server = InferenceServer(
+        engine, max_delay_ms=args.max_delay_ms,
+        max_queue_examples=args.max_queue, workdir=args.workdir,
+        flush_every_s=args.flush_every)
+    try:
+        if args.smoke:
+            _smoke(server, args.duration, args.load_threads)
+        else:
+            server.serve(port=args.port, host=args.host)
+    finally:
+        server.close()
+    return 0

@@ -1,0 +1,266 @@
+"""Shape-bucketed, ahead-of-time-compiled predict engine.
+
+The training side pays trace+compile once and then dispatches one program per
+step; a naive serving loop instead pays per-request dispatch, per-shape
+retrace, and batch-of-1 utilization. This engine removes the first two:
+every registered model's apply fn is wrapped in a predict function that is
+**AOT-compiled once per shape bucket at startup** (`jit(...).lower(...)
+.compile()`, against the persistent XLA compilation cache when one is
+configured — see `cli.setup_compilation_cache`), so no request ever traces
+or compiles. Incoming batches are padded up to the nearest bucket
+({1, 8, 32, max_batch} by default) and the padding rows are stripped from
+the outputs; in inference mode (`train=False`, BatchNorm on running stats)
+rows are independent, so padding provably cannot contaminate real outputs —
+pinned by tests/test_serve.py's equivalence tests against direct
+`model.apply`.
+
+Dtype policy matches the training step (core/steps.py): inputs cast to the
+config's compute dtype (bf16 unless the config pins f32), outputs returned
+as f32.
+
+The engine is single-device on purpose: serving parallelism is one engine
+process per chip behind a load balancer (each process owns its params on
+`jax.devices()[0]`), not a mesh — the mesh is training's tool for batches
+too big for one chip, which serving buckets never are. The batch-of-1
+utilization problem is the dynamic micro-batcher's job (serve/batcher.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# the ONE definition of on-device input normalization, shared with the
+# train/eval steps so serving can never drift from the training dtype policy
+from ..core.steps import _normalize_input
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (buckets ascending). Raises past the largest
+    bucket — predict() chunks oversize batches before calling this, and the
+    batcher never coalesces past max_batch."""
+    if n < 1:
+        raise ValueError(f"need at least one example, got {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+
+
+def tree_slice(outputs, lo: int, hi: int):
+    """Per-leaf `[lo:hi]` over an output pytree (detection/pose models
+    return tuples of per-scale arrays; classification a single array)."""
+    return jax.tree_util.tree_map(lambda a: a[lo:hi], outputs)
+
+
+def tree_concat(chunks: Sequence[Any]):
+    """Concatenate a list of same-structure output pytrees along batch."""
+    return jax.tree_util.tree_map(lambda *xs: np.concatenate(xs), *chunks)
+
+
+class PredictEngine:
+    """Bucketed AOT predict cache over `apply_fn(variables, x, train=False)`.
+
+    `predict(images)` accepts a host array of shape `(n, *example_shape)`
+    (or one bare example), pads to the nearest bucket, runs ONE compiled
+    dispatch per <=max_batch chunk, and returns the host output pytree with
+    the padding rows stripped. Thread-safe: dispatches serialize on the
+    device, and the compiled executables are stateless.
+    """
+
+    def __init__(self, apply_fn: Callable, variables, *,
+                 example_shape: Sequence[int],
+                 buckets: Sequence[int] = (1, 8, 32),
+                 max_batch: Optional[int] = None,
+                 compute_dtype=jnp.bfloat16,
+                 input_norm: Optional[Tuple] = None,
+                 take_first_output: bool = False,
+                 name: str = "model", verbose: bool = True):
+        bs = sorted({int(b) for b in buckets})
+        if not bs or bs[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        max_batch = int(max_batch) if max_batch else bs[-1]
+        if max_batch < bs[-1]:
+            raise ValueError(f"max_batch={max_batch} below the largest "
+                             f"bucket {bs[-1]}")
+        if max_batch not in bs:
+            bs.append(max_batch)  # the {1, 8, 32, max_batch} policy
+        self.buckets: Tuple[int, ...] = tuple(bs)
+        self.max_batch = max_batch
+        self.example_shape = tuple(example_shape)
+        self.name = name
+        self.input_dtype = np.dtype(np.uint8 if input_norm is not None
+                                    else np.float32)
+        # params live on ONE device, committed once — compiled calls reuse
+        # the buffers instead of re-staging them per request
+        self._device = jax.devices()[0]
+        self._variables = jax.device_put(variables, self._device)
+
+        def predict(variables, images):
+            x = _normalize_input(images, input_norm, compute_dtype)
+            out = apply_fn(variables, x, train=False)
+            if take_first_output and isinstance(out, (tuple, list)):
+                out = out[0]  # inception-style aux heads: primary logits
+            return jax.tree_util.tree_map(
+                lambda y: y.astype(jnp.float32), out)
+
+        self._predict_fn = predict
+        self._jitted = jax.jit(predict)
+        self._compiled = {}
+        self.compile_log: list = []
+        self._compile_all(verbose)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, name: str, *, workdir: Optional[str] = None,
+                    checkpoint=None, image_size: Optional[int] = None,
+                    buckets: Sequence[int] = (1, 8, 32),
+                    max_batch: Optional[int] = None,
+                    verbose: bool = True) -> "PredictEngine":
+        """Build an engine for a registered config. With a `workdir`, the
+        latest (or given-epoch) checkpoint is restored through the config's
+        own trainer family — EMA weights win when present, exactly the
+        weights validation scored (`Trainer.eval_state`); without one, the
+        params are a fresh init (smoke/bench use)."""
+        from ..configs import get_config, trainer_class_for_config
+        cfg = get_config(name)
+        if cfg.family == "gan":
+            raise ValueError(
+                f"config {name!r} is adversarial — serve a generator via "
+                f"tools/export.py instead (no single logits apply fn)")
+        image_size = image_size or cfg.data.image_size
+        sample_shape = (image_size, image_size, cfg.data.channels)
+        compute_dtype = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+        if workdir:
+            trainer = trainer_class_for_config(name)(cfg, workdir=workdir)
+            try:
+                trainer.init_state(sample_shape)
+                got = trainer.resume(
+                    None if checkpoint in (None, "latest")
+                    else int(checkpoint))
+                if got is None and verbose:
+                    print(f"[serve:{cfg.name}] WARNING: nothing restorable "
+                          f"in {workdir!r} — serving RANDOM weights",
+                          flush=True)
+                st = trainer.eval_state()
+                apply_fn = st.apply_fn
+                params = jax.device_get(st.params)
+                batch_stats = jax.device_get(st.batch_stats)
+            finally:
+                trainer.close()
+        else:
+            from ..core.train_state import init_model
+            from ..core.trainer import build_model_from_config
+            model, cfg = build_model_from_config(cfg)
+            params, batch_stats = init_model(
+                model, jax.random.PRNGKey(cfg.seed),
+                jnp.zeros((2, *sample_shape), jnp.float32))
+            apply_fn = model.apply
+        variables = {"params": params}
+        if jax.tree_util.tree_leaves(batch_stats):
+            variables["batch_stats"] = batch_stats
+        input_norm = ((cfg.data.mean, cfg.data.std)
+                      if cfg.data.normalize_on_device else None)
+        return cls(apply_fn, variables, example_shape=sample_shape,
+                   buckets=buckets, max_batch=max_batch,
+                   compute_dtype=compute_dtype, input_norm=input_norm,
+                   take_first_output=cfg.family == "classification",
+                   name=cfg.name, verbose=verbose)
+
+    # -- compilation -------------------------------------------------------
+
+    def _compile_all(self, verbose: bool) -> None:
+        """AOT-compile every bucket up front — startup pays all compiles
+        (or persistent-cache reads), requests pay none. Per-bucket
+        hit/miss is logged so a cold cache is visible, not mysterious."""
+        from ..cli import compilation_cache_stats, install_cache_stats_hooks
+        install_cache_stats_hooks()
+        for b in self.buckets:
+            before = compilation_cache_stats()
+            t0 = time.perf_counter()
+            spec = jax.ShapeDtypeStruct((b, *self.example_shape),
+                                        self.input_dtype)
+            self._compiled[b] = self._jitted.lower(
+                self._variables, spec).compile()
+            dt = time.perf_counter() - t0
+            after = compilation_cache_stats()
+            if after["hits"] > before["hits"]:
+                cache = "hit"
+            elif after["misses"] > before["misses"]:
+                cache = "miss"
+            else:
+                cache = "off"
+            self.compile_log.append(
+                {"bucket": b, "compile_s": round(dt, 3), "cache": cache})
+            if verbose:
+                print(f"[serve:{self.name}] bucket {b}: compiled in "
+                      f"{dt:.2f}s (persistent-cache {cache})", flush=True)
+
+    def warmup(self) -> None:
+        """One blocking dispatch per bucket: absorbs first-call transfer and
+        runtime setup so the first real request doesn't pay it."""
+        x = np.zeros((self.max_batch, *self.example_shape), self.input_dtype)
+        for b in self.buckets:
+            jax.block_until_ready(self._compiled[b](self._variables, x[:b]))
+
+    # -- prediction --------------------------------------------------------
+
+    def _coerce(self, images) -> np.ndarray:
+        x = np.asarray(images, self.input_dtype)
+        if x.shape == self.example_shape:
+            x = x[None]
+        if x.ndim != len(self.example_shape) + 1 \
+                or x.shape[1:] != self.example_shape:
+            raise ValueError(
+                f"expected (n, {', '.join(map(str, self.example_shape))}) "
+                f"(or one bare example), got {x.shape}")
+        return x
+
+    def predict(self, images):
+        """Host-in host-out bucketed prediction (pads, dispatches, strips).
+        Oversize batches run as max_batch chunks plus one tail bucket."""
+        x = self._coerce(images)
+        n = x.shape[0]
+        if n <= self.max_batch:
+            return self._dispatch(x)
+        return tree_concat([self._dispatch(x[i:i + self.max_batch])
+                            for i in range(0, n, self.max_batch)])
+
+    def _dispatch(self, x: np.ndarray):
+        n = x.shape[0]
+        b = pick_bucket(n, self.buckets)
+        if b != n:
+            x = np.pad(x, [(0, b - n)] + [(0, 0)] * (x.ndim - 1))
+        out = self._compiled[b](self._variables, x)
+        return tree_slice(jax.device_get(out), 0, n)
+
+    def reference(self, images):
+        """Eager, un-bucketed predict at the exact batch size — the direct
+        `model.apply` oracle the padding-equivalence tests (and preflight's
+        serve check) compare the bucketed path against."""
+        x = self._coerce(images)
+        return jax.device_get(self._predict_fn(self._variables,
+                                               jnp.asarray(x)))
+
+    # -- measurement -------------------------------------------------------
+
+    def measure_batch_ms(self, bucket: Optional[int] = None,
+                         iters: int = 5) -> float:
+        """Steady-state wall time of one compiled dispatch of `bucket`
+        (default max_batch), in ms — the "one batch compute time" term of
+        the serving latency contract (docs/SERVING.md)."""
+        b = pick_bucket(bucket or self.max_batch, self.buckets)
+        x = np.zeros((b, *self.example_shape), self.input_dtype)
+        c = self._compiled[b]
+        jax.block_until_ready(c(self._variables, x))  # warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = c(self._variables, x)
+        jax.block_until_ready(out)  # same device: prior dispatches serialized
+        return (time.perf_counter() - t0) / iters * 1000.0

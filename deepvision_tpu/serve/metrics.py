@@ -1,0 +1,88 @@
+"""Serving metrics: latency quantiles, batching efficiency, padding waste.
+
+One thread-safe accumulator the batcher feeds per dispatched batch; the
+server flushes snapshots onto the SAME metrics stream the trainer uses
+(core/metrics.MetricsLogger → console echo + `serve.jsonl` + TensorBoard
+when a workdir is given), so serving runs leave the same forensics trail
+training runs do.
+
+The numbers that matter (docs/SERVING.md):
+- `p50_ms` / `p99_ms`: request latency submit→result over a bounded window.
+  The healthy contract is p99 <= max_delay_ms + one max-bucket compute time;
+  p99 far above it means overload (queueing), far below p50 ~= max_delay
+  means the deadline is doing nothing (traffic always fills batches).
+- `padding_waste`: fraction of dispatched device rows that were padding —
+  the price of shape bucketing. High waste at low traffic is fine (the
+  rows are free when the chip is idle); high waste at HIGH traffic means
+  the bucket ladder is too coarse for the arriving batch sizes.
+- `mean_batch_fill` / `batches_per_sec` / `images_per_sec`: how well the
+  coalescing window converts request concurrency into device batch size.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class ServingMetrics:
+    """Cumulative counters since construction (or the last reset) plus a
+    bounded latency window. All methods are thread-safe."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._window = window
+        self._reset_locked(time.monotonic())
+
+    def _reset_locked(self, now: float) -> None:
+        self._t0 = now
+        self._lat: deque = deque(maxlen=self._window)
+        self._requests = 0
+        self._examples = 0
+        self._batches = 0
+        self._rows = 0          # device rows dispatched, padding included
+        self._dispatch_s = 0.0
+
+    def observe_batch(self, *, n_real: int, bucket: int, dispatch_s: float,
+                      request_latencies_s: Sequence[float]) -> None:
+        with self._lock:
+            self._requests += len(request_latencies_s)
+            self._examples += n_real
+            self._batches += 1
+            self._rows += bucket
+            self._dispatch_s += dispatch_s
+            self._lat.extend(request_latencies_s)
+
+    def snapshot(self, queue_depth: Optional[int] = None,
+                 reset: bool = False) -> dict:
+        """Metric dict (floats only — MetricsLogger-ready). `reset=True`
+        zeroes the counters afterwards, making consecutive snapshots
+        per-interval rates (the server's periodic flush; /stats leaves the
+        counters alone)."""
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._t0, 1e-9)
+            out = {
+                "requests": float(self._requests),
+                "images_per_sec": self._examples / dt,
+                "batches_per_sec": self._batches / dt,
+                "mean_batch_fill": (self._examples / self._batches
+                                    if self._batches else 0.0),
+                "padding_waste": ((self._rows - self._examples) / self._rows
+                                  if self._rows else 0.0),
+                "mean_dispatch_ms": (1000.0 * self._dispatch_s / self._batches
+                                     if self._batches else 0.0),
+            }
+            if self._lat:
+                lat_ms = np.asarray(self._lat, np.float64) * 1000.0
+                out["p50_ms"] = float(np.percentile(lat_ms, 50))
+                out["p99_ms"] = float(np.percentile(lat_ms, 99))
+            if queue_depth is not None:
+                out["queue_depth"] = float(queue_depth)
+            if reset:
+                self._reset_locked(now)
+        return out

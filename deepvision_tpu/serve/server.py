@@ -1,0 +1,184 @@
+"""Inference server: engine + batcher + metrics + graceful lifecycle.
+
+`InferenceServer.serve()` runs a stdlib `ThreadingHTTPServer` (no new
+dependencies — each connection gets a thread, and concurrent handler
+threads are exactly the concurrency the micro-batcher coalesces):
+
+    POST /predict   {"instances": [[...HWC floats...], ...]}
+                    -> 200 {"predictions": [...]}   (f32 model outputs)
+                    -> 400 bad shape/body, 429 overloaded (backpressure),
+                       503 draining
+    GET  /healthz   -> 200 {"status": "ok"|"draining", ...}
+    GET  /stats     -> 200 cumulative ServingMetrics snapshot + queue depth
+
+Graceful drain reuses the resilience SIGTERM/SIGINT contract
+(core/resilience.GracefulShutdown — same handler the trainer installs):
+the first signal stops the accept path (new submits get 503), every
+request already accepted finishes and is answered, metrics flush, and the
+process exits 0 — a preempted serving replica under a grace window answers
+everything it promised and leaves cleanly. A second signal aborts
+immediately, same as training.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..core.metrics import MetricsLogger
+from ..core.resilience import GracefulShutdown
+from .batcher import Draining, DynamicBatcher, Overloaded
+from .engine import PredictEngine
+from .metrics import ServingMetrics
+
+DRAIN_WHAT = ("finishing in-flight batches, rejecting new work, "
+              "then exiting 0")
+
+
+class InferenceServer:
+    """Owns the serving stack's lifecycle; `serve()` blocks until a signal
+    (or `stop()`), drains, and returns the final metrics snapshot."""
+
+    def __init__(self, engine: PredictEngine, *,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: float = 5.0,
+                 max_queue_examples: int = 1024,
+                 workdir: Optional[str] = None,
+                 flush_every_s: float = 10.0):
+        self.engine = engine
+        self.metrics = ServingMetrics()
+        self.batcher = DynamicBatcher(
+            engine, max_batch=max_batch, max_delay_ms=max_delay_ms,
+            max_queue_examples=max_queue_examples, metrics=self.metrics)
+        # same stream as the trainer: JSONL + TB when a workdir is given,
+        # console echo always (MetricsLogger is the one logging mechanism)
+        self.logger = MetricsLogger(workdir, name="serve")
+        self.flush_every_s = flush_every_s
+        self._flush_step = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.ready = threading.Event()   # set once the listener is bound
+        self.bound_port: Optional[int] = None
+
+    # -- metrics -----------------------------------------------------------
+
+    def flush_metrics(self, echo: bool = True, reset: bool = True) -> dict:
+        """Flush one per-interval snapshot to the metrics stream."""
+        self._flush_step += 1
+        snap = self.metrics.snapshot(queue_depth=self.batcher.queue_depth,
+                                     reset=reset)
+        self.logger.log(self._flush_step, snap, prefix="serve_", echo=echo)
+        return snap
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        """Programmatic equivalent of one SIGTERM (tests/embedding use)."""
+        self._stop.set()
+        self._wake.set()
+
+    def drain(self) -> dict:
+        """Reject new work, finish everything accepted, flush metrics."""
+        print(f"[serve:{self.engine.name}] graceful drain: rejecting new "
+              f"work, finishing {self.batcher.queue_depth} queued examples",
+              flush=True)
+        self.batcher.drain()
+        return self.flush_metrics(reset=False)
+
+    def close(self) -> None:
+        self.batcher.drain()
+        self.logger.close()
+
+    def serve(self, port: int = 8700, host: str = "127.0.0.1") -> dict:
+        httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.bound_port = httpd.server_address[1]
+        http_thread = threading.Thread(target=httpd.serve_forever,
+                                       daemon=True, name="http-serve")
+        with GracefulShutdown(on_signal=self._wake.set,
+                              what=DRAIN_WHAT) as gs:
+            http_thread.start()
+            self.ready.set()
+            print(f"[serve:{self.engine.name}] listening on "
+                  f"http://{host}:{self.bound_port} "
+                  f"buckets={list(self.engine.buckets)} "
+                  f"max_delay_ms={self.batcher.max_delay * 1000:g}",
+                  flush=True)
+            while not (gs.requested or self._stop.is_set()):
+                if self._wake.wait(self.flush_every_s):
+                    self._wake.clear()   # signal/stop — re-check the flag
+                    continue
+                self.flush_metrics()     # quiet period: periodic flush
+            # drain FIRST: handlers blocked on accepted futures still get
+            # their answers while new submits 503; only then stop accepting
+            # connections at all
+            snap = self.drain()
+            httpd.shutdown()
+            httpd.server_close()
+            http_thread.join(timeout=10)
+        print(f"[serve:{self.engine.name}] drained cleanly", flush=True)
+        return snap
+
+
+def _make_handler(server: InferenceServer):
+    class Handler(BaseHTTPRequestHandler):
+        # per-request stderr lines are pure noise under load; the metrics
+        # stream is the observability surface
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {
+                    "status": ("draining" if server.batcher.draining
+                               else "ok"),
+                    "model": server.engine.name,
+                    "buckets": list(server.engine.buckets),
+                    "max_batch": server.batcher.max_batch,
+                })
+            elif self.path == "/stats":
+                self._json(200, server.metrics.snapshot(
+                    queue_depth=server.batcher.queue_depth))
+            else:
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                return self._json(404, {"error": f"unknown path "
+                                                 f"{self.path!r}"})
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                x = np.asarray(payload["instances"], np.float32)
+            except (KeyError, TypeError, ValueError) as e:
+                return self._json(400, {
+                    "error": f"body must be JSON {{'instances': "
+                             f"[...]}}: {e}"})
+            try:
+                fut = server.batcher.submit(x)
+            except Overloaded as e:
+                return self._json(429, {"error": str(e)})
+            except Draining as e:
+                return self._json(503, {"error": str(e)})
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            try:
+                out = fut.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — a failed dispatch must
+                return self._json(500, {"error": repr(e)})  # not hang the client
+            self._json(200, {"predictions": jax.tree_util.tree_map(
+                lambda a: np.asarray(a).tolist(), out)})
+
+    return Handler

@@ -43,6 +43,12 @@ class Registry:
     def names(self):
         return sorted(self._entries)
 
+    def items(self):
+        """Sorted (name, object) pairs — the enumeration surface for tools
+        that list the registry (e.g. `python -m deepvision_tpu.serve
+        --list-models`)."""
+        return sorted(self._entries.items())
+
     def __contains__(self, name: str) -> bool:
         return name in self._entries
 

@@ -1,0 +1,347 @@
+"""Serving subsystem (deepvision_tpu/serve/) on the CPU backend.
+
+The contracts pinned here are the ones traffic depends on:
+- bucket selection and the bucketed/padded predict path matching direct
+  (un-bucketed) `model.apply` exactly — padding rows provably contaminate
+  nothing;
+- the micro-batcher's two flush triggers (max_batch fill vs max_delay_ms
+  deadline) and its coalescing under backlog;
+- concurrent clients each getting THEIR OWN rows back, in order;
+- example-counted backpressure (Overloaded) and drain semantics (Draining);
+- graceful drain on SIGTERM: the serve CLI finishes in-flight work and
+  exits 0 (the resilience contract, serving edition);
+- the HTTP front-end roundtrip (predict / healthz / stats / 400s).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.serve.batcher import (Draining, DynamicBatcher,
+                                          Overloaded)
+from deepvision_tpu.serve.engine import PredictEngine, pick_bucket
+from deepvision_tpu.serve.metrics import ServingMetrics
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # one engine for the whole module: 3 bucket compiles happen once
+    return PredictEngine.from_config("lenet5", buckets=(1, 4, 8),
+                                     verbose=False)
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 32, 32, 1).astype(np.float32)
+
+
+# -- bucket selection ---------------------------------------------------------
+
+def test_pick_bucket():
+    buckets = (1, 4, 8)
+    assert pick_bucket(1, buckets) == 1
+    assert pick_bucket(2, buckets) == 4
+    assert pick_bucket(4, buckets) == 4
+    assert pick_bucket(5, buckets) == 8
+    assert pick_bucket(8, buckets) == 8
+    with pytest.raises(ValueError):
+        pick_bucket(9, buckets)
+    with pytest.raises(ValueError):
+        pick_bucket(0, buckets)
+
+
+def test_bucket_policy_appends_max_batch(engine):
+    # the {1, 8, 32, max_batch} policy: an explicit max_batch beyond the
+    # ladder becomes its own compiled bucket
+    eng = PredictEngine.from_config("lenet5", buckets=(1, 4), max_batch=6,
+                                    verbose=False)
+    assert eng.buckets == (1, 4, 6) and eng.max_batch == 6
+    with pytest.raises(ValueError):
+        PredictEngine.from_config("lenet5", buckets=(1, 8), max_batch=4,
+                                  verbose=False)
+    assert engine.buckets == (1, 4, 8)  # fixture ladder untouched
+
+
+# -- padded/bucketed equivalence ----------------------------------------------
+
+def test_engine_equivalence_per_bucket(engine):
+    """Every partial fill of every bucket must match direct apply: padded
+    rows contribute nothing (train=False rows are independent)."""
+    for n in (1, 2, 3, 4, 5, 7, 8):
+        x = _imgs(n, seed=n)
+        out = engine.predict(x)
+        ref = engine.reference(x)
+        assert out.shape == (n, 10)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_padding_is_inert(engine):
+    """The same row must produce the same output whether it rides in a
+    full bucket, a padded bucket, or alone in bucket 1."""
+    x = _imgs(8, seed=42)
+    full = engine.predict(x)                      # bucket 8, no padding
+    padded = engine.predict(x[:3])                # bucket 4, 1 padded row
+    singles = np.concatenate([engine.predict(x[i]) for i in range(3)])
+    np.testing.assert_allclose(padded, full[:3], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(singles, full[:3], rtol=1e-4, atol=1e-5)
+
+
+def test_engine_chunks_oversize_batches(engine):
+    x = _imgs(19, seed=3)  # 8 + 8 + tail 3 → three dispatches
+    np.testing.assert_allclose(engine.predict(x), engine.reference(x),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_engine_rejects_bad_shapes(engine):
+    with pytest.raises(ValueError):
+        engine.predict(np.zeros((2, 16, 16, 1), np.float32))
+    with pytest.raises(ValueError):
+        engine.predict(np.zeros((0, 32, 32, 1), np.float32))
+
+
+# -- micro-batcher flush triggers ---------------------------------------------
+
+def test_deadline_flush(engine):
+    """Fewer requests than max_batch: the batch flushes at ~max_delay_ms
+    (not max_batch), and all requests ride in ONE dispatch."""
+    metrics = ServingMetrics()
+    b = DynamicBatcher(engine, max_delay_ms=200.0, metrics=metrics)
+    try:
+        t0 = time.monotonic()
+        futs = [b.submit(_imgs(1, seed=i)) for i in range(3)]
+        outs = [f.result(timeout=60) for f in futs]
+        elapsed = time.monotonic() - t0
+        assert all(o.shape == (1, 10) for o in outs)
+        # flushed by the deadline: after max_delay, well before forever
+        assert 0.15 <= elapsed < 10.0
+        snap = metrics.snapshot()
+        assert snap["requests"] == 3
+        assert snap["mean_batch_fill"] == 3.0          # one batch of 3
+        assert snap["padding_waste"] == pytest.approx(0.25)  # bucket 4
+    finally:
+        assert b.drain(timeout=30)
+
+
+def test_max_batch_flush(engine):
+    """max_batch examples arriving fast flush IMMEDIATELY — far before a
+    deliberately huge deadline."""
+    metrics = ServingMetrics()
+    b = DynamicBatcher(engine, max_batch=4, max_delay_ms=30_000.0,
+                       metrics=metrics)
+    try:
+        t0 = time.monotonic()
+        futs = [b.submit(_imgs(1, seed=i)) for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+        assert time.monotonic() - t0 < 10.0  # not the 30s deadline
+        snap = metrics.snapshot()
+        assert snap["mean_batch_fill"] == 4.0 and snap["requests"] == 4
+        assert snap["padding_waste"] == 0.0  # exact bucket hit
+    finally:
+        assert b.drain(timeout=30)
+
+
+def test_multi_image_requests_and_carry(engine):
+    """Requests bigger than the remaining batch room carry over to the
+    NEXT batch whole (a request is never split across dispatches)."""
+    b = DynamicBatcher(engine, max_batch=4, max_delay_ms=50.0)
+    try:
+        xs = [_imgs(3, seed=1), _imgs(3, seed=2), _imgs(2, seed=3)]
+        futs = [b.submit(x) for x in xs]
+        outs = [f.result(timeout=60) for f in futs]
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(o, engine.reference(x),
+                                       rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            b.submit(_imgs(5))  # > max_batch must be split by the client
+    finally:
+        assert b.drain(timeout=30)
+
+
+# -- concurrency / correctness ------------------------------------------------
+
+def test_concurrent_clients_get_their_own_rows(engine):
+    """12 threads x 4 rounds of distinct inputs: every future resolves to
+    exactly its caller's outputs (scatter back is order-preserving)."""
+    b = DynamicBatcher(engine, max_delay_ms=5.0)
+    refs = {i: engine.reference(_imgs(1 + i % 3, seed=100 + i))
+            for i in range(12)}
+    errors = []
+
+    def client(i):
+        x = _imgs(1 + i % 3, seed=100 + i)
+        try:
+            for _ in range(4):
+                out = b.submit(x).result(timeout=60)
+                np.testing.assert_allclose(out, refs[i], rtol=1e-4,
+                                           atol=1e-5)
+        except Exception as e:  # noqa: BLE001 — surface in the main thread
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert b.drain(timeout=30)
+    assert not errors, errors[:2]
+
+
+def test_backpressure_overloaded():
+    """Example-counted backpressure: with the dispatcher wedged in a slow
+    predict, submits past max_queue_examples raise Overloaded; accepted
+    work still completes."""
+
+    class SlowEngine:
+        buckets = (1, 4)
+        max_batch = 4
+        example_shape = (2,)
+        input_dtype = np.dtype(np.float32)
+        _coerce = PredictEngine._coerce  # reuse the validation path
+
+        def predict(self, x):
+            time.sleep(0.3)
+            return np.asarray(x) * 2.0
+
+    b = DynamicBatcher(SlowEngine(), max_delay_ms=0.0,
+                       max_queue_examples=4)
+    accepted = [b.submit(np.zeros((1, 2), np.float32)) for _ in range(4)]
+    with pytest.raises(Overloaded):
+        for _ in range(5):  # dispatcher may have consumed a few already
+            b.submit(np.zeros((1, 2), np.float32))
+            accepted.append(b.submit(np.zeros((1, 2), np.float32)))
+    for f in accepted:
+        assert f.result(timeout=60).shape == (1, 2)
+    assert b.drain(timeout=30)
+    with pytest.raises(Draining):
+        b.submit(np.zeros((1, 2), np.float32))
+
+
+def test_dispatch_error_reaches_futures_not_thread():
+    """A failing dispatch must settle every rider future with the error and
+    leave the dispatcher alive for the next batch."""
+
+    class FlakyEngine:
+        buckets = (1, 4)
+        max_batch = 4
+        example_shape = (2,)
+        input_dtype = np.dtype(np.float32)
+        _coerce = PredictEngine._coerce
+        fail = True
+
+        def predict(self, x):
+            if self.fail:
+                self.fail = False
+                raise RuntimeError("boom")
+            return np.asarray(x)
+
+    b = DynamicBatcher(FlakyEngine(), max_delay_ms=0.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        b.submit(np.zeros((1, 2), np.float32)).result(timeout=60)
+    out = b.submit(np.zeros((1, 2), np.float32)).result(timeout=60)
+    assert out.shape == (1, 2)
+    assert b.queue_depth == 0
+    assert b.drain(timeout=30)
+
+
+# -- serving metrics ----------------------------------------------------------
+
+def test_serving_metrics_snapshot_reset():
+    m = ServingMetrics()
+    m.observe_batch(n_real=6, bucket=8, dispatch_s=0.004,
+                    request_latencies_s=[0.01, 0.02, 0.03])
+    snap = m.snapshot(queue_depth=2, reset=True)
+    assert snap["requests"] == 3 and snap["queue_depth"] == 2.0
+    assert snap["padding_waste"] == pytest.approx(0.25)
+    assert snap["p50_ms"] == pytest.approx(20.0)
+    assert snap["p99_ms"] <= 30.0 + 1e-6
+    assert m.snapshot()["requests"] == 0  # reset wiped the window
+
+
+# -- HTTP front-end -----------------------------------------------------------
+
+def test_http_server_roundtrip(engine):
+    from deepvision_tpu.serve.server import InferenceServer
+
+    srv = InferenceServer(engine, max_delay_ms=3.0, flush_every_s=60.0)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                  timeout=30))
+        assert health["status"] == "ok" and health["model"] == "lenet5"
+        x = _imgs(2, seed=7)
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"instances": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req, timeout=60))
+        np.testing.assert_allclose(
+            np.asarray(out["predictions"], np.float32),
+            engine.reference(x), rtol=1e-4, atol=1e-5)
+        stats = json.load(urllib.request.urlopen(base + "/stats",
+                                                 timeout=30))
+        assert stats["requests"] >= 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/predict", data=b"{}"),
+                timeout=30)
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+    assert not t.is_alive()
+
+
+# -- graceful drain on SIGTERM (the serve CLI, end to end) --------------------
+
+def test_sigterm_graceful_drain(tmp_path):
+    """SIGTERM mid-smoke: the serve CLI finishes in-flight batches, prints
+    the drain line and the summary JSON, and exits 0 — the serving edition
+    of the trainer's preemption contract."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepvision_tpu.serve", "-m", "lenet5",
+         "--smoke", "--duration", "120", "--load-threads", "2",
+         "--max-delay-ms", "5"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    lines = []
+    try:
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if "ready:" in line:
+                break
+        else:
+            pytest.fail("serve smoke never became ready")
+        time.sleep(0.5)  # let some load flow
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    full = "".join(lines) + out
+    assert proc.returncode == 0, full[-2000:]
+    assert "graceful drain" in full
+    summary = json.loads(
+        [ln for ln in full.splitlines() if '"serve_smoke"' in ln][-1])
+    assert summary["serve_smoke"] == "pass"
+    assert summary["requests"] > 0  # work flowed before the drain

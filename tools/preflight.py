@@ -10,6 +10,10 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
   lint        jaxlint static analysis over the framework + tools
               (docs/LINTING.md): a donation-aliasing or host-sync hazard
               must stop a launch BEFORE it burns pod-hours
+  serve       serving-stack smoke (docs/SERVING.md): bucketed AOT predict
+              cache + dynamic micro-batcher + graceful drain on the tiny
+              fixed lenet5 config — concurrent requests must coalesce,
+              padded outputs must match direct predict, drain must finish
   devices     backend reachable, device count/platform, mesh construction
   input       host tf.data throughput (real TFRecords when --data-dir is
               given, synthetic JPEG shards otherwise) vs --input-floor
@@ -79,6 +83,43 @@ def check_lint(args):
             f"{len(findings)} jaxlint finding(s) — fix or `# jaxlint: "
             f"disable=RULE` with a justification before launching: {head}")
     return "jaxlint clean (deepvision_tpu, tools)"
+
+
+@check("serve")
+def check_serve(args):
+    # serving plumbing, not the pod's model (that's check_step's job): the
+    # tiny fixed lenet5 keeps this cheap on CPU and TPU alike. Six
+    # concurrent single-image requests through the micro-batcher must
+    # coalesce, produce finite outputs EQUAL to the direct (un-bucketed)
+    # predict — i.e. padding rows provably contaminated nothing — and the
+    # batcher must drain cleanly (the SIGTERM contract's mechanism).
+    import numpy as np
+
+    from deepvision_tpu.serve.batcher import DynamicBatcher
+    from deepvision_tpu.serve.engine import PredictEngine
+
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    batcher = DynamicBatcher(engine, max_delay_ms=20.0)
+    try:
+        rs = np.random.RandomState(0)
+        xs = [rs.randn(1, *engine.example_shape).astype(np.float32)
+              for _ in range(6)]
+        futs = [batcher.submit(x) for x in xs]
+        outs = [f.result(timeout=120) for f in futs]
+        direct = engine.reference(np.concatenate(xs))
+        err = max(float(np.max(np.abs(o[0] - direct[i])))
+                  for i, o in enumerate(outs))
+        if not all(np.all(np.isfinite(o)) for o in outs):
+            raise RuntimeError("non-finite serving outputs")
+        if err > 1e-4:
+            raise RuntimeError(f"bucketed/padded outputs diverge from "
+                               f"direct predict (max abs err {err:.2e})")
+    finally:
+        drained = batcher.drain(timeout=60)
+    if not drained:
+        raise RuntimeError("batcher failed to drain within 60s")
+    return f"lenet5 buckets={engine.buckets} max_abs_err={err:.1e} drained"
 
 
 @check("devices")
@@ -289,6 +330,7 @@ def main(argv=None):
         args.image_size = 224 if platform == "tpu" else 64
 
     check_lint(args)
+    check_serve(args)
     check_devices(args)
     check_input(args)
     check_step(args)
