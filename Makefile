@@ -10,12 +10,18 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve dryrun smoke serve-smoke \
-        preflight preflight-record lint
+        preflight preflight-record lint fsck
 
 lint:        ## jaxlint: donation-aliasing / retrace / host-sync / trace
 	## hazards (docs/LINTING.md) over the framework, the tools, and the
 	## per-model entrypoints — exit 1 on any finding
 	$(PY) -m deepvision_tpu.lint deepvision_tpu tools $(wildcard */jax)
+
+RUN_DIR ?= runs
+fsck:        ## checkpoint-integrity audit (docs/FAILURES.md): verify every
+	## committed epoch under RUN_DIR (default runs/) against its
+	## manifest; exit 1 on corruption. Repair: add QUARANTINE=1
+	$(PY) -m deepvision_tpu fsck $(RUN_DIR) $(if $(QUARANTINE),--quarantine)
 
 preflight:   ## pod go/no-go: devices, input floor, train step, ckpt roundtrip
 	$(PY) tools/preflight.py

@@ -1,0 +1,120 @@
+"""`python -m deepvision_tpu <subcommand>` — repo-level maintenance CLI.
+
+    # audit checkpoint integrity across a run dir (or a whole runs/ root)
+    python -m deepvision_tpu fsck runs/resnet50
+    python -m deepvision_tpu fsck runs/resnet50 --quarantine   # repair
+
+fsck walks every checkpoint directory it can find under the given path (the
+path itself when it holds committed epochs, its `ckpt/` child for a run
+workdir, else every `<child>/ckpt` one level down) and prints one line per
+epoch:
+
+    OK                epoch 3   9 files  1.2 MB  manifest=ab12cd34
+    CORRUPT           epoch 2   state/d/...: content hash mismatch (bit rot?)
+    MISSING-MANIFEST  epoch 1   no integrity manifest
+    QUARANTINED       corrupt-2
+
+Exit codes (the lint-CLI convention): 0 = nothing corrupt, 1 = at least one
+CORRUPT epoch found (even if `--quarantine` just repaired it — rerun to get
+a clean 0), 2 = usage error (path does not exist). `--quarantine` renames
+corrupt epochs (and missing-manifest epochs in dirs whose siblings carry
+manifests — an interrupted save) to `corrupt-<epoch>/` so restores stop
+considering them; `tools/preflight.py` runs the same audit as its fsck
+check. Contract: docs/FAILURES.md.
+
+The audit is file-level (sizes + sha256 against the manifest) and stdlib-
+only — no jax import, so it is safe and fast on a login host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def _human_bytes(n) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def _cmd_fsck(args: argparse.Namespace) -> int:
+    from .core import integrity
+
+    path = os.path.abspath(args.path)
+    if not os.path.isdir(path):
+        print(f"fsck: {args.path!r} is not a directory", file=sys.stderr)
+        return 2
+    ckpt_dirs = integrity.find_checkpoint_dirs(path)
+    if not ckpt_dirs:
+        print(f"fsck: no checkpoint directories under {args.path} "
+              f"(nothing to audit)")
+        return 0
+    all_records = []
+    n_corrupt = 0
+    for d in ckpt_dirs:
+        records = integrity.audit(d, quarantine=args.quarantine)
+        all_records.append({"dir": d, "epochs": records})
+        print(f"== {d}")
+        if not records:
+            print("   (no committed epochs)")
+        for r in records:
+            status = r["status"].upper().replace("_", "-")
+            if r["status"] == integrity.OK:
+                detail = (f"{_human_bytes(r.get('total_bytes'))}  "
+                          f"manifest={r.get('manifest_sha256', '')[:12]}")
+            elif r["status"] == integrity.QUARANTINED:
+                detail = r["detail"]
+            else:
+                detail = r["detail"]
+                if "quarantined_to" in r:
+                    detail += f" -> {r['quarantined_to']}"
+            epoch = f"epoch {r['epoch']}" if r["epoch"] is not None else ""
+            print(f"{status:17s} {epoch:9s} {detail}")
+            n_corrupt += r["status"] == integrity.CORRUPT
+    summary = {"fsck": "corrupt" if n_corrupt else "ok",
+               "checkpoint_dirs": len(ckpt_dirs),
+               "epochs_audited": sum(
+                   1 for d in all_records for r in d["epochs"]
+                   if r["epoch"] is not None),
+               "corrupt": n_corrupt,
+               "quarantined": args.quarantine and n_corrupt > 0}
+    if args.json:
+        summary["reports"] = all_records
+    print(json.dumps(summary))
+    return 1 if n_corrupt else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepvision_tpu",
+        description="Repo-level maintenance subcommands (see also: "
+                    "-m deepvision_tpu.serve, -m deepvision_tpu.lint)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    fsck = sub.add_parser(
+        "fsck", help="audit checkpoint integrity across a run directory",
+        description="Verify every committed checkpoint epoch against its "
+                    "integrity manifest (file sizes + sha256). Exit 0 = "
+                    "clean, 1 = corruption found, 2 = usage error.")
+    fsck.add_argument("path", help="run workdir, its ckpt/ dir, or a runs/ "
+                                   "root to scan one level deep")
+    fsck.add_argument("--quarantine", action="store_true",
+                      help="rename corrupt epochs to corrupt-<epoch>/ so "
+                           "restores stop considering them (repair)")
+    fsck.add_argument("--json", action="store_true",
+                      help="append full per-epoch reports to the summary "
+                           "JSON line")
+    fsck.set_defaults(fn=_cmd_fsck)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

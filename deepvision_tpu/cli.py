@@ -89,6 +89,14 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--auto-resume", action="store_true",
                    help="resume from the latest checkpoint if one exists "
                         "(preemption recovery; starts fresh otherwise)")
+    p.add_argument("--resume", choices=["strict", "fallback"], default=None,
+                   help="checkpoint integrity mode for -c/--auto-resume: "
+                        "'fallback' (default) verifies the integrity "
+                        "manifest and on corruption quarantines the bad "
+                        "epoch (corrupt-<N>/) and resumes from the next-"
+                        "newest epoch that verifies; 'strict' refuses to "
+                        "restore an unverified checkpoint (docs/FAILURES.md; "
+                        "audit with `python -m deepvision_tpu fsck`)")
     p.add_argument("--recover-on-divergence", type=int, default=None,
                    metavar="N",
                    help="when an epoch's loss goes non-finite, roll back to "
@@ -377,6 +385,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(prefetch_batches=args.prefetch_batches)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
+    if args.resume:
+        cfg = cfg.replace(resume_verify=args.resume)
     if args.recover_on_divergence is not None:
         if args.recover_on_divergence < 0:
             raise SystemExit(f"--recover-on-divergence must be >= 0, got "

@@ -1,4 +1,4 @@
-"""Checkpoint save/restore via Orbax.
+"""Checkpoint save/restore via Orbax, with an integrity layer.
 
 One mechanism replacing the reference's four (SURVEY.md §5.4): torch dict-per-epoch
 (`ResNet/pytorch/train.py:417-428`), Keras hdf5 callback, save-best weights with the
@@ -7,13 +7,26 @@ metric in the filename (`YOLO/tensorflow/train.py:244-257`), and
 `{params, batch_stats, opt_state, step}` plus host metadata (epoch, plateau state,
 metric history), with keep-latest and keep-best policies and atomic writes (safe for
 preemption — a gap called out in SURVEY.md §5.3).
+
+Integrity (core/integrity.py): every save also commits an
+`integrity_manifest.json` into the epoch dir — per-leaf shapes/dtypes/content
+hashes plus a per-file size+sha256 inventory — written by a finalizer thread
+AFTER the Orbax commit, so training never blocks on hashing and a manifest's
+presence certifies the save finished. `restore()` verifies by default and, in
+fallback mode, quarantines a corrupt epoch (`corrupt-<epoch>/`) and lands on
+the next-newest generation that verifies — a run resumes from epoch N-1
+instead of dying on an opaque deserialization error. Failures inside the
+async background write (previously lost until `close()`) are captured by the
+finalizer and re-raised through the `what="ckpt_save"` retry path at the
+next `save()`/`flush()` barrier.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import shutil
+import queue
+import sys
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -21,8 +34,17 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from . import integrity
+from .integrity import CheckpointCorruptionError  # noqa: F401 — re-export:
+# callers catch it from the module that raised it
 from .resilience import RetryPolicy, call_with_retry
 from .train_state import TrainState
+
+# restore() verification modes: "fallback" verifies and walks back to the
+# next-newest generation that passes (quarantining what didn't), "strict"
+# raises on the first unverified checkpoint, "off" is the pre-integrity
+# behavior. True/False/None are accepted aliases for CLI/bool callers.
+VERIFY_MODES = ("fallback", "strict", "off")
 
 
 class CheckpointManager:
@@ -38,7 +60,8 @@ class CheckpointManager:
         restore (flaky storage must cost a logged retry, not the run);
         `on_retry(what, attempt, exc, delay)` is the trainers' logging hook,
         and `fault_injector` (utils/faults.py) provides the deterministic
-        checkpoint-write failures the resilience tests inject."""
+        checkpoint-write failures AND post-commit corruption the resilience
+        and integrity tests inject."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
@@ -59,6 +82,22 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # provenance of the last successful restore: {"epoch", "verified",
+        # "manifest_sha256", "fallback_skipped"/"legacy"/"mode"} — serving
+        # reports it on /healthz so replicas can be audited for weight skew
+        self.last_restore_info: Optional[Dict[str, Any]] = None
+        # Integrity finalizer: one worker thread waits for each Orbax commit
+        # off the training thread, writes the manifest into the committed
+        # epoch dir, and CAPTURES background-write failures (previously those
+        # surfaced only from wait_until_finished at close — i.e. silently
+        # after the run had moved on). A captured error re-raises through the
+        # ckpt_save retry path at the next save()/flush() barrier.
+        self._finalize_q: "queue.Queue" = queue.Queue()
+        self._async_error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self._finalizer = threading.Thread(
+            target=self._finalize_loop, daemon=True, name="ckpt-finalizer")
+        self._finalizer.start()
 
     @staticmethod
     def _payload(state):
@@ -77,12 +116,82 @@ class CheckpointManager:
             return p
         return state
 
+    def _step_dir(self, epoch: int) -> str:
+        return os.path.join(self.directory, str(epoch))
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"[ckpt] {msg}", file=sys.stderr, flush=True)
+
+    # -- async-failure surfacing -------------------------------------------
+
+    def _record_async_failure(self, exc: BaseException) -> None:
+        with self._error_lock:
+            if self._async_error is None:  # first failure wins — it names
+                self._async_error = exc    # the epoch that actually broke
+
+    def _reraise_async_failure(self) -> None:
+        with self._error_lock:
+            err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
+
+    def _finalize_loop(self) -> None:
+        """Worker: per save, barrier on the Orbax commit, hash the committed
+        files + the payload leaves (host buffers — off the step loop's
+        critical path), write the manifest atomically, then run any armed
+        post-commit corruption injection. Failures (including Orbax's own
+        async-write errors, which surface from wait_until_finished) are
+        captured for the next save/flush barrier, never swallowed."""
+        while True:
+            item = self._finalize_q.get()
+            try:
+                if item is None:
+                    return
+                epoch, payload, host_state = item
+                if self.fault_injector is not None:
+                    self.fault_injector.during_async_save()
+                self._mgr.wait_until_finished()
+                step_dir = self._step_dir(epoch)
+                if not os.path.isdir(step_dir):
+                    # committed then already garbage-collected (keep=N churn
+                    # faster than the finalizer) — nothing left to stamp
+                    continue
+                manifest = integrity.build_manifest(
+                    epoch=epoch,
+                    leaves=integrity.leaf_entries(payload),
+                    files=integrity.hash_tree_files(step_dir),
+                    writer={"async_save": self.async_save,
+                            "process_index": jax.process_index(),
+                            "host_state_keys": sorted(host_state)})
+                integrity.write_manifest(step_dir, manifest)
+                if self.fault_injector is not None:
+                    self.fault_injector.corrupt_checkpoint(
+                        epoch, step_dir,
+                        manifest_name=integrity.MANIFEST_NAME)
+            except BaseException as e:  # noqa: BLE001 — captured, re-raised
+                self._record_async_failure(e)  # at the next barrier
+            finally:
+                self._finalize_q.task_done()
+
+    def _barrier(self) -> None:
+        """Wait for every enqueued finalization (which itself barriers on
+        the Orbax async write) — after this, all committed epochs carry
+        their manifests. Does NOT re-raise captured failures; that is
+        save()/flush()'s contract."""
+        self._finalize_q.join()
+        self._mgr.wait_until_finished()
+
+    # -- save ---------------------------------------------------------------
+
     def save(self, epoch: int, state, host_state: Optional[Dict[str, Any]] = None,
              metric: Optional[float] = None):
         """Save at `epoch` (reference saves per-epoch with epoch in the payload,
         ResNet/pytorch/train.py:417-428). A transient OSError (real, or the
         injector's) is retried with backoff under `retry_policy` before it is
-        allowed to kill the run."""
+        allowed to kill the run — and a failure captured from a PREVIOUS
+        save's async background write re-raises here first, through the same
+        retry path, instead of surfacing silently at close()."""
         payload = self._payload(state)
         if self.async_save:
             # Snapshot before backgrounding: the async writer keeps
@@ -98,11 +207,13 @@ class CheckpointManager:
                 lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
                 payload)
         metrics = {"best_metric": float(metric)} if metric is not None else None
+        result = {}
 
         def _save():
+            self._reraise_async_failure()
             if self.fault_injector is not None:
                 self.fault_injector.before_checkpoint_save()
-            self._mgr.save(
+            result["saved"] = self._mgr.save(
                 epoch,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(payload),
@@ -113,26 +224,123 @@ class CheckpointManager:
 
         call_with_retry(_save, self.retry_policy, what="ckpt_save",
                         on_retry=self.on_retry)
+        if result.get("saved", True):
+            self._finalize_q.put((epoch, payload, dict(host_state or {})))
+        else:
+            # orbax skips (returns False) when the step already exists —
+            # stamping a manifest from the NEW payload over the OLD bytes
+            # would read as corruption forever after, so don't
+            self._log(f"save skipped: epoch {epoch} already exists on disk "
+                      f"(orbax keeps the existing bytes)")
         if not self.async_save:
-            self._mgr.wait_until_finished()
+            self.flush()
+
+    # -- queries ------------------------------------------------------------
 
     def latest_epoch(self) -> Optional[int]:
-        self._mgr.wait_until_finished()
+        self._barrier()
         return self._mgr.latest_step()
 
     def best_epoch(self) -> Optional[int]:
-        self._mgr.wait_until_finished()
+        self._barrier()
         return self._mgr.best_step()
 
-    def restore(self, state, epoch: Optional[int] = None):
+    # -- restore ------------------------------------------------------------
+
+    def restore(self, state, epoch: Optional[int] = None,
+                verify: Any = "fallback"):
         """Restore into an abstract/concrete template (TrainState or pytree);
         returns (state, host_state, epoch). `epoch=None` → latest
-        (auto-resume-from-latest)."""
-        self._mgr.wait_until_finished()  # barrier on any in-flight async save
-        if epoch is None:
-            epoch = self._mgr.latest_step()
-        if epoch is None:
+        (auto-resume-from-latest).
+
+        `verify` (default "fallback"): check the epoch's integrity manifest
+        (file sizes/hashes before deserializing, restored leaf hashes after)
+        and on corruption QUARANTINE the epoch (`corrupt-<epoch>/`, logged
+        loudly) and fall back to the next-newest generation that verifies.
+        "strict" raises CheckpointCorruptionError instead of falling back;
+        "off" (or False) restores blindly. A fully-legacy dir — no manifest
+        anywhere, written before this layer existed — restores with a
+        one-line warning in every mode (not a breaking change)."""
+        mode = {True: "fallback", False: "off", None: "fallback"}.get(
+            verify, verify)
+        if mode not in VERIFY_MODES:
+            raise ValueError(f"verify must be one of {VERIFY_MODES} (or a "
+                             f"bool), got {verify!r}")
+        self._barrier()  # commits + manifests of any in-flight save
+        epochs = integrity.committed_epochs(self.directory)
+        if epoch is not None and epoch not in epochs:
+            raise FileNotFoundError(
+                f"no committed checkpoint at epoch {epoch} in "
+                f"{self.directory} (committed: {epochs or 'none'})")
+        candidates = [s for s in reversed(epochs)
+                      if epoch is None or s <= epoch]
+        if not candidates:
             return state, {}, None
+        if mode == "off":
+            new_state, host, got, _ = self._restore_epoch(state, candidates[0])
+            self.last_restore_info = {"epoch": got, "verified": False,
+                                      "mode": mode, "manifest_sha256": None}
+            return new_state, host, got
+        any_manifest = any(
+            os.path.exists(integrity.manifest_path(self._step_dir(s)))
+            for s in epochs)
+        attempts = []
+        for skipped, s in enumerate(candidates):
+            step_dir = self._step_dir(s)
+            status, detail = integrity.verify_files(step_dir)
+            if status == integrity.MISSING_MANIFEST and not any_manifest:
+                # legacy run dir predating the integrity layer: warn, don't
+                # break (pinned by tests — existing run dirs keep restoring)
+                self._log(f"epoch {s}: no integrity manifest (legacy "
+                          f"checkpoint, predates verification) — restoring "
+                          f"unverified")
+                new_state, host, got, _ = self._restore_epoch(state, s)
+                self.last_restore_info = {
+                    "epoch": got, "verified": False, "mode": mode,
+                    "legacy": True, "manifest_sha256": None}
+                return new_state, host, got
+            problem = None
+            if status == integrity.MISSING_MANIFEST:
+                problem = (f"epoch {s}: manifest missing while sibling "
+                           f"epochs carry one — save interrupted before "
+                           f"the manifest committed?")
+            elif status == integrity.CORRUPT:
+                problem = f"epoch {s}: {detail}"
+            else:
+                new_state, host, got, payload = self._restore_epoch(state, s)
+                manifest = integrity.load_manifest(step_dir)
+                mismatches = integrity.verify_leaves(payload, manifest)
+                if mismatches:
+                    problem = (f"epoch {s}: restored arrays disagree with "
+                               f"the manifest: " + "; ".join(mismatches[:3]))
+                else:
+                    self.last_restore_info = {
+                        "epoch": got, "verified": True, "mode": mode,
+                        "manifest_sha256": integrity.manifest_digest(manifest),
+                        "fallback_skipped": skipped}
+                    if skipped:
+                        self._log(f"restored epoch {got} after skipping "
+                                  f"{skipped} bad generation(s)")
+                    return new_state, host, got
+            if mode == "strict":
+                raise CheckpointCorruptionError(
+                    f"{problem} — refusing to restore (verify='strict'). "
+                    f"Audit with `python -m deepvision_tpu fsck "
+                    f"{self.directory}`, or restore with fallback/--no-verify "
+                    f"semantics to use an older generation.")
+            dest = integrity.quarantine_epoch(self.directory, s)
+            self._mgr.reload()  # orbax's step cache must drop the renamed dir
+            self._log(f"QUARANTINED {problem} -> {os.path.basename(dest)}; "
+                      f"falling back to the next-newest checkpoint")
+            attempts.append(problem)
+        raise CheckpointCorruptionError(
+            f"no checkpoint in {self.directory} passed verification: "
+            + " | ".join(attempts))
+
+    def _restore_epoch(self, state, epoch: int):
+        """One epoch's raw restore (retry-wrapped, EMA-slot tolerant,
+        donation-safe): returns (new_state, host_state, epoch, payload)
+        where `payload` is the copied on-disk tree for deep verification."""
         template = self._payload(state)
 
         def _restore(tmpl):
@@ -203,12 +411,25 @@ class CheckpointManager:
                 ema_params=ema)
         else:
             new_state = payload
-        return new_state, dict(restored["host"] or {}), epoch
+        return new_state, dict(restored["host"] or {}), epoch, payload
+
+    # -- lifecycle ----------------------------------------------------------
 
     def flush(self):
-        """Barrier on any in-flight async save (the manager stays usable)."""
-        self._mgr.wait_until_finished()
+        """Barrier on any in-flight async save AND its manifest
+        finalization (the manager stays usable) — then re-raise a failure
+        captured from the background write, so a broken save surfaces at a
+        well-defined point in the epoch loop instead of at close()."""
+        self._barrier()
+        self._reraise_async_failure()
 
     def close(self):
+        self._finalize_q.join()
+        self._finalize_q.put(None)  # sentinel: finalizer exits
+        self._finalizer.join(timeout=60)
         self._mgr.wait_until_finished()
         self._mgr.close()
+        # last resort for a failure no later save()/flush() ever observed
+        # (fit() flushes on every normal path, so reaching here means the
+        # caller is already unwinding — still better loud than silent)
+        self._reraise_async_failure()

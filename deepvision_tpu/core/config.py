@@ -185,6 +185,15 @@ class TrainConfig:
     # (composes with the plateau schedule's scale; persists for the rest of
     # the run — a blown-up run that needed a lower LR keeps it).
     recovery_lr_factor: float = 0.5
+    # Checkpoint-integrity mode when restoring (-c / --auto-resume /
+    # divergence rollback): "fallback" (default) verifies the epoch's
+    # integrity manifest and, on corruption, quarantines it
+    # (corrupt-<epoch>/) and resumes from the next-newest generation that
+    # verifies; "strict" raises CheckpointCorruptionError instead of
+    # falling back; "off" restores blindly (pre-integrity behavior).
+    # Legacy run dirs with no manifests restore with a warning in every
+    # mode. The CLI exposes --resume {strict,fallback}; docs/FAILURES.md.
+    resume_verify: str = "fallback"
     # In-process step watchdog (resilience.StepWatchdog): abort with
     # diagnostics (last step, last checkpoint epoch, prefetch queue depth +
     # all-thread stacks) when no train step completes for this many seconds.

@@ -1,0 +1,314 @@
+"""Checkpoint integrity: manifests, verification, quarantine, fsck.
+
+PR 1 made the trainer survive preemption and transient I/O, but every
+recovery path still trusted the newest checkpoint blindly: a host killed
+mid-async-save, a truncated write, or bit rot on flaky storage turns both
+auto-resume and serve-side weight loading into an opaque Orbax error and a
+dead run. Production checkpoint managers treat checkpoints as a verified,
+multi-generation lineage (Orbax/t5x-style management, PAPERS.md); this
+module is that proof layer:
+
+- every `CheckpointManager.save` commits a small **integrity manifest**
+  (`integrity_manifest.json` inside the committed epoch dir) recording the
+  per-leaf tree structure (shapes/dtypes + content hashes streamed over the
+  host buffers) and a per-file size+sha256 inventory of everything Orbax
+  wrote, plus writer metadata — written atomically AFTER the Orbax commit,
+  so a manifest's presence certifies the save finished;
+- `verify_files` / `verify_leaves` prove an epoch intact before anything
+  consumes it (file level without deserializing — fsck's path — and leaf
+  level against the restored arrays — restore's deep check);
+- `quarantine_epoch` renames a bad epoch to `corrupt-<epoch>` so fallback
+  restore can land on the next-newest generation that verifies and a later
+  re-save of the same epoch number cannot collide with the bad bytes;
+- `audit` drives the `python -m deepvision_tpu fsck` subcommand and
+  preflight's fsck check.
+
+Committed Orbax step dirs are immutable (the atomic tmp->digit rename is
+the commit marker, and later saves/GC never touch older steps — probed in
+tests), so file hashes taken right after the commit stay valid for the
+checkpoint's lifetime. Everything here is stdlib+numpy on the host; jax is
+imported lazily only for leaf hashing so the fsck CLI starts fast.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST_NAME = "integrity_manifest.json"
+MANIFEST_VERSION = 1
+QUARANTINE_PREFIX = "corrupt-"
+
+# verification statuses (audit/verify_files contract; fsck prints them)
+OK = "ok"
+CORRUPT = "corrupt"
+MISSING_MANIFEST = "missing-manifest"
+QUARANTINED = "quarantined"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification: strict mode refused it,
+    or fallback mode exhausted every generation without one verifying."""
+
+
+def _log(msg: str) -> None:
+    # stderr like the trainers' retry hook: corruption events must be loud
+    # on every host, not buried in a return value
+    print(f"[ckpt-integrity] {msg}", file=sys.stderr, flush=True)
+
+
+# -- hashing -------------------------------------------------------------------
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> Tuple[int, str]:
+    """(size, sha256) of a file, streamed — checkpoint shards can be GBs."""
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as fp:
+        while True:
+            block = fp.read(chunk)
+            if not block:
+                break
+            size += len(block)
+            h.update(block)
+    return size, h.hexdigest()
+
+
+def leaf_entries(payload) -> Dict[str, dict]:
+    """Per-leaf {keypath: {shape, dtype, sha256}} over a payload pytree.
+    Hashes are over the host buffer bytes (device_get then tobytes), so the
+    same values always hash the same regardless of sharding; a leaf that
+    cannot become an array (rare host metadata) hashes its repr instead."""
+    import jax  # lazy: fsck's file-level path never needs it
+    import numpy as np
+
+    out: Dict[str, dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(payload)[0]:
+        key = jax.tree_util.keystr(path)
+        try:
+            arr = np.asarray(jax.device_get(leaf))
+            out[key] = {
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "sha256": hashlib.sha256(
+                    np.ascontiguousarray(arr).tobytes()).hexdigest(),
+            }
+        except Exception:  # noqa: BLE001 — non-array host leaf
+            out[key] = {"repr_sha256": hashlib.sha256(
+                repr(leaf).encode()).hexdigest()}
+    return out
+
+
+def hash_tree_files(step_dir: str) -> Dict[str, dict]:
+    """{relpath: {bytes, sha256}} for every file under a committed epoch dir
+    (the manifest itself excluded — it describes, it isn't described)."""
+    out: Dict[str, dict] = {}
+    for root, dirs, files in os.walk(step_dir):
+        dirs.sort()
+        for f in sorted(files):
+            if root == step_dir and f == MANIFEST_NAME:
+                continue
+            path = os.path.join(root, f)
+            rel = os.path.relpath(path, step_dir).replace(os.sep, "/")
+            size, digest = file_sha256(path)
+            out[rel] = {"bytes": size, "sha256": digest}
+    return out
+
+
+# -- manifest ------------------------------------------------------------------
+
+def build_manifest(*, epoch: int, leaves: Dict[str, dict],
+                   files: Dict[str, dict],
+                   writer: Optional[dict] = None) -> dict:
+    return {
+        "format_version": MANIFEST_VERSION,
+        "epoch": int(epoch),
+        "created_unix": time.time(),
+        "writer": {"hostname": socket.gethostname(), "pid": os.getpid(),
+                   **(writer or {})},
+        "total_bytes": sum(f["bytes"] for f in files.values()),
+        "files": files,
+        "leaves": leaves,
+    }
+
+
+def manifest_digest(manifest: dict) -> str:
+    """Canonical sha256 of a manifest — the provenance fingerprint serving
+    replicas report (/healthz) so a fleet can be audited for weight skew."""
+    blob = json.dumps(manifest, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def manifest_path(step_dir: str) -> str:
+    return os.path.join(step_dir, MANIFEST_NAME)
+
+
+def write_manifest(step_dir: str, manifest: dict) -> str:
+    """Atomic commit: tmp + fsync + rename, so a kill mid-write leaves NO
+    manifest (the epoch then reads as missing-manifest, never as a torn
+    manifest that happens to parse)."""
+    path = manifest_path(step_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fp:
+        json.dump(manifest, fp, sort_keys=True, indent=1)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(step_dir: str) -> Optional[dict]:
+    path = manifest_path(step_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fp:
+        return json.load(fp)
+
+
+# -- verification --------------------------------------------------------------
+
+def verify_files(step_dir: str) -> Tuple[str, str]:
+    """File-level check of one committed epoch against its manifest,
+    without deserializing anything: (status, detail) where status is OK /
+    CORRUPT / MISSING_MANIFEST. Catches exactly the boring production
+    corruption classes — truncation (size), bit rot (hash), deleted or
+    torn files (missing / unreadable manifest)."""
+    if not os.path.isdir(step_dir):
+        return CORRUPT, "checkpoint directory missing"
+    if not os.path.exists(manifest_path(step_dir)):
+        return MISSING_MANIFEST, "no integrity manifest"
+    try:
+        manifest = load_manifest(step_dir)
+    except (OSError, ValueError) as e:
+        return CORRUPT, f"unreadable manifest: {e}"
+    problems: List[str] = []
+    files = manifest.get("files", {})
+    for rel, rec in sorted(files.items()):
+        path = os.path.join(step_dir, rel.replace("/", os.sep))
+        if not os.path.isfile(path):
+            problems.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(path)
+        if size != rec["bytes"]:
+            problems.append(f"{rel}: {size} bytes, manifest says "
+                            f"{rec['bytes']} (truncated write?)")
+            continue
+        if file_sha256(path)[1] != rec["sha256"]:
+            problems.append(f"{rel}: content hash mismatch (bit rot?)")
+    if problems:
+        head = "; ".join(problems[:4])
+        more = f" (+{len(problems) - 4} more)" if len(problems) > 4 else ""
+        return CORRUPT, head + more
+    return OK, f"{len(files)} files verified"
+
+
+def verify_leaves(payload, manifest: dict) -> List[str]:
+    """Deep check: restored payload leaves vs the manifest's save-time
+    hashes. Compares the intersection of keypaths only — the EMA slot is
+    legitimately template-dependent (checkpoint.py's flip logic), so a
+    missing/extra leaf is a structure difference, not corruption."""
+    got = leaf_entries(payload)
+    want = manifest.get("leaves", {})
+    mismatches: List[str] = []
+    for key in sorted(set(got) & set(want)):
+        for field in ("shape", "dtype", "sha256", "repr_sha256"):
+            if field in want[key] and want[key][field] != got[key].get(field):
+                mismatches.append(
+                    f"{key}: {field} {got[key].get(field)!r} != manifest "
+                    f"{want[key][field]!r}")
+                break
+    return mismatches
+
+
+# -- run-dir layout ------------------------------------------------------------
+
+def committed_epochs(ckpt_dir: str) -> List[int]:
+    """Ascending committed epochs: orbax finalizes by atomically renaming
+    the tmp dir to `<epoch>`, so a pure-digit directory name IS the commit
+    marker (same predicate as tests/test_preemption.py)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(d) for d in os.listdir(ckpt_dir)
+                  if d.isdigit() and os.path.isdir(os.path.join(ckpt_dir, d)))
+
+
+def quarantined_dirs(ckpt_dir: str) -> List[str]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(d for d in os.listdir(ckpt_dir)
+                  if d.startswith(QUARANTINE_PREFIX)
+                  and os.path.isdir(os.path.join(ckpt_dir, d)))
+
+
+def quarantine_epoch(ckpt_dir: str, epoch: int) -> str:
+    """Rename `<epoch>` -> `corrupt-<epoch>` (collision appends `.2`,
+    `.3`, ...): the bad bytes stay on disk for forensics, stop shadowing
+    older verified generations, and can never collide with a re-save of
+    the same epoch number after the fallback resume retrains it."""
+    src = os.path.join(ckpt_dir, str(epoch))
+    dest = os.path.join(ckpt_dir, f"{QUARANTINE_PREFIX}{epoch}")
+    n = 1
+    while os.path.exists(dest):
+        n += 1
+        dest = os.path.join(ckpt_dir, f"{QUARANTINE_PREFIX}{epoch}.{n}")
+    os.rename(src, dest)
+    return dest
+
+
+def audit(ckpt_dir: str, quarantine: bool = False) -> List[dict]:
+    """fsck one checkpoint dir: a record per committed epoch (OK / CORRUPT /
+    MISSING_MANIFEST + detail) plus one per already-quarantined dir. With
+    `quarantine=True`, CORRUPT epochs — and missing-manifest epochs in a
+    dir whose other epochs DO carry manifests (an interrupted save, by this
+    writer's contract) — are renamed aside; a fully-legacy dir (no
+    manifests anywhere) is never touched, only reported."""
+    epochs = committed_epochs(ckpt_dir)
+    any_manifest = any(
+        os.path.exists(manifest_path(os.path.join(ckpt_dir, str(e))))
+        for e in epochs)
+    records: List[dict] = []
+    for epoch in epochs:
+        step_dir = os.path.join(ckpt_dir, str(epoch))
+        status, detail = verify_files(step_dir)
+        rec = {"epoch": epoch, "status": status, "detail": detail}
+        if status == OK:
+            manifest = load_manifest(step_dir)
+            rec["manifest_sha256"] = manifest_digest(manifest)
+            rec["total_bytes"] = manifest.get("total_bytes")
+        suspect = status == CORRUPT or (status == MISSING_MANIFEST
+                                        and any_manifest)
+        if quarantine and suspect:
+            rec["quarantined_to"] = os.path.basename(
+                quarantine_epoch(ckpt_dir, epoch))
+            _log(f"fsck: quarantined epoch {epoch} -> "
+                 f"{rec['quarantined_to']} ({detail})")
+        records.append(rec)
+    for d in quarantined_dirs(ckpt_dir):
+        records.append({"epoch": None, "status": QUARANTINED, "detail": d})
+    return records
+
+
+def find_checkpoint_dirs(path: str) -> List[str]:
+    """Checkpoint dirs under `path` for the fsck CLI: `path` itself when it
+    holds committed epochs (or quarantined ones), its `ckpt/` child (a run
+    workdir), else every `<child>/ckpt` one level down (a runs/ root)."""
+    def is_ckpt_dir(p: str) -> bool:
+        return bool(committed_epochs(p) or quarantined_dirs(p)
+                    or os.path.basename(p.rstrip(os.sep)) == "ckpt")
+
+    if is_ckpt_dir(path):
+        return [path]
+    child = os.path.join(path, "ckpt")
+    if os.path.isdir(child):
+        return [child]
+    found = []
+    for name in sorted(os.listdir(path)):
+        sub = os.path.join(path, name, "ckpt")
+        if os.path.isdir(sub):
+            found.append(sub)
+    return found

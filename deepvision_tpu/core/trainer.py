@@ -540,11 +540,19 @@ class Trainer:
             print(f"[{self.config.name}] grad correction verified at "
                   f"production batch {b_real}", flush=True)
 
-    def resume(self, epoch: Optional[int] = None) -> Optional[int]:
+    def resume(self, epoch: Optional[int] = None,
+               verify: Optional[str] = None) -> Optional[int]:
         """Restore latest (or given) checkpoint — the `-c` / auto-resume UX
-        (`ResNet/pytorch/train.py:552-557`, `YOLO/tensorflow/train.py:300-304`)."""
+        (`ResNet/pytorch/train.py:552-557`, `YOLO/tensorflow/train.py:300-304`).
+
+        `verify` overrides `config.resume_verify` (fallback/strict/off —
+        core/checkpoint.py): by default a corrupt latest checkpoint is
+        quarantined and the run resumes from the next-newest epoch that
+        verifies instead of dying on an opaque deserialization error."""
         assert self.state is not None, "call init_state first"
-        state, host, got = self.ckpt.restore(self.state, epoch)
+        state, host, got = self.ckpt.restore(
+            self.state, epoch,
+            verify=verify if verify is not None else self.config.resume_verify)
         if got is None:
             return None
         self.state = state
@@ -563,6 +571,17 @@ class Trainer:
             # cycle (a run can stop mid-cycle when accum doesn't divide
             # steps_per_epoch)
             self._micro_count = int(self.state.opt_state.mini_step)
+        info = self.ckpt.last_restore_info or {}
+        if _is_main_process() and (info.get("fallback_skipped")
+                                   or not info.get("verified", False)):
+            # corruption fallback / unverified (legacy) restore: forensics
+            # belong in the metrics stream, not only on stderr
+            self.logger.log(self._host_step,
+                            {"ckpt_fallback_generations":
+                                float(info.get("fallback_skipped") or 0),
+                             "ckpt_verified":
+                                1.0 if info.get("verified") else 0.0},
+                            prefix="resilience_", echo=False)
         if _is_main_process():
             print(f"[{self.config.name}] resumed from epoch {got}", flush=True)
         return got

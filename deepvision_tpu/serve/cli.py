@@ -41,6 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "smoke serving")
     p.add_argument("--image-size", type=int, default=None,
                    help="serving resolution (default: the config's)")
+    p.add_argument("--no-verify", action="store_true",
+                   help="serve weights whose checkpoint fails (or skips) "
+                        "integrity verification — by default a corrupt "
+                        "checkpoint REFUSES to serve "
+                        "(CheckpointCorruptionError; audit with `python -m "
+                        "deepvision_tpu fsck <workdir>`); legacy workdirs "
+                        "with no manifests always serve, flagged "
+                        "verified:false on /healthz")
     p.add_argument("--buckets", default="1,8,32",
                    help="comma-separated batch buckets compiled at startup "
                         "(max-batch is appended; default 1,8,32)")
@@ -159,7 +167,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     engine = PredictEngine.from_config(
         args.model, workdir=args.workdir, checkpoint=args.checkpoint,
         image_size=args.image_size, buckets=buckets,
-        max_batch=args.max_batch)
+        max_batch=args.max_batch, verify=not args.no_verify)
     engine.warmup()
     server = InferenceServer(
         engine, max_delay_ms=args.max_delay_ms,

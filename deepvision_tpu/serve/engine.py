@@ -79,7 +79,8 @@ class PredictEngine:
                  compute_dtype=jnp.bfloat16,
                  input_norm: Optional[Tuple] = None,
                  take_first_output: bool = False,
-                 name: str = "model", verbose: bool = True):
+                 name: str = "model", verbose: bool = True,
+                 provenance: Optional[dict] = None):
         bs = sorted({int(b) for b in buckets})
         if not bs or bs[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
@@ -93,6 +94,12 @@ class PredictEngine:
         self.max_batch = max_batch
         self.example_shape = tuple(example_shape)
         self.name = name
+        # weight provenance, reported on /healthz and /stats so a fleet of
+        # replicas can be audited for skew (same epoch? same manifest hash?
+        # verified?) — filled by from_config when restoring a checkpoint
+        self.provenance = dict(provenance or {
+            "weights": "random-init", "checkpoint_epoch": None,
+            "verified": False, "manifest_sha256": None})
         self.input_dtype = np.dtype(np.uint8 if input_norm is not None
                                     else np.float32)
         # params live on ONE device, committed once — compiled calls reuse
@@ -121,12 +128,22 @@ class PredictEngine:
                     checkpoint=None, image_size: Optional[int] = None,
                     buckets: Sequence[int] = (1, 8, 32),
                     max_batch: Optional[int] = None,
-                    verbose: bool = True) -> "PredictEngine":
+                    verbose: bool = True,
+                    verify: bool = True) -> "PredictEngine":
         """Build an engine for a registered config. With a `workdir`, the
         latest (or given-epoch) checkpoint is restored through the config's
         own trainer family — EMA weights win when present, exactly the
         weights validation scored (`Trainer.eval_state`); without one, the
-        params are a fresh init (smoke/bench use)."""
+        params are a fresh init (smoke/bench use).
+
+        `verify=True` (default) restores in STRICT integrity mode: a
+        checkpoint whose manifest does not verify raises
+        CheckpointCorruptionError instead of serving silently corrupt
+        weights (`--no-verify` on the serve CLI disables; a legacy workdir
+        with no manifests serves with a warning and `verified: false`
+        provenance). The resulting provenance — checkpoint epoch, manifest
+        hash, verified flag — lands on `engine.provenance` and the
+        server's /healthz and /stats."""
         from ..configs import get_config, trainer_class_for_config
         cfg = get_config(name)
         if cfg.family == "gan":
@@ -136,16 +153,32 @@ class PredictEngine:
         image_size = image_size or cfg.data.image_size
         sample_shape = (image_size, image_size, cfg.data.channels)
         compute_dtype = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+        provenance = None
         if workdir:
             trainer = trainer_class_for_config(name)(cfg, workdir=workdir)
             try:
                 trainer.init_state(sample_shape)
                 got = trainer.resume(
                     None if checkpoint in (None, "latest")
-                    else int(checkpoint))
+                    else int(checkpoint),
+                    verify="strict" if verify else "off")
                 if got is None and verbose:
                     print(f"[serve:{cfg.name}] WARNING: nothing restorable "
                           f"in {workdir!r} — serving RANDOM weights",
+                          flush=True)
+                info = trainer.ckpt.last_restore_info or {}
+                provenance = {
+                    "weights": ("checkpoint" if got is not None
+                                else "random-init"),
+                    "checkpoint_epoch": got,
+                    "verified": bool(info.get("verified", False)),
+                    "manifest_sha256": info.get("manifest_sha256"),
+                }
+                if (got is not None and not provenance["verified"]
+                        and verbose):
+                    print(f"[serve:{cfg.name}] WARNING: serving UNVERIFIED "
+                          f"weights (epoch {got}: "
+                          f"{'legacy checkpoint without a manifest' if info.get('legacy') else 'verification off'})",
                           flush=True)
                 st = trainer.eval_state()
                 apply_fn = st.apply_fn
@@ -170,7 +203,7 @@ class PredictEngine:
                    buckets=buckets, max_batch=max_batch,
                    compute_dtype=compute_dtype, input_norm=input_norm,
                    take_first_output=cfg.family == "classification",
-                   name=cfg.name, verbose=verbose)
+                   name=cfg.name, verbose=verbose, provenance=provenance)
 
     # -- compilation -------------------------------------------------------
 

@@ -147,10 +147,17 @@ def _make_handler(server: InferenceServer):
                     "model": server.engine.name,
                     "buckets": list(server.engine.buckets),
                     "max_batch": server.batcher.max_batch,
+                    # weight provenance (checkpoint epoch + integrity-
+                    # manifest hash + verified flag): diff it across
+                    # replicas to audit a fleet for weight skew
+                    "weights": server.engine.provenance,
                 })
             elif self.path == "/stats":
-                self._json(200, server.metrics.snapshot(
-                    queue_depth=server.batcher.queue_depth))
+                self._json(200, {
+                    **server.metrics.snapshot(
+                        queue_depth=server.batcher.queue_depth),
+                    "weights": server.engine.provenance,
+                })
             else:
                 self._json(404, {"error": f"unknown path {self.path!r}"})
 

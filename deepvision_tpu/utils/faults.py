@@ -1,13 +1,19 @@
 """Deterministic fault injection for the resilience subsystem.
 
-Every recovery path in core/resilience.py must be testable on CPU without a
-flaky TPU pod to provide the faults, so the injector fakes the three failure
-classes the north star's production runs actually see (ROADMAP.md; TPU-pod
-preemptions and flaky storage are routine at scale):
+Every recovery path in core/resilience.py (and the checkpoint-integrity
+layer in core/integrity.py) must be testable on CPU without a flaky TPU pod
+to provide the faults, so the injector fakes the failure classes the north
+star's production runs actually see (ROADMAP.md; TPU-pod preemptions and
+flaky storage are routine at scale):
 
 - transient I/O errors from the host data pipeline,
 - a loss blow-up (NaN) at a known step,
-- checkpoint writes that fail transiently.
+- checkpoint writes that fail transiently,
+- checkpoint writes that fail in the ASYNC background path (after the
+  synchronous enqueue already succeeded),
+- a checkpoint that COMMITS and then rots on disk (truncated file, flipped
+  bit, or a manifest lost to a kill between the data commit and the
+  manifest commit).
 
 Configuration is environment-driven so subprocess tests (CLI entrypoints)
 and in-process tests configure it the same way:
@@ -23,6 +29,18 @@ and in-process tests configure it the same way:
                                              jitted program (one-shot)
     DEEPVISION_FAULT_CKPT_SAVE_FAILS=M       raise OSError from the first M
                                              checkpoint save() calls
+    DEEPVISION_FAULT_CKPT_ASYNC_FAILS=M      raise OSError inside the first M
+                                             background finalizations — the
+                                             failure class the synchronous
+                                             enqueue retry can never see
+    DEEPVISION_FAULT_CKPT_CORRUPT=k:mode     after epoch k's save commits
+                                             (manifest written), corrupt it on
+                                             disk (one-shot). mode: `truncate`
+                                             (halve the largest payload file),
+                                             `bitflip` (flip one bit in its
+                                             middle), `delete_manifest` (what
+                                             a kill between data commit and
+                                             manifest commit leaves behind)
 
 An unset environment yields an inert injector (`active` False) whose hooks
 are cheap no-ops — production runs pay two integer compares per batch.
@@ -31,9 +49,12 @@ are cheap no-ops — production runs pay two integer compares per batch.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional, Tuple
 
 import numpy as np
+
+CORRUPT_MODES = ("truncate", "bitflip", "delete_manifest")
 
 
 def _parse_step_count(raw: Optional[str]) -> Tuple[Optional[int], int]:
@@ -41,6 +62,17 @@ def _parse_step_count(raw: Optional[str]) -> Tuple[Optional[int], int]:
         return None, 0
     step, _, count = raw.partition(":")
     return int(step), int(count) if count else 1
+
+
+def _parse_epoch_mode(raw: Optional[str]) -> Tuple[Optional[int], Optional[str]]:
+    if not raw:
+        return None, None
+    epoch, _, mode = raw.partition(":")
+    mode = mode or "bitflip"
+    if mode not in CORRUPT_MODES:
+        raise ValueError(f"DEEPVISION_FAULT_CKPT_CORRUPT mode must be one of "
+                         f"{CORRUPT_MODES}, got {mode!r}")
+    return int(epoch), mode
 
 
 class FaultInjector:
@@ -51,13 +83,20 @@ class FaultInjector:
     def __init__(self, data_io_step: Optional[int] = None,
                  data_io_count: int = 1,
                  nan_step: Optional[int] = None,
-                 ckpt_save_fails: int = 0):
+                 ckpt_save_fails: int = 0,
+                 ckpt_async_fails: int = 0,
+                 ckpt_corrupt_epoch: Optional[int] = None,
+                 ckpt_corrupt_mode: Optional[str] = None):
         self.data_io_step = data_io_step
         self.data_io_remaining = data_io_count if data_io_step is not None else 0
         self.nan_step = nan_step
         self.ckpt_save_fails = ckpt_save_fails
+        self.ckpt_async_fails = ckpt_async_fails
+        self.ckpt_corrupt_epoch = ckpt_corrupt_epoch
+        self.ckpt_corrupt_mode = ckpt_corrupt_mode
         self._batch_index = 0   # advances once per batch PULLED (post-fault)
         self._save_index = 0
+        self._async_index = 0
 
     @classmethod
     def from_env(cls, env=None) -> "FaultInjector":
@@ -65,15 +104,22 @@ class FaultInjector:
         io_step, io_count = _parse_step_count(
             env.get("DEEPVISION_FAULT_DATA_IO_STEP"))
         nan_step, _ = _parse_step_count(env.get("DEEPVISION_FAULT_NAN_STEP"))
+        corrupt_epoch, corrupt_mode = _parse_epoch_mode(
+            env.get("DEEPVISION_FAULT_CKPT_CORRUPT"))
         return cls(data_io_step=io_step, data_io_count=io_count,
                    nan_step=nan_step,
                    ckpt_save_fails=int(
-                       env.get("DEEPVISION_FAULT_CKPT_SAVE_FAILS", "0")))
+                       env.get("DEEPVISION_FAULT_CKPT_SAVE_FAILS", "0")),
+                   ckpt_async_fails=int(
+                       env.get("DEEPVISION_FAULT_CKPT_ASYNC_FAILS", "0")),
+                   ckpt_corrupt_epoch=corrupt_epoch,
+                   ckpt_corrupt_mode=corrupt_mode)
 
     @property
     def active(self) -> bool:
         return (self.data_io_step is not None or self.nan_step is not None
-                or self.ckpt_save_fails > 0)
+                or self.ckpt_save_fails > 0 or self.ckpt_async_fails > 0
+                or self.ckpt_corrupt_epoch is not None)
 
     # -- hooks -------------------------------------------------------------
     def before_batch(self) -> None:
@@ -112,3 +158,51 @@ class FaultInjector:
             raise OSError(
                 f"injected transient checkpoint-write failure "
                 f"({i + 1}/{self.ckpt_save_fails})")
+
+    def during_async_save(self) -> None:
+        """Called from the checkpoint finalizer thread (core/checkpoint.py)
+        AFTER the synchronous enqueue succeeded; the first M calls raise —
+        the background-writer failure the enqueue-side retry can never see,
+        which must surface at the next save/flush barrier rather than at
+        close()."""
+        i = self._async_index
+        self._async_index += 1
+        if i < self.ckpt_async_fails:
+            raise OSError(
+                f"injected async checkpoint-write failure "
+                f"({i + 1}/{self.ckpt_async_fails})")
+
+    def corrupt_checkpoint(self, epoch: int, step_dir: str,
+                           manifest_name: str = "integrity_manifest.json"
+                           ) -> None:
+        """Called after epoch `epoch`'s save fully committed (data + manifest
+        on disk): deterministically corrupt it so the verification/fallback
+        path is exercised end-to-end against real on-disk damage. One-shot;
+        file choice is deterministic (largest payload file, path as the
+        tiebreak)."""
+        if self.ckpt_corrupt_epoch is None or epoch != self.ckpt_corrupt_epoch:
+            return
+        mode = self.ckpt_corrupt_mode
+        self.ckpt_corrupt_epoch = None
+        if mode == "delete_manifest":
+            target = os.path.join(step_dir, manifest_name)
+            os.remove(target)
+        else:
+            candidates = sorted(
+                (os.path.join(root, f)
+                 for root, _, files in os.walk(step_dir) for f in files
+                 if f != manifest_name),
+                key=lambda p: (os.path.getsize(p), p))
+            target = candidates[-1]
+            if mode == "truncate":
+                with open(target, "r+b") as fp:
+                    fp.truncate(max(1, os.path.getsize(target) // 2))
+            else:  # bitflip
+                with open(target, "r+b") as fp:
+                    fp.seek(os.path.getsize(target) // 2)
+                    byte = fp.read(1) or b"\x00"
+                    fp.seek(-len(byte), 1)
+                    fp.write(bytes([byte[0] ^ 0x80]))
+        print(f"[faults] corrupted checkpoint epoch {epoch} ({mode}: "
+              f"{os.path.relpath(target, step_dir)})",
+              file=sys.stderr, flush=True)

@@ -1,0 +1,417 @@
+"""Checkpoint-integrity layer (core/integrity.py + CheckpointManager):
+manifest roundtrip, truncation/bit-flip/missing-manifest fallback with
+quarantine, strict-mode refusal, legacy (pre-manifest) compatibility,
+async-save error surfacing at the save/flush barrier, serve-side
+provenance, the fsck CLI's exit-code contract, and the acceptance case —
+an in-process resume whose corrupt latest epoch falls back to the
+next-older verified generation and trains to completion."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.core import integrity
+from deepvision_tpu.core.checkpoint import (CheckpointCorruptionError,
+                                            CheckpointManager)
+from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                        ScheduleConfig, TrainConfig)
+from deepvision_tpu.core.resilience import RetryPolicy
+from deepvision_tpu.data.synthetic import SyntheticClassification
+from deepvision_tpu.utils.faults import FaultInjector
+
+FAST = RetryPolicy(max_retries=3, base_delay=0.01, max_delay=0.02)
+
+
+def _payload(k=1):
+    return {"step": np.full((), k, np.int32),
+            "params": {"w": np.arange(32, dtype=np.float32) * k,
+                       "b": np.ones((4, 4), np.float32) * k}}
+
+
+def _mgr(path, **kw):
+    kw.setdefault("keep", 8)
+    kw.setdefault("keep_best", False)
+    kw.setdefault("retry_policy", FAST)
+    return CheckpointManager(str(path), **kw)
+
+
+def _save_epochs(path, *epochs, **kw):
+    m = _mgr(path, **kw)
+    for e in epochs:
+        m.save(e, _payload(e))
+    m.flush()
+    return m
+
+
+def _largest_file(step_dir):
+    return max((os.path.join(r, f) for r, _, fs in os.walk(step_dir)
+                for f in fs if f != integrity.MANIFEST_NAME),
+               key=os.path.getsize)
+
+
+def _bitflip(path):
+    with open(path, "r+b") as fp:
+        fp.seek(os.path.getsize(path) // 2)
+        byte = fp.read(1)
+        fp.seek(-1, 1)
+        fp.write(bytes([byte[0] ^ 0x80]))
+
+
+# -- manifest roundtrip -------------------------------------------------------
+
+def test_manifest_roundtrip(tmp_path):
+    """Every save commits a manifest into the epoch dir: per-leaf
+    shapes/dtypes/content hashes + a per-file inventory that matches the
+    bytes orbax actually wrote; strict restore verifies it and reports the
+    manifest digest as provenance."""
+    m = _save_epochs(tmp_path / "ckpt", 1)
+    step_dir = str(tmp_path / "ckpt" / "1")
+    manifest = integrity.load_manifest(step_dir)
+    assert manifest is not None and manifest["epoch"] == 1
+    leaf = manifest["leaves"]["['params']['w']"]
+    assert leaf["shape"] == [32] and leaf["dtype"] == "float32"
+    assert len(leaf["sha256"]) == 64
+    for rel, rec in manifest["files"].items():
+        assert os.path.getsize(os.path.join(step_dir, rel)) == rec["bytes"]
+    assert manifest["total_bytes"] > 0 and manifest["writer"]["pid"]
+    assert integrity.verify_files(step_dir) == (
+        integrity.OK, f"{len(manifest['files'])} files verified")
+
+    restored, _, epoch = m.restore(_payload(0), verify="strict")
+    assert epoch == 1
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  _payload(1)["params"]["w"])
+    info = m.last_restore_info
+    assert info["verified"] is True and info["fallback_skipped"] == 0
+    assert info["manifest_sha256"] == integrity.manifest_digest(manifest)
+    m.close()
+
+
+# -- fallback + quarantine ----------------------------------------------------
+
+def test_truncation_falls_back_and_quarantines(tmp_path):
+    """Truncated latest epoch: restore lands on epoch N-1 and the bad epoch
+    is renamed corrupt-<N> (kept for forensics, out of the lineage)."""
+    m = _save_epochs(tmp_path / "ckpt", 1, 2)
+    target = _largest_file(str(tmp_path / "ckpt" / "2"))
+    with open(target, "r+b") as fp:
+        fp.truncate(os.path.getsize(target) // 2)
+    restored, _, epoch = m.restore(_payload(0))
+    assert epoch == 1
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  _payload(1)["params"]["w"])
+    assert (tmp_path / "ckpt" / "corrupt-2").is_dir()
+    assert not (tmp_path / "ckpt" / "2").exists()
+    assert m.last_restore_info["fallback_skipped"] == 1
+    # the quarantined epoch number is free again: a retrained epoch 2
+    # saves fresh instead of colliding with (or silently keeping) bad bytes
+    m.save(2, _payload(20))
+    m.flush()
+    _, _, epoch = m.restore(_payload(0))
+    assert epoch == 2
+    m.close()
+
+
+def test_injector_bitflip_falls_back(tmp_path, monkeypatch):
+    """DEEPVISION_FAULT_CKPT_CORRUPT=2:bitflip corrupts epoch 2 right after
+    its save commits; fallback restore detects it via the file hashes and
+    lands on epoch 1."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:bitflip")
+    inj = FaultInjector.from_env()
+    assert inj.active
+    m = _save_epochs(tmp_path / "ckpt", 1, 2, fault_injector=inj)
+    assert integrity.verify_files(str(tmp_path / "ckpt" / "2"))[0] == \
+        integrity.CORRUPT
+    _, _, epoch = m.restore(_payload(0))
+    assert epoch == 1
+    assert (tmp_path / "ckpt" / "corrupt-2").is_dir()
+    m.close()
+
+
+def test_missing_manifest_falls_back(tmp_path, monkeypatch):
+    """A committed epoch with no manifest in a dir whose siblings have one
+    (exactly what a kill between the data commit and the manifest commit
+    leaves behind — here via the delete_manifest injector): skipped AND
+    quarantined, resume lands one generation back."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:delete_manifest")
+    m = _save_epochs(tmp_path / "ckpt", 1, 2,
+                     fault_injector=FaultInjector.from_env())
+    assert not os.path.exists(
+        integrity.manifest_path(str(tmp_path / "ckpt" / "2")))
+    _, _, epoch = m.restore(_payload(0))
+    assert epoch == 1
+    assert (tmp_path / "ckpt" / "corrupt-2").is_dir()
+    m.close()
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    m = _save_epochs(tmp_path / "ckpt", 1, 2)
+    for e in (1, 2):
+        _bitflip(_largest_file(str(tmp_path / "ckpt" / str(e))))
+    with pytest.raises(CheckpointCorruptionError, match="no checkpoint"):
+        m.restore(_payload(0))
+    m.close()
+
+
+def test_strict_mode_raises_without_quarantine(tmp_path):
+    """verify='strict' (the serve default / --resume strict): a corrupt
+    latest raises instead of silently serving an older generation — and
+    mutates nothing (no quarantine; the operator decides)."""
+    m = _save_epochs(tmp_path / "ckpt", 1, 2)
+    _bitflip(_largest_file(str(tmp_path / "ckpt" / "2")))
+    with pytest.raises(CheckpointCorruptionError, match="strict"):
+        m.restore(_payload(0), verify="strict")
+    assert (tmp_path / "ckpt" / "2").is_dir()
+    assert not (tmp_path / "ckpt" / "corrupt-2").exists()
+    # verify='off' restores the corrupt bytes blindly (the old behavior,
+    # kept as an explicit escape hatch) — orbax may or may not notice
+    m.close()
+
+
+def test_legacy_checkpoints_restore_with_warning(tmp_path, capfd):
+    """A run dir written before the integrity layer (no manifest anywhere)
+    restores with a one-line warning, not a failure — the feature is not a
+    breaking change for existing run dirs."""
+    m = _save_epochs(tmp_path / "ckpt", 1, 2)
+    for e in (1, 2):
+        os.remove(integrity.manifest_path(str(tmp_path / "ckpt" / str(e))))
+    restored, _, epoch = m.restore(_payload(0))
+    assert epoch == 2
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  _payload(2)["params"]["w"])
+    info = m.last_restore_info
+    assert info["verified"] is False and info.get("legacy") is True
+    assert integrity.quarantined_dirs(str(tmp_path / "ckpt")) == []
+    assert "legacy" in capfd.readouterr().err
+    m.close()
+
+
+def test_quarantine_naming_collision(tmp_path):
+    """corrupt-<epoch>, then corrupt-<epoch>.2, .3 ... — a twice-corrupted
+    epoch number never overwrites earlier forensics."""
+    root = tmp_path / "ckpt"
+    for expected in ("corrupt-7", "corrupt-7.2", "corrupt-7.3"):
+        (root / "7").mkdir(parents=True)
+        dest = integrity.quarantine_epoch(str(root), 7)
+        assert os.path.basename(dest) == expected
+
+
+# -- async-save failure surfacing ---------------------------------------------
+
+def test_async_save_failure_surfaces_at_flush(tmp_path, monkeypatch):
+    """A failure inside the background write (after the synchronous enqueue
+    already succeeded) is captured by the finalizer and re-raised at the
+    next flush() barrier — not silently at close()."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_ASYNC_FAILS", "1")
+    m = _mgr(tmp_path / "ckpt", fault_injector=FaultInjector.from_env())
+    m.save(1, _payload(1))
+    with pytest.raises(OSError, match="injected async"):
+        m.flush()
+    m.save(2, _payload(2))  # the manager stays usable
+    m.flush()
+    _, _, epoch = m.restore(_payload(0))
+    assert epoch == 2
+    m.close()
+
+
+def test_async_save_failure_retried_at_next_save(tmp_path, monkeypatch):
+    """The captured background failure re-raises through the
+    what='ckpt_save' retry path at the next save(): logged via on_retry
+    (stderr + metrics stream in the trainer), then the NEW save proceeds."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_ASYNC_FAILS", "1")
+    events = []
+    m = _mgr(tmp_path / "ckpt", fault_injector=FaultInjector.from_env(),
+             on_retry=lambda what, attempt, exc, delay:
+             events.append((what, attempt, str(exc))))
+    m.save(1, _payload(1))
+    assert m.latest_epoch() == 1  # barrier that must NOT raise (query path)
+    m.save(2, _payload(2))
+    assert events and events[0][0] == "ckpt_save"
+    assert "injected async" in events[0][2]
+    m.flush()  # error was consumed by the retry — nothing pending
+    m.close()
+
+
+# -- fsck CLI -----------------------------------------------------------------
+
+def test_fsck_cli_exit_codes(tmp_path, capsys):
+    """`python -m deepvision_tpu fsck`: 0 clean, 1 corruption (with
+    --quarantine repairing so the rerun is clean), 2 usage error; accepts a
+    workdir and audits its ckpt/ child."""
+    from deepvision_tpu.__main__ import main
+
+    wd = tmp_path / "run"
+    _save_epochs(wd / "ckpt", 1, 2).close()
+
+    assert main(["fsck", str(wd)]) == 0
+    out = capsys.readouterr().out
+    assert out.count("OK") == 2 and json.loads(
+        out.strip().splitlines()[-1])["fsck"] == "ok"
+
+    _bitflip(_largest_file(str(wd / "ckpt" / "2")))
+    assert main(["fsck", str(wd)]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "epoch 2" in out
+    assert not (wd / "ckpt" / "corrupt-2").exists()  # report-only by default
+
+    assert main(["fsck", str(wd), "--quarantine"]) == 1  # found → nonzero
+    assert (wd / "ckpt" / "corrupt-2").is_dir()
+    capsys.readouterr()
+
+    assert main(["fsck", str(wd)]) == 0  # repaired: clean rerun
+    out = capsys.readouterr().out
+    assert "QUARANTINED" in out
+
+    assert main(["fsck", str(tmp_path / "nope")]) == 2
+
+
+def test_fsck_scans_runs_root_and_empty_dirs(tmp_path, capsys):
+    """A runs/ root scans one level deep for <run>/ckpt; a dir with no
+    checkpoints is a no-op exit 0 (make fsck on a fresh clone passes)."""
+    from deepvision_tpu.__main__ import main
+
+    _save_epochs(tmp_path / "runs" / "a" / "ckpt", 1).close()
+    _save_epochs(tmp_path / "runs" / "b" / "ckpt", 1, 2).close()
+    (tmp_path / "runs" / "no_ckpt_here").mkdir()
+    assert main(["fsck", str(tmp_path / "runs")]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["epochs_audited"] == 3
+
+    (tmp_path / "empty").mkdir()
+    assert main(["fsck", str(tmp_path / "empty")]) == 0
+
+
+# -- trainer acceptance: corrupt latest → fallback resume → completion --------
+
+def _config(tmp_path, **kw):
+    base = dict(
+        name="integ", model="lenet5",
+        batch_size=32, total_epochs=2,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        train_examples=32 * 2),
+        dtype="float32",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_every_steps=1,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(epoch):
+    return SyntheticClassification(batch_size=32, image_size=32, channels=1,
+                                   num_classes=10, num_batches=2, seed=epoch)
+
+
+def test_corrupt_latest_resume_falls_back_and_completes(tmp_path, monkeypatch):
+    """Acceptance: the fault injector corrupts epoch 2 after its save
+    commits; a fresh trainer's auto-resume quarantines it, restores the
+    verified epoch 1, logs the fallback to the metrics stream, and trains
+    to completion."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:bitflip")
+    from deepvision_tpu.core.trainer import Trainer
+
+    tr = Trainer(_config(tmp_path), workdir=str(tmp_path / "wd"))
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+    ckpt_root = tmp_path / "wd" / "ckpt"
+    assert integrity.verify_files(str(ckpt_root / "2"))[0] == integrity.CORRUPT
+
+    monkeypatch.delenv("DEEPVISION_FAULT_CKPT_CORRUPT")
+    tr2 = Trainer(_config(tmp_path, total_epochs=3),
+                  workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    assert tr2.resume() == 1  # epoch 2 corrupt → next-older verified epoch
+    assert (ckpt_root / "corrupt-2").is_dir()
+    hist = tr2.logger.history
+    assert hist["resilience_ckpt_fallback_generations"]["value"] == [1.0]
+    result = tr2.fit(_data, None, sample_shape=(32, 32, 1))
+    assert result["best_metric"] is not None
+    # resumed at epoch 1's state (step 2), trained epochs 2 and 3
+    assert int(tr2.state.step) == 6
+    assert tr2.ckpt.latest_epoch() == 3
+    assert integrity.verify_files(str(ckpt_root / "3"))[0] == integrity.OK
+    tr2.close()
+
+
+def test_resume_strict_mode_via_config(tmp_path, monkeypatch):
+    """TrainConfig.resume_verify='strict' (the CLI's --resume strict) makes
+    auto-resume refuse a corrupt latest instead of falling back."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:truncate")
+    from deepvision_tpu.core.trainer import Trainer
+
+    tr = Trainer(_config(tmp_path), workdir=str(tmp_path / "wd"))
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+    monkeypatch.delenv("DEEPVISION_FAULT_CKPT_CORRUPT")
+    tr2 = Trainer(_config(tmp_path, resume_verify="strict"),
+                  workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    with pytest.raises(CheckpointCorruptionError, match="strict"):
+        tr2.resume()
+    tr2.close()
+
+
+# -- serve provenance ---------------------------------------------------------
+
+def test_serve_provenance_and_refusal(tmp_path, monkeypatch):
+    """Serve-side loading verifies in strict mode and reports provenance
+    (epoch + manifest hash + verified) for replica auditing; a corrupt
+    checkpoint refuses to serve unless verify=False (--no-verify)."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.serve.engine import PredictEngine
+
+    wd = str(tmp_path / "wd")
+    tr = Trainer(_config(tmp_path, name="lenet5", total_epochs=1), workdir=wd)
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+    engine = PredictEngine.from_config("lenet5", workdir=wd, buckets=(1,),
+                                       verbose=False)
+    prov = engine.provenance
+    assert prov["weights"] == "checkpoint" and prov["checkpoint_epoch"] == 1
+    assert prov["verified"] is True and len(prov["manifest_sha256"]) == 64
+    manifest = integrity.load_manifest(str(tmp_path / "wd" / "ckpt" / "1"))
+    assert prov["manifest_sha256"] == integrity.manifest_digest(manifest)
+
+    # the provenance reaches the HTTP surface (/healthz and /stats)
+    import urllib.request
+
+    from deepvision_tpu.serve.server import InferenceServer
+    import threading
+    server = InferenceServer(engine, max_delay_ms=1.0)
+    t = threading.Thread(target=server.serve, kwargs={"port": 0},
+                         daemon=True)
+    t.start()
+    assert server.ready.wait(timeout=30)
+    try:
+        for path in ("/healthz", "/stats"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.bound_port}{path}",
+                    timeout=30) as resp:
+                body = json.loads(resp.read())
+            assert body["weights"] == prov, path
+    finally:
+        server.stop()
+        t.join(timeout=30)
+        server.close()
+
+    # --no-verify escape hatch: serves the same (good) weights, but the
+    # provenance flags them unverified so the replica is auditable
+    engine = PredictEngine.from_config("lenet5", workdir=wd, buckets=(1,),
+                                       verbose=False, verify=False)
+    assert engine.provenance["verified"] is False
+    assert engine.provenance["checkpoint_epoch"] == 1
+
+    # a corrupt checkpoint REFUSES to serve (strict is the serve default)
+    _bitflip(_largest_file(str(tmp_path / "wd" / "ckpt" / "1")))
+    with pytest.raises(CheckpointCorruptionError):
+        PredictEngine.from_config("lenet5", workdir=wd, buckets=(1,),
+                                  verbose=False)

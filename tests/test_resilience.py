@@ -219,6 +219,75 @@ def test_sigterm_graceful_checkpoint_and_resume(tmp_path):
     assert "resumed from epoch" in relaunch.stdout
 
 
+# -- checkpoint-corruption chaos (integrity layer, docs/FAILURES.md) ---------
+
+def test_kill_during_save_then_resume_lands_on_verified_epoch(tmp_path):
+    """Chaos: SIGKILL the trainer right as a checkpoint commits (inside the
+    integrity-finalize window, so its manifest may or may not exist), then
+    rot the newest epoch's bytes on disk. The relaunch must quarantine the
+    damaged generation and resume from an OLDER epoch that verifies —
+    before the integrity layer this exact sequence killed the run with an
+    opaque deserialization error."""
+    import re
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.join(REPO, "LeNet", "jax", "train.py"),
+           "-m", "lenet5", "--synthetic", "--epochs", "50",
+           "--steps-per-epoch", "2", "--batch-size", "16",
+           "--workdir", str(tmp_path), "--auto-resume"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    ckpt_root = tmp_path / "ckpt"
+    manifest_name = "integrity_manifest.json"
+    try:
+        # kill once >= 2 epochs are committed AND the older one's manifest
+        # landed — so the relaunch provably has a VERIFIED generation to
+        # fall back to (the newest one's manifest is left to the race, which
+        # is the point: both outcomes must recover)
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            steps = sorted(_committed_steps(ckpt_root))
+            if len(steps) >= 2 and (ckpt_root / str(steps[-2])
+                                    / manifest_name).exists():
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("no two committed checkpoints (with an older "
+                        "manifest) within 420s")
+        proc.send_signal(signal.SIGKILL)  # no cleanup, mid-finalize
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    steps = sorted(_committed_steps(ckpt_root))
+    newest = steps[-1]
+    # bit rot on the newest generation's largest payload file: whether or
+    # not the kill also lost its manifest, it must not verify
+    step_dir = ckpt_root / str(newest)
+    target = max((os.path.join(r, f) for r, _, fs in os.walk(step_dir)
+                  for f in fs if f != manifest_name), key=os.path.getsize)
+    with open(target, "r+b") as fp:
+        fp.seek(os.path.getsize(target) // 2)
+        byte = fp.read(1)
+        fp.seek(-1, 1)
+        fp.write(bytes([byte[0] ^ 0x80]))
+
+    relaunch = subprocess.run(
+        cmd[:cmd.index("50")] + [str(newest + 1)] + cmd[cmd.index("50") + 1:],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert relaunch.returncode == 0, (relaunch.stdout[-1000:]
+                                      + relaunch.stderr[-2000:])
+    got = re.search(r"resumed from epoch (\d+)", relaunch.stdout)
+    assert got, relaunch.stdout[-2000:]
+    assert int(got.group(1)) < newest  # the rotten epoch was NOT trusted
+    assert "QUARANTINED" in relaunch.stderr
+    assert any(d.name.startswith("corrupt-") for d in ckpt_root.iterdir())
+
+
 # -- GAN trainer wiring -------------------------------------------------------
 
 def test_gan_divergence_rollback(tmp_path, monkeypatch):

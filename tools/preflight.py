@@ -21,6 +21,10 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               step returns a finite loss on the mesh
   checkpoint  an Orbax save/restore roundtrip in the workdir's filesystem
               (the pod's real checkpoint target when --workdir is given)
+  fsck        checkpoint-integrity audit (docs/FAILURES.md): a saved epoch
+              must carry a verifying manifest, AND an injected bit-flip
+              must be detected as CORRUPT — the auditor a resumed run's
+              fallback restore depends on has to actually catch damage
   mesh_parity (--verify-mesh only) one seeded train step on the requested
               spatial/model mesh matches the pure-DP oracle per-leaf
               (tools/verify_mesh.py — run before the first run on a new
@@ -295,6 +299,47 @@ def check_checkpoint(args):
     return f"orbax roundtrip in {root}"
 
 
+@check("fsck")
+def check_fsck(args):
+    import shutil
+
+    import numpy as np
+
+    from deepvision_tpu.core import integrity
+    from deepvision_tpu.core.checkpoint import CheckpointManager
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_fsck_")
+    try:
+        path = os.path.join(tmpdir, "ckpt")
+        mgr = CheckpointManager(path, keep=2, keep_best=False,
+                                async_save=False)
+        for ep in (1, 2):
+            mgr.save(ep, {"params": {"w": np.arange(64, dtype=np.float32)
+                                     * ep}})
+        mgr.close()
+        records = {r["epoch"]: r["status"] for r in integrity.audit(path)}
+        if records != {1: integrity.OK, 2: integrity.OK}:
+            raise RuntimeError(f"clean checkpoint dir did not audit OK: "
+                               f"{records}")
+        # the auditor must actually DETECT damage, not just parse manifests:
+        # flip one bit in epoch 2's largest payload file and re-audit
+        step = os.path.join(path, "2")
+        target = max((os.path.join(r, f) for r, _, fs in os.walk(step)
+                      for f in fs if f != integrity.MANIFEST_NAME),
+                     key=os.path.getsize)
+        with open(target, "r+b") as fp:
+            fp.seek(os.path.getsize(target) // 2)
+            byte = fp.read(1)
+            fp.seek(-1, 1)
+            fp.write(bytes([byte[0] ^ 0x80]))
+        records = {r["epoch"]: r["status"] for r in integrity.audit(path)}
+        if records.get(2) != integrity.CORRUPT or records.get(1) != integrity.OK:
+            raise RuntimeError(f"injected bit-flip not detected: {records}")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return "2 epochs manifest-verified; injected bit-flip reported CORRUPT"
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Validate a host before a pod run (see module docstring).")
@@ -337,6 +382,7 @@ def main(argv=None):
     if args.verify_mesh:
         check_mesh_parity(args)
     check_checkpoint(args)
+    check_fsck(args)
 
     ok = all(RESULTS)
     print(json.dumps({"preflight": "pass" if ok else "fail",
