@@ -9,8 +9,8 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_spatial.py tests/test_spatial_shardmap.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
-.PHONY: test test-all verify bench bench-serve dryrun smoke serve-smoke \
-        preflight preflight-record lint fsck
+.PHONY: test test-all verify bench bench-serve bench-input dryrun smoke \
+        serve-smoke preflight preflight-record lint fsck
 
 lint:        ## jaxlint: donation-aliasing / retrace / host-sync / trace
 	## hazards (docs/LINTING.md) over the framework, the tools, and the
@@ -59,6 +59,11 @@ bench:       ## ResNet-50 step throughput (TPU if reachable, else CPU)
 bench-serve: ## dynamic-batching serving throughput + latency vs the naive
 	## per-request dispatch loop (one JSON line; docs/SERVING.md)
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py
+
+bench-input: ## input pipeline end-to-end: uint8 + device-augment vs the
+	## host-f32 transform path — images/sec and bytes-to-device per
+	## batch (one JSON line; docs/INPUT_PIPELINE.md)
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_input.py
 
 serve-smoke: ## serving-stack smoke: bucketed AOT cache, micro-batcher,
 	## metrics, graceful drain — synthetic load, exit 0 on pass

@@ -1,0 +1,177 @@
+"""Benchmark: input-pipeline end-to-end — host-f32 vs uint8 + device-augment.
+
+Prints ONE JSON line in bench.py's schema ({"metric", "value", "unit",
+"vs_baseline", ...}). `value` is the uint8+device-augment path's sustained
+images/sec through host batching -> DevicePrefetcher staging -> the jitted
+augment (data/device_augment.py); `vs_baseline` compares against the host-f32
+path doing the SAME augmentation work per image on host threads
+(data/transforms.py: RandomCrop + flip + ColorJitter + normalize) and
+staging float32 batches — the reference pipelines' architecture.
+
+Both paths start from identical already-decoded uint8 images at the padded
+decode size (`config.decode_image_size`), so JPEG decode — common to both —
+is excluded and the delta is exactly the work `--device-augment` moves:
+per-pixel host augmentation CPU and 4x-fatter host->device transfers.
+
+Bytes-to-device come from the DevicePrefetcher's own transfer ledger
+(`bytes_staged_total` — the number the trainer logs as
+`prefetch_bytes_staged`), not a formula, so the record proves what was
+actually staged: f32 ships B*S*S*C*4, uint8 ships B*D*D*C with
+D = decode_image_size(S); at the 224->256 ratio that is 3.06x fewer bytes.
+
+Runs on whatever platform the env selects; like tools/bench_input.py this is
+a host-dominated measurement, so it defaults JAX_PLATFORMS to cpu rather
+than touching a relay-attached TPU that can wedge for minutes (set
+JAX_PLATFORMS=tpu explicitly to measure real PCIe/ICI staging).
+
+    python bench_input.py                       # one JSON line
+    python bench_input.py --batch-size 256 --image-size 224 --steps 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _host_f32_pipeline(src_u8, image_size, batch_size, steps, workers, seed):
+    """The reference architecture: per-image numpy/PIL-style transforms on a
+    host thread pool (FlatImageNet's layout), float32 batches out."""
+    import numpy as np
+
+    from deepvision_tpu.data.transforms import (ColorJitter, Compose,
+                                                Normalize, RandomCrop,
+                                                RandomHorizontalFlip, ToFloat)
+    tf = Compose([RandomCrop(image_size), RandomHorizontalFlip(),
+                  ColorJitter(0.2, 0.2, 0.2), ToFloat(), Normalize()])
+    root = np.random.default_rng(seed)
+    n = len(src_u8)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for step in range(steps):
+            rngs = root.spawn(batch_size)
+            idx = [(step * batch_size + i) % n for i in range(batch_size)]
+            outs = list(pool.map(lambda a: tf(src_u8[a[0]], a[1]),
+                                 zip(idx, rngs)))
+            yield np.stack(outs).astype(np.float32)
+
+
+def _uint8_pipeline(src_u8, batch_size, steps):
+    """The device-augment staging contract: stack raw uint8, nothing else —
+    all per-pixel work happens in the jitted augment on device."""
+    import numpy as np
+    n = len(src_u8)
+    for step in range(steps):
+        idx = [(step * batch_size + i) % n for i in range(batch_size)]
+        yield np.stack([src_u8[i] for i in idx])
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=128)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--source-images", type=int, default=64,
+                   help="distinct pre-decoded source images to cycle over")
+    p.add_argument("--workers", type=int, default=None,
+                   help="host transform threads for the f32 baseline "
+                        "(default: min(16, cores), the loaders' default)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    # host-dominated measurement: never implicitly claim a relay-attached TPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.cli import setup_compilation_cache
+    from deepvision_tpu.core.config import decode_image_size
+    from deepvision_tpu.data import device_augment as daug
+    from deepvision_tpu.parallel import mesh as mesh_lib
+    from deepvision_tpu.parallel.prefetch import DevicePrefetcher
+
+    setup_compilation_cache()
+    platform = jax.devices()[0].platform
+    mesh = mesh_lib.make_mesh()
+    mesh_lib.check_batch_divisible(args.batch_size, mesh)
+    cores = (len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+             else os.cpu_count() or 1)
+    workers = args.workers or min(16, cores)
+
+    S = args.image_size
+    D = decode_image_size(S)
+    rs = np.random.RandomState(args.seed)
+    src = [rs.randint(0, 256, (D, D, 3)).astype(np.uint8)
+           for _ in range(args.source_images)]
+
+    augment = jax.jit(daug.make_train_augment(S, compute_dtype=jnp.bfloat16))
+    # the f32 baseline's device side: one cast to the compute dtype — the
+    # only per-pixel op its pre-augmented batches still need
+    cast = jax.jit(lambda x: x.astype(jnp.bfloat16))
+    key = jax.random.PRNGKey(args.seed)
+
+    def consume_uint8(staged, step):
+        return augment(staged, jax.random.fold_in(key, step))
+
+    def consume_f32(staged, step):
+        return cast(staged)
+
+    def run(make_batches, consume):
+        """Drive batches through DevicePrefetcher staging + the device-side
+        consumer; returns (images/sec, bytes/batch, stage MB/s). A short
+        unmeasured prefix absorbs compile + thread-pool ramp."""
+        warm = DevicePrefetcher(mesh, make_batches(2), size=2)
+        for i, staged in enumerate(warm):
+            jax.block_until_ready(consume(staged[0], i))
+        warm.close()
+        pf = DevicePrefetcher(mesh, make_batches(args.steps), size=2)
+        t0 = time.perf_counter()
+        out = None
+        for i, staged in enumerate(pf):
+            out = consume(staged[0], i)
+        jax.block_until_ready(out)  # sync: depends on the full chain
+        dt = time.perf_counter() - t0
+        bytes_total = pf.bytes_staged_total
+        bps = pf.bytes_per_sec
+        pf.close()
+        return (args.steps * args.batch_size / dt,
+                bytes_total // args.steps, bps)
+
+    u8_ips, u8_bytes, u8_bps = run(
+        lambda steps: ((b,) for b in _uint8_pipeline(
+            src, args.batch_size, steps)),
+        consume_uint8)
+    f32_ips, f32_bytes, f32_bps = run(
+        lambda steps: ((b,) for b in _host_f32_pipeline(
+            src, S, args.batch_size, steps, workers, args.seed)),
+        consume_f32)
+
+    print(json.dumps({
+        "metric": f"input_uint8_device_augment_images_per_sec"
+                  f"(b{args.batch_size},{S}px,{platform})",
+        "value": round(u8_ips, 1),
+        "unit": "images/sec",
+        # the bar: >= 1x (no worse), target >= 1.5x on the CPU fallback
+        "vs_baseline": round(u8_ips / f32_ips, 3) if f32_ips else 0.0,
+        "platform": platform,
+        "host_f32_images_per_sec": round(f32_ips, 1),
+        # measured by the prefetcher's ledger, not computed from shapes
+        "bytes_to_device_per_batch_host_f32": int(f32_bytes),
+        "bytes_to_device_per_batch_uint8": int(u8_bytes),
+        # the acceptance bar: >= 3x fewer bytes per batch
+        "bytes_to_device_ratio": round(f32_bytes / u8_bytes, 3)
+        if u8_bytes else 0.0,
+        "stage_mb_per_sec": {"host_f32": round(f32_bps / 1e6, 1),
+                             "uint8": round(u8_bps / 1e6, 1)},
+        "decode_size": D,
+        "host_workers": workers,
+        "cpu_cores": cores,
+        "timed_batches": args.steps,
+    }))
+
+
+if __name__ == "__main__":
+    main()

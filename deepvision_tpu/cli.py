@@ -134,6 +134,15 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                         "inside the jitted step (4x less host->device "
                         "traffic; TFRecord pipelines: ImageNet / "
                         "detection / pose)")
+    p.add_argument("--device-augment", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="classification: host decodes + resizes to a padded "
+                        "uint8 square and RandomCrop/flip/ColorJitter/"
+                        "normalize run batched inside the jitted train step "
+                        "(~4x less host->device traffic AND no host "
+                        "augmentation CPU; per-step PRNG keys keep runs "
+                        "seed-reproducible — docs/INPUT_PIPELINE.md; "
+                        "synthetic / imagenet / imagenet_flat pipelines)")
     p.add_argument("--cache-val", action="store_true",
                    help="cache the validation records in host RAM after the "
                         "first epoch (classification ImageNet TFRecords)")
@@ -377,6 +386,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     if getattr(args, "device_normalize", False):
         cfg = cfg.replace(data=dataclasses.replace(
             cfg.data, normalize_on_device=True))
+    if getattr(args, "device_augment", None) is not None:
+        cfg = cfg.replace(device_augment=args.device_augment)
     if getattr(args, "cache_val", False):
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, cache_val=True))
     if args.steps_per_dispatch:
@@ -490,13 +501,30 @@ def _classification_data(cfg, args):
     data = cfg.data
     # note: --synthetic already rewrote data.dataset to "synthetic" in _run,
     # so synthetic smoke runs are rejected here too (random floats were never
-    # [0,255] pixels)
-    if data.normalize_on_device and data.dataset != "imagenet":
+    # [0,255] pixels). device_augment subsumes normalize_on_device (the fused
+    # augment normalizes), so the uint8 pipelines below satisfy both flags.
+    if (data.normalize_on_device and not cfg.device_augment
+            and data.dataset != "imagenet"):
         raise SystemExit(
             "--device-normalize is supported by the TFRecord ImageNet "
             f"pipeline only (dataset={data.dataset!r} normalizes on host)")
+    if cfg.device_augment and data.dataset not in (
+            "synthetic", "imagenet", "imagenet_flat"):
+        raise SystemExit(
+            "--device-augment needs a host-decode-only pipeline: synthetic, "
+            f"imagenet (TFRecords), or imagenet_flat — dataset="
+            f"{data.dataset!r} ships pre-transformed float batches")
     if args.synthetic or data.dataset == "synthetic":
+        from .core.config import decode_image_size
         from .data.synthetic import SyntheticClassification
+        if cfg.device_augment:
+            # uint8 at the padded decode size — the same staging contract
+            # the real decode-only loaders emit
+            return _synthetic_data(
+                cfg, lambda steps, seed: SyntheticClassification(
+                    cfg.batch_size, decode_image_size(data.image_size),
+                    data.channels, data.num_classes, steps, seed=seed,
+                    emit_uint8=True))
         return _synthetic_data(cfg, lambda steps, seed: SyntheticClassification(
             cfg.batch_size, data.image_size, data.channels, data.num_classes,
             steps, seed=seed))
@@ -524,6 +552,7 @@ def _classification_data(cfg, args):
             return inet.build_dataset(
                 pattern, training=training,
                 normalize_on_host=not data.normalize_on_device,
+                host_decode_only=cfg.device_augment,
                 mean=data.mean, std=data.std, **kw)
 
         return _tfrecord_data(
@@ -541,7 +570,8 @@ def _classification_data(cfg, args):
         synsets = os.path.join(data_dir, "synsets.txt")
         common = dict(image_size=data.image_size,
                       num_shards=jax.process_count(),
-                      shard_index=jax.process_index())
+                      shard_index=jax.process_index(),
+                      host_decode_only=cfg.device_augment)
         steps = args.steps_per_epoch
         # one instance per split: the directory scan happens once, and
         # FlatImageNet reshuffles internally on each __iter__ (epoch bump)

@@ -26,6 +26,17 @@ IMAGENET_STD = (0.229, 0.224, 0.225)
 UNIT_RANGE_NORM = ((0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
 
 
+def decode_image_size(image_size: int) -> int:
+    """Host decode/resize target for the device-augment path
+    (`data/device_augment.py`): the reference's Rescale(256) -> crop(224)
+    headroom ratio, floored to at least one spare pixel so RandomCrop has
+    offsets to draw. 224 -> 256; the single source of truth shared by the
+    host decode-only loaders, the trainer's calibration batch, the synthetic
+    uint8 generator, and bench_input.py — mismatched sizes would surface as
+    an in-step crop shape error."""
+    return max(image_size + 1, (image_size * 256) // 224)
+
+
 @dataclasses.dataclass
 class OptimizerConfig:
     name: str = "sgd"               # sgd | momentum | rmsprop | adam | adamw
@@ -155,6 +166,17 @@ class TrainConfig:
     # the permuted batch instead of blending pixels; lam = exact kept-pixel
     # fraction. Mutually exclusive with mixup_alpha. Typical a: 1.0.
     cutmix_alpha: float = 0.0
+    # Device-side augmentation (data/device_augment.py, classification only):
+    # the host pipeline decodes + resizes to decode_image_size(image_size)
+    # and ships RAW uint8 NHWC (~4x less host->device traffic than the f32
+    # path); RandomCrop/flip/ColorJitter/normalize run batched INSIDE the
+    # jitted train step, driven by per-step PRNG keys folded from
+    # TrainState.step (seed-reproducible like mixup). Eval center-crops +
+    # normalizes on device, matching the host eval_transform exactly.
+    # Subsumes data.normalize_on_device (the augment normalizes; the step's
+    # input_norm is disabled so the two never double-normalize). CLI:
+    # --device-augment / --no-device-augment; docs/INPUT_PIPELINE.md.
+    device_augment: bool = False
     # Log the global L2 gradient norm as a per-step metric (`grad_norm` in
     # JSONL/TensorBoard) — divergence forensics to pair with the halt below
     # and the data for choosing grad_clip_norm. Off by default: it's one

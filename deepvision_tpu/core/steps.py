@@ -58,6 +58,7 @@ def make_classification_train_step(
     mixup_alpha: float = 0.0,
     cutmix_alpha: float = 0.0,
     input_norm: Optional[tuple] = None,
+    device_augment: Optional[Callable] = None,
     log_grad_norm: bool = False,
     grad_correction=None,
 ) -> Callable:
@@ -87,13 +88,31 @@ def make_classification_train_step(
     `normalize_on_host=False` pipeline) and normalizes them ON DEVICE:
     (x/255 - mean)/std. uint8 transfer is 4x smaller than normalized f32 —
     the host->device bandwidth lever for input-bound pods (SURVEY.md §7.2.1).
+
+    `device_augment` (data/device_augment.make_train_augment) goes further:
+    images arrive as uint8 at `config.decode_image_size` and the whole
+    train-time augmentation stack — RandomCrop/flip/ColorJitter/normalize —
+    runs here, fused into this step's XLA program, driven by a per-step key
+    folded from `state.step` (seed-reproducible like mixup). It REPLACES
+    `input_norm` (the augment normalizes; passing both is an error — the
+    Trainer guarantees they never double-normalize).
     """
     if mixup_alpha > 0.0 and cutmix_alpha > 0.0:
         raise ValueError("mixup_alpha and cutmix_alpha are mutually exclusive")
+    if device_augment is not None and input_norm is not None:
+        raise ValueError("device_augment already normalizes; passing "
+                         "input_norm too would double-normalize")
     mixing = mixup_alpha > 0.0 or cutmix_alpha > 0.0
 
     def step(state: TrainState, images, labels, rng):
-        images = _normalize_input(images, input_norm, compute_dtype)
+        step_rng = jax.random.fold_in(rng, state.step)
+        if device_augment is not None:
+            # fold tag 2 (mixup owns tag 1 below): crop/flip/jitter draws are
+            # a pure function of (seed, step), independent of host threading
+            images = device_augment(images,
+                                    jax.random.fold_in(step_rng, 2))
+        else:
+            images = _normalize_input(images, input_norm, compute_dtype)
         if mesh is not None:
             # batch over 'data'; on a spatial mesh also H over 'spatial' —
             # GSPMD partitions every conv with halo exchange (context
@@ -101,7 +120,6 @@ def make_classification_train_step(
             images = jax.lax.with_sharding_constraint(
                 images, mesh_lib.batch_sharding(mesh, images.ndim,
                                                 dim1=images.shape[1]))
-        step_rng = jax.random.fold_in(rng, state.step)
         if mixing:
             mix_rng, perm_rng, box_rng = jax.random.split(
                 jax.random.fold_in(step_rng, 1), 3)
@@ -236,17 +254,29 @@ def make_multistep_train_step(step_fn: Callable, k: int, n_batch_args: int,
 
 def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
                                   mesh: Optional[Mesh] = None,
-                                  input_norm: Optional[tuple] = None) -> Callable:
+                                  input_norm: Optional[tuple] = None,
+                                  device_augment: Optional[Callable] = None,
+                                  ) -> Callable:
     """Build a jitted `(state, images, labels, mask) -> sums` step (no_grad validate
     loop, reference `validate()` ResNet/pytorch/train.py:488-520).
 
     `mask` is a (batch,) 0/1 float marking real examples: partial final batches are
     padded up to a multiple of the data axis on the host, and padded rows contribute
     nothing to the returned SUMS. The host divides by `count` to get means.
+
+    `device_augment` here is the EVAL stage (make_eval_augment: deterministic
+    center crop + normalize on uint8 input) — it replaces `input_norm`, same
+    no-double-normalize contract as the train step.
     """
+    if device_augment is not None and input_norm is not None:
+        raise ValueError("device_augment already normalizes; passing "
+                         "input_norm too would double-normalize")
 
     def step(state: TrainState, images, labels, mask):
-        images = _normalize_input(images, input_norm, compute_dtype)
+        if device_augment is not None:
+            images = device_augment(images)
+        else:
+            images = _normalize_input(images, input_norm, compute_dtype)
         if mesh is not None:
             images = jax.lax.with_sharding_constraint(
                 images, mesh_lib.batch_sharding(mesh, images.ndim,

@@ -199,6 +199,30 @@ class Trainer:
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = ((config.data.mean, config.data.std)
                       if config.data.normalize_on_device else None)
+        # Device-side augmentation (data/device_augment.py): the pipeline
+        # ships uint8 at decode_image_size and crop/flip/jitter/normalize run
+        # inside the jitted step. Subsumes input_norm — the augment
+        # normalizes, so input_norm is dropped here and the two can never
+        # double-normalize (the step factories also reject the combination).
+        self._train_augment = self._eval_augment = None
+        if config.device_augment:
+            if config.spatial_parallel > 1:
+                # per-example dynamic_slice crops cross the H shard; run
+                # device_augment on (data[, model]) meshes only
+                raise ValueError(
+                    "device_augment does not compose with spatial_parallel "
+                    "> 1 (the random crop would gather across the 'spatial' "
+                    "shards); use the host pipeline for spatial meshes")
+            from ..data import device_augment as daug
+            mean = daug.channel_stats(config.data.mean, config.data.channels)
+            std = daug.channel_stats(config.data.std, config.data.channels)
+            self._train_augment = daug.make_train_augment(
+                config.data.image_size, mean=mean, std=std,
+                compute_dtype=compute_dtype)
+            self._eval_augment = daug.make_eval_augment(
+                config.data.image_size, mean=mean, std=std,
+                compute_dtype=compute_dtype)
+            input_norm = None
         # A FACTORY, not just a step: on combined spatial×model meshes the
         # step must be rebuilt with the measured per-leaf grad correction
         # (mesh_lib.calibrate_grad_correction, run in init_state) — and the
@@ -241,6 +265,7 @@ class Trainer:
                 compute_dtype=compute_dtype, mesh=m,
                 remat=config.remat, mixup_alpha=config.mixup_alpha,
                 cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
+                device_augment=self._train_augment,
                 log_grad_norm=config.log_grad_norm,
                 donate=config.steps_per_dispatch == 1, grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
@@ -248,7 +273,8 @@ class Trainer:
         # AFTER subclasses have installed their family's train_step
         self._multi_step = None
         self.eval_step = steps.make_classification_eval_step(
-            compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm)
+            compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm,
+            device_augment=self._eval_augment)
 
         # Polyak averaging: eval/best-model use the EMA weights (config.ema_decay).
         # Under gradient accumulation the average must advance once per APPLIED
@@ -387,7 +413,14 @@ class Trainer:
         parity check. Subclasses with different batch tuples override."""
         rs = np.random.RandomState(seed)
         b = self._calibration_batch_size()
-        if self.config.data.normalize_on_device:
+        if self.config.device_augment:
+            # the step's input contract is uint8 at the decode (padded) size;
+            # the jitted augment crops it down to sample_shape
+            from .config import decode_image_size
+            d = decode_image_size(sample_shape[0])
+            images = rs.randint(
+                0, 256, (b, d, d, sample_shape[-1])).astype(np.uint8)
+        elif self.config.data.normalize_on_device:
             images = rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
         else:
             images = rs.randn(b, *sample_shape).astype(np.float32)
@@ -622,19 +655,18 @@ class Trainer:
                     and _is_main_process()):
                 # JSONL/TB writes are process-0-only, like checkpoints
                 # (SURVEY.md §5.8) — other hosts skip the device_get too.
-                # The prefetch queue depth is sampled NOW (host-side int, no
-                # sync — the same value the watchdog dumps on a stall):
-                # depth 0 at the flush cadence means the input pipeline is
-                # starving the step loop, visible in logs instead of only
-                # in post-mortem stall dumps.
-                pf = self._prefetcher
+                # The prefetch stats are sampled NOW (host-side ints, no
+                # sync — queue depth is the same value the watchdog dumps on
+                # a stall): depth 0 at the flush cadence means the input
+                # pipeline is starving the step loop, and the staged-bytes
+                # ledger makes the uint8-vs-f32 transfer savings visible in
+                # logs, not just in bench runs.
                 pending.append((step0 + consumed, metrics,
-                                pf.queue_depth if pf is not None else 0))
+                                self._prefetch_stats()))
                 if len(pending) > 1:
-                    s, m, depth = pending.pop(0)
+                    s, m, pf_stats = pending.pop(0)
                     self.logger.log(
-                        s, {**jax.device_get(m),
-                            "prefetch_queue_depth": depth},
+                        s, {**jax.device_get(m), **pf_stats},
                         epoch=epoch, prefix="train_", echo=True)
 
         def run_single(batch):
@@ -712,9 +744,8 @@ class Trainer:
             self._prefetcher = None
             staged.close()
         jax.block_until_ready(self.state.params)
-        for s, m, depth in pending:  # main process only
-            self.logger.log(s, {**jax.device_get(m),
-                                "prefetch_queue_depth": depth},
+        for s, m, pf_stats in pending:  # main process only
+            self.logger.log(s, {**jax.device_get(m), **pf_stats},
                             epoch=epoch, prefix="train_", echo=True)
         dt = time.time() - t0
         if device_metrics:
@@ -970,6 +1001,19 @@ class Trainer:
                 epoch=epoch, prefix="resilience_", echo=False)
         return got
 
+    def _prefetch_stats(self) -> dict:
+        """Host-side snapshot of the live prefetcher's transfer ledger (no
+        device sync): queue depth plus the staged-bytes total and the last
+        single-batch staging latency — logged at the log_every cadence so a
+        starving pipeline AND the uint8-vs-f32 transfer savings both show up
+        in the metrics stream (parallel/prefetch.py)."""
+        pf = self._prefetcher
+        if pf is None:
+            return {"prefetch_queue_depth": 0}
+        return {"prefetch_queue_depth": pf.queue_depth,
+                "prefetch_bytes_staged": float(pf.bytes_staged_total),
+                "prefetch_stage_ms": round(pf.last_stage_secs * 1e3, 3)}
+
     def _watchdog_diagnostics(self) -> dict:
         pf = self._prefetcher
         return {
@@ -999,6 +1043,14 @@ class LossWatchedTrainer(Trainer):
                 "mixup_alpha/cutmix_alpha are classification-only; the "
                 f"{type(self).__name__} ignores them — use the task's own "
                 "augmentations (flip/crop in the data pipeline) instead")
+        if config.device_augment:
+            # same shape of latent bug: the task steps would never call the
+            # augment, silently training on raw padded uint8
+            raise ValueError(
+                "device_augment is classification-only; the "
+                f"{type(self).__name__} steps don't fuse it — use "
+                "--device-normalize (uint8 transfer + on-device normalize) "
+                "for this family instead")
         super().__init__(config, *args, **kwargs)
 
     def evaluate(self, data: Iterable) -> dict:

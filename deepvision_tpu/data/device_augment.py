@@ -1,0 +1,204 @@
+"""Device-side batched augmentation: uint8 staging + jitted transforms.
+
+The host pipelines' per-image PIL/numpy transforms (data/transforms.py) run on
+host threads and ship float32 batches — 4x the host->device bytes of the raw
+pixels, and host CPU that cannot keep a chip fed (the trainer logs
+`prefetch_queue_depth` precisely because input starvation is the observed
+stall mode). This module is the tf.data/DALI counterpart for the jit world:
+the host only decodes and resizes to a slightly padded square
+(`config.decode_image_size`, the reference's Rescale(256)->crop(224)
+headroom), ships compact **uint8 NHWC**, and every dense per-pixel op —
+RandomCrop, RandomHorizontalFlip, ColorJitter, mean/std normalize — runs
+batched on the accelerator as part of the jitted train step (one fused XLA
+program; math in f32, output in the step's compute dtype).
+
+RNG contract: the train step drives the returned `device_train_augment` with
+a key folded from `TrainState.step` exactly like mixup
+(`core/steps.make_classification_train_step`), so runs stay seed-reproducible
+per (seed, step) regardless of host thread scheduling — something the host
+pipelines can only approximate with per-image spawned generators.
+
+Host/device split (docs/INPUT_PIPELINE.md):
+
+  host   decode JPEG -> resize to (D, D) uint8        D = decode_image_size(S)
+  device train: random DxD->SxS crop (per-example `dynamic_slice` offsets)
+               + per-example flip + per-example ColorJitter factors
+               + (x/255 - mean)/std -> compute dtype
+         eval:  center DxD->SxS crop + normalize (deterministic, no rng)
+
+The eval stage composes EXACTLY with the host `eval_transform` path: a
+centered S-crop of a centered D-crop equals the direct centered S-crop, so
+`make_eval_augment` output matches the host pipeline bit-for-bit up to f32
+rounding (pinned by tests/test_device_augment.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import IMAGENET_MEAN, IMAGENET_STD, decode_image_size
+
+__all__ = ["decode_image_size", "make_train_augment", "make_eval_augment",
+           "channel_stats"]
+
+
+def channel_stats(values: Sequence[float], channels: int) -> Tuple[float, ...]:
+    """Adapt length-C' normalization stats to a C-channel input: passthrough
+    on match, else collapse to the channel mean replicated C times (the
+    grayscale MNIST-family configs carry the 3-channel ImageNet stats —
+    broadcasting those against a (B,H,W,1) batch would silently widen it to
+    3 channels and crash the model with a kernel shape error)."""
+    values = tuple(float(v) for v in values)
+    if len(values) == channels:
+        return values
+    return (sum(values) / len(values),) * channels
+
+# matches the host train_transform's ColorJitter(0.2, 0.2, 0.2) defaults
+DEFAULT_JITTER: Tuple[float, float, float] = (0.2, 0.2, 0.2)
+
+
+def _to_unit_f32(images) -> jnp.ndarray:
+    """uint8 (or raw [0,255] float) pixels -> f32 [0,255]. Division and
+    normalization stay in f32 so uint8 values are exact; the caller drops to
+    the compute dtype once, at the end."""
+    return images.astype(jnp.float32)
+
+
+def _normalize(images: jnp.ndarray, mean, std) -> jnp.ndarray:
+    """[0,255] f32 -> (x/255 - mean)/std, channel-last (same [0,1]-unit
+    statistics as the host Normalize and the steps' input_norm)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    return (images / 255.0 - mean) / std
+
+
+def _batched_crop(images: jnp.ndarray, tops: jnp.ndarray, lefts: jnp.ndarray,
+                  size: int) -> jnp.ndarray:
+    """Per-example (size, size) crops via vmapped dynamic_slice — the gather
+    stays fused in the augment program (no host round trip, no padding)."""
+    def one(img, top, left):
+        return jax.lax.dynamic_slice(
+            img, (top, left, 0), (size, size, img.shape[-1]))
+    return jax.vmap(one)(images, tops, lefts)
+
+
+def _factor(key, strength: float, batch: int) -> jnp.ndarray:
+    """Per-example jitter factor ~ U[max(0, 1-s), 1+s], shaped to broadcast
+    over HWC — the host ColorJitter._factor contract, drawn per image."""
+    return jax.random.uniform(
+        key, (batch, 1, 1, 1), jnp.float32,
+        minval=max(0.0, 1.0 - strength), maxval=1.0 + strength)
+
+
+def make_train_augment(
+    image_size: int,
+    *,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    jitter: Tuple[float, float, float] = DEFAULT_JITTER,
+    flip_prob: float = 0.5,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> Callable:
+    """Build `device_train_augment(images_u8, rng) -> images` for the train
+    step: per-example RandomCrop to `image_size`, RandomHorizontalFlip,
+    ColorJitter (brightness/contrast/saturation on [0,255], matching the host
+    ColorJitter order and factor ranges), then (x/255 - mean)/std in f32 and
+    a single cast to `compute_dtype`.
+
+    `images_u8` is (B, D, D, C) uint8 with D >= image_size (the host's
+    decode-only output, `config.decode_image_size`); D == image_size
+    degenerates to the identity crop. Pure jnp — trace it inside the train
+    step's jit (one fused program) or `jax.jit` it standalone (bench/tests).
+    """
+    brightness, contrast, saturation = jitter
+
+    def device_train_augment(images, rng):
+        b, h, w = images.shape[0], images.shape[1], images.shape[2]
+        k_crop, k_flip, k_b, k_c, k_s = jax.random.split(rng, 5)
+        imgs = _to_unit_f32(images)
+        # RandomCrop: uniform per-example offsets in [0, D - S]
+        offs = jax.random.randint(
+            k_crop, (2, b), 0, max(h - image_size, w - image_size) + 1)
+        tops = jnp.minimum(offs[0], h - image_size)
+        lefts = jnp.minimum(offs[1], w - image_size)
+        imgs = _batched_crop(imgs, tops, lefts, image_size)
+        # RandomHorizontalFlip, per example
+        flip = jax.random.bernoulli(k_flip, flip_prob, (b,))
+        imgs = jnp.where(flip[:, None, None, None], imgs[:, :, ::-1, :], imgs)
+        # ColorJitter on [0,255]: brightness -> contrast -> saturation, the
+        # host class's application order; factors drawn per example
+        if brightness:
+            imgs = imgs * _factor(k_b, brightness, b)
+        if contrast:
+            m = imgs.mean(axis=(1, 2), keepdims=True)
+            imgs = (imgs - m) * _factor(k_c, contrast, b) + m
+        if saturation:
+            gray = imgs.mean(axis=3, keepdims=True)
+            imgs = (imgs - gray) * _factor(k_s, saturation, b) + gray
+        imgs = jnp.clip(imgs, 0.0, 255.0)
+        return _normalize(imgs, mean, std).astype(compute_dtype)
+
+    return device_train_augment
+
+
+def make_eval_augment(
+    image_size: int,
+    *,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> Callable:
+    """Build `device_eval_augment(images_u8) -> images`: deterministic center
+    crop to `image_size` + normalize, the device half of the host
+    `eval_transform` path (no rng — eval stays bit-stable across runs)."""
+
+    def device_eval_augment(images):
+        h, w = images.shape[1], images.shape[2]
+        top = (h - image_size) // 2
+        left = (w - image_size) // 2
+        imgs = _to_unit_f32(
+            images[:, top:top + image_size, left:left + image_size, :])
+        return _normalize(imgs, mean, std).astype(compute_dtype)
+
+    return device_eval_augment
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(factory_args) -> Callable:
+    kind, image_size, mean, std, jitter, flip_prob, dtype = factory_args
+    if kind == "train":
+        fn = make_train_augment(image_size, mean=mean, std=std, jitter=jitter,
+                                flip_prob=flip_prob,
+                                compute_dtype=jnp.dtype(dtype))
+    else:
+        fn = make_eval_augment(image_size, mean=mean, std=std,
+                               compute_dtype=jnp.dtype(dtype))
+    return jax.jit(fn)
+
+
+def device_train_augment(images, rng, *, image_size: int,
+                         mean: Sequence[float] = IMAGENET_MEAN,
+                         std: Sequence[float] = IMAGENET_STD,
+                         jitter: Tuple[float, float, float] = DEFAULT_JITTER,
+                         flip_prob: float = 0.5,
+                         compute_dtype=jnp.bfloat16):
+    """One-shot jitted convenience wrapper (bench/tools); the Trainer traces
+    the factory's closure inside its own step jit instead. Cached per
+    config so repeated calls don't re-jit (JIT001)."""
+    fn = _jitted(("train", image_size, tuple(mean), tuple(std), tuple(jitter),
+                  flip_prob, jnp.dtype(compute_dtype).name))
+    return fn(images, rng)
+
+
+def device_eval_augment(images, *, image_size: int,
+                        mean: Sequence[float] = IMAGENET_MEAN,
+                        std: Sequence[float] = IMAGENET_STD,
+                        compute_dtype=jnp.bfloat16):
+    """One-shot jitted convenience wrapper for the eval stage."""
+    fn = _jitted(("eval", image_size, tuple(mean), tuple(std), None, 0.0,
+                  jnp.dtype(compute_dtype).name))
+    return fn(images)

@@ -69,13 +69,15 @@ def distorted_crop(encoded, image_size, tf):
     return image
 
 
-def central_crop(encoded, image_size, tf):
-    """Aspect-preserving resize so the crop is `image_size` at CROP_FRACTION, then
-    central crop — the reference's eval path semantics
-    (`ResNet/tensorflow/data_load.py:123-158`)."""
+def central_crop(encoded, image_size, tf, crop_fraction=CROP_FRACTION):
+    """Aspect-preserving resize so the crop is `image_size` at
+    `crop_fraction`, then central crop — the reference's eval path semantics
+    (`ResNet/tensorflow/data_load.py:123-158`). `crop_fraction=1.0` resizes
+    the short side to exactly `image_size` (the host_decode_only stage: the
+    device's later centered crop then supplies the usual fraction)."""
     shape = tf.io.extract_jpeg_shape(encoded)
     h, w = shape[0], shape[1]
-    padded = tf.cast(tf.round(image_size / CROP_FRACTION), tf.int32)
+    padded = tf.cast(tf.round(image_size / crop_fraction), tf.int32)
     scale = tf.cast(padded, tf.float32) / tf.cast(tf.minimum(h, w), tf.float32)
     new_h = tf.cast(tf.round(tf.cast(h, tf.float32) * scale), tf.int32)
     new_w = tf.cast(tf.round(tf.cast(w, tf.float32) * scale), tf.int32)
@@ -88,7 +90,25 @@ def central_crop(encoded, image_size, tf):
 
 
 def preprocess(encoded, label, image_size, training, tf, normalize_on_host=True,
-               mean=None, std=None):
+               mean=None, std=None, host_decode_only=False):
+    if host_decode_only:
+        # the `--device-augment` staging contract (docs/INPUT_PIPELINE.md):
+        # decode + resize to the padded square, emit uint8 — crop/flip/
+        # jitter/normalize run batched inside the jitted step
+        # (data/device_augment.py). `image_size` here is already the padded
+        # decode size (build_dataset resolves it). Train resizes exactly
+        # (static staged shapes); eval center-crops at fraction 1.0 so the
+        # device's nested centered crop equals the plain eval path.
+        if training:
+            image = tf.image.resize(
+                tf.image.decode_jpeg(encoded, channels=3),
+                [image_size, image_size],
+                method=tf.image.ResizeMethod.BICUBIC)
+        else:
+            image = central_crop(encoded, image_size, tf, crop_fraction=1.0)
+        image = to_uint8_pixels(image, tf)
+        image.set_shape([image_size, image_size, 3])
+        return image, label
     if training:
         image = distorted_crop(encoded, image_size, tf)
         image = tf.image.random_flip_left_right(image)
@@ -116,7 +136,7 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
                   num_process: int = 1, process_index: int = 0,
                   num_parallel_calls: Optional[int] = None, cache: bool = False,
                   seed: int = 0, normalize_on_host: bool = True,
-                  mean=None, std=None):
+                  mean=None, std=None, host_decode_only: bool = False):
     """Per-host tf.data pipeline over sharded TFRecords.
 
     `batch_size` here is the PER-HOST batch (global / process_count); the caller
@@ -126,8 +146,17 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
     the train/eval step's `input_norm`) — 4x less host->device traffic.
     `mean`/`std` override the ImageNet channel statistics (pass
     `DataConfig.mean/std` so both normalization modes see the same values).
+
+    `host_decode_only=True` (the `--device-augment` contract) goes further:
+    decode + resize to `config.decode_image_size(image_size)` only, uint8
+    NHWC out, with ALL augmentation fused into the jitted step
+    (data/device_augment.py). Overrides the normalize flags — there is
+    nothing left on the host to normalize.
     """
     tf = _tf()
+    if host_decode_only:
+        from ..core.config import decode_image_size
+        image_size = decode_image_size(image_size)
     AUTOTUNE = tf.data.AUTOTUNE
     files = tf.data.Dataset.list_files(file_pattern, shuffle=training, seed=seed)
     if num_process > 1:
@@ -142,7 +171,8 @@ def build_dataset(file_pattern: str, *, batch_size: int, image_size: int = 224,
         ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
     ds = ds.map(lambda s: preprocess(*parse_example(s, tf), image_size, training,
                                      tf, normalize_on_host=normalize_on_host,
-                                     mean=mean, std=std),
+                                     mean=mean, std=std,
+                                     host_decode_only=host_decode_only),
                 num_parallel_calls=num_parallel_calls or AUTOTUNE,
                 deterministic=not training)
     ds = ds.batch(batch_size, drop_remainder=True)

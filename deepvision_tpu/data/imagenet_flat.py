@@ -9,8 +9,10 @@ synset id (`n01440764_10026.JPEG`), labels resolved through the synset list
 - PIL decode (no cv2 dependency) in a thread pool — JPEG decode releases the
   GIL, so this parallels like the reference's `num_workers=16` loader procs
   without fork overhead;
-- batches are NHWC float32 numpy arrays ready for `device_put` (the
-  `DataLoader` role of `ResNet/pytorch/train.py:229-234`);
+- batches are NHWC numpy arrays ready for `device_put` (the `DataLoader`
+  role of `ResNet/pytorch/train.py:229-234`): float32 by default, compact
+  uint8 at the padded decode size in `host_decode_only` mode
+  (`--device-augment`, docs/INPUT_PIPELINE.md);
 - per-epoch seeded shuffling (the reference never seeds, SURVEY.md §5.2).
 
 The TFRecord pipeline (`data/imagenet.py`) is the fast path for pods; this
@@ -49,18 +51,35 @@ class FlatImageNet:
                  transform: Optional[Callable] = None, training: bool = True,
                  image_size: int = 224, seed: int = 0, workers: int = 16,
                  drop_remainder: Optional[bool] = None,
-                 num_shards: int = 1, shard_index: int = 0):
+                 num_shards: int = 1, shard_index: int = 0,
+                 host_decode_only: bool = False):
         """`batch_size` is the PER-HOST batch; on a pod pass
         `num_shards=jax.process_count(), shard_index=jax.process_index()` so
         each host reads a disjoint slice of the directory (the
-        `files.shard(...)` role of the TFRecord pipelines)."""
+        `files.shard(...)` role of the TFRecord pipelines).
+
+        `host_decode_only=True` is the `--device-augment` contract
+        (docs/INPUT_PIPELINE.md): the host only decodes + resizes to the
+        padded square (`config.decode_image_size`) and batches stay **uint8
+        NHWC** — ~4x less host->device traffic, with crop/flip/jitter/
+        normalize fused into the jitted step (data/device_augment.py)."""
+        from .transforms import (host_decode_eval_transform,
+                                 host_decode_train_transform)
         self.root_dir = root_dir
         self.synset_to_idx = (load_synsets(synsets) if isinstance(synsets, str)
                               else dict(synsets))
         self.batch_size = batch_size
         self.training = training
-        self.transform = transform or (train_transform(image_size) if training
-                                       else eval_transform(image_size))
+        self.host_decode_only = host_decode_only
+        if transform is not None:
+            self.transform = transform
+        elif host_decode_only:
+            self.transform = (host_decode_train_transform(image_size)
+                              if training
+                              else host_decode_eval_transform(image_size))
+        else:
+            self.transform = (train_transform(image_size) if training
+                              else eval_transform(image_size))
         self.seed = seed
         self.workers = workers
         self.drop_remainder = training if drop_remainder is None else drop_remainder
@@ -122,6 +141,9 @@ class FlatImageNet:
                 pending = (submit(pool, starts[n + 1])
                            if n + 1 < len(starts) else None)
                 pairs = [f.result() for f in futures]
-                images = np.stack([p[0] for p in pairs]).astype(np.float32)
+                # decode-only batches stay uint8 (the whole point of the
+                # staging split); transformed batches are f32 as before
+                dtype = np.uint8 if self.host_decode_only else np.float32
+                images = np.stack([p[0] for p in pairs]).astype(dtype)
                 labels = np.asarray([p[1] for p in pairs], np.int32)
                 yield images, labels

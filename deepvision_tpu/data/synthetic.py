@@ -19,7 +19,12 @@ class SyntheticClassification:
 
     def __init__(self, batch_size: int, image_size: int = 32, channels: int = 3,
                  num_classes: int = 10, num_batches: int = 8, seed: int = 0,
-                 learnable: bool = True):
+                 learnable: bool = True, emit_uint8: bool = False):
+        """`emit_uint8=True` yields raw [0,255] uint8 pixel batches (the
+        `--device-augment` staging contract, data/device_augment.py) with
+        the same label-in-the-mean learnable signal mapped into pixel space
+        — pass the PADDED `config.decode_image_size` as `image_size`; the
+        jitted augment crops back down to the model's input."""
         self.batch_size = batch_size
         self.image_size = image_size
         self.channels = channels
@@ -27,6 +32,7 @@ class SyntheticClassification:
         self.num_batches = num_batches
         self.seed = seed
         self.learnable = learnable
+        self.emit_uint8 = emit_uint8
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         rng = np.random.RandomState(self.seed)
@@ -36,6 +42,12 @@ class SyntheticClassification:
                                self.channels).astype(np.float32)
             if self.learnable:
                 images += (labels / self.num_classes - 0.5)[:, None, None, None] * 4.0
+            if self.emit_uint8:
+                # same signal, pixel units: unit-ish floats -> mean 128,
+                # ~32px std, label shift up to +-64px — survives the
+                # device-side (x/255 - mean)/std remap with room to spare
+                images = np.clip(images * 32.0 + 128.0, 0.0, 255.0)
+                images = np.round(images).astype(np.uint8)
             yield images, labels.astype(np.int32)
 
     def __len__(self):

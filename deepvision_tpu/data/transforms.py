@@ -186,3 +186,25 @@ def eval_transform(image_size: int = 224) -> Compose:
         ToFloat(),
         Normalize(),
     ])
+
+
+def host_decode_train_transform(image_size: int = 224) -> Compose:
+    """Host half of the device-augment split (`--device-augment`,
+    data/device_augment.py): decode + exact resize to the padded square,
+    emitting **uint8** — crop/flip/jitter/normalize all happen batched on
+    the device. The exact (D, D) resize keeps staged batch shapes static
+    (one XLA program), unlike the aspect-preserving Rescale(256)."""
+    from ..core.config import decode_image_size
+    d = decode_image_size(image_size)
+    return Compose([Rescale((d, d))])
+
+
+def host_decode_eval_transform(image_size: int = 224) -> Compose:
+    """Host half of the eval split: aspect resize + center crop to the
+    padded square, uint8 out. The device's centered `image_size` crop of
+    this centered crop equals the direct `eval_transform` crop (nested
+    centered crops compose), so the split path matches the host path up to
+    f32 rounding — pinned by tests/test_device_augment.py."""
+    from ..core.config import decode_image_size
+    d = decode_image_size(image_size)
+    return Compose([Rescale(d), CenterCrop(d)])

@@ -9,13 +9,23 @@ device executing batch i — the JAX-side counterpart of tf.data's
 `prefetch_to_device` (the reference relied on
 `experimental_distribute_dataset` + device prefetch inside MirroredStrategy,
 `YOLO/tensorflow/train.py:291-294`).
+
+The prefetcher also keeps the transfer ledger: `bytes_staged_total` /
+`last_stage_secs` / `bytes_per_sec` quantify what the uint8 device-augment
+path (data/device_augment.py, `--device-augment`) saves over f32 batches —
+the trainer surfaces them in its periodic `log_every` flush next to
+`prefetch_queue_depth`, and bench_input.py reads them for its
+bytes-to-device comparison.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator
+
+import jax
 
 from . import mesh as mesh_lib
 
@@ -25,6 +35,14 @@ _SENTINEL = object()
 class _ProducerError:
     def __init__(self, exc: BaseException):
         self.exc = exc
+
+
+def _host_nbytes(batch) -> int:
+    """Bytes the host hands to device_put for one batch — dtype-honest (a
+    uint8 batch counts 1/4 of the same batch as f32), computed from the host
+    arrays so it never syncs the device."""
+    return sum(int(getattr(x, "nbytes", 0))
+               for x in jax.tree_util.tree_leaves(batch))
 
 
 class DevicePrefetcher:
@@ -45,6 +63,16 @@ class DevicePrefetcher:
     stall diagnostic resilience.StepWatchdog dumps: depth `size-1` during a
     stall means the device/dispatch is wedged (producer filled the queue and
     blocked), depth 0 means the host pipeline starved the step loop.
+
+    Transfer accounting (read from any thread; plain-int/float writes are
+    atomic under the GIL):
+
+    - `bytes_staged_total`: host bytes handed to device_put so far — the
+      number the uint8 staging path (`--device-augment`) divides by ~4.
+    - `last_stage_secs`: wall time of the most recent `shard_batch_pytree`
+      call (dispatch + transfer of one batch).
+    - `bytes_per_sec`: cumulative staged bytes / cumulative staging wall
+      time — effective host→device staging bandwidth.
     """
 
     def __init__(self, mesh, batches: Iterable, size: int = 2):
@@ -53,6 +81,10 @@ class DevicePrefetcher:
         self._inline = None
         self._stop = threading.Event()
         self._q: "queue.Queue" = None
+        self.bytes_staged_total = 0
+        self.batches_staged_total = 0
+        self.last_stage_secs = 0.0
+        self._stage_secs_total = 0.0
         if size <= 1:
             self._inline = iter(batches)
             return
@@ -64,6 +96,24 @@ class DevicePrefetcher:
     @property
     def queue_depth(self) -> int:
         return self._q.qsize() if self._q is not None else 0
+
+    @property
+    def bytes_per_sec(self) -> float:
+        if self._stage_secs_total <= 0.0:
+            return 0.0
+        return self.bytes_staged_total / self._stage_secs_total
+
+    def _stage(self, b):
+        """shard_batch_pytree with the transfer ledger updated around it."""
+        nbytes = _host_nbytes(b)
+        t0 = time.perf_counter()
+        staged = mesh_lib.shard_batch_pytree(self._mesh, tuple(b))
+        dt = time.perf_counter() - t0
+        self.bytes_staged_total += nbytes
+        self.batches_staged_total += 1
+        self.last_stage_secs = dt
+        self._stage_secs_total += dt
+        return staged
 
     def _put(self, item) -> bool:
         """Blocking put that still observes stop; True if delivered."""
@@ -80,8 +130,7 @@ class DevicePrefetcher:
             for b in self._batches:
                 if self._stop.is_set():
                     return
-                if not self._put(
-                        mesh_lib.shard_batch_pytree(self._mesh, tuple(b))):
+                if not self._put(self._stage(b)):
                     return
         except BaseException as e:  # propagate into the consumer
             self._put(_ProducerError(e))
@@ -93,8 +142,7 @@ class DevicePrefetcher:
 
     def __next__(self):
         if self._inline is not None:
-            return mesh_lib.shard_batch_pytree(self._mesh,
-                                               tuple(next(self._inline)))
+            return self._stage(next(self._inline))
         if self._stop.is_set():
             raise StopIteration
         item = self._q.get()

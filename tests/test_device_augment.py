@@ -1,0 +1,380 @@
+"""Device-side augmentation (data/device_augment.py, `--device-augment`):
+host/device transform equivalence, train-augment contract, the prefetcher's
+transfer ledger, and seeded device-augmented training end to end."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                        ScheduleConfig, TrainConfig,
+                                        decode_image_size)
+from deepvision_tpu.data import device_augment as daug
+from deepvision_tpu.data.synthetic import SyntheticClassification
+from deepvision_tpu.data.transforms import (eval_transform,
+                                            host_decode_eval_transform,
+                                            host_decode_train_transform)
+
+S = 28                      # model input; decode pads to 32 (224->256 ratio)
+D = decode_image_size(S)
+
+
+def _u8(shape, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, shape).astype(np.uint8)
+
+
+class TestDecodeSize:
+    def test_reference_ratio_and_floor(self):
+        assert decode_image_size(224) == 256
+        assert decode_image_size(28) == 32
+        # tiny sizes still leave the crop at least one offset to draw
+        assert decode_image_size(4) == 5
+
+    def test_channel_stats(self):
+        assert daug.channel_stats((0.5, 0.5, 0.5), 3) == (0.5, 0.5, 0.5)
+        # grayscale configs collapse the RGB stats instead of broadcasting
+        # a (B,H,W,1) batch up to 3 channels
+        m = daug.channel_stats((0.2, 0.4, 0.6), 1)
+        assert m == (pytest.approx(0.4),)
+
+
+class TestEvalEquivalence:
+    def test_device_eval_matches_host_eval_transform(self):
+        """The split path (host decode-only stage -> device center crop +
+        normalize) must equal the host eval_transform pixel-for-pixel: for a
+        SQUARE source both resize identically and the device's centered crop
+        of the host's centered crop is the direct centered crop."""
+        import jax.numpy as jnp
+        ev = daug.make_eval_augment(S, compute_dtype=jnp.float32)
+        host_stage = host_decode_eval_transform(S)
+        host_ref = eval_transform(S)
+        for seed, src in ((0, 64), (1, 100), (2, D)):  # incl. identity resize
+            img = _u8((src, src, 3), seed=seed)
+            staged = host_stage(img)
+            assert staged.shape == (D, D, 3) and staged.dtype == np.uint8
+            got = np.asarray(ev(staged[None]))[0]
+            want = host_ref(img)
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_eval_is_deterministic(self):
+        import jax.numpy as jnp
+        ev = daug.make_eval_augment(S, compute_dtype=jnp.float32)
+        batch = _u8((4, D, D, 3))
+        np.testing.assert_array_equal(np.asarray(ev(batch)),
+                                      np.asarray(ev(batch)))
+
+
+class TestTrainAugment:
+    def test_shape_dtype_range_and_determinism(self):
+        """Train augment: (B,D,D,C) uint8 -> (B,S,S,C) compute dtype, values
+        inside the normalized-pixel range, identical per (key), different
+        across keys — the per-(seed, step) reproducibility the step's
+        fold_in contract provides."""
+        import jax
+        import jax.numpy as jnp
+        fn = jax.jit(daug.make_train_augment(S, compute_dtype=jnp.float32))
+        batch = _u8((8, D, D, 3))
+        a = np.asarray(fn(batch, jax.random.PRNGKey(7)))
+        b = np.asarray(fn(batch, jax.random.PRNGKey(7)))
+        c = np.asarray(fn(batch, jax.random.PRNGKey(8)))
+        assert a.shape == (8, S, S, 3) and a.dtype == np.float32
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+        # jitter clips to [0,255] before normalize, so outputs stay inside
+        # the normalized range of raw pixels
+        from deepvision_tpu.core.config import IMAGENET_MEAN, IMAGENET_STD
+        lo = min((0.0 - m) / s for m, s in zip(IMAGENET_MEAN, IMAGENET_STD))
+        hi = max((1.0 - m) / s for m, s in zip(IMAGENET_MEAN, IMAGENET_STD))
+        assert a.min() >= lo - 1e-5 and a.max() <= hi + 1e-5
+        # compute-dtype contract (the step's bf16 policy)
+        bf = jax.jit(daug.make_train_augment(S))(batch, jax.random.PRNGKey(0))
+        assert bf.dtype == jnp.bfloat16
+
+    def test_no_jitter_no_flip_no_pad_is_pure_normalize(self):
+        """With augmentation degenerate (zero jitter, flip off, no crop
+        headroom) the device stage must reduce to exactly the host
+        ToFloat+Normalize — anchors the normalization arithmetic."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepvision_tpu.data.transforms import Normalize, ToFloat
+        fn = jax.jit(daug.make_train_augment(
+            S, jitter=(0.0, 0.0, 0.0), flip_prob=0.0,
+            compute_dtype=jnp.float32))
+        img = _u8((S, S, 3))
+        got = np.asarray(fn(img[None], jax.random.PRNGKey(0)))[0]
+        want = Normalize()(ToFloat()(img))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_crop_offsets_cover_the_pad(self):
+        """Across keys the random crop must actually draw distinct offsets
+        (an off-by-one in the randint bound would pin every crop to the
+        top-left and silently kill the augmentation)."""
+        import jax
+        import jax.numpy as jnp
+        fn = jax.jit(daug.make_train_augment(
+            S, jitter=(0.0, 0.0, 0.0), flip_prob=0.0,
+            compute_dtype=jnp.float32))
+        # image encodes its own coordinates, so the crop offset is readable
+        # back off the cropped values
+        base = np.arange(D * D, dtype=np.float32).reshape(D, D)
+        img = np.stack([base, base, base], -1)
+        img = (img / img.max() * 255).astype(np.uint8)
+        tops = set()
+        for k in range(8):
+            out = np.asarray(fn(img[None], jax.random.PRNGKey(k)))[0]
+            tops.add(float(out[0, 0, 0]))
+        assert len(tops) > 1, "crop offsets never varied across keys"
+
+
+class TestHostDecodeLoaders:
+    def test_flat_imagenet_host_decode_only_uint8(self, tmp_path):
+        """FlatImageNet(host_decode_only=True) yields uint8 NHWC at the
+        padded decode size for train AND eval, labels unchanged."""
+        from PIL import Image
+
+        from deepvision_tpu.data.imagenet_flat import FlatImageNet
+        root = tmp_path / "flat"
+        root.mkdir()
+        rs = np.random.RandomState(0)
+        synsets = {"n01": 0, "n02": 1}
+        for i in range(6):
+            syn = "n01" if i % 2 else "n02"
+            arr = rs.randint(0, 256, (40, 48, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(root / f"{syn}_{i}.JPEG")
+        for training in (True, False):
+            ds = FlatImageNet(str(root), synsets, batch_size=3,
+                              training=training, image_size=S, seed=0,
+                              workers=2, host_decode_only=True)
+            images, labels = next(iter(ds))
+            assert images.shape == (3, D, D, 3)
+            assert images.dtype == np.uint8
+            assert labels.dtype == np.int32
+            assert set(labels) <= {0, 1}
+
+    def test_synthetic_uint8_contract(self):
+        ds = SyntheticClassification(4, image_size=D, channels=3,
+                                     num_classes=10, num_batches=2, seed=0,
+                                     emit_uint8=True)
+        batches = list(ds)
+        assert all(im.dtype == np.uint8 and im.shape == (4, D, D, 3)
+                   for im, _ in batches)
+        # deterministic per seed (the loaders' epoch-seeding contract)
+        again = list(SyntheticClassification(4, image_size=D, channels=3,
+                                             num_classes=10, num_batches=2,
+                                             seed=0, emit_uint8=True))
+        np.testing.assert_array_equal(batches[0][0], again[0][0])
+
+    def test_host_decode_train_transform_shapes(self):
+        t = host_decode_train_transform(S)
+        out = t(_u8((50, 70, 3)))
+        assert out.shape == (D, D, 3) and out.dtype == np.uint8
+
+
+class TestPrefetcherLedger:
+    def test_bytes_staged_and_latency(self, mesh8):
+        """The transfer ledger is dtype-honest: a uint8 batch counts 1/4 the
+        bytes of the same-shape f32 batch; staging latency is recorded."""
+        from deepvision_tpu.parallel.prefetch import DevicePrefetcher
+        u8 = [( _u8((8, 16, 16, 3)), np.zeros(8, np.int32)) for _ in range(3)]
+        f32 = [(b[0].astype(np.float32), b[1]) for b in u8]
+        for size in (1, 2):  # inline and threaded staging paths
+            pf_u8 = DevicePrefetcher(mesh8, iter(u8), size=size)
+            list(pf_u8)
+            pf_f32 = DevicePrefetcher(mesh8, iter(f32), size=size)
+            list(pf_f32)
+            per_batch = 8 * 16 * 16 * 3
+            assert pf_u8.bytes_staged_total == 3 * (per_batch + 8 * 4)
+            assert pf_f32.bytes_staged_total == 3 * (per_batch * 4 + 8 * 4)
+            assert pf_u8.batches_staged_total == 3
+            assert pf_u8.last_stage_secs > 0.0
+            assert pf_u8.bytes_per_sec > 0.0
+            pf_u8.close(), pf_f32.close()
+
+    def test_trainer_logs_transfer_stats(self, tmp_path):
+        """The log_every flush carries prefetch_bytes_staged and
+        prefetch_stage_ms next to prefetch_queue_depth (satellite: savings
+        visible in logs, not just bench runs)."""
+        from deepvision_tpu.core.trainer import Trainer
+        cfg = _cfg(tmp_path, device_augment=False)
+        tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+        tr.fit(lambda e: SyntheticClassification(
+            batch_size=32, image_size=32, channels=1, num_classes=10,
+            num_batches=4, seed=e), None, sample_shape=(32, 32, 1))
+        hist = tr.logger.history
+        tr.close()
+        for key in ("train_prefetch_queue_depth", "train_prefetch_bytes_staged",
+                    "train_prefetch_stage_ms"):
+            assert key in hist, sorted(hist)
+        assert hist["train_prefetch_bytes_staged"]["value"][-1] > 0
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        name="daug", model="lenet5", batch_size=32, total_epochs=3,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        channels=1, train_examples=32 * 4),
+        dtype="float32", device_augment=True,
+        checkpoint_dir=str(tmp_path / "ckpt"), log_every_steps=2)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _uint8_data(epoch, *, n=4, seed=None):
+    return SyntheticClassification(
+        batch_size=32, image_size=decode_image_size(32), channels=1,
+        num_classes=10, num_batches=n, seed=epoch if seed is None else seed,
+        emit_uint8=True)
+
+
+class TestDeviceAugmentedTraining:
+    def test_loss_trajectory_matches_host_path_shape(self, tmp_path):
+        """Acceptance pin: seeded device-augmented training fits the
+        synthetic label-in-the-mean task the way the host-augmented path
+        does — loss decreases across epochs and ends well below its start
+        on BOTH paths (same trajectory shape; exact values differ because
+        the pixel-space remap quantizes the signal)."""
+        from deepvision_tpu.core.trainer import Trainer
+
+        def run(device_augment, wd):
+            cfg = _cfg(tmp_path, device_augment=device_augment)
+            tr = Trainer(cfg, workdir=str(tmp_path / wd))
+            data = (_uint8_data if device_augment else
+                    lambda e: SyntheticClassification(
+                        batch_size=32, image_size=32, channels=1,
+                        num_classes=10, num_batches=4, seed=e))
+            tr.fit(data, None, sample_shape=(32, 32, 1))
+            hist = list(tr.logger.history["epoch_train_loss"]["value"])
+            tr.close()
+            return hist
+
+        dev = run(True, "dev")
+        host = run(False, "host")
+        for name, hist in (("device", dev), ("host", host)):
+            assert all(np.isfinite(hist)), f"{name} path diverged: {hist}"
+            assert hist[-1] < hist[0] * 0.95, \
+                f"{name} path did not fit the synthetic task: {hist}"
+        # same SHAPE: the device path's relative decrease keeps pace with
+        # the host path's (margin covers the pixel-space quantization of
+        # the signal and the extra crop/jitter noise)
+        dev_ratio = dev[-1] / dev[0]
+        host_ratio = host[-1] / host[0]
+        assert dev_ratio <= host_ratio + 0.15, (dev, host)
+
+    def test_seed_reproducible_per_step(self, tmp_path):
+        """Two identical seeded runs produce IDENTICAL loss trajectories:
+        augmentation randomness is a pure function of (seed, step), not of
+        host thread scheduling."""
+        from deepvision_tpu.core.trainer import Trainer
+
+        def run(wd):
+            cfg = _cfg(tmp_path, total_epochs=2)
+            tr = Trainer(cfg, workdir=str(tmp_path / wd))
+            tr.fit(_uint8_data, None, sample_shape=(32, 32, 1))
+            hist = list(tr.logger.history["epoch_train_loss"]["value"])
+            tr.close()
+            return hist
+
+        assert run("a") == run("b")
+
+    def test_eval_path_and_padding(self, tmp_path):
+        """evaluate() center-crops + normalizes uint8 batches on device, and
+        the partial-batch zero-padding works on uint8 input."""
+        from deepvision_tpu.core.trainer import Trainer
+        cfg = _cfg(tmp_path, total_epochs=1)
+        tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+        tr.init_state((32, 32, 1))
+        d = decode_image_size(32)
+        rs = np.random.RandomState(0)
+
+        def val():
+            # a full batch then a partial one (12 rows): exercises the
+            # running-max pad on uint8
+            yield (rs.randint(0, 256, (32, d, d, 1)).astype(np.uint8),
+                   rs.randint(0, 10, (32,)).astype(np.int32))
+            yield (rs.randint(0, 256, (12, d, d, 1)).astype(np.uint8),
+                   rs.randint(0, 10, (12,)).astype(np.int32))
+
+        out = tr.evaluate(val())
+        tr.close()
+        assert out["count"] == 44.0
+        assert np.isfinite(out["loss"])
+
+    def test_steps_guard_rejects_double_normalize(self):
+        import jax.numpy as jnp
+
+        from deepvision_tpu.core import steps
+        fn = daug.make_train_augment(S, compute_dtype=jnp.float32)
+        with pytest.raises(ValueError, match="double-normalize"):
+            steps.make_classification_train_step(
+                device_augment=fn, input_norm=((0.5,), (0.5,)))
+        with pytest.raises(ValueError, match="double-normalize"):
+            steps.make_classification_eval_step(
+                device_augment=daug.make_eval_augment(S),
+                input_norm=((0.5,), (0.5,)))
+
+    def test_task_families_reject_device_augment(self, tmp_path):
+        """Detection/pose/centernet steps never fuse the augment — the
+        shared guard must refuse instead of training on raw padded uint8."""
+        from deepvision_tpu.core.detection import DetectionTrainer
+        cfg = _cfg(tmp_path, model="yolov3", family="yolo",
+                   data=DataConfig(dataset="synthetic", image_size=64,
+                                   num_classes=3))
+        with pytest.raises(ValueError, match="classification-only"):
+            DetectionTrainer(cfg, workdir=str(tmp_path / "wd"))
+
+    def test_spatial_mesh_rejected(self, tmp_path):
+        from deepvision_tpu.core.trainer import Trainer
+        cfg = _cfg(tmp_path, spatial_parallel=2)
+        with pytest.raises(ValueError, match="spatial"):
+            Trainer(cfg, workdir=str(tmp_path / "wd"))
+
+
+class TestCliWiring:
+    def test_synthetic_device_augment_smoke(self, tmp_path, monkeypatch):
+        """`--synthetic --device-augment` trains end to end through the
+        shared CLI (uint8 staging pipeline + fused augment)."""
+        monkeypatch.chdir(tmp_path)
+        from deepvision_tpu.cli import run_classification
+        result = run_classification(
+            "lenet", ["lenet5"],
+            ["-m", "lenet5", "--synthetic", "--epochs", "1",
+             "--batch-size", "32", "--steps-per-epoch", "2",
+             "--device-augment", "--workdir", str(tmp_path / "wd")])
+        assert np.isfinite(result["best_metric"])
+
+    def test_device_augment_rejects_float_pipelines(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        from deepvision_tpu.cli import run_classification
+        with pytest.raises(SystemExit, match="host-decode-only"):
+            run_classification(
+                "lenet", ["lenet5"],
+                ["-m", "lenet5", "--dataset", "digits", "--epochs", "1",
+                 "--device-augment", "--workdir", str(tmp_path / "wd")])
+
+
+def test_bench_input_schema(tmp_path, capsys):
+    """bench_input.py emits one bench.py-schema JSON record; the uint8 path
+    must move >=3x fewer host->device bytes per batch than host-f32 (the
+    measured ledger, not a formula) and be no slower end to end."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_input_root", os.path.join(os.path.dirname(__file__), "..",
+                                         "bench_input.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["--batch-size", "16", "--image-size", "48", "--steps", "6",
+              "--source-images", "16"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["unit"] == "images/sec" and rec["value"] > 0
+    assert "uint8_device_augment" in rec["metric"]
+    # acceptance: >=3x fewer bytes to device, throughput no worse
+    assert rec["bytes_to_device_ratio"] >= 3.0, rec
+    assert rec["vs_baseline"] >= 1.0, rec
+    assert rec["bytes_to_device_per_batch_uint8"] < \
+        rec["bytes_to_device_per_batch_host_f32"]

@@ -864,14 +864,20 @@ def test_steps_per_dispatch_matches_single_step_training(tmp_path):
     m1, s1 = run(1, "k1")
     m3, s3 = run(3, "k3")
     assert int(s1.step) == int(s3.step) == 7
+    # atol 2e-5, not 1e-6: the scanned and singly-dispatched programs are
+    # different XLA fusions, so adam's f32 arithmetic legitimately
+    # reassociates — on the seed tree this test already failed 1/2400
+    # elements at ~6e-6 (CHANGES.md PR 4 known-flake note). 2e-5 is an
+    # honest bound for "same math, different fusion"; a real cadence bug
+    # (EMA advancing per dispatch instead of per step) errs at >1e-2.
     for a, b in zip(jax.tree_util.tree_leaves(s1.params),
                     jax.tree_util.tree_leaves(s3.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=2e-5)
     for a, b in zip(jax.tree_util.tree_leaves(s1.ema_params),
                     jax.tree_util.tree_leaves(s3.ema_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=2e-5)
     # the step-weighted epoch mean agrees between groupings
     np.testing.assert_allclose(m1["loss"], m3["loss"], rtol=1e-5)
 

@@ -17,6 +17,10 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
   devices     backend reachable, device count/platform, mesh construction
   input       host tf.data throughput (real TFRecords when --data-dir is
               given, synthetic JPEG shards otherwise) vs --input-floor
+  augment     device-augment smoke (docs/INPUT_PIPELINE.md): the jitted
+              uint8 train/eval augment stages compile, are deterministic
+              per PRNG key, and the eval split matches the host
+              eval_transform path
   step        the model's jitted train step compiles and one synthetic
               step returns a finite loss on the mesh
   checkpoint  an Orbax save/restore roundtrip in the workdir's filesystem
@@ -169,6 +173,51 @@ def check_input(args):
         raise RuntimeError(f"bench_input exited {proc.returncode}: "
                            f"{lines[-1] if lines else '(no stderr)'}")
     return f"floor={args.input_floor or 'unset'}"
+
+
+@check("augment")
+def check_augment(args):
+    # device-augment smoke (docs/INPUT_PIPELINE.md): the jitted train/eval
+    # augment stages compile on this host's backend and honor their
+    # contract over synthetic uint8 batches — shape (crop to image_size),
+    # finiteness, per-key determinism (the seed-reproducibility the
+    # per-step fold depends on), and eval matching the host eval_transform
+    # split. A host that fails this would crash (or silently skew) every
+    # --device-augment run at the first train step.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.core.config import decode_image_size
+    from deepvision_tpu.data import device_augment as daug
+    from deepvision_tpu.data.transforms import (eval_transform,
+                                                host_decode_eval_transform)
+
+    size = min(args.image_size, 64)
+    d = decode_image_size(size)
+    rs = np.random.RandomState(0)
+    u8 = rs.randint(0, 256, (8, d, d, 3)).astype(np.uint8)
+    train_fn = jax.jit(daug.make_train_augment(size,
+                                               compute_dtype=jnp.float32))
+    eval_fn = jax.jit(daug.make_eval_augment(size, compute_dtype=jnp.float32))
+    key = jax.random.PRNGKey(0)
+    a, b = train_fn(u8, key), train_fn(u8, key)
+    c = train_fn(u8, jax.random.PRNGKey(1))
+    if a.shape != (8, size, size, 3) or not np.all(np.isfinite(a)):
+        raise RuntimeError(f"train augment broke shape/finiteness: {a.shape}")
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        raise RuntimeError("train augment is not deterministic per key")
+    if np.array_equal(np.asarray(a), np.asarray(c)):
+        raise RuntimeError("train augment ignored the PRNG key")
+    # eval split vs the host path, one square image (nested centered crops)
+    img = rs.randint(0, 256, (2 * d, 2 * d, 3)).astype(np.uint8)
+    host = eval_transform(size)(img)
+    dev = np.asarray(eval_fn(host_decode_eval_transform(size)(img)[None]))[0]
+    err = float(np.max(np.abs(host - dev)))
+    if err > 1e-4:
+        raise RuntimeError(f"device eval augment diverges from host "
+                           f"eval_transform (max abs err {err:.2e})")
+    return f"uint8 {d}->{size}px train+eval jitted; host parity {err:.1e}"
 
 
 @check("step")
@@ -378,6 +427,7 @@ def main(argv=None):
     check_serve(args)
     check_devices(args)
     check_input(args)
+    check_augment(args)
     check_step(args)
     if args.verify_mesh:
         check_mesh_parity(args)
