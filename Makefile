@@ -10,12 +10,22 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-input dryrun smoke \
-        serve-smoke preflight preflight-record lint fsck
+        serve-smoke preflight preflight-record lint lint-changed fsck
 
-lint:        ## jaxlint: donation-aliasing / retrace / host-sync / trace
-	## hazards (docs/LINTING.md) over the framework, the tools, and the
-	## per-model entrypoints — exit 1 on any finding
-	$(PY) -m deepvision_tpu.lint deepvision_tpu tools $(wildcard */jax)
+lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
+	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
+	## project — framework, tools, tests, per-model entrypoints AND the
+	## repo-root scripts (bench*.py, __graft_entry__.py); exit 1 on any
+	## finding
+	$(PY) -m deepvision_tpu.lint
+
+lint-changed: ## jaxlint over only the files `git diff` touches (staged or
+	## not, vs HEAD) — seconds, for the inner loop; falls back to clean
+	## when nothing changed
+	@files=$$( (git diff --name-only HEAD; git ls-files --others \
+	  --exclude-standard) | sort -u | grep '\.py$$' | grep -v '^tests/data/lint/' ); \
+	if [ -z "$$files" ]; then echo "lint-changed: no changed .py files"; \
+	else $(PY) -m deepvision_tpu.lint $$files; fi
 
 RUN_DIR ?= runs
 fsck:        ## checkpoint-integrity audit (docs/FAILURES.md): verify every

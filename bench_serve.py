@@ -76,7 +76,8 @@ def main() -> None:
     t0 = time.perf_counter()
     n_naive = 0
     while time.perf_counter() - t0 < min(secs, 2.0) and n_naive < 100:
-        np.asarray(jax.jit(predict_fn)(engine._variables, x1)[:1])  # noqa — jaxlint: disable=JIT001 — this IS the measured anti-pattern
+        # jaxlint: disable=JIT001 — this IS the measured anti-pattern
+        np.asarray(jax.jit(predict_fn)(engine._variables, x1)[:1])
         n_naive += 1
     naive_ips = n_naive / (time.perf_counter() - t0)
 

@@ -2,10 +2,16 @@
 
 Catches the hazard classes this codebase pays for in pod-hours rather than
 tracebacks: use-after-donate aliasing (DON001, the PR 1 checkpoint bug
-class), per-call retraces (JIT001), hot-loop host syncs (SYNC001),
-side effects under trace (EFF001), and tracer bools (TRC001).
+class), per-call retraces (JIT001), hot-loop host syncs (SYNC001), side
+effects under trace (EFF001), tracer bools (TRC001), PRNG key reuse and
+un-folded step keys (RNG001/RNG002), dtype-policy leaks (DTY001/DTY002),
+and mesh-axis / placement inconsistencies (SHD001/SHD002). All eleven rules
+run on one shared interprocedural dataflow core (framework.CallGraph +
+trace-reach/taint, donation.ProjectIndex), so a hazard that crosses a
+function or module boundary is still visible at the call site.
 
-CLI:      python -m deepvision_tpu.lint <paths> [--format json] [--select R,..]
+CLI:      python -m deepvision_tpu.lint <paths> [--format json|github]
+                                                [--select R,..]
 Library:  lint_paths([...]) -> [Finding]
 Suppress: `# jaxlint: disable=RULE` inline; `[tool.jaxlint]` in
           pyproject.toml for path excludes. See docs/LINTING.md.

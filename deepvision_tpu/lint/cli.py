@@ -1,9 +1,14 @@
-"""jaxlint CLI: `python -m deepvision_tpu.lint <paths> [options]`.
+"""jaxlint CLI: `python -m deepvision_tpu.lint [paths] [options]`.
+
+With no paths, lints the whole project rooted at the nearest pyproject.toml
+(the default lint set: the package, tools/, tests/, the per-model
+entrypoints, AND the repo-root scripts — bench*.py, __graft_entry__.py —
+minus `[tool.jaxlint] exclude`).
 
 Exit codes (stable, for CI):
   0 — clean
   1 — findings reported
-  2 — usage error (no/unknown paths, bad flags, unreadable config)
+  2 — usage error (unknown paths/rules, bad flags, no project root found)
 """
 
 from __future__ import annotations
@@ -87,6 +92,25 @@ def lint_paths(paths: Sequence[str], config: Optional[Config] = None,
     return _lint(paths, config, select, root)[0]
 
 
+def _render_github(findings: List[Finding], n_files: int) -> str:
+    """GitHub Actions workflow annotations: one `::error`/`::warning`
+    command per finding (rendered inline on the PR diff), then the same
+    human summary line the text format ends with."""
+    lines = []
+    for f in findings:
+        kind = "error" if f.severity == "error" else "warning"
+        # the message lands in the annotation body; newlines must be %0A
+        msg = f.message.replace("%", "%25").replace("\n", "%0A")
+        lines.append(f"::{kind} file={f.path},line={f.line},col={f.col},"
+                     f"title=jaxlint {f.rule}::{msg}")
+    if findings:
+        lines.append(f"jaxlint: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''}")
+    else:
+        lines.append(f"jaxlint: clean ({n_files} files)")
+    return "\n".join(lines)
+
+
 def _render_text(findings: List[Finding], n_files: int) -> str:
     lines = [f.format() for f in findings]
     if findings:
@@ -122,8 +146,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         f"{rid}: {doc}"
                         for rid, (_, _, doc) in ALL_RULES.items()))
     parser.add_argument("paths", nargs="*",
-                        help="files or directories to lint")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+                        help="files or directories to lint (default: the "
+                             "project rooted at the nearest pyproject.toml)")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text",
+                        help="github emits ::error/::warning workflow "
+                             "annotations for Actions")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run (default: all)")
     parser.add_argument("--config", default=None,
@@ -134,8 +162,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except SystemExit as e:
         return EXIT_USAGE if e.code not in (0, None) else 0
     if not args.paths:
-        print("usage error: at least one path is required", file=sys.stderr)
-        return EXIT_USAGE
+        # default lint set: everything under the project root, so the
+        # repo-root scripts (bench*.py, __graft_entry__.py) are swept too
+        anchor = (os.path.dirname(os.path.abspath(args.config))
+                  if args.config else os.getcwd())
+        pyproject = find_pyproject(anchor)
+        if not pyproject:
+            print("usage error: no paths given and no pyproject.toml found "
+                  "upward of the working directory", file=sys.stderr)
+            return EXIT_USAGE
+        args.paths = [os.path.dirname(pyproject) or "."]
     for path in args.paths:
         if not os.path.exists(path):
             print(f"usage error: no such path: {path}", file=sys.stderr)
@@ -160,7 +196,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         root = os.path.dirname(os.path.abspath(args.config))
 
     findings, n_files = _lint(args.paths, config, select, root)
-    render = _render_json if args.format == "json" else _render_text
+    render = {"json": _render_json, "github": _render_github,
+              "text": _render_text}[args.format]
     print(render(findings, n_files))
     return EXIT_FINDINGS if findings else EXIT_CLEAN
 

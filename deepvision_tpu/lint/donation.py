@@ -30,11 +30,12 @@ donating configuration regardless of the flag's value at runtime.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, NamedTuple, Optional, Tuple
+from typing import Dict, Iterable, NamedTuple, Optional, Set, Tuple
 
-from .framework import Module, terminal_name, walk_scope
+from .framework import (JIT_FNS, CallGraph, Module, ReachedFn,
+                        compute_trace_reach, terminal_name, walk_scope)
 
-JIT_FNS = {"jax.jit", "jax.pjit", "flax.nnx.jit", "nnx.jit"}
+__all__ = ["JIT_FNS", "Donation", "JittedIndex", "ProjectIndex"]
 
 
 class Donation(NamedTuple):
@@ -125,8 +126,131 @@ def donating_jit_call(call: ast.Call, module: Module,
     return don if (don.argnums or don.argnames) else None
 
 
+class JittedIndex:
+    """Which spellings evaluate to ANY jitted callable, donating or not.
+
+    The donation index below answers "does this call donate"; this one
+    answers the weaker "is this call a jit dispatch boundary" — what the
+    dtype rules need to spot host-side casts crossing into compiled code.
+    Same three layers as donation: factories returning a `jax.jit(...)`,
+    module-level names bound to one, and instance attrs."""
+
+    def __init__(self) -> None:
+        self.factories: Set[str] = set()
+        self.module_names: Dict[str, Set[str]] = {}
+        self.class_attrs: Dict[str, Set[str]] = {}
+        # class name -> attrs holding *factories* (lambda-valued
+        # `self._step_factory = lambda ...: make_x_train_step(...)`), so
+        # `self.train_step = self._step_factory(...)` resolves as jitted
+        self.attr_factories: Dict[str, Set[str]] = {}
+
+    def build(self, modules: Iterable[Module]) -> "JittedIndex":
+        modules = list(modules)
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for sub in walk_scope(node):
+                    if isinstance(sub, ast.Return) \
+                            and isinstance(sub.value, ast.Call) \
+                            and module.resolve(sub.value.func) in JIT_FNS:
+                        self.factories.add(node.name)
+        for _ in range(3):  # attrs may chain through factories found above
+            changed = False
+            for module in modules:
+                changed |= self._collect(module)
+            if not changed:
+                break
+        return self
+
+    def _lambda_factory(self, node: ast.AST, module: Module) -> bool:
+        """`lambda ...: <jit call or known-factory call>`."""
+        return (isinstance(node, ast.Lambda)
+                and isinstance(node.body, ast.Call)
+                and (module.resolve(node.body.func) in JIT_FNS
+                     or terminal_name(node.body.func) in self.factories))
+
+    def _value_jitted(self, node: ast.AST, module: Module,
+                      cls_name: Optional[str] = None,
+                      self_arg: Optional[str] = None) -> bool:
+        if isinstance(node, ast.IfExp):
+            return (self._value_jitted(node.body, module, cls_name, self_arg)
+                    or self._value_jitted(node.orelse, module, cls_name,
+                                          self_arg))
+        if not isinstance(node, ast.Call):
+            return False
+        if module.resolve(node.func) in JIT_FNS:
+            return True
+        # self._step_factory(...) — attr known to hold a factory lambda
+        if (cls_name and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == self_arg
+                and node.func.attr in self.attr_factories.get(cls_name,
+                                                              set())):
+            return True
+        return terminal_name(node.func) in self.factories
+
+    def _collect(self, module: Module) -> bool:
+        changed = False
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            scope = module.enclosing_scope(node)
+            ctx = module.self_name(scope)
+            cls_name = self_arg = None
+            if ctx:
+                self_arg, cls_name = ctx
+            is_self_attr = (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and ctx and tgt.value.id == self_arg)
+            if is_self_attr and self._lambda_factory(node.value, module):
+                bucket = self.attr_factories.setdefault(cls_name, set())
+                if tgt.attr not in bucket:
+                    bucket.add(tgt.attr)
+                    changed = True
+                continue
+            if not self._value_jitted(node.value, module, cls_name, self_arg):
+                continue
+            if isinstance(tgt, ast.Name) \
+                    and module.parent(node) is module.tree:
+                bucket = self.module_names.setdefault(module.path, set())
+                if tgt.id not in bucket:
+                    bucket.add(tgt.id)
+                    changed = True
+            elif is_self_attr:
+                bucket = self.class_attrs.setdefault(cls_name, set())
+                if tgt.attr not in bucket:
+                    bucket.add(tgt.attr)
+                    changed = True
+        return changed
+
+    def callable_spellings(self, module: Module, scope: ast.AST) -> Set[str]:
+        """Dotted spellings that name a jitted callable inside `scope`:
+        module-level names, `self.attr` for the enclosing class, and local
+        names bound to a jit call / factory call in this scope."""
+        out = set(self.module_names.get(module.path, set()))
+        ctx = module.self_name(scope)
+        self_arg = cls_name = None
+        if ctx:
+            self_arg, cls_name = ctx
+            out |= {f"{self_arg}.{a}"
+                    for a in self.class_attrs.get(cls_name, set())}
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if self._value_jitted(node.value, module, cls_name, self_arg):
+                    out.add(node.targets[0].id)
+                elif node.targets[0].id in out:
+                    out.discard(node.targets[0].id)
+        return out
+
+
 class ProjectIndex:
-    """Donation knowledge shared across every file of one lint invocation."""
+    """Dataflow knowledge shared across every file of one lint invocation:
+    the donation maps DON001 runs on, the project call graph, the
+    interprocedural trace-reach/taint map, and the jitted-callable index."""
 
     def __init__(self) -> None:
         # factory terminal name -> Donation of the jitted callable it returns
@@ -138,10 +262,20 @@ class ProjectIndex:
         self.attr_factories: Dict[str, Dict[str, Donation]] = {}
         # module path -> top-level name -> Donation
         self.module_names: Dict[str, Dict[str, Donation]] = {}
+        self.graph: Optional[CallGraph] = None
+        # id(fn node) -> ReachedFn for every function that runs under trace
+        self.reach: Dict[int, ReachedFn] = {}
+        self.jitted = JittedIndex()
+        # scratch space for per-run derived analyses (rule modules memoize
+        # their own fixpoints here instead of recomputing per file)
+        self.cache: Dict[str, object] = {}
 
     # -- building ------------------------------------------------------------
     def build(self, modules: Iterable[Module]) -> "ProjectIndex":
         modules = list(modules)
+        self.graph = CallGraph(modules)
+        self.reach = compute_trace_reach(self.graph)
+        self.jitted.build(modules)
         for module in modules:
             self._collect_factories(module)
         # attr assignments can reference factories from other modules and
@@ -157,6 +291,12 @@ class ProjectIndex:
         for module in modules:
             self._collect_module_names(module)
         return self
+
+    def reached_in(self, module: Module):
+        """ReachedFn entries whose def lives in `module`, i.e. every function
+        here that executes under a jax trace (directly or via a call chain
+        from another module's traced code)."""
+        return [r for r in self.reach.values() if r.info.module is module]
 
     def _collect_factories(self, module: Module) -> None:
         for node in ast.walk(module.tree):

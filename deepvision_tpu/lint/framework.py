@@ -12,7 +12,7 @@ import dataclasses
 import fnmatch
 import os
 import re
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
@@ -72,6 +72,9 @@ class Config:
     disable: Tuple[str, ...] = ()          # rule ids disabled project-wide
     hot_loop_callees: Tuple[str, ...] = () # extra callee names marking a loop hot
     sync_allowed_guards: Tuple[str, ...] = ()  # extra guard-name patterns
+    # declared policy dtype for the DTY rules ("bfloat16"/"float16"); empty
+    # string means no declared policy and DTY001 stays off
+    compute_dtype: str = ""
 
     def rule_enabled(self, rule_id: str) -> bool:
         return rule_id.upper() not in {r.upper() for r in self.disable}
@@ -192,10 +195,13 @@ def load_config(pyproject_path: Optional[str]) -> Config:
             val = [val]
         return tuple(str(v) for v in val if isinstance(v, (str, int)))
 
+    compute_dtype = raw.get("compute-dtype", "")
     return Config(exclude=strings("exclude"),
                   disable=strings("disable"),
                   hot_loop_callees=strings("hot-loop-callees"),
-                  sync_allowed_guards=strings("sync-allowed-guards"))
+                  sync_allowed_guards=strings("sync-allowed-guards"),
+                  compute_dtype=(compute_dtype
+                                 if isinstance(compute_dtype, str) else ""))
 
 
 # -- AST module context ------------------------------------------------------
@@ -250,6 +256,7 @@ class Module:
                 self.parents[id(child)] = parent
         self.aliases, self.import_roots = self._collect_aliases()
         self.line_suppress, self.file_suppress = parse_suppressions(source)
+        self._scope_defs: Dict[int, Dict[str, ast.AST]] = {}
 
     @classmethod
     def from_path(cls, path: str) -> "Module":
@@ -316,6 +323,17 @@ class Module:
             if isinstance(node, SCOPE_TYPES):
                 yield node
 
+    def scope_defs(self, scope: ast.AST) -> Dict[str, ast.AST]:
+        """Function defs directly visible in `scope` (memoized — the call
+        resolvers hit the same scopes once per call site)."""
+        cached = self._scope_defs.get(id(scope))
+        if cached is None:
+            cached = {node.name: node for node in walk_scope(scope)
+                      if isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))}
+            self._scope_defs[id(scope)] = cached
+        return cached
+
     def self_name(self, scope: ast.AST) -> Optional[Tuple[str, str]]:
         """For a method (or a function nested in one), the instance-arg name
         of the nearest method, plus its class name — (`self`, `Trainer`)."""
@@ -338,3 +356,438 @@ class Module:
             return None
         return Finding(self.path, line, getattr(node, "col_offset", 0) + 1,
                        rule, severity, message)
+
+
+# -- hot-loop detection (shared by SYNC001 / SHD002) -------------------------
+_HOT_CALLEES = re.compile(r"^(train_step|multi_step|train_batch|step_fn)$")
+# serving dispatch loops count as hot only for the placement rule (SHD002):
+# a batch-detect CLI legitimately fetches outputs per image for host NMS, so
+# SYNC001 keeps its train-loop-only scope
+_SERVE_CALLEES = re.compile(r"^(predict|submit)$")
+
+
+def _loop_statements(loop: ast.AST) -> Iterator[ast.AST]:
+    """Nodes in the loop's repeated part, not descending into nested defs."""
+    for stmt in list(loop.body) + list(getattr(loop, "orelse", [])):
+        stack = [stmt]
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, SCOPE_TYPES):
+                stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_hot_loop(loop: ast.AST, config: Config, serve: bool = False) -> bool:
+    extra = [re.compile(p) for p in config.hot_loop_callees]
+    for n in _loop_statements(loop):
+        if isinstance(n, ast.Call):
+            name = terminal_name(n.func)
+            if not name:
+                continue
+            bare = name.lstrip("_")
+            if _HOT_CALLEES.match(bare) or any(p.search(name) for p in extra):
+                return True
+            if serve and _SERVE_CALLEES.match(bare):
+                return True
+    return False
+
+
+# -- traced-function discovery ----------------------------------------------
+# (Moved here from rules.py so the interprocedural reach pass below can seed
+# from it without a framework -> rules import cycle.)
+
+JIT_FNS = {"jax.jit", "jax.pjit", "flax.nnx.jit", "nnx.jit"}
+
+TRACE_FNS = JIT_FNS | {
+    "jax.grad", "jax.value_and_grad", "jax.jacfwd", "jax.jacrev",
+    "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.map", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+}
+
+
+def find_local_def(module: Module, call: ast.AST,
+                   name: str) -> Optional[ast.AST]:
+    """FunctionDef named `name` in the scope chain enclosing `call`."""
+    scope = module.enclosing_scope(call)
+    while True:
+        found = module.scope_defs(scope).get(name)
+        if found is not None:
+            return found
+        if isinstance(scope, ast.Module):
+            return None
+        scope = module.enclosing_scope(scope)
+
+
+def traced_functions(module: Module) -> Set[ast.AST]:
+    """Function defs (and lambdas) that are traced: passed to a
+    jit/grad/vmap/scan/shard_map/pallas_call in this module, or decorated
+    with one (incl. `functools.partial(jax.jit, ...)`)."""
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and module.resolve(node.func) in TRACE_FNS:
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    fd = find_local_def(module, node, arg.id)
+                    if fd is not None:
+                        traced.add(fd)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    if module.resolve(dec.func) == "functools.partial" \
+                            and dec.args:
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if module.resolve(target) in TRACE_FNS:
+                    traced.add(node)
+    return traced
+
+
+def traced_closure(module: Module, traced: Set[ast.AST]) -> Set[ast.AST]:
+    """Traced defs plus every function nested inside one (their bodies all
+    run under the same trace)."""
+    out = set(traced)
+    for fn in traced:
+        for node in ast.walk(fn):
+            if isinstance(node, SCOPE_TYPES):
+                out.add(node)
+    return out
+
+
+# -- project-wide call graph -------------------------------------------------
+
+class FunctionInfo:
+    """One function definition plus where it lives — the call graph's node."""
+
+    __slots__ = ("module", "node", "cls_name", "qualname")
+
+    def __init__(self, module: Module, node: ast.AST,
+                 cls_name: Optional[str] = None):
+        self.module = module
+        self.node = node
+        self.cls_name = cls_name
+        name = getattr(node, "name", "<lambda>")
+        self.qualname = f"{cls_name}.{name}" if cls_name else name
+
+    @property
+    def params(self) -> List[str]:
+        args = getattr(self.node, "args", None)
+        if args is None:
+            return []
+        out = [a.arg for a in args.posonlyargs + args.args]
+        return out
+
+    def param_index(self, skip_self: bool = True) -> List[str]:
+        """Positional parameter names as seen by a call site (instance-arg
+        dropped for methods called through an instance)."""
+        params = self.params
+        if skip_self and self.cls_name and params \
+                and params[0] in ("self", "cls"):
+            return params[1:]
+        return params
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"FunctionInfo({self.module.path}:{self.qualname})"
+
+
+class CallGraph:
+    """Project-wide name resolution for defs and module-level constants.
+
+    Resolution is deliberately name-based (the same terminal-name strategy
+    donation.py's factory index proved out): a call site binds to defs it can
+    plausibly see — local scope chain first, then same-module defs, then
+    cross-module defs *only* when the name was imported. Multiple candidates
+    are all returned; analyses union their effects (conservative)."""
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules = list(modules)
+        # terminal def name -> every project def with that name
+        self.defs: Dict[str, List[FunctionInfo]] = {}
+        # class name -> method name -> FunctionInfo
+        self.methods: Dict[str, Dict[str, FunctionInfo]] = {}
+        # terminal constant name -> string/tuple-of-string values assigned at
+        # module level anywhere in the project (mesh axis names and friends)
+        self.constants: Dict[str, List[object]] = {}
+        self.info_of: Dict[int, FunctionInfo] = {}
+        self._resolve_cache: Dict[int, List[FunctionInfo]] = {}
+        for module in self.modules:
+            self._index_module(module)
+
+    def _index_module(self, module: Module) -> None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parent = module.parent(node)
+                cls = parent.name if isinstance(parent, ast.ClassDef) else None
+                info = FunctionInfo(module, node, cls)
+                self.defs.setdefault(node.name, []).append(info)
+                if cls:
+                    self.methods.setdefault(cls, {})[node.name] = info
+                self.info_of[id(node)] = info
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = _const_value(node.value)
+                if val is not None:
+                    self.constants.setdefault(
+                        node.targets[0].id, []).append(val)
+
+    def info(self, node: ast.AST) -> Optional[FunctionInfo]:
+        return self.info_of.get(id(node))
+
+    def resolve_call(self, module: Module,
+                     call: ast.Call) -> List[FunctionInfo]:
+        """Project defs a call site may invoke ([] when the callee is not a
+        plain def reference we can see — jitted objects, params, builtins).
+        Memoized per call node — the fixpoints revisit call sites."""
+        cached = self._resolve_cache.get(id(call))
+        if cached is None:
+            cached = self._resolve_call(module, call)
+            self._resolve_cache[id(call)] = cached
+        return cached
+
+    def _resolve_call(self, module: Module,
+                      call: ast.Call) -> List[FunctionInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = find_local_def(module, call, func.id)
+            if local is not None:
+                info = self.info(local)
+                return [info] if info else []
+            if func.id in module.aliases:  # imported name
+                target = module.aliases[func.id].rsplit(".", 1)[-1]
+                return [i for i in self.defs.get(target, [])
+                        if i.cls_name is None]
+            return []
+        if isinstance(func, ast.Attribute):
+            # self.method(...) within a class body
+            ctx = module.self_name(module.enclosing_scope(call))
+            if ctx and isinstance(func.value, ast.Name) \
+                    and func.value.id == ctx[0]:
+                info = self.methods.get(ctx[1], {}).get(func.attr)
+                return [info] if info else []
+            # mod.fn(...) through an imported module
+            parts = dotted_parts(func)
+            if parts and parts[0] in module.import_roots:
+                return [i for i in self.defs.get(func.attr, [])
+                        if i.cls_name is None]
+        return []
+
+    def resolve_strings(self, module: Module, node: ast.AST,
+                        scope: Optional[ast.AST] = None,
+                        _depth: int = 0) -> List[str]:
+        """Every string a simple expression can evaluate to: constants,
+        tuples/lists/sets of them, `a or b` fallbacks, module-level constant
+        names (local module first, then project-wide by terminal name), and
+        — when `scope` is given — names assigned within that scope
+        (`names = axis_names or (DATA_AXIS, MODEL_AXIS)` then
+        `Mesh(grid, names)`, the parallel/mesh.py idiom). Returns [] when
+        nothing is statically resolvable."""
+        if _depth > 6:
+            return []
+        if isinstance(node, ast.Constant):
+            return [node.value] if isinstance(node.value, str) else []
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: List[str] = []
+            for el in node.elts:
+                out.extend(self.resolve_strings(module, el, scope, _depth + 1))
+            return out
+        if isinstance(node, ast.BoolOp):
+            out = []
+            for v in node.values:
+                out.extend(self.resolve_strings(module, v, scope, _depth + 1))
+            return out
+        if isinstance(node, ast.IfExp):
+            return (self.resolve_strings(module, node.body, scope, _depth + 1)
+                    + self.resolve_strings(module, node.orelse, scope,
+                                           _depth + 1))
+        if isinstance(node, ast.Name):
+            if scope is not None:
+                local: List[str] = []
+                for n in walk_scope(scope):
+                    if isinstance(n, ast.Assign) and n.value is not node \
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == node.id for t in n.targets):
+                        local.extend(self.resolve_strings(
+                            module, n.value, scope, _depth + 1))
+                if local:
+                    return local
+            vals = self.constants.get(node.id, [])
+            return [s for v in vals for s in _strings_of(v)]
+        if isinstance(node, ast.Attribute):
+            vals = self.constants.get(node.attr, [])
+            return [s for v in vals for s in _strings_of(v)]
+        return []
+
+
+def _const_value(node: ast.AST):
+    """Literal value of a module-level constant assignment we care about:
+    a string, or a tuple/list of strings. None otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _strings_of(value) -> List[str]:
+    if isinstance(value, str):
+        return [value]
+    if isinstance(value, tuple):
+        return [v for v in value if isinstance(v, str)]
+    return []
+
+
+# -- tracer-use classification (shared by TRC001 and the reach pass) ---------
+
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+              "is_fully_replicated"}
+SAFE_CALLS = {"isinstance", "len", "hasattr", "type", "callable", "id",
+              "getattr", "repr", "str"}
+
+
+def unsafe_tracer_use(module: Module, name: ast.AST, root: ast.AST) -> bool:
+    """Climb from a tainted Name toward `root`: uses that stay static at
+    trace time (shape/dtype inspection, isinstance, `is None`) are safe;
+    anything that produces a value dependent on the tracer's DATA is not."""
+    cur = name
+    while cur is not root:
+        parent = module.parent(cur)
+        if parent is None:
+            break
+        if isinstance(parent, ast.Attribute) and parent.value is cur \
+                and parent.attr in SAFE_ATTRS:
+            return False
+        if isinstance(parent, ast.Call):
+            in_args = cur in parent.args or any(
+                kw.value is cur for kw in parent.keywords)
+            if in_args:
+                fn = terminal_name(parent.func)
+                return fn not in SAFE_CALLS
+            if cur is parent.func:
+                return True  # calling a tracer-valued thing -> tracer result
+        if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            return False
+        cur = parent
+    return True
+
+
+# -- interprocedural trace reach + argument taint ----------------------------
+
+class ReachedFn:
+    """One function known to execute under a jax trace.
+
+    `tainted` holds the parameter names that can carry tracer values: every
+    parameter for trace entry points (seeds — jit/grad/vmap/... see the
+    actual call), and for functions only *called* from traced code, exactly
+    the parameters some traced call site passes a tainted value to. That
+    per-call-site mapping is what keeps interprocedural TRC001 from flagging
+    host-side config flags threaded into shared helpers."""
+
+    __slots__ = ("info", "tainted", "seed")
+
+    def __init__(self, info: FunctionInfo, tainted: Set[str], seed: bool):
+        self.info = info
+        self.tainted = tainted
+        self.seed = seed
+
+
+def _map_call_args(call: ast.Call, callee: FunctionInfo,
+                   skip_self: bool) -> Iterator[Tuple[ast.AST, str]]:
+    """(argument expression, parameter name) pairs for a call site. Stops
+    positional mapping at a * unpacking."""
+    params = callee.param_index(skip_self=skip_self)
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            yield arg, params[i]
+    all_params = set(callee.params)
+    for kw in call.keywords:
+        if kw.arg and kw.arg in all_params:
+            yield kw.value, kw.arg
+
+
+def _expr_carries_taint(module: Module, expr: ast.AST,
+                        tainted: Set[str]) -> bool:
+    """A call argument propagates taint only when a tainted name reaches it
+    through a value-producing use — `x.shape[1]` / `isinstance(x, ...)` are
+    trace-time statics and stay clean (same policy as TRC001)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in tainted \
+                and isinstance(n.ctx, ast.Load) \
+                and unsafe_tracer_use(module, n, expr):
+            return True
+    return False
+
+
+def compute_trace_reach(graph: CallGraph) -> Dict[int, ReachedFn]:
+    """Fixpoint over the call graph: which functions run under a trace, and
+    which of their parameters may be tracers.
+
+    Seeds are each module's directly-traced defs (plus nested defs — one
+    trace closure), with every parameter tainted. A call from reached code
+    to a project def marks the callee reached and taints the callee params
+    receiving expressions that mention a tainted name of the caller."""
+    reach: Dict[int, ReachedFn] = {}
+    work: List[FunctionInfo] = []
+
+    def add(info: FunctionInfo, tainted: Set[str], seed: bool) -> None:
+        cur = reach.get(id(info.node))
+        if cur is None:
+            reach[id(info.node)] = ReachedFn(info, set(tainted), seed)
+            work.append(info)
+        elif not tainted <= cur.tainted or (seed and not cur.seed):
+            cur.tainted |= tainted
+            cur.seed = cur.seed or seed
+            work.append(info)
+
+    for module in graph.modules:
+        for fn in traced_closure(module, traced_functions(module)):
+            info = graph.info(fn)
+            if info is None:  # lambdas: no params worth tracking, no calls
+                info = FunctionInfo(module, fn)
+                graph.info_of[id(fn)] = info
+            params = set(info.params) - {"self", "cls"}
+            args = getattr(fn, "args", None)
+            if args is not None:
+                if args.vararg:
+                    params.add(args.vararg.arg)
+                params |= {a.arg for a in args.kwonlyargs}
+            add(info, params, seed=True)
+
+    while work:
+        caller = work.pop()
+        entry = reach[id(caller.node)]
+        for node in walk_scope(caller.node):
+            if not isinstance(node, ast.Call):
+                continue
+            skip_self = isinstance(node.func, ast.Attribute)
+            for callee in graph.resolve_call(caller.module, node):
+                tainted = {param for arg, param
+                           in _map_call_args(node, callee, skip_self)
+                           if _expr_carries_taint(caller.module, arg,
+                                                  entry.tainted)}
+                add(callee, tainted, seed=False)
+                # the callee's nested defs share its trace
+                for sub in ast.walk(callee.node):
+                    if sub is not callee.node and isinstance(sub, SCOPE_TYPES):
+                        sub_info = graph.info(sub)
+                        if sub_info is None:
+                            sub_info = FunctionInfo(callee.module, sub,
+                                                    callee.cls_name)
+                            graph.info_of[id(sub)] = sub_info
+                        add(sub_info, set(), seed=False)
+    return reach

@@ -15,7 +15,9 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from .donation import JIT_FNS, Donation, ProjectIndex, _dict_donations
 from .framework import (Config, Finding, Module, SCOPE_TYPES, SEVERITY_ERROR,
-                        SEVERITY_WARNING, dotted_str, terminal_name,
+                        SEVERITY_WARNING, TRACE_FNS, _is_hot_loop,
+                        _loop_statements, dotted_str, find_local_def,
+                        terminal_name, traced_closure, traced_functions,
                         walk_scope)
 
 Pos = Tuple[int, int]
@@ -269,35 +271,10 @@ def _repeating_loop(module: Module, node: ast.AST) -> Optional[ast.AST]:
 # SYNC001 — host synchronization inside a hot training loop
 # ---------------------------------------------------------------------------
 
-_HOT_CALLEES = re.compile(r"^(train_step|multi_step|train_batch|step_fn)$")
 _SYNC_PATHS = {"jax.device_get"}
 _SYNC_NP = {"numpy.asarray", "numpy.array"}
 _GUARD_NAMES = re.compile(r"log|flush|every|interval|debug|verbose",
                           re.IGNORECASE)
-
-
-def _loop_statements(loop: ast.AST) -> Iterator[ast.AST]:
-    """Nodes in the loop's repeated part, not descending into nested defs."""
-    for stmt in list(loop.body) + list(getattr(loop, "orelse", [])):
-        stack = [stmt]
-        while stack:
-            n = stack.pop()
-            yield n
-            if not isinstance(n, SCOPE_TYPES):
-                stack.extend(ast.iter_child_nodes(n))
-
-
-def _is_hot_loop(loop: ast.AST, config: Config) -> bool:
-    extra = [re.compile(p) for p in config.hot_loop_callees]
-    for n in _loop_statements(loop):
-        if isinstance(n, ast.Call):
-            name = terminal_name(n.func)
-            if not name:
-                continue
-            bare = name.lstrip("_")
-            if _HOT_CALLEES.match(bare) or any(p.search(name) for p in extra):
-                return True
-    return False
 
 
 def _sync_call_kind(node: ast.Call, module: Module) -> Optional[str]:
@@ -374,70 +351,23 @@ def check_sync001(module: Module, index: ProjectIndex,
 
 
 # ---------------------------------------------------------------------------
-# traced-function discovery (shared by EFF001 / TRC001)
+# traced-function discovery now lives in framework.py (the interprocedural
+# reach pass seeds from it); `index.reached_in(module)` supersedes the old
+# per-module closure — a function called from traced code in ANOTHER module
+# is now visible here too.
 # ---------------------------------------------------------------------------
 
-TRACE_FNS = JIT_FNS | {
-    "jax.grad", "jax.value_and_grad", "jax.jacfwd", "jax.jacrev",
-    "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
-    "jax.lax.scan", "jax.lax.map", "jax.lax.while_loop", "jax.lax.fori_loop",
-    "jax.lax.cond", "jax.lax.switch", "jax.lax.associative_scan",
-    "jax.shard_map", "jax.experimental.shard_map.shard_map",
-    "jax.experimental.pallas.pallas_call",
-}
+_find_local_def = find_local_def
+_traced_closure = traced_closure
 
 
-def _find_local_def(module: Module, call: ast.AST,
-                    name: str) -> Optional[ast.AST]:
-    """FunctionDef named `name` in the scope chain enclosing `call`."""
-    scope = module.enclosing_scope(call)
-    while True:
-        for node in walk_scope(scope):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name == name:
-                return node
-        if isinstance(scope, ast.Module):
-            return None
-        scope = module.enclosing_scope(scope)
-
-
-def traced_functions(module: Module) -> Set[ast.AST]:
-    """Function defs (and lambdas) that are traced: passed to a
-    jit/grad/vmap/scan/shard_map/pallas_call in this module, or decorated
-    with one (incl. `functools.partial(jax.jit, ...)`)."""
-    traced: Set[ast.AST] = set()
-    for node in ast.walk(module.tree):
-        if isinstance(node, ast.Call) and module.resolve(node.func) in TRACE_FNS:
-            for arg in node.args:
-                if isinstance(arg, ast.Lambda):
-                    traced.add(arg)
-                elif isinstance(arg, ast.Name):
-                    fd = _find_local_def(module, node, arg.id)
-                    if fd is not None:
-                        traced.add(fd)
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                target = dec
-                if isinstance(dec, ast.Call):
-                    if module.resolve(dec.func) == "functools.partial" \
-                            and dec.args:
-                        target = dec.args[0]
-                    else:
-                        target = dec.func
-                if module.resolve(target) in TRACE_FNS:
-                    traced.add(node)
-    return traced
-
-
-def _traced_closure(module: Module, traced: Set[ast.AST]) -> Set[ast.AST]:
-    """Traced defs plus every function nested inside one (their bodies all
-    run under the same trace)."""
-    out = set(traced)
-    for fn in traced:
-        for node in ast.walk(fn):
-            if isinstance(node, SCOPE_TYPES):
-                out.add(node)
-    return out
+def _fns_under_trace(module: Module, index: ProjectIndex):
+    """Every function node in `module` that runs under a trace. The project
+    reach map when the index carries one (normal lint runs), with the
+    module-local closure as the jax-free fallback for direct rule calls."""
+    if index.reach:
+        return [r.info.node for r in index.reached_in(module)]
+    return list(traced_closure(module, traced_functions(module)))
 
 
 # ---------------------------------------------------------------------------
@@ -447,7 +377,7 @@ def _traced_closure(module: Module, traced: Set[ast.AST]) -> Set[ast.AST]:
 def check_eff001(module: Module, index: ProjectIndex,
                  config: Config) -> List[Finding]:
     findings: List[Finding] = []
-    closure = _traced_closure(module, traced_functions(module))
+    closure = _fns_under_trace(module, index)
     seen: Set[int] = set()
     for fn in closure:
         for node in walk_scope(fn):
@@ -495,38 +425,10 @@ def check_eff001(module: Module, index: ProjectIndex,
 # TRC001 — concrete boolean on a likely tracer
 # ---------------------------------------------------------------------------
 
-SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
-              "is_fully_replicated"}
-SAFE_CALLS = {"isinstance", "len", "hasattr", "type", "callable", "id",
-              "getattr", "repr", "str"}
-
-
-def _unsafe_tracer_use(module: Module, name: ast.AST,
-                       root: ast.AST) -> bool:
-    """Climb from a tainted Name toward `root`: uses that stay static at
-    trace time (shape/dtype inspection, isinstance, `is None`) are safe;
-    anything that produces a value dependent on the tracer's DATA is not."""
-    cur = name
-    while cur is not root:
-        parent = module.parent(cur)
-        if parent is None:
-            break
-        if isinstance(parent, ast.Attribute) and parent.value is cur \
-                and parent.attr in SAFE_ATTRS:
-            return False
-        if isinstance(parent, ast.Call):
-            in_args = cur in parent.args or any(
-                kw.value is cur for kw in parent.keywords)
-            if in_args:
-                fn = terminal_name(parent.func)
-                return fn not in SAFE_CALLS
-            if cur is parent.func:
-                return True  # calling a tracer-valued thing -> tracer result
-        if isinstance(parent, ast.Compare) and all(
-                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
-            return False
-        cur = parent
-    return True
+# shared with the interprocedural reach pass, which applies the same policy
+# when deciding whether a call argument propagates taint into a callee
+from .framework import (SAFE_ATTRS, SAFE_CALLS,  # noqa: E402,F401
+                        unsafe_tracer_use as _unsafe_tracer_use)
 
 
 def _expr_tainted(module: Module, expr: ast.AST, tainted: Set[str]) -> bool:
@@ -538,17 +440,23 @@ def _expr_tainted(module: Module, expr: ast.AST, tainted: Set[str]) -> bool:
     return False
 
 
-def _check_traced_fn(module: Module, fn: ast.AST,
-                     findings: List[Finding]) -> None:
+def _check_traced_fn(module: Module, fn: ast.AST, findings: List[Finding],
+                     initial: Optional[Set[str]] = None) -> None:
     args = getattr(fn, "args", None)
     if args is None:
         return
-    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
-    if params and params[0] in ("self", "cls"):
-        params = params[1:]
-    tainted: Set[str] = set(params)
-    if args.vararg:
-        tainted.add(args.vararg.arg)
+    if initial is not None:
+        # interprocedural entry: only the params traced call sites actually
+        # pass tracer-derived values to (framework.compute_trace_reach)
+        tainted: Set[str] = set(initial)
+    else:
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        tainted = set(params)
+        if args.vararg:
+            tainted.add(args.vararg.arg)
 
     def visit(stmts) -> None:
         for stmt in stmts:
@@ -602,16 +510,27 @@ def _check_traced_fn(module: Module, fn: ast.AST,
 def check_trc001(module: Module, index: ProjectIndex,
                  config: Config) -> List[Finding]:
     findings: List[Finding] = []
-    for fn in _traced_closure(module, traced_functions(module)):
-        if isinstance(fn, ast.Lambda):
-            continue  # a lambda body has no if/while statements
-        _check_traced_fn(module, fn, findings)
+    if index.reach:
+        for entry in index.reached_in(module):
+            if isinstance(entry.info.node, ast.Lambda):
+                continue  # a lambda body has no if/while statements
+            _check_traced_fn(module, entry.info.node, findings,
+                             initial=None if entry.seed else entry.tainted)
+    else:
+        for fn in traced_closure(module, traced_functions(module)):
+            if isinstance(fn, ast.Lambda):
+                continue
+            _check_traced_fn(module, fn, findings)
     return findings
 
 
 # ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
+
+from .rules_dtype import check_dty001, check_dty002  # noqa: E402
+from .rules_rng import check_rng001, check_rng002  # noqa: E402
+from .rules_sharding import check_shd001, check_shd002  # noqa: E402
 
 ALL_RULES = {
     "DON001": (SEVERITY_ERROR, check_don001,
@@ -629,4 +548,22 @@ ALL_RULES = {
     "TRC001": (SEVERITY_ERROR, check_trc001,
                "Python bool of a tracer-derived value (if/while under "
                "trace)"),
+    "RNG001": (SEVERITY_ERROR, check_rng001,
+               "PRNG key consumed twice without an intervening "
+               "split/fold_in rebind"),
+    "RNG002": (SEVERITY_WARNING, check_rng002,
+               "traced step consumes its rng without deriving it from "
+               "state.step (scan-safe reproducibility)"),
+    "DTY001": (SEVERITY_WARNING, check_dty001,
+               "full-precision value reaches the model apply fn under a "
+               "declared bf16 compute policy"),
+    "DTY002": (SEVERITY_WARNING, check_dty002,
+               "host-side float32 upcast at a jit/device_put boundary "
+               "(4x transfer bytes)"),
+    "SHD001": (SEVERITY_ERROR, check_shd001,
+               "mesh-axis name not defined by any mesh constructed in "
+               "the project"),
+    "SHD002": (SEVERITY_WARNING, check_shd002,
+               "device_put without an explicit sharding inside a hot "
+               "train/serve loop"),
 }

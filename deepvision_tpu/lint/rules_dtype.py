@@ -1,0 +1,239 @@
+"""Dtype-policy rules: full-precision leaks in a declared bf16 compute path.
+
+The r05 ResNet-50 traffic grid showed the bf16 compute policy is THE
+HBM-bandwidth lever (97.4% of roof, runs/r05_resnet50_tpu_profile): an f32
+tensor on the hot path doubles every read and write it touches, produces
+numerically-correct results, and therefore survives every test. Two
+mechanically-detectable shapes of that leak:
+
+  DTY001  a value explicitly materialized in float32/float64 inside traced
+          code is fed to the model's apply fn uncast — the whole forward
+          (and its backward) runs full-precision under a declared bf16
+          policy. Return dtypes propagate through the project call graph,
+          so a helper that forgot its `.astype(compute_dtype)` is caught at
+          the call site.
+  DTY002  a host-side upcast at a jit dispatch boundary
+          (`step(x.astype(np.float32))`, `device_put(np.asarray(x,
+          np.float32))`): the cast belongs INSIDE the jitted program —
+          staging f32 ships 4x the bytes of the uint8 pixels
+          (docs/INPUT_PIPELINE.md; bench_input.py measured 3.07x
+          end-to-end).
+
+DTY001 only runs when pyproject declares the policy
+(`[tool.jaxlint] compute-dtype = "bfloat16"`); with an f32 policy there is
+nothing to leak. DTY002 is about transfer bytes, not compute dtype, and is
+always on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .donation import ProjectIndex
+from .framework import (Config, Finding, Module, SEVERITY_WARNING, dotted_str,
+                        walk_scope)
+
+_FULL_PRECISION = {
+    "jax.numpy.float32", "jax.numpy.float64", "numpy.float32",
+    "numpy.float64",
+}
+_FULL_PRECISION_STR = {"float32", "float64", "f32", "f64"}
+
+# array-creating callables where an explicit dtype kwarg pins the result
+_CREATORS = re.compile(
+    r"^(jax\.numpy|numpy)\.(asarray|array|zeros|ones|full|empty|arange|"
+    r"linspace|eye|zeros_like|ones_like|full_like)$")
+
+_APPLY_RE = re.compile(r"(^|_)apply(_fn)?$")
+
+
+def _is_full_precision_dtype(module: Module, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value in _FULL_PRECISION_STR
+    resolved = module.resolve(node)
+    return resolved in _FULL_PRECISION if resolved else False
+
+
+def _explicit_dtype(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+def _value_kind(module: Module, node: ast.AST,
+                returns_f32: Set[int],
+                index: ProjectIndex) -> Optional[str]:
+    """'f32' when the expression materializes a full-precision array,
+    'cast' when it explicitly casts to something else (kills taint),
+    None when we can't tell."""
+    if not isinstance(node, ast.Call):
+        return None
+    # <x>.astype(dtype)
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and len(node.args) == 1 and not node.keywords:
+        return "f32" if _is_full_precision_dtype(module, node.args[0]) \
+            else "cast"
+    resolved = module.resolve(node.func)
+    if resolved and _CREATORS.match(resolved):
+        dtype = _explicit_dtype(node)
+        if dtype is not None:
+            return "f32" if _is_full_precision_dtype(module, dtype) \
+                else "cast"
+        if len(node.args) >= 2 \
+                and resolved.rsplit(".", 1)[-1] in ("asarray", "array"):
+            return "f32" if _is_full_precision_dtype(module, node.args[1]) \
+                else None
+        return None
+    dtype = _explicit_dtype(node)
+    if dtype is not None and _is_full_precision_dtype(module, dtype):
+        return "f32"
+    if index.graph is not None:
+        for callee in index.graph.resolve_call(module, node):
+            if id(callee.node) in returns_f32:
+                return "f32"
+    return None
+
+
+def _returns_f32(index: ProjectIndex) -> Set[int]:
+    """id(def node) for project functions whose return value is an
+    explicitly full-precision array — fixpoint so a wrapper returning a
+    full-precision helper's result is marked too."""
+    cached = index.cache.get("dty_returns_f32")
+    if cached is not None:
+        return cached
+    marked: Set[int] = set()
+    graph = index.graph
+    infos = [] if graph is None else [i for lst in graph.defs.values()
+                                      for i in lst]
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            if id(info.node) in marked:
+                continue
+            for node in walk_scope(info.node):
+                if isinstance(node, ast.Return) and node.value is not None \
+                        and _value_kind(info.module, node.value, marked,
+                                        index) == "f32":
+                    marked.add(id(info.node))
+                    changed = True
+                    break
+    index.cache["dty_returns_f32"] = marked
+    return marked
+
+
+def check_dty001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    policy = config.compute_dtype.lower()
+    if policy not in ("bfloat16", "float16", "bf16", "f16"):
+        return []
+    returns_f32 = _returns_f32(index)
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for entry in index.reached_in(module):
+        fn = entry.info.node
+        if id(fn) in seen or isinstance(fn, ast.Lambda):
+            continue
+        seen.add(id(fn))
+        # linear scan in source order: assignments taint/untaint names,
+        # apply-fn calls are the sinks
+        events: List[Tuple[Tuple[int, int], str, ast.AST]] = []
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                events.append(((node.lineno, node.col_offset), "assign",
+                               node))
+            elif isinstance(node, ast.Call):
+                name = dotted_str(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else None
+                if tail and _APPLY_RE.search(tail):
+                    events.append(((node.lineno, node.col_offset), "sink",
+                                   node))
+        events.sort(key=lambda e: e[0])
+        tainted: Dict[str, int] = {}  # name -> taint-site line
+        for _, kind, node in events:
+            if kind == "assign":
+                tgt = node.targets[0].id
+                vk = _value_kind(module, node.value, returns_f32, index)
+                if vk == "f32":
+                    tainted[tgt] = node.lineno
+                elif tgt in tainted:
+                    del tainted[tgt]
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in tainted:
+                    f = module.finding(
+                        node, "DTY001", SEVERITY_WARNING,
+                        f"'{arg.id}' was materialized in full precision "
+                        f"(line {tainted[arg.id]}) and reaches the model's "
+                        f"apply fn uncast under the declared "
+                        f"'{config.compute_dtype}' compute policy — the "
+                        f"whole forward/backward runs f32 and doubles HBM "
+                        f"traffic; cast first "
+                        f"(`{arg.id} = {arg.id}.astype(compute_dtype)`, "
+                        f"core/steps.py:_normalize_input)")
+                    if f:
+                        findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DTY002 — host upcast at a jit boundary
+# ---------------------------------------------------------------------------
+
+def _host_upcast(module: Module, expr: ast.AST) -> Optional[str]:
+    """Describe `expr` when it is an explicit full-precision cast performed
+    on the host side of a dispatch ('x.astype(np.float32)' etc.)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    if isinstance(expr.func, ast.Attribute) and expr.func.attr == "astype" \
+            and len(expr.args) == 1 \
+            and _is_full_precision_dtype(module, expr.args[0]):
+        return ".astype(float32)"
+    resolved = module.resolve(expr.func)
+    if resolved and _CREATORS.match(resolved):
+        dtype = _explicit_dtype(expr)
+        if dtype is None and len(expr.args) >= 2 \
+                and resolved.rsplit(".", 1)[-1] in ("asarray", "array"):
+            dtype = expr.args[1]
+        if dtype is not None and _is_full_precision_dtype(module, dtype):
+            return f"{resolved.rsplit('.', 1)[-1]}(..., float32)"
+    return None
+
+
+def check_dty002(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for scope in module.iter_scopes():
+        jitted = index.jitted.callable_spellings(module, scope)
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_str(node.func)
+            resolved = module.resolve(node.func)
+            if callee in jitted:
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                boundary = f"jitted callable '{callee}'"
+            elif resolved == "jax.device_put" and node.args:
+                args = [node.args[0]]
+                boundary = "jax.device_put"
+            else:
+                continue
+            for arg in args:
+                what = _host_upcast(module, arg)
+                if not what:
+                    continue
+                f = module.finding(
+                    arg, "DTY002", SEVERITY_WARNING,
+                    f"host-side {what} at the {boundary} boundary: the "
+                    f"upcast runs on host and ships 4x the bytes of the "
+                    f"raw uint8 pixels over PCIe/ICI every dispatch — move "
+                    f"the cast inside the jitted function (input_norm / "
+                    f"device_augment stage batches as uint8 and convert "
+                    f"on device, docs/INPUT_PIPELINE.md)")
+                if f:
+                    findings.append(f)
+    return findings

@@ -1,0 +1,323 @@
+"""RNG rules: PRNG key hygiene (RNG001) and the per-step fold invariant
+(RNG002).
+
+JAX PRNG keys are values, not stateful generators: drawing from the same key
+twice yields the SAME numbers. In this codebase that failure mode is silent
+numerics skew — two augmentation draws correlating, or a scanned multi-step
+dispatch replaying identical "randomness" k times — not a traceback. Both
+rules run on the project call graph (framework.CallGraph): a key handed to a
+local helper whose parameter flows into `jax.random.uniform` counts as
+consumed at the call site, exactly the `_factor(k_b, ...)` idiom in
+data/device_augment.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .donation import ProjectIndex
+from .framework import (Config, Finding, Module, SCOPE_TYPES, SEVERITY_ERROR,
+                        SEVERITY_WARNING, _map_call_args, dotted_str,
+                        walk_scope)
+
+Pos = Tuple[int, int]
+
+# jax.random.* that DERIVE or CONSTRUCT keys rather than drawing randomness.
+# Deriving (split/fold_in) from one key many times is the blessed tagging
+# pattern (core/steps.py folds step_rng with tags 1 and 2); what must never
+# repeat is an actual draw.
+_NON_DRAWING = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data",
+                "key_data", "key_impl", "default_prng_impl"}
+
+
+def _drawing_key_arg(call: ast.Call, module: Module) -> Optional[ast.AST]:
+    """The key argument of a `jax.random.<sampler>` draw, else None."""
+    resolved = module.resolve(call.func)
+    if not resolved or not resolved.startswith("jax.random."):
+        return None
+    fn = resolved.rsplit(".", 1)[-1]
+    if fn in _NON_DRAWING:
+        return None
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _key_consuming_params(index: ProjectIndex) -> Dict[int, Set[str]]:
+    """id(def node) -> parameter names the function consumes as PRNG keys,
+    directly (arg 0 of a jax.random draw) or transitively through a resolved
+    project callee. Fixpoint over the call graph, memoized per lint run."""
+    cached = index.cache.get("rng_key_consumers")
+    if cached is not None:
+        return cached
+    consumers: Dict[int, Set[str]] = {}
+    graph = index.graph
+    infos = [] if graph is None else [i for lst in graph.defs.values()
+                                      for i in lst]
+    calls_of = {id(i.node): [c for c in walk_scope(i.node)
+                             if isinstance(c, ast.Call)]
+                for i in infos if i.params}
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            params = set(info.params)
+            if not params:
+                continue
+            got = consumers.setdefault(id(info.node), set())
+            for call in calls_of[id(info.node)]:
+                key = _drawing_key_arg(call, info.module)
+                if isinstance(key, ast.Name) and key.id in params \
+                        and key.id not in got:
+                    got.add(key.id)
+                    changed = True
+                for callee in graph.resolve_call(info.module, call):
+                    callee_consumes = consumers.get(id(callee.node), set())
+                    if not callee_consumes:
+                        continue
+                    skip_self = isinstance(call.func, ast.Attribute)
+                    for arg, param in _map_call_args(call, callee, skip_self):
+                        if param in callee_consumes \
+                                and isinstance(arg, ast.Name) \
+                                and arg.id in params and arg.id not in got:
+                            got.add(arg.id)
+                            changed = True
+    index.cache["rng_key_consumers"] = consumers
+    return consumers
+
+
+def _pos(node: ast.AST) -> Pos:
+    return (node.lineno, node.col_offset)
+
+
+def _consumptions(scope: ast.AST, module: Module,
+                  index: ProjectIndex) -> Iterator[Tuple[str, ast.Call]]:
+    """(key name, call) for every draw in `scope` that consumes a key
+    spelled as a plain dotted name."""
+    consumers = _key_consuming_params(index)
+    for call in walk_scope(scope):
+        if not isinstance(call, ast.Call):
+            continue
+        key = _drawing_key_arg(call, module)
+        name = dotted_str(key) if key is not None else None
+        if name:
+            yield name, call
+        if index.graph is not None:
+            skip_self = isinstance(call.func, ast.Attribute)
+            for callee in index.graph.resolve_call(module, call):
+                consumed = consumers.get(id(callee.node), set())
+                for arg, param in _map_call_args(call, callee, skip_self):
+                    if param in consumed:
+                        arg_name = dotted_str(arg)
+                        if arg_name:
+                            yield arg_name, call
+
+
+def _stores_of(scope: ast.AST, name: str) -> List[Pos]:
+    out = []
+    for node in walk_scope(scope):
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None),
+                               (ast.Store, ast.Del)) \
+                and dotted_str(node) == name:
+            out.append(_pos(node))
+    return out
+
+
+def _disjoint_branches(module: Module, a: ast.AST, b: ast.AST) -> bool:
+    """True when a and b sit in mutually exclusive arms of a shared If (or
+    Try handlers): only one of the two draws runs, so no reuse."""
+    anc_a = list(module.ancestors(a))
+    for anc in module.ancestors(b):
+        if isinstance(anc, (ast.If, ast.Try)) and anc in anc_a:
+            arms = [anc.body, getattr(anc, "orelse", [])]
+            for h in getattr(anc, "handlers", []):
+                arms.append(h.body)
+
+            def arm_of(node):
+                chain = [node] + list(module.ancestors(node))
+                for i, arm in enumerate(arms):
+                    if any(n in arm for n in chain):
+                        return i
+                return None
+
+            ia, ib = arm_of(a), arm_of(b)
+            if ia is not None and ib is not None and ia != ib:
+                return True
+    return False
+
+
+def _enclosing_loop(module: Module, node: ast.AST,
+                    scope: ast.AST) -> Optional[ast.AST]:
+    for anc in module.ancestors(node):
+        if anc is scope or isinstance(anc, SCOPE_TYPES):
+            return None
+        if isinstance(anc, (ast.For, ast.While)):
+            return anc
+    return None
+
+
+def _terminates_scope(module: Module, node: ast.AST) -> bool:
+    """A draw inside `return`/`raise` exits the scope — nothing after it in
+    the same scope can run, so it cannot pair with a later draw (the
+    early-return branch idiom)."""
+    for anc in module.ancestors(node):
+        if isinstance(anc, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(anc, SCOPE_TYPES):
+            return False
+    return False
+
+
+def check_rng001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    """RNG001 — the same PRNG key drawn from twice without an intervening
+    rebind (straight-line or via loop repetition)."""
+    findings: List[Finding] = []
+    for scope in module.iter_scopes():
+        uses: Dict[str, List[Tuple[Pos, ast.Call]]] = {}
+        for name, call in _consumptions(scope, module, index):
+            uses.setdefault(name, []).append((_pos(call), call))
+        for name, events in uses.items():
+            stores = sorted(_stores_of(scope, name))
+            events = sorted(set(events), key=lambda e: e[0])
+            reported: Set[int] = set()
+            for (pa, ca), (pb, cb) in zip(events, events[1:]):
+                if any(pa < s <= pb for s in stores):
+                    continue
+                if _disjoint_branches(module, ca, cb) \
+                        or _terminates_scope(module, ca):
+                    continue
+                if id(cb) in reported:
+                    continue
+                f = module.finding(
+                    cb, "RNG001", SEVERITY_ERROR,
+                    f"PRNG key '{name}' is consumed again here (already "
+                    f"drawn from at line {pa[0]}) — the same key yields the "
+                    f"SAME random numbers, silently correlating the two "
+                    f"draws; derive fresh keys first "
+                    f"(`jax.random.split({name})` or "
+                    f"`jax.random.fold_in({name}, tag)`)")
+                if f:
+                    findings.append(f)
+                    reported.add(id(cb))
+            # loop repetition: one textual draw re-runs every iteration
+            # with the same key unless the key is rebound inside the loop
+            for pos, call in events:
+                if id(call) in reported:
+                    continue
+                loop = _enclosing_loop(module, call, scope)
+                if loop is None or _terminates_scope(module, call):
+                    continue
+                lo, hi = _pos(loop), (getattr(loop, "end_lineno", loop.lineno),
+                                      getattr(loop, "end_col_offset", 0))
+                if any(lo <= s <= hi for s in stores):
+                    continue
+                f = module.finding(
+                    call, "RNG001", SEVERITY_ERROR,
+                    f"PRNG key '{name}' is consumed inside a loop without "
+                    f"being rebound in the loop body: every iteration draws "
+                    f"the SAME numbers; split per iteration "
+                    f"(`keys = jax.random.split({name}, n)`) or fold in the "
+                    f"loop index (`jax.random.fold_in({name}, i)`)")
+                if f:
+                    findings.append(f)
+                    reported.add(id(call))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# RNG002 — step key not derived from the step counter
+# ---------------------------------------------------------------------------
+
+_RNG_PARAM = {"rng", "key", "prng_key"}
+_STATE_ATTRS = {"step", "params", "opt_state", "apply_gradients", "apply_fn",
+                "batch_stats", "ema_params"}
+
+
+def _state_params(fn: ast.AST) -> Set[str]:
+    """Parameters that look like a TrainState: some `<param>.<attr>` read in
+    the body hits the TrainState surface."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return set()
+    params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in _STATE_ATTRS \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in params:
+            out.add(node.value.id)
+    return out
+
+
+def _folds_in_step(fn: ast.AST, module: Module, states: Set[str]) -> bool:
+    """True when the body calls `jax.random.fold_in(<x>, <...state.step...>)`
+    somewhere — the scan-safe derivation the trainers rely on."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and module.resolve(node.func) == "jax.random.fold_in"
+                and len(node.args) >= 2):
+            continue
+        for sub in ast.walk(node.args[1]):
+            if isinstance(sub, ast.Attribute) and sub.attr == "step" \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in states:
+                return True
+    return False
+
+
+def check_rng002(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    """RNG002 — a traced step takes a TrainState and an rng, uses the rng,
+    but never derives it from `state.step`.
+
+    Why it matters: the trainers pass ONE key per epoch/dispatch and rely on
+    every step folding it with the on-device step counter
+    (`jax.random.fold_in(rng, state.step)`, core/steps.py). A step that
+    consumes the raw key draws identical randomness every invocation under
+    `make_multistep_train_step`'s `lax.scan` (the counter advances inside
+    the scan, the host key does not) and loses (seed, step)
+    reproducibility — the exact invariant the fused device augmentation
+    depends on (data/device_augment.py)."""
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for fn in (r.info.node for r in index.reached_in(module)):
+        if isinstance(fn, ast.Lambda) or id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        args = getattr(fn, "args", None)
+        if args is None:
+            continue
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        rng_params = [p for p in params if p in _RNG_PARAM]
+        states = _state_params(fn)
+        if not rng_params or not states:
+            continue
+        if _folds_in_step(fn, module, states):
+            continue
+        for rng in rng_params:
+            first_use = next(
+                (n for n in ast.walk(fn)
+                 if isinstance(n, ast.Name) and n.id == rng
+                 and isinstance(n.ctx, ast.Load)), None)
+            if first_use is None:
+                continue  # `del rng` steps (YOLO/CenterNet/pose): no hazard
+            f = module.finding(
+                first_use, "RNG002", SEVERITY_WARNING,
+                f"traced step consumes '{rng}' without deriving it from the "
+                f"step counter: under a scanned multi-step dispatch every "
+                f"inner step replays the SAME randomness, and runs lose "
+                f"(seed, step) reproducibility — derive "
+                f"`step_rng = jax.random.fold_in({rng}, "
+                f"{sorted(states)[0]}.step)` first "
+                f"(core/steps.py:make_classification_train_step)")
+            if f:
+                findings.append(f)
+            break  # one report per step fn
+    return findings

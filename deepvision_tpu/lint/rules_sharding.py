@@ -1,0 +1,165 @@
+"""Sharding-consistency rules.
+
+  SHD001  a mesh-axis name in a PartitionSpec / shard_map / collective that
+          no mesh constructed anywhere in the project defines. GSPMD axis
+          names are stringly-typed: a typo ('sptial') compiles fine in the
+          editor and dies minutes into a pod bring-up with an XLA error —
+          or worse, a P() that silently replicates. The universe of valid
+          names is built project-wide from every `Mesh(...)` construction
+          and `axis_names=`/pmap-`axis_name=` definition, with constants
+          (`DATA_AXIS = "data"`) resolved through the call graph's
+          constant index, so `P(DATA_AXIS, SPATIAL_AXIS)` in
+          parallel/spatial_shard.py checks against the axes
+          parallel/mesh.py actually builds.
+  SHD002  `jax.device_put(x)` with no explicit sharding/device inside a hot
+          train/serve loop: placement falls to the default device and the
+          first collective re-shards the value EVERY step — a hidden
+          per-batch transfer. Batches crossing into a mesh must carry their
+          sharding (parallel/mesh.py:shard_batch_pytree is the pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .donation import ProjectIndex
+from .framework import (Config, Finding, Module, SEVERITY_ERROR,
+                        SEVERITY_WARNING, _is_hot_loop, _loop_statements,
+                        walk_scope)
+
+_MESH_FNS = {"jax.sharding.Mesh", "jax.interpreters.pxla.Mesh", "Mesh",
+             "jax.make_mesh", "jax.sharding.make_mesh"}
+_SPEC_FNS = {"jax.sharding.PartitionSpec", "PartitionSpec",
+             "jax.experimental.pjit.PartitionSpec"}
+_SHARD_MAP_FNS = {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+_AXIS_DEFINERS = {"jax.pmap", "jax.vmap"}
+_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.ppermute", "jax.lax.pshuffle", "jax.lax.all_gather",
+    "jax.lax.all_to_all", "jax.lax.psum_scatter", "jax.lax.axis_index",
+    "jax.lax.axis_size",
+}
+
+
+def _axis_universe(index: ProjectIndex) -> Set[str]:
+    """Every axis name any mesh construction (or pmap/vmap axis definition)
+    in the project can produce. Memoized per lint run; an empty universe
+    disables SHD001 (the project builds its meshes elsewhere)."""
+    cached = index.cache.get("shd_axis_universe")
+    if cached is not None:
+        return cached
+    universe: Set[str] = set()
+    graph = index.graph
+    for module in ([] if graph is None else graph.modules):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            scope = module.enclosing_scope(node)
+            if resolved in _MESH_FNS and len(node.args) >= 2:
+                universe.update(graph.resolve_strings(module, node.args[1],
+                                                      scope))
+            for kw in node.keywords:
+                if kw.arg == "axis_names" \
+                        and resolved not in _SHARD_MAP_FNS:
+                    # axis_names DEFINES axes everywhere except shard_map,
+                    # where it selects manual axes of an existing mesh (a
+                    # use, checked below)
+                    universe.update(graph.resolve_strings(module, kw.value,
+                                                          scope))
+                elif kw.arg == "axis_name" and resolved in _AXIS_DEFINERS:
+                    universe.update(graph.resolve_strings(module, kw.value,
+                                                          scope))
+    index.cache["shd_axis_universe"] = universe
+    return universe
+
+
+def _check_axes(module: Module, index: ProjectIndex, node: ast.AST,
+                expr: ast.AST, universe: Set[str], what: str,
+                findings: List[Finding]) -> None:
+    graph = index.graph
+    if graph is None:
+        return
+    scope = module.enclosing_scope(node)
+    for name in graph.resolve_strings(module, expr, scope):
+        if name in universe:
+            continue
+        f = module.finding(
+            node, "SHD001", SEVERITY_ERROR,
+            f"mesh axis '{name}' in {what} is not defined by any mesh "
+            f"constructed in this project (known axes: "
+            f"{', '.join(sorted(universe))}) — a typo'd axis name "
+            f"compiles locally and fails (or silently replicates) on the "
+            f"pod; use the shared axis constants "
+            f"(parallel/mesh.py:DATA_AXIS/SPATIAL_AXIS/MODEL_AXIS)")
+        if f:
+            findings.append(f)
+
+
+def check_shd001(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    universe = _axis_universe(index)
+    if not universe:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = module.resolve(node.func)
+        if resolved in _SPEC_FNS:
+            for arg in node.args:
+                _check_axes(module, index, node, arg, universe,
+                            "PartitionSpec", findings)
+        elif resolved in _SHARD_MAP_FNS:
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    _check_axes(module, index, node, kw.value, universe,
+                                "shard_map axis_names", findings)
+        elif resolved in _COLLECTIVES:
+            axis_expr: Optional[ast.AST] = None
+            if len(node.args) >= 2:
+                axis_expr = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_expr = kw.value
+            if axis_expr is not None:
+                _check_axes(module, index, node, axis_expr, universe,
+                            f"{resolved.rsplit('.', 1)[-1]}(axis_name=...)",
+                            findings)
+    return findings
+
+
+def check_shd002(module: Module, index: ProjectIndex,
+                 config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for loop in ast.walk(module.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # outermost hot loop only, mirroring SYNC001; serving dispatch loops
+        # (predict/submit callees) count as hot here too
+        if any(isinstance(a, (ast.For, ast.While))
+               and _is_hot_loop(a, config, serve=True)
+               for a in module.ancestors(loop)):
+            continue
+        if not _is_hot_loop(loop, config, serve=True):
+            continue
+        for node in _loop_statements(loop):
+            if not (isinstance(node, ast.Call)
+                    and module.resolve(node.func) == "jax.device_put"
+                    and len(node.args) == 1
+                    and not any(kw.arg in ("device", "sharding") or
+                                kw.arg is None
+                                for kw in node.keywords)):
+                continue
+            f = module.finding(
+                node, "SHD002", SEVERITY_WARNING,
+                "jax.device_put without an explicit sharding inside a hot "
+                "loop: the batch lands on the default device and gets "
+                "implicitly re-sharded by the first computation that "
+                "needs it — a hidden per-step transfer; pass the batch "
+                "sharding (parallel/mesh.py:shard_batch_pytree / "
+                "batch_sharding) or hoist the put to setup time")
+            if f:
+                findings.append(f)
+    return findings

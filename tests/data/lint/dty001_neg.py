@@ -1,0 +1,20 @@
+"""DTY001 near miss: the blessed f32-island pattern
+(core/steps.py:_normalize_input) — math in f32 so uint8 pixel values stay
+exact, then ONE cast to the compute dtype before the model sees the
+batch."""
+import jax
+import jax.numpy as jnp
+
+
+def _to_f32(images):
+    return images.astype(jnp.float32)
+
+
+def make_train_step(compute_dtype=jnp.bfloat16):
+    def step(state, images, labels):
+        x = _to_f32(images)
+        x = x.astype(compute_dtype)
+        logits = state.apply_fn({"params": state.params}, x)
+        return logits, labels
+
+    return jax.jit(step)

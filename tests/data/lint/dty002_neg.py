@@ -1,0 +1,22 @@
+"""DTY002 near misses: uint8 batches cross the boundary raw (the cast —
+if any — happens inside the compiled program), and a downcast at the
+boundary shrinks the transfer instead of inflating it."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_train_step():
+    # the upcast lives INSIDE the jit: device-side, fused, free transfer
+    return jax.jit(lambda s, b: (s + b.astype(jnp.float32).mean(), b.sum()))
+
+
+class Trainer:
+    def __init__(self):
+        self.train_step = make_train_step()
+
+    def train_epoch(self, state, batches):
+        for batch in batches:
+            state, _ = self.train_step(state, batch)
+        state, _ = self.train_step(state, batches[0].astype(np.uint8))
+        return state

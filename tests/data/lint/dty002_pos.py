@@ -1,0 +1,22 @@
+"""DTY002 true positive: the batch is upcast to float32 ON HOST at the
+jitted-step boundary — every dispatch ships 4x the bytes of the raw uint8
+pixels over PCIe/ICI (the exact waste PR 5's uint8 staging removed;
+bench_input.py measured the 3.07x). The cast belongs inside the jitted
+program.
+"""
+import jax
+import numpy as np
+
+
+def make_train_step():
+    return jax.jit(lambda s, b: (s + b.mean(), b.sum()))
+
+
+class Trainer:
+    def __init__(self):
+        self.train_step = make_train_step()
+
+    def train_epoch(self, state, batches):
+        for batch in batches:
+            state, _ = self.train_step(state, batch.astype(np.float32))
+        return state

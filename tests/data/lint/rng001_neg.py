@@ -1,0 +1,38 @@
+"""RNG001 near misses that must stay silent: distinct derived keys per
+draw, the same key in mutually exclusive branches, derivation (split /
+fold_in) used many times over one parent, and a loop that rebinds its key
+every iteration."""
+import jax
+import jax.numpy as jnp
+
+
+def _factor(key, strength, batch):
+    return jax.random.uniform(key, (batch, 1, 1, 1),
+                              minval=1.0 - strength, maxval=1.0 + strength)
+
+
+def augment(images, rng):
+    b = images.shape[0]
+    k_flip, k_bright, k_contrast = jax.random.split(rng, 3)
+    flip = jax.random.bernoulli(k_flip, 0.5, (b,))
+    imgs = jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+    imgs = imgs * _factor(k_bright, 0.2, b)
+    m = imgs.mean(axis=(1, 2), keepdims=True)
+    imgs = (imgs - m) * _factor(k_contrast, 0.2, b) + m
+    return imgs
+
+
+def sample(key, shape, training):
+    # exclusive arms: only one draw ever runs
+    if training:
+        return jax.random.normal(key, shape)
+    return jax.random.uniform(key, shape)
+
+
+def rollout(key, steps):
+    # deriving many children from one parent is the blessed tagging pattern
+    out = []
+    for i in range(steps):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (4,)))
+    return out

@@ -1,0 +1,26 @@
+"""RNG001 true positive: one jitter key drawn from twice.
+
+The device-augment idiom (data/device_augment.py): per-effect keys split
+once, each handed to a `_factor` helper that draws from it. The copy-paste
+bug reuses the brightness key for contrast — both effects correlate
+perfectly, silently, forever. The second draw happens INSIDE `_factor`, so
+only the call-graph consumption pass can see it.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _factor(key, strength, batch):
+    return jax.random.uniform(key, (batch, 1, 1, 1),
+                              minval=1.0 - strength, maxval=1.0 + strength)
+
+
+def augment(images, rng):
+    b = images.shape[0]
+    k_flip, k_bright, k_contrast = jax.random.split(rng, 3)
+    flip = jax.random.bernoulli(k_flip, 0.5, (b,))
+    imgs = jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+    imgs = imgs * _factor(k_bright, 0.2, b)
+    m = imgs.mean(axis=(1, 2), keepdims=True)
+    imgs = (imgs - m) * _factor(k_bright, 0.2, b) + m  # BUG: k_bright again
+    return imgs

@@ -1,0 +1,24 @@
+"""RNG002 near misses: the blessed fold-by-step derivation
+(core/steps.py:make_classification_train_step), and a family step that
+takes the rng only for signature uniformity and deletes it (YOLO /
+CenterNet / pose)."""
+import jax
+
+
+def make_train_step():
+    def step(state, images, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        k_noise, k_drop = jax.random.split(step_rng)
+        noise = jax.random.normal(k_noise, images.shape)
+        keep = jax.random.bernoulli(k_drop, 0.9, images.shape)
+        return state.apply_gradients(noise * keep + images)
+
+    return jax.jit(step)
+
+
+def make_detection_step():
+    def step(state, images, rng):
+        del rng  # no dropout in this family; augmentation is host-side
+        return state.apply_gradients(images)
+
+    return jax.jit(step)

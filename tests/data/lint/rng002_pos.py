@@ -1,0 +1,20 @@
+"""RNG002 true positive: a jitted train step consumes its rng without
+deriving it from the step counter.
+
+Under `make_multistep_train_step`'s `lax.scan` the host passes ONE key per
+dispatch; a step that uses it raw replays identical "randomness" for all k
+inner steps (the counter advances inside the scan, the key does not), and
+the run is no longer reproducible per (seed, step) — the invariant the
+fused device augmentation relies on (data/device_augment.py).
+"""
+import jax
+
+
+def make_train_step():
+    def step(state, images, rng):
+        k_noise, k_drop = jax.random.split(rng)  # BUG: raw key, no fold_in
+        noise = jax.random.normal(k_noise, images.shape)
+        keep = jax.random.bernoulli(k_drop, 0.9, images.shape)
+        return state.apply_gradients(noise * keep + images)
+
+    return jax.jit(step)

@@ -1,0 +1,28 @@
+"""SHD001 near misses: specs spelled through the shared axis constants
+(always in the constructed mesh's universe), a replicated P(), and a spec
+built from a runtime value the linter cannot resolve (stays silent rather
+than guessing)."""
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(devices, spatial_parallel):
+    grid = np.asarray(devices).reshape(
+        (len(devices) // spatial_parallel, spatial_parallel))
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh, spatial):
+    spec = P(DATA_AXIS, SPATIAL_AXIS if spatial else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def dynamic_sharding(mesh, axis_from_config):
+    return NamedSharding(mesh, P(axis_from_config))

@@ -1,0 +1,21 @@
+"""SHD001 true positive: a PartitionSpec names a mesh axis that no mesh in
+the project defines — the 'sptial' typo compiles fine on the laptop and
+dies (or silently replicates) minutes into pod bring-up. The valid-axis
+universe comes from the `Mesh(...)` construction below, with the axis
+constants resolved the way parallel/spatial_shard.py spells them.
+"""
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(devices, spatial_parallel):
+    grid = np.asarray(devices).reshape(
+        (len(devices) // spatial_parallel, spatial_parallel))
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh):
+    return NamedSharding(mesh, P(DATA_AXIS, "sptial"))  # BUG: typo

@@ -1,0 +1,13 @@
+"""SHD002 true positive: `jax.device_put` with no explicit sharding inside
+the hot train loop — the batch lands on the default device and the sharded
+step re-shards it every iteration, a hidden per-step transfer the profiler
+shows as idle chips (parallel/mesh.py:shard_batch_pytree is the
+pattern)."""
+import jax
+
+
+def train_epoch(train_step, state, batches):
+    for batch in batches:
+        batch = jax.device_put(batch)  # BUG: no sharding
+        state, metrics = train_step(state, batch)
+    return state

@@ -88,7 +88,8 @@ class TestTrainAugment:
         hi = max((1.0 - m) / s for m, s in zip(IMAGENET_MEAN, IMAGENET_STD))
         assert a.min() >= lo - 1e-5 and a.max() <= hi + 1e-5
         # compute-dtype contract (the step's bf16 policy)
-        bf = jax.jit(daug.make_train_augment(S))(batch, jax.random.PRNGKey(0))
+        bf_fn = jax.jit(daug.make_train_augment(S))
+        bf = bf_fn(batch, jax.random.PRNGKey(0))
         assert bf.dtype == jnp.bfloat16
 
     def test_no_jitter_no_flip_no_pad_is_pure_normalize(self):

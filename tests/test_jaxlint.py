@@ -19,9 +19,14 @@ REPO = os.path.dirname(HERE)
 DATA = os.path.join(HERE, "data", "lint")
 
 
-def rules_in(*names):
+# the repo's declared policy (pyproject [tool.jaxlint] compute-dtype) — the
+# fixture runs must see it or DTY001 is vacuously off
+POLICY = Config(compute_dtype="bfloat16")
+
+
+def rules_in(*names, config=POLICY):
     paths = [os.path.join(DATA, n) for n in names]
-    return {f.rule for f in lint_paths(paths, config=Config())}
+    return {f.rule for f in lint_paths(paths, config=config)}
 
 
 # -- the per-rule fixture corpus --------------------------------------------
@@ -32,10 +37,22 @@ def rules_in(*names):
     ("SYNC001", "sync001_pos.py", "sync001_neg.py"),
     ("EFF001", "eff001_pos.py", "eff001_neg.py"),
     ("TRC001", "trc001_pos.py", "trc001_neg.py"),
+    ("RNG001", "rng001_pos.py", "rng001_neg.py"),
+    ("RNG002", "rng002_pos.py", "rng002_neg.py"),
+    ("DTY001", "dty001_pos.py", "dty001_neg.py"),
+    ("DTY002", "dty002_pos.py", "dty002_neg.py"),
+    ("SHD001", "shd001_pos.py", "shd001_neg.py"),
+    ("SHD002", "shd002_pos.py", "shd002_neg.py"),
 ])
 def test_rule_fires_on_positive_and_not_on_near_miss(rule, pos, neg):
     assert rule in rules_in(pos), f"{rule} must fire on {pos}"
     assert rules_in(neg) == set(), f"{neg} must stay clean"
+
+
+def test_dty001_requires_declared_policy():
+    """With no compute-dtype declared there is nothing to leak — the rule
+    must stay off rather than guess a policy."""
+    assert "DTY001" not in rules_in("dty001_pos.py", config=Config())
 
 
 def test_don001_through_factory_and_attr_idiom():
@@ -62,11 +79,12 @@ def test_fixture_corpus_is_complete():
 # -- self-clean: the linter's own verdict on the tree it ships in -----------
 
 def test_tree_is_clean():
-    """`python -m deepvision_tpu.lint deepvision_tpu tools` exits 0 — every
-    true positive was fixed and every deliberate exception suppressed with a
-    justification (docs/LINTING.md)."""
-    findings = lint_paths([os.path.join(REPO, "deepvision_tpu"),
-                           os.path.join(REPO, "tools")])
+    """The default lint set — the whole project including the repo-root
+    scripts (bench*.py, __graft_entry__.py), all 11 rules, the declared
+    bf16 policy — exits 0: every true positive was fixed and every
+    deliberate exception suppressed with a justification
+    (docs/LINTING.md)."""
+    findings = lint_paths([REPO])
     assert findings == [], "\n".join(f.format() for f in findings)
 
 
@@ -131,10 +149,38 @@ def test_planted_bug_in_real_trainer_is_caught(tmp_path):
 def test_cli_exit_codes(capsys):
     assert main([os.path.join(DATA, "don001_pos.py")]) == EXIT_FINDINGS
     assert main([os.path.join(DATA, "don001_neg.py")]) == EXIT_CLEAN
-    assert main([]) == EXIT_USAGE
     assert main(["/no/such/path.py"]) == EXIT_USAGE
     assert main(["--select", "NOPE", os.path.join(DATA, "suppress.py")]) \
         == EXIT_USAGE
+    capsys.readouterr()
+
+
+def test_cli_default_set_sweeps_repo_root_scripts(tmp_path, monkeypatch,
+                                                 capsys):
+    """`python -m deepvision_tpu.lint` with no paths lints the whole project
+    rooted at the nearest pyproject.toml — a hazard in a repo-ROOT script
+    (outside any package) is found; with no pyproject upward it is a usage
+    error instead of a silent empty run."""
+    (tmp_path / "pyproject.toml").write_text("[tool.jaxlint]\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    (tmp_path / "bench_root.py").write_text(
+        "import jax\n\n\n"
+        "def loop(fs, x):\n"
+        "    for f in fs:\n"
+        "        jax.jit(f)(x)\n")
+    monkeypatch.chdir(tmp_path)
+    assert main([]) == EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "bench_root.py" in out and "JIT001" in out
+
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    monkeypatch.chdir(bare)
+    monkeypatch.setattr("deepvision_tpu.lint.cli.find_pyproject",
+                        lambda _anchor: None)
+    assert main([]) == EXIT_USAGE
     capsys.readouterr()
 
 
@@ -146,6 +192,24 @@ def test_cli_json_format(capsys):
     (finding,) = out["findings"]
     assert finding["rule"] == "SYNC001" and finding["line"] == 9
     assert finding["severity"] == "warning"
+
+
+def test_cli_github_format(capsys):
+    """--format github emits one ::error/::warning workflow command per
+    finding with file/line/col/title properties, and a plain summary line —
+    the Actions annotation contract."""
+    path = os.path.join(DATA, "sync001_pos.py")
+    rc = main(["--format", "github", path])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == EXIT_FINDINGS
+    assert out[0].startswith("::warning ")
+    assert f"file={path},line=9," in out[0]
+    assert "title=jaxlint SYNC001::" in out[0]
+    assert out[-1] == "jaxlint: 1 finding"
+
+    rc = main(["--format", "github", os.path.join(DATA, "don001_neg.py")])
+    out = capsys.readouterr().out
+    assert rc == EXIT_CLEAN and "::" not in out and "clean" in out
 
 
 def test_cli_select(capsys):
@@ -185,6 +249,338 @@ def test_pyproject_excludes_and_disable(tmp_path, capsys):
     (tmp_path / "pyproject.toml").write_text(
         '[tool.jaxlint]\ndisable = ["DON001"]\n')
     assert lint_paths([str(pkg)]) == []
+
+
+def test_load_config_reads_compute_dtype(tmp_path):
+    from deepvision_tpu.lint import load_config
+    p = tmp_path / "pyproject.toml"
+    p.write_text('[tool.jaxlint]\ncompute-dtype = "bfloat16"\n')
+    assert load_config(str(p)).compute_dtype == "bfloat16"
+    p.write_text("[tool.jaxlint]\n")
+    assert load_config(str(p)).compute_dtype == ""
+
+
+# -- mutation tests against the REAL package files ---------------------------
+# (same discipline as test_planted_bug_in_real_trainer_is_caught: replant the
+# bug class in the actual code the rule was built to protect, prove it fires,
+# and prove the unmutated tree stays silent — the rules are not vacuous)
+
+def _lint_package_with_mutation(filename, old, new, select):
+    """Lint deepvision_tpu/ with `old`->`new` applied in-memory to the one
+    file named `filename` (project index rebuilt over the mutated tree)."""
+    from deepvision_tpu.lint.cli import collect_files
+    from deepvision_tpu.lint.donation import ProjectIndex
+    from deepvision_tpu.lint.framework import Module, load_config
+    from deepvision_tpu.lint.rules import ALL_RULES as RULES
+    config = load_config(os.path.join(REPO, "pyproject.toml"))
+    files = collect_files([os.path.join(REPO, "deepvision_tpu")], config,
+                          REPO)
+    modules = []
+    mutated = False
+    for path in files:
+        module = Module.from_path(path)
+        if os.path.basename(path) == filename:
+            assert old in module.source, f"mutation anchor gone: {old!r}"
+            module = Module(path, module.source.replace(old, new))
+            mutated = True
+        modules.append(module)
+    assert mutated, f"{filename} not in the package sweep"
+    index = ProjectIndex().build(modules)
+    out = []
+    for module in modules:
+        out.extend(RULES[select][1](module, index, config))
+    return out
+
+
+@pytest.mark.parametrize("rule,filename,old,new", [
+    # PR 5's invariant: drop the fold-by-step derivation in the real
+    # classification step -> every scanned inner step replays its randomness
+    ("RNG002", "steps.py",
+     "step_rng = jax.random.fold_in(rng, state.step)", "step_rng = rng"),
+    # the device-augment copy-paste bug: contrast reuses the brightness key
+    ("RNG001", "device_augment.py",
+     "_factor(k_c, contrast, b)", "_factor(k_b, contrast, b)"),
+    # the stringly-typed axis typo at a real collective call site
+    ("SHD001", "spatial_shard.py",
+     "lax.all_to_all(x, SPATIAL_AXIS,", 'lax.all_to_all(x, "sptial",'),
+    # upcast the batch on host at the real trainer's dispatch boundary
+    ("DTY002", "trainer.py",
+     "self.state, metrics = self.train_step(self.state, *batch,",
+     "self.state, metrics = self.train_step("
+     "self.state.astype(np.float32), *batch,"),
+])
+def test_replanted_real_bug_is_caught(rule, filename, old, new):
+    findings = _lint_package_with_mutation(filename, old, new, rule)
+    assert any(f.rule == rule for f in findings), \
+        f"{rule} must fire when {filename} is mutated"
+    clean = _lint_package_with_mutation(filename, old, old, rule)
+    assert clean == [], "\n".join(f.format() for f in clean)
+
+
+# -- the interprocedural dataflow core ---------------------------------------
+
+def _modules(**sources):
+    from deepvision_tpu.lint.framework import Module
+    return {name: Module(f"{name}.py", textwrap.dedent(src))
+            for name, src in sources.items()}
+
+
+def _calls_in(module, name):
+    import ast
+    return sorted(
+        (n for n in ast.walk(module.tree) if isinstance(n, ast.Call)
+         and getattr(n.func, "id", getattr(n.func, "attr", None)) == name),
+        key=lambda n: (n.lineno, n.col_offset))
+
+
+def test_call_graph_resolves_imports_locals_and_methods():
+    """Call resolution is import-aware and conservative: an imported name
+    binds to the project defs with that terminal name (candidates union —
+    the imported module's def must be among them), a local def shadows the
+    import entirely, `self.method` binds through the enclosing class, and a
+    bare name that is neither local nor imported stays unresolved."""
+    from deepvision_tpu.lint.framework import CallGraph
+    mods = _modules(
+        lib="""\
+            def helper(x):
+                return x + 1
+            """,
+        app="""\
+            from lib import helper
+
+
+            class Trainer:
+                def run(self, x):
+                    return self.prep(helper(x))
+
+                def prep(self, x):
+                    return x
+
+
+            def local_wins(x):
+                def helper(y):
+                    return y
+                return helper(x)
+
+
+            def unimported(x):
+                return mystery(x)
+            """,
+    )
+    graph = CallGraph(mods.values())
+    app = mods["app"]
+
+    imported_call, local_call = _calls_in(app, "helper")
+    targets = graph.resolve_call(app, imported_call)
+    assert mods["lib"] in {t.module for t in targets}
+
+    (meth,) = graph.resolve_call(app, _calls_in(app, "prep")[0])
+    assert meth.cls_name == "Trainer" and meth.node.name == "prep"
+
+    (local,) = graph.resolve_call(app, local_call)
+    assert local.module is app and local.cls_name is None, \
+        "nested def shadows the import"
+
+    assert graph.resolve_call(app, _calls_in(app, "mystery")[0]) == []
+
+
+def test_call_graph_resolves_constant_strings():
+    """The constant index: P(DATA_AXIS, ...) must check the STRING the
+    constant holds, including tuples and `a or b` fallbacks."""
+    from deepvision_tpu.lint.framework import CallGraph
+    mods = _modules(
+        mesh="""\
+            DATA_AXIS = "data"
+            AXES = ("data", "spatial")
+            """,
+        use="""\
+            import mesh
+
+            def f(flag):
+                return mesh.DATA_AXIS or "fallback"
+            """,
+    )
+    graph = CallGraph(mods.values())
+    use = mods["use"]
+    import ast
+    ret = next(n for n in ast.walk(use.tree) if isinstance(n, ast.Return))
+    got = graph.resolve_strings(use, ret.value)
+    assert got == ["data", "fallback"]
+    name = next(n for n in ast.walk(mods["mesh"].tree)
+                if isinstance(n, ast.Name) and n.id == "AXES")
+    assert set(graph.resolve_strings(mods["mesh"], name)) \
+        == {"data", "spatial"}
+
+
+def test_trace_reach_crosses_modules_with_per_callsite_taint():
+    """The tentpole property: a helper that is only traced from ANOTHER
+    module is reached, with exactly the parameters that receive
+    tracer-derived values tainted — `x.shape[0]` (trace-time static) must
+    NOT taint, and a host-only helper must not be reached at all."""
+    from deepvision_tpu.lint.framework import CallGraph, compute_trace_reach
+    mods = _modules(
+        util="""\
+            def traced_helper(x, n):
+                return x * n
+
+
+            def host_helper(cfg):
+                return cfg
+            """,
+        step="""\
+            import jax
+            from util import traced_helper
+
+
+            def make_step():
+                def step(state, batch):
+                    return traced_helper(batch, batch.shape[0])
+                return jax.jit(step)
+
+
+            def host_setup(cfg):
+                from util import host_helper
+                return host_helper(cfg)
+            """,
+    )
+    graph = CallGraph(mods.values())
+    reach = compute_trace_reach(graph)
+    by_name = {r.info.qualname: r for r in reach.values()}
+    assert "step" in by_name and by_name["step"].seed
+    helper = by_name["traced_helper"]
+    assert helper.info.module is mods["util"] and not helper.seed
+    assert helper.tainted == {"x"}, "shape[0] is static — 'n' stays clean"
+    assert "host_helper" not in by_name
+
+
+def test_trc001_fires_interprocedurally(tmp_path):
+    """TRC001 through the reach map: the tracer bool lives in a helper
+    MODULE that never mentions jit — only the cross-module reach pass can
+    see it is traced; the config flag threaded alongside stays clean."""
+    (tmp_path / "helpers.py").write_text(textwrap.dedent("""\
+        def scale(x, verbose):
+            if verbose:          # host flag: fine
+                pass
+            if x > 0:            # tracer bool: TRC001
+                return x * 2
+            return x
+        """))
+    (tmp_path / "train.py").write_text(textwrap.dedent("""\
+        import jax
+        from helpers import scale
+
+
+        def make_step(verbose):
+            def step(state, batch):
+                return scale(batch, verbose)
+            return jax.jit(step)
+        """))
+    findings = lint_paths([str(tmp_path)], config=Config())
+    assert [(os.path.basename(f.path), f.rule) for f in findings] \
+        == [("helpers.py", "TRC001")]
+
+
+def test_rng001_key_reuse_through_imported_helper(tmp_path):
+    """Replanted PR 5 bug shape, cross-module: the draw happens inside a
+    helper imported from another file; only the call-graph consumption
+    fixpoint can see the second consumption of k_bright."""
+    (tmp_path / "factors.py").write_text(textwrap.dedent("""\
+        import jax
+
+
+        def factor(key, strength, b):
+            return jax.random.uniform(key, (b, 1, 1, 1),
+                                      minval=1 - strength,
+                                      maxval=1 + strength)
+        """))
+    (tmp_path / "augment.py").write_text(textwrap.dedent("""\
+        import jax
+        from factors import factor
+
+
+        def augment(images, rng):
+            b = images.shape[0]
+            k_bright, k_contrast = jax.random.split(rng)
+            imgs = images * factor(k_bright, 0.2, b)
+            return imgs * factor(k_bright, 0.2, b)  # BUG: k_bright again
+        """))
+    findings = lint_paths([str(tmp_path)], config=Config())
+    assert [f.rule for f in findings] == ["RNG001"]
+    assert "k_bright" in findings[0].message
+
+
+def test_dty001_leak_through_helper_return(tmp_path):
+    """DTY001's call-graph arm: the f32 materialization hides behind a
+    helper's return value; the near-miss twin casts before apply and must
+    stay silent."""
+    (tmp_path / "leak.py").write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+
+        def to_float(images):
+            return images.astype(jnp.float32)
+
+
+        def make_step():
+            def step(state, images):
+                x = to_float(images)
+                return state.apply_fn({"params": state.params}, x)
+            return jax.jit(step)
+        """))
+    policy = Config(compute_dtype="bfloat16")
+    findings = lint_paths([str(tmp_path)], config=policy)
+    assert [f.rule for f in findings] == ["DTY001"]
+
+    (tmp_path / "leak.py").write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+
+        def to_float(images):
+            return images.astype(jnp.float32)
+
+
+        def make_step(compute_dtype):
+            def step(state, images):
+                x = to_float(images)
+                x = x.astype(compute_dtype)
+                return state.apply_fn({"params": state.params}, x)
+            return jax.jit(step)
+        """))
+    assert lint_paths([str(tmp_path)], config=policy) == []
+
+
+def test_shd001_axis_universe_is_project_wide(tmp_path):
+    """SHD001 checks a PartitionSpec in one file against the mesh another
+    file constructs, resolving the axis constants; renaming the mesh axis
+    turns the spec's constant into a finding."""
+    mesh_src = """\
+        import numpy as np
+        from jax.sharding import Mesh
+
+        DATA_AXIS = "{axis}"
+
+
+        def make_mesh(devices):
+            return Mesh(np.asarray(devices), (DATA_AXIS,))
+        """
+    (tmp_path / "mesh.py").write_text(
+        textwrap.dedent(mesh_src.format(axis="data")))
+    (tmp_path / "shard.py").write_text(textwrap.dedent("""\
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+        def batch_sharding(mesh):
+            return NamedSharding(mesh, P("data"))
+        """))
+    assert lint_paths([str(tmp_path)], config=Config()) == []
+
+    (tmp_path / "mesh.py").write_text(
+        textwrap.dedent(mesh_src.format(axis="batch")))
+    findings = lint_paths([str(tmp_path)], config=Config())
+    assert [f.rule for f in findings] == ["SHD001"]
+    assert "'data'" in findings[0].message
 
 
 def test_toml_subset_parser():
