@@ -9,8 +9,9 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_spatial.py tests/test_spatial_shardmap.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
-.PHONY: test test-all verify bench bench-serve bench-input dryrun smoke \
-        serve-smoke preflight preflight-record lint lint-changed fsck
+.PHONY: test test-all verify bench bench-serve bench-serve-load \
+        bench-input dryrun smoke serve-smoke serve-fleet-smoke preflight \
+        preflight-record lint lint-changed fsck
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
 	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
@@ -79,6 +80,17 @@ serve-smoke: ## serving-stack smoke: bucketed AOT cache, micro-batcher,
 	## metrics, graceful drain — synthetic load, exit 0 on pass
 	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve -m lenet5 --smoke \
 	    --duration 2
+
+serve-fleet-smoke: ## multi-model fleet smoke: two engines behind one
+	## process, per-model batchers/metrics, round-robin synthetic load —
+	## every served model must answer (docs/SERVING.md "Fleet")
+	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve -m lenet5,lenet5_digits \
+	    --smoke --duration 2
+
+bench-serve-load: ## open-loop fleet load bench: sustained-QPS arrival
+	## schedule over a 2-model fleet — sustained QPS, p99-under-load,
+	## shed rate (one JSON line; docs/SERVING.md "Load bench")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py --load
 
 dryrun:      ## 8-virtual-device multichip compile/exec check
 	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

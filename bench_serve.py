@@ -5,6 +5,16 @@ Prints ONE JSON line in bench.py's schema ({"metric", "value", "unit",
 under closed-loop synthetic offered load (single-image requests — the
 serving worst case the tentpole targets).
 
+`--load` switches to the OPEN-LOOP fleet bench (docs/SERVING.md "Load
+bench"): a sustained-QPS arrival schedule — requests fire on the clock,
+never gated on completions — over a >=2-model fleet, reporting sustained
+QPS, p99-under-load, and shed rate. Closed-loop load (the default mode's
+clients) measures capacity but hides overload: a saturated server slows
+its own clients down, so offered load politely collapses to whatever the
+server can do. Open-loop arrivals are what real traffic does — they keep
+coming — so p99 and shed rate under a FIXED offered rate are the numbers a
+capacity plan can actually use (Schroeder et al., "Open Versus Closed").
+
 Two baselines, measured in the same process on the same model/config:
 
 - `vs_baseline` compares against the NAIVE per-request loop the serving
@@ -36,6 +46,7 @@ DEEPVISION_SERVE_BENCH_DELAY_MS.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import threading
@@ -44,7 +55,7 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def closed_loop() -> None:
     model_name = os.environ.get("DEEPVISION_SERVE_BENCH_MODEL", "lenet5")
     secs = float(os.environ.get("DEEPVISION_SERVE_BENCH_SECS", "2.0"))
     max_delay_ms = float(os.environ.get("DEEPVISION_SERVE_BENCH_DELAY_MS",
@@ -171,6 +182,151 @@ def main() -> None:
         "platform": platform,
         "compile_cache": compilation_cache_stats(),
     }))
+
+
+def open_loop(args) -> None:
+    """Open-loop fleet load bench: arrivals on a fixed sustained-QPS
+    schedule round-robined over the fleet's models, single-image requests
+    (the worst case). Submissions never wait for completions; when a
+    model's queue is full the request is SHED (counted, not retried) —
+    exactly what the HTTP front door does with 429."""
+    import jax
+
+    from deepvision_tpu.cli import (compilation_cache_stats,
+                                    setup_compilation_cache)
+    setup_compilation_cache()
+
+    from deepvision_tpu.serve.batcher import RequestRejected
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+
+    names = [s.strip() for s in args.models.split(",") if s.strip()]
+    max_batch = args.max_batch
+    fleet = ModelFleet()
+    for name in names:
+        engine = PredictEngine.from_config(
+            name, buckets=(1, 8, 32), max_batch=max_batch, verbose=False)
+        engine.warmup()
+        fleet.add(engine, max_delay_ms=args.delay_ms,
+                  max_queue_examples=8 * max_batch)
+    models = list(fleet)
+    platform = jax.devices()[0].platform
+
+    # capacity estimate for the auto offered rate: the fleet shares ONE
+    # device, so a fair round-robin of one max-bucket dispatch per model
+    # yields sum(max_batch) images per sum(batch_ms) — NOT the sum of each
+    # model's solo capacity
+    batch_ms = {sm.name: sm.engine.measure_batch_ms(max_batch)
+                for sm in models}
+    fleet_capacity = (max_batch * len(models)
+                      / (sum(batch_ms.values()) / 1000.0))
+    offered_qps = args.qps or round(0.7 * fleet_capacity, 1)
+
+    xs = {sm.name: np.random.RandomState(1).randn(
+        1, *sm.engine.example_shape).astype(sm.engine.input_dtype)
+        for sm in models}
+    for sm in models:         # prime + discard warmup noise
+        sm.batcher.submit(xs[sm.name]).result(timeout=120)
+        sm.metrics.snapshot(reset=True)
+
+    # the arrival schedule: request i fires at t0 + i/qps, whether or not
+    # any earlier request has completed — the generator only sleeps until
+    # the next arrival time, it never blocks on a future
+    futs = []
+    t0 = time.perf_counter()
+    i = 0
+    while True:
+        t_next = t0 + i / offered_qps
+        now = time.perf_counter()
+        if t_next >= t0 + args.secs:
+            break
+        if t_next > now:
+            time.sleep(t_next - now)
+        sm = models[i % len(models)]
+        try:
+            futs.append(sm.batcher.submit(xs[sm.name]))
+        except RequestRejected:
+            pass              # shed — counted by the batcher's metrics
+        i += 1
+    gen_elapsed = time.perf_counter() - t0
+    offered = i
+    # under-load snapshot BEFORE the tail drains: completions during the
+    # arrival window are the sustained rate; the drain tail would flatter it
+    under_load = {sm.name: sm.metrics.snapshot() for sm in models}
+    for f in futs:
+        f.result(timeout=120)
+    final = {sm.name: sm.metrics.snapshot() for sm in models}
+    fleet.drain(timeout=30)
+
+    sustained = sum(s["requests"] for s in under_load.values()) / gen_elapsed
+    shed = sum(s["shed_requests"] for s in final.values())
+    p99 = max((s.get("p99_ms", 0.0) for s in under_load.values()),
+              default=0.0)
+    p50 = max((s.get("p50_ms", 0.0) for s in under_load.values()),
+              default=0.0)
+    shed_rate = shed / offered if offered else 0.0
+    print(json.dumps({
+        "metric": f"serve_fleet_sustained_qps(open-loop,1img/req,"
+                  f"{'+'.join(names)},b{max_batch},"
+                  f"delay{args.delay_ms:g}ms,{platform})",
+        "value": round(sustained, 2),
+        "unit": "req/sec",
+        # goodput fraction: completions per offered arrival — 1.0 means the
+        # fleet absorbed the schedule; well below it means queueing/shedding
+        "vs_baseline": round(sustained / offered_qps, 3) if offered_qps
+                       else 0.0,
+        "baseline": f"offered open-loop arrival rate "
+                    f"({offered_qps:g} req/s; vs_baseline is the goodput "
+                    f"fraction completed at that rate)",
+        "offered_qps": round(offered_qps, 1),
+        "offered_requests": offered,
+        "p50_ms_under_load": round(p50, 3),
+        "p99_ms_under_load": round(p99, 3),
+        "shed_requests": int(shed),
+        "shed_rate": round(shed_rate, 4),
+        "fleet_capacity_est_qps": round(fleet_capacity, 1),
+        "models": {sm.name: {
+            "requests": under_load[sm.name]["requests"],
+            "p99_ms": round(under_load[sm.name].get("p99_ms", 0.0), 3),
+            "shed_requests": int(final[sm.name]["shed_requests"]),
+            "batch_compute_ms": round(batch_ms[sm.name], 3),
+        } for sm in models},
+        "secs": args.secs,
+        "cpu_cores": os.cpu_count(),
+        "platform": platform,
+        "compile_cache": compilation_cache_stats(),
+    }))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--load", action="store_true",
+                   help="open-loop fleet load bench (sustained-QPS arrival "
+                        "schedule over --models) instead of the closed-loop "
+                        "single-model throughput bench")
+    p.add_argument("--models",
+                   default=os.environ.get("DEEPVISION_SERVE_BENCH_FLEET",
+                                          "lenet5,lenet5_digits"),
+                   help="comma-separated fleet for --load (default "
+                        "lenet5,lenet5_digits — two models, CPU-cheap)")
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="offered arrival rate for --load (default 0 = auto: "
+                        "70%% of the measured fleet capacity estimate)")
+    p.add_argument("--secs", type=float,
+                   default=float(os.environ.get("DEEPVISION_SERVE_BENCH_SECS",
+                                                "2.0")),
+                   help="arrival-schedule duration for --load")
+    p.add_argument("--max-batch", type=int,
+                   default=int(os.environ.get(
+                       "DEEPVISION_SERVE_BENCH_MAX_BATCH", "32")))
+    p.add_argument("--delay-ms", type=float,
+                   default=float(os.environ.get(
+                       "DEEPVISION_SERVE_BENCH_DELAY_MS", "5.0")))
+    args = p.parse_args(argv)
+    if args.load:
+        open_loop(args)
+    else:
+        closed_loop()
 
 
 if __name__ == "__main__":

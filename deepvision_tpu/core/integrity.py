@@ -207,6 +207,21 @@ def verify_files(step_dir: str) -> Tuple[str, str]:
     return OK, f"{len(files)} files verified"
 
 
+def verify_epoch(ckpt_dir: str, epoch: int) -> Tuple[str, str, Optional[str]]:
+    """File-level verdict on ONE committed epoch of a checkpoint dir:
+    `(status, detail, manifest_sha256)` with the digest only when the epoch
+    verifies OK. This is the cheap gate hot reload (serve/reload.py) runs
+    on every candidate BEFORE deserializing anything: a corrupt candidate
+    costs a hash pass and a log line, never a swap — and MISSING_MANIFEST
+    doubles as the "save still committing" signal, because the manifest is
+    written by the finalizer strictly AFTER the Orbax commit."""
+    step_dir = os.path.join(ckpt_dir, str(epoch))
+    status, detail = verify_files(step_dir)
+    if status != OK:
+        return status, detail, None
+    return status, detail, manifest_digest(load_manifest(step_dir))
+
+
 def verify_leaves(payload, manifest: dict) -> List[str]:
     """Deep check: restored payload leaves vs the manifest's save-time
     hashes. Compares the intersection of keypaths only — the EMA slot is

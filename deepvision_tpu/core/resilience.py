@@ -116,6 +116,21 @@ def resilient_batches(batches: Iterable, policy: RetryPolicy,
         yield batch
 
 
+def log_resilience_event(logger, step: int, metrics: dict,
+                         epoch: Optional[int] = None) -> None:
+    """Write one event onto the `resilience_` metrics stream — the single
+    forensics channel every recovery path shares (divergence rollbacks and
+    checkpoint fallbacks in the trainers, refused hot reloads in
+    serve/reload.py): prefixed keys, float values, no console echo, same
+    JSONL/TB stream as the run's ordinary metrics so incidents line up
+    with the training/serving timeline. A None logger is a no-op, so
+    callers without a metrics stream (library embedding) need no guard."""
+    if logger is None:
+        return
+    logger.log(step, {k: float(v) for k, v in metrics.items()},
+               epoch=epoch, prefix="resilience_", echo=False)
+
+
 class PreemptionExit(Exception):
     """Raised by fit() after a graceful-shutdown checkpoint is committed;
     `fit_and_close` (and the GAN mains) convert it to a clean exit 0. Carries

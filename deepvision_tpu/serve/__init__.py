@@ -8,14 +8,21 @@ checkpoint into something that takes traffic (docs/SERVING.md):
   (no per-request trace/compile; padding provably inert)
 - batcher.DynamicBatcher: thread-safe micro-batching with deadline +
   max_batch flush, futures, and example-counted backpressure
-- metrics.ServingMetrics: p50/p99, padding waste, batch fill — flushed on
-  the trainer's MetricsLogger stream
+- metrics.ServingMetrics: p50/p99, padding waste, batch fill, shed —
+  flushed on the trainer's MetricsLogger stream
+- fleet.ModelFleet: many models behind one process — per-model batcher +
+  metrics, routed by registry name (`POST /predict/<model>`)
+- reload.WeightReloader: hot weight reload — new integrity-verified
+  epochs swap into live engines atomically, zero downtime, zero recompiles
 - server.InferenceServer: stdlib HTTP front-end + graceful SIGTERM drain
   (core/resilience.GracefulShutdown contract, exit 0)
-- cli: `python -m deepvision_tpu.serve` (HTTP or --smoke)
+- cli: `python -m deepvision_tpu.serve` (HTTP or --smoke; multi-model via
+  `-m name1,name2 --runs-root runs/`)
 """
 
 from .batcher import Draining, DynamicBatcher, Overloaded, RequestRejected  # noqa: F401
-from .engine import PredictEngine, pick_bucket  # noqa: F401
+from .engine import PredictEngine, load_checkpoint_weights, pick_bucket  # noqa: F401
+from .fleet import ModelFleet, ServedModel, UnknownModel  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .reload import WeightReloader  # noqa: F401
 from .server import InferenceServer  # noqa: F401

@@ -122,6 +122,10 @@ class DynamicBatcher:
                     "server is draining: in-flight batches are finishing, "
                     "new work is rejected — retry against another replica")
             if self._pending + n > self.max_queue_examples:
+                if self.metrics is not None:
+                    self.metrics.observe_shed(1)  # the shed-rate side of the
+                    # load contract: rejected work must be counted where it
+                    # was rejected, not inferred by the client
                 raise Overloaded(
                     f"queue full ({self._pending} examples pending, cap "
                     f"{self.max_queue_examples}) — shed load or raise "

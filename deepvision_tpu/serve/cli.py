@@ -1,17 +1,26 @@
 """`python -m deepvision_tpu.serve` — the serving entrypoint.
 
-Two modes over the same stack (engine → batcher → metrics → drain):
+Two modes over the same stack (fleet → engines → batchers → metrics →
+drain), single-model or multi-model:
 
-    # HTTP serving (POST /predict, GET /healthz, GET /stats; SIGTERM drains)
+    # HTTP serving, one model (POST /predict; SIGTERM drains)
     python -m deepvision_tpu.serve -m resnet50 --workdir runs/resnet50
+
+    # a FLEET: several models behind one process, routed by name
+    # (POST /predict/<model>), weights restored per model from the runs
+    # root, hot-reloaded when training commits a new verified epoch
+    python -m deepvision_tpu.serve -m resnet50,yolov3_digits \
+        --runs-root runs --reload-every 10
 
     # self-driving synthetic load, one JSON summary line, exit 0
     python -m deepvision_tpu.serve -m lenet5 --smoke
+    python -m deepvision_tpu.serve -m lenet5,lenet5_digits --smoke
 
-The smoke mode is the `make serve-smoke` / CI surface: it proves the whole
-path (bucketed AOT compile cache, coalescing, padding, metrics, graceful
-drain) end to end without a client, and SIGTERM mid-smoke exercises the
-drain contract exactly like production (docs/SERVING.md).
+The smoke mode is the `make serve-smoke` / `make serve-fleet-smoke` / CI
+surface: it proves the whole path (bucketed AOT compile cache, per-model
+coalescing, padding, routing, metrics, graceful drain) end to end without
+a client, and SIGTERM mid-smoke exercises the drain contract exactly like
+production (docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -29,18 +38,37 @@ from ..core.resilience import GracefulShutdown
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m deepvision_tpu.serve",
-        description="Dynamic-batching inference server over the model zoo "
-                    "(shape-bucketed AOT predict cache; docs/SERVING.md)")
+        description="Dynamic-batching inference fleet over the model zoo "
+                    "(shape-bucketed AOT predict cache, multi-model "
+                    "routing, hot weight reload; docs/SERVING.md)")
     p.add_argument("-m", "--model", default=None,
-                   help="registered config name (see --list-models)")
+                   help="registered config name, or a comma-separated list "
+                        "to serve a fleet (first name is the default model "
+                        "bare POST /predict hits; see --list-models)")
     p.add_argument("-c", "--checkpoint", default=None,
-                   help="epoch number or 'latest' (needs --workdir)")
+                   help="epoch number or 'latest' (needs --workdir; "
+                        "single-model only)")
     p.add_argument("--workdir", default=None,
                    help="training workdir to restore weights from (EMA "
-                        "weights win when present); omit for random-weight "
-                        "smoke serving")
+                        "weights win when present); single-model only — a "
+                        "fleet resolves per-model workdirs under "
+                        "--runs-root. Omit both for random-weight smoke "
+                        "serving")
+    p.add_argument("--runs-root", default=None,
+                   help="runs root holding one <runs-root>/<model> workdir "
+                        "per served model; models with a restorable "
+                        "checkpoint there serve it (and hot-reload from "
+                        "it), the rest serve random weights with a warning")
+    p.add_argument("--reload-every", type=float, default=0.0,
+                   metavar="SECS",
+                   help="hot weight reload: poll each model's run dir every "
+                        "SECS seconds for new committed epochs; a candidate "
+                        "swaps in only after its integrity manifest "
+                        "verifies (corrupt candidates are refused and "
+                        "logged, old weights keep serving). 0 disables "
+                        "(default)")
     p.add_argument("--image-size", type=int, default=None,
-                   help="serving resolution (default: the config's)")
+                   help="serving resolution (default: each config's)")
     p.add_argument("--no-verify", action="store_true",
                    help="serve weights whose checkpoint fails (or skips) "
                         "integrity verification — by default a corrupt "
@@ -59,21 +87,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="micro-batching deadline: a request waits at most "
                         "this long for batch-mates (p99 floor; default 5)")
     p.add_argument("--max-queue", type=int, default=1024,
-                   help="backpressure: pending-example cap before submits "
-                        "are rejected with 429 (default 1024)")
+                   help="backpressure: per-model pending-example cap before "
+                        "submits are rejected with 429 (default 1024)")
     p.add_argument("--port", type=int, default=8700)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--flush-every", type=float, default=10.0,
                    help="seconds between periodic metric flushes")
     p.add_argument("--smoke", action="store_true",
-                   help="drive synthetic in-process load instead of HTTP; "
-                        "print one JSON summary line and exit 0")
+                   help="drive synthetic in-process load (round-robin over "
+                        "the fleet) instead of HTTP; print one JSON summary "
+                        "line and exit 0")
     p.add_argument("--duration", type=float, default=2.0,
                    help="--smoke load duration in seconds")
     p.add_argument("--load-threads", type=int, default=8,
                    help="--smoke concurrent synthetic clients")
     p.add_argument("--list-models", action="store_true",
-                   help="list servable registered configs and exit")
+                   help="list servable registered configs — annotated with "
+                        "whether a restorable checkpoint exists under "
+                        "--runs-root (default runs/) — and exit")
     p.add_argument("--compilation-cache",
                    default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
                                           "auto"),
@@ -83,32 +114,55 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _list_models() -> None:
+def restorable_epoch(runs_root: str, name: str) -> Optional[int]:
+    """Newest committed checkpoint epoch under `<runs_root>/<name>/ckpt`,
+    or None — what `--list-models` annotates and what decides whether a
+    fleet member serves trained weights or a random init."""
+    from ..core import integrity
+    epochs = integrity.committed_epochs(
+        os.path.join(runs_root, name, "ckpt"))
+    return epochs[-1] if epochs else None
+
+
+def _list_models(runs_root: Optional[str]) -> None:
+    """One line per registered config: family, model, servability, and —
+    so operators can see what a fleet can ACTUALLY serve — the newest
+    restorable checkpoint epoch under the runs root."""
     from ..configs import CONFIGS
+    root = runs_root or "runs"
     for name, cfg in CONFIGS.items():
         servable = "-" if cfg.family == "gan" else "yes"
+        if cfg.family == "gan":
+            ckpt = "-"
+        else:
+            epoch = restorable_epoch(root, name)
+            ckpt = f"epoch {epoch}" if epoch is not None else "-"
         print(f"{name:24s} family={cfg.family:16s} model={cfg.model:16s} "
-              f"servable={servable}")
+              f"servable={servable:3s} ckpt={ckpt}")
 
 
 def _smoke(server, duration: float, n_threads: int) -> dict:
-    """Closed-loop synthetic clients through the batcher; SIGTERM drains
-    early and still exits 0 (the production drain contract, minus HTTP)."""
+    """Closed-loop synthetic clients round-robined over the fleet's
+    models; SIGTERM drains early and still exits 0 (the production drain
+    contract, minus HTTP). Pass requires EVERY served model to have
+    answered requests."""
     import numpy as np
 
     from .batcher import RequestRejected
 
-    eng = server.engine
+    models = list(server.fleet)
     stop = threading.Event()
     errors: list = []
 
     def client(i: int) -> None:
+        sm = models[i % len(models)]   # round robin: all models get load
         rs = np.random.RandomState(i)
-        n = 1 + i % min(4, eng.max_batch)  # mixed sizes: exercise buckets
-        x = rs.randn(n, *eng.example_shape).astype(eng.input_dtype)
+        n = 1 + i % min(4, sm.engine.max_batch)  # mixed sizes: buckets
+        x = rs.randn(n, *sm.engine.example_shape).astype(
+            sm.engine.input_dtype)
         while not stop.is_set():
             try:
-                server.batcher.submit(x).result(timeout=120)
+                sm.batcher.submit(x).result(timeout=120)
             except RequestRejected:
                 return  # drain/overload reached this client — done
             except Exception as e:  # noqa: BLE001 — smoke must report
@@ -118,9 +172,11 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
     with GracefulShutdown(on_signal=stop.set,
                           what="finishing in-flight batches, rejecting new "
                                "work, then exiting 0") as gs:
+        server.reloader.start()
         threads = [threading.Thread(target=client, args=(i,), daemon=True)
-                   for i in range(n_threads)]
-        print(f"[serve:{eng.name}] ready: synthetic load x{n_threads} for "
+                   for i in range(max(n_threads, len(models)))]
+        print(f"[serve:{server.engine.name}] ready: synthetic load "
+              f"x{len(threads)} over {server.fleet.names()} for "
               f"{duration:g}s (SIGTERM drains early)", flush=True)
         for t in threads:
             t.start()
@@ -131,16 +187,27 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
         for t in threads:
             t.join(timeout=60)
         snap = server.drain()
-    ok = not errors and snap.get("requests", 0) > 0
+    per_model = server.fleet.snapshots()
+    requests_total = sum(s.get("requests", 0) for s in per_model.values())
+    starved = [n for n, s in per_model.items() if s.get("requests", 0) == 0]
+    ok = not errors and snap.get("requests", 0) > 0 and not starved
     print(json.dumps({
         "serve_smoke": "pass" if ok else "fail",
-        "model": eng.name,
-        "buckets": list(eng.buckets),
+        "model": server.engine.name,
+        "models": {n: {"requests": s.get("requests", 0.0),
+                       "weights_epoch": s["weights"]["checkpoint_epoch"],
+                       "reloads": server.fleet.get(n).describe()["reload"]
+                                  ["reloads"]}
+                   for n, s in per_model.items()},
+        "requests_total": round(float(requests_total), 1),
+        "buckets": list(server.engine.buckets),
         **{k: round(float(v), 4) for k, v in snap.items()},
     }), flush=True)
     if not ok:
-        raise SystemExit(f"serve smoke failed: {errors[:1]!r}" if errors
-                         else "serve smoke failed: no requests completed")
+        detail = (f"errors: {errors[:1]!r}" if errors
+                  else f"models with zero requests: {starved}" if starved
+                  else "no requests completed")
+        raise SystemExit(f"serve smoke failed: {detail}")
     return snap
 
 
@@ -148,15 +215,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.list_models:
-        _list_models()
+        _list_models(args.runs_root)
         return 0
     if not args.model:
         parser.error("-m/--model is required (see --list-models)")
+    names = [s.strip() for s in args.model.split(",") if s.strip()]
+    if len(set(names)) != len(names):
+        parser.error(f"duplicate model names in -m {args.model!r}")
+    if len(names) > 1 and args.workdir:
+        parser.error("--workdir is single-model; a fleet resolves "
+                     "per-model workdirs under --runs-root")
+    if len(names) > 1 and args.checkpoint:
+        parser.error("-c/--checkpoint is single-model; a fleet serves each "
+                     "model's latest verified checkpoint")
 
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
 
     from .engine import PredictEngine
+    from .fleet import ModelFleet
     from .server import InferenceServer
 
     try:
@@ -164,15 +241,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError:
         raise SystemExit(f"--buckets must be comma-separated ints, got "
                          f"{args.buckets!r}")
-    engine = PredictEngine.from_config(
-        args.model, workdir=args.workdir, checkpoint=args.checkpoint,
-        image_size=args.image_size, buckets=buckets,
-        max_batch=args.max_batch, verify=not args.no_verify)
-    engine.warmup()
+
+    fleet = ModelFleet()
+    for name in names:
+        workdir = args.workdir
+        if workdir is None and args.runs_root:
+            candidate = os.path.join(args.runs_root, name)
+            if restorable_epoch(args.runs_root, name) is not None:
+                workdir = candidate
+            else:
+                print(f"[serve:{name}] WARNING: nothing restorable under "
+                      f"{candidate!r} — serving RANDOM weights (hot reload "
+                      f"stays armed for when training commits there)",
+                      flush=True)
+                workdir = (candidate if os.path.isdir(candidate)
+                           else None)
+        engine = PredictEngine.from_config(
+            name, workdir=workdir, checkpoint=args.checkpoint,
+            image_size=args.image_size, buckets=buckets,
+            max_batch=args.max_batch, verify=not args.no_verify)
+        engine.warmup()
+        fleet.add(engine, workdir=workdir, max_batch=args.max_batch,
+                  max_delay_ms=args.max_delay_ms,
+                  max_queue_examples=args.max_queue)
     server = InferenceServer(
-        engine, max_delay_ms=args.max_delay_ms,
-        max_queue_examples=args.max_queue, workdir=args.workdir,
-        flush_every_s=args.flush_every)
+        fleet=fleet, flush_every_s=args.flush_every,
+        reload_every_s=args.reload_every,
+        log_dir=args.workdir or args.runs_root)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

@@ -39,6 +39,78 @@ import numpy as np
 from ..core.steps import _normalize_input
 
 
+def load_checkpoint_weights(name: str, workdir: str, *,
+                            checkpoint=None, image_size: Optional[int] = None,
+                            verify: bool = True, verbose: bool = True):
+    """Restore a registered config's SERVING weights from a training
+    workdir: the checkpoint is restored through the config's own trainer
+    family, EMA weights win when present (exactly the weights validation
+    scored, `Trainer.eval_state`), and `verify=True` restores in STRICT
+    integrity mode — a checkpoint whose manifest does not verify raises
+    CheckpointCorruptionError instead of returning silently corrupt
+    weights.
+
+    Returns `(apply_fn, variables, provenance, cfg)` where `variables` is
+    the host-side `{params[, batch_stats]}` dict an engine dispatches with
+    and `provenance` is the `{weights, checkpoint_epoch, verified,
+    manifest_sha256}` record /healthz reports. Shared by
+    `PredictEngine.from_config` (startup) and `reload.WeightReloader`
+    (hot swap) so the two paths can never verify differently."""
+    from ..configs import get_config, trainer_class_for_config
+    cfg = get_config(name)
+    if cfg.family == "gan":
+        raise ValueError(
+            f"config {name!r} is adversarial — serve a generator via "
+            f"tools/export.py instead (no single logits apply fn)")
+    image_size = image_size or cfg.data.image_size
+    sample_shape = (image_size, image_size, cfg.data.channels)
+    trainer = trainer_class_for_config(name)(cfg, workdir=workdir)
+    try:
+        trainer.init_state(sample_shape)
+        got = trainer.resume(
+            None if checkpoint in (None, "latest") else int(checkpoint),
+            verify="strict" if verify else "off")
+        if got is None and verbose:
+            print(f"[serve:{cfg.name}] WARNING: nothing restorable "
+                  f"in {workdir!r} — serving RANDOM weights",
+                  flush=True)
+        info = trainer.ckpt.last_restore_info or {}
+        provenance = {
+            "weights": ("checkpoint" if got is not None
+                        else "random-init"),
+            "checkpoint_epoch": got,
+            "verified": bool(info.get("verified", False)),
+            "manifest_sha256": info.get("manifest_sha256"),
+        }
+        if (got is not None and not provenance["verified"]
+                and verbose):
+            print(f"[serve:{cfg.name}] WARNING: serving UNVERIFIED "
+                  f"weights (epoch {got}: "
+                  f"{'legacy checkpoint without a manifest' if info.get('legacy') else 'verification off'})",
+                  flush=True)
+        st = trainer.eval_state()
+        apply_fn = st.apply_fn
+        params = jax.device_get(st.params)
+        batch_stats = jax.device_get(st.batch_stats)
+    finally:
+        trainer.close()
+    variables = {"params": params}
+    if jax.tree_util.tree_leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+    return apply_fn, variables, provenance, cfg
+
+
+def weight_signature(variables):
+    """(treedef, [(shape, dtype), ...]) of a variables pytree — the
+    compiled-executable compatibility key hot reload checks before a swap:
+    equal signatures mean the AOT bucket programs run the new weights
+    as-is (zero recompiles); anything else needs a new engine."""
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    return treedef, [(tuple(np.shape(leaf)),
+                      str(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+                     for leaf in leaves]
+
+
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= n (buckets ascending). Raises past the largest
     bucket — predict() chunks oversize batches before calling this, and the
@@ -144,7 +216,7 @@ class PredictEngine:
         provenance). The resulting provenance — checkpoint epoch, manifest
         hash, verified flag — lands on `engine.provenance` and the
         server's /healthz and /stats."""
-        from ..configs import get_config, trainer_class_for_config
+        from ..configs import get_config
         cfg = get_config(name)
         if cfg.family == "gan":
             raise ValueError(
@@ -155,37 +227,9 @@ class PredictEngine:
         compute_dtype = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
         provenance = None
         if workdir:
-            trainer = trainer_class_for_config(name)(cfg, workdir=workdir)
-            try:
-                trainer.init_state(sample_shape)
-                got = trainer.resume(
-                    None if checkpoint in (None, "latest")
-                    else int(checkpoint),
-                    verify="strict" if verify else "off")
-                if got is None and verbose:
-                    print(f"[serve:{cfg.name}] WARNING: nothing restorable "
-                          f"in {workdir!r} — serving RANDOM weights",
-                          flush=True)
-                info = trainer.ckpt.last_restore_info or {}
-                provenance = {
-                    "weights": ("checkpoint" if got is not None
-                                else "random-init"),
-                    "checkpoint_epoch": got,
-                    "verified": bool(info.get("verified", False)),
-                    "manifest_sha256": info.get("manifest_sha256"),
-                }
-                if (got is not None and not provenance["verified"]
-                        and verbose):
-                    print(f"[serve:{cfg.name}] WARNING: serving UNVERIFIED "
-                          f"weights (epoch {got}: "
-                          f"{'legacy checkpoint without a manifest' if info.get('legacy') else 'verification off'})",
-                          flush=True)
-                st = trainer.eval_state()
-                apply_fn = st.apply_fn
-                params = jax.device_get(st.params)
-                batch_stats = jax.device_get(st.batch_stats)
-            finally:
-                trainer.close()
+            apply_fn, variables, provenance, cfg = load_checkpoint_weights(
+                name, workdir, checkpoint=checkpoint, image_size=image_size,
+                verify=verify, verbose=verbose)
         else:
             from ..core.train_state import init_model
             from ..core.trainer import build_model_from_config
@@ -194,9 +238,9 @@ class PredictEngine:
                 model, jax.random.PRNGKey(cfg.seed),
                 jnp.zeros((2, *sample_shape), jnp.float32))
             apply_fn = model.apply
-        variables = {"params": params}
-        if jax.tree_util.tree_leaves(batch_stats):
-            variables["batch_stats"] = batch_stats
+            variables = {"params": params}
+            if jax.tree_util.tree_leaves(batch_stats):
+                variables["batch_stats"] = batch_stats
         input_norm = ((cfg.data.mean, cfg.data.std)
                       if cfg.data.normalize_on_device else None)
         return cls(apply_fn, variables, example_shape=sample_shape,
@@ -240,6 +284,35 @@ class PredictEngine:
         x = np.zeros((self.max_batch, *self.example_shape), self.input_dtype)
         for b in self.buckets:
             jax.block_until_ready(self._compiled[b](self._variables, x[:b]))
+
+    # -- hot weight reload -------------------------------------------------
+
+    def swap_variables(self, variables, provenance: Optional[dict] = None
+                       ) -> None:
+        """Atomically swap the live weights — the hot-reload primitive
+        (serve/reload.py). The new variables must match the current tree
+        structure and per-leaf shapes/dtypes EXACTLY: the AOT bucket
+        executables were compiled against those avals, so an equal
+        signature means they run the new weights with zero recompiles,
+        and anything else is refused (a changed architecture needs a new
+        engine, not a swap). Staging (device_put + block) happens BEFORE
+        the swap, off the request path; the swap itself is one reference
+        assignment, so in-flight dispatches — which captured the old
+        reference on entry to `_dispatch` — complete against the old
+        weights and every later dispatch sees the new ones."""
+        new_sig = weight_signature(variables)
+        old_sig = weight_signature(self._variables)
+        if new_sig != old_sig:
+            raise ValueError(
+                f"refusing hot swap for {self.name!r}: new weights do not "
+                f"match the compiled signature (tree structure or leaf "
+                f"shapes/dtypes differ) — the AOT bucket programs would "
+                f"need a recompile; build a fresh engine instead")
+        staged = jax.device_put(variables, self._device)
+        jax.block_until_ready(staged)   # fully resident before going live
+        self._variables = staged
+        if provenance is not None:
+            self.provenance = dict(provenance)
 
     # -- prediction --------------------------------------------------------
 

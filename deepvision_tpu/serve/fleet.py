@@ -1,0 +1,174 @@
+"""Multi-model serving fleet: many engines, one process, one front door.
+
+PR 3's stack serves exactly one model per process; the reference zoo
+registers 13 model families, and a real deployment serves several at once.
+`ModelFleet` is the registry-shaped layer between the HTTP front-end and
+the engines: each served model gets its OWN `DynamicBatcher` and
+`ServingMetrics` (coalescing only ever combines same-model requests — the
+compiled programs are per-model, so cross-model batching is meaningless),
+while the device is shared naturally because every batcher dispatches
+through the same JAX runtime and dispatches serialize there anyway.
+
+Routing contract (served by serve/server.py):
+
+    POST /predict            -> the DEFAULT model (first added) — the PR 3
+                                single-model surface, unchanged
+    POST /predict/<name>     -> that model; unknown names get 404 with the
+                                served-model list in the body
+    GET  /stats[/<name>]     -> per-model ServingMetrics + weight provenance
+    GET  /healthz            -> aggregate: per-model provenance (epoch,
+                                manifest hash, verified) so a fleet can be
+                                audited for weight skew with one request
+
+Hot weight reload (serve/reload.py) operates on `ServedModel` entries that
+carry a `workdir`: the reloader polls the run dir, verifies candidates
+against the PR 4 integrity manifest, and swaps verified weights into the
+live engine via `PredictEngine.swap_variables` — per-model `reload_stats`
+surface the outcome on /healthz.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional
+
+from .batcher import DynamicBatcher
+from .engine import PredictEngine
+from .metrics import ServingMetrics
+
+
+class UnknownModel(KeyError):
+    """Routed model name is not served; carries the served list so the
+    HTTP 404 body can say what IS available instead of being opaque."""
+
+    def __init__(self, name: str, served: List[str]):
+        super().__init__(name)
+        self.name = name
+        self.served = list(served)
+
+    def __str__(self) -> str:
+        return (f"unknown model {self.name!r} — served models: "
+                f"{', '.join(self.served)}")
+
+
+class ServedModel:
+    """One model's serving unit: engine + its own batcher + its own
+    metrics, plus the run dir hot reload watches (None = static weights).
+    `reload_stats` is mutated by the WeightReloader and read by /healthz —
+    guarded by `reload_lock` since poller and handler threads race."""
+
+    def __init__(self, engine: PredictEngine, batcher: DynamicBatcher,
+                 metrics: ServingMetrics, workdir: Optional[str] = None):
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        self.workdir = workdir
+        self.reload_lock = threading.Lock()
+        self.reload_stats: Dict[str, float] = {
+            "reloads": 0, "refused_corrupt": 0, "refused_incompatible": 0}
+
+    @property
+    def name(self) -> str:
+        return self.engine.name
+
+    def describe(self) -> dict:
+        """The /healthz per-model record: serving shape + weight
+        provenance + reload outcomes."""
+        with self.reload_lock:
+            reload_stats = dict(self.reload_stats)
+        return {
+            "buckets": list(self.engine.buckets),
+            "max_batch": self.batcher.max_batch,
+            "queue_depth": self.batcher.queue_depth,
+            "weights": self.engine.provenance,
+            "hot_reload": bool(self.workdir),
+            "reload": reload_stats,
+        }
+
+    def snapshot(self) -> dict:
+        """The /stats per-model record."""
+        return {
+            **self.metrics.snapshot(queue_depth=self.batcher.queue_depth),
+            "weights": self.engine.provenance,
+        }
+
+
+class ModelFleet:
+    """Ordered name -> ServedModel map. The first model added is the
+    default (`POST /predict` without a name), mirroring how the PR 3
+    single-model server behaved — a one-model fleet is byte-for-byte that
+    server."""
+
+    def __init__(self):
+        self._models: Dict[str, ServedModel] = {}  # insertion-ordered
+
+    def add(self, engine: PredictEngine, *,
+            workdir: Optional[str] = None,
+            max_batch: Optional[int] = None,
+            max_delay_ms: float = 5.0,
+            max_queue_examples: int = 1024) -> ServedModel:
+        """Register an engine under its own name with a fresh batcher and
+        metrics accumulator. Per-model backpressure: one model being
+        hammered sheds ITS requests (429) without starving the others'
+        queues."""
+        if engine.name in self._models:
+            raise ValueError(f"model {engine.name!r} already served — one "
+                             f"entry per registry name")
+        metrics = ServingMetrics()
+        batcher = DynamicBatcher(
+            engine, max_batch=max_batch, max_delay_ms=max_delay_ms,
+            max_queue_examples=max_queue_examples, metrics=metrics)
+        sm = ServedModel(engine, batcher, metrics, workdir=workdir)
+        self._models[engine.name] = sm
+        return sm
+
+    # -- lookup ------------------------------------------------------------
+
+    @property
+    def default(self) -> ServedModel:
+        if not self._models:
+            raise RuntimeError("empty fleet: add at least one model")
+        return next(iter(self._models.values()))
+
+    def get(self, name: Optional[str] = None) -> ServedModel:
+        """Resolve a routed name (None/'' = default). Raises UnknownModel
+        carrying the served list — the 404 body contract."""
+        if not name:
+            return self.default
+        try:
+            return self._models[name]
+        except KeyError:
+            raise UnknownModel(name, self.names()) from None
+
+    def names(self) -> List[str]:
+        return list(self._models)
+
+    def __len__(self) -> int:
+        return len(self._models)
+
+    def __iter__(self) -> Iterator[ServedModel]:
+        return iter(self._models.values())
+
+    # -- aggregates --------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(sm.batcher.queue_depth for sm in self)
+
+    @property
+    def draining(self) -> bool:
+        return any(sm.batcher.draining for sm in self)
+
+    def describe(self) -> Dict[str, dict]:
+        return {sm.name: sm.describe() for sm in self}
+
+    def snapshots(self) -> Dict[str, dict]:
+        return {sm.name: sm.snapshot() for sm in self}
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Drain every batcher (reject new work, finish accepted, stop the
+        dispatcher threads). True once ALL dispatchers exited."""
+        ok = True
+        for sm in self:
+            ok = sm.batcher.drain(timeout) and ok
+        return ok

@@ -46,6 +46,7 @@ class ServingMetrics:
         self._batches = 0
         self._rows = 0          # device rows dispatched, padding included
         self._dispatch_s = 0.0
+        self._shed = 0          # requests rejected at the door (Overloaded)
 
     def observe_batch(self, *, n_real: int, bucket: int, dispatch_s: float,
                       request_latencies_s: Sequence[float]) -> None:
@@ -56,6 +57,15 @@ class ServingMetrics:
             self._rows += bucket
             self._dispatch_s += dispatch_s
             self._lat.extend(request_latencies_s)
+
+    def observe_shed(self, n_requests: int = 1) -> None:
+        """Count a request rejected by backpressure (`Overloaded`, HTTP
+        429). Shed rate = shed / (requests + shed) is the third number of
+        the load contract next to sustained QPS and p99-under-load
+        (bench_serve.py --load): a server meeting its p99 by shedding half
+        its offered traffic is not meeting anything."""
+        with self._lock:
+            self._shed += n_requests
 
     def snapshot(self, queue_depth: Optional[int] = None,
                  reset: bool = False) -> dict:
@@ -76,6 +86,7 @@ class ServingMetrics:
                                   if self._rows else 0.0),
                 "mean_dispatch_ms": (1000.0 * self._dispatch_s / self._batches
                                      if self._batches else 0.0),
+                "shed_requests": float(self._shed),
             }
             if self._lat:
                 lat_ms = np.asarray(self._lat, np.float64) * 1000.0

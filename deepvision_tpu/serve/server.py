@@ -1,23 +1,35 @@
-"""Inference server: engine + batcher + metrics + graceful lifecycle.
+"""Inference server: fleet + batchers + metrics + reload + lifecycle.
 
 `InferenceServer.serve()` runs a stdlib `ThreadingHTTPServer` (no new
 dependencies — each connection gets a thread, and concurrent handler
-threads are exactly the concurrency the micro-batcher coalesces):
+threads are exactly the concurrency the micro-batchers coalesce) over a
+`ModelFleet` (serve/fleet.py) — one model or many behind one process:
 
-    POST /predict   {"instances": [[...HWC floats...], ...]}
-                    -> 200 {"predictions": [...]}   (f32 model outputs)
-                    -> 400 bad shape/body, 429 overloaded (backpressure),
-                       503 draining
-    GET  /healthz   -> 200 {"status": "ok"|"draining", ...}
-    GET  /stats     -> 200 cumulative ServingMetrics snapshot + queue depth
+    POST /predict           {"instances": [[...HWC floats...], ...]}
+                            -> 200 {"predictions": [...]} from the DEFAULT
+                               model (f32 outputs; the PR 3 surface)
+    POST /predict/<model>   -> same, routed by registry name; an unknown
+                               name gets 404 with "served_models" in the
+                               body (never an opaque error)
+                            -> 400 bad shape/body, 429 overloaded
+                               (per-model backpressure), 503 draining
+    GET  /healthz           -> 200 aggregate status + per-model weight
+                               provenance (epoch, manifest hash, verified)
+                               and reload outcomes — diff across replicas
+                               to audit a fleet for weight skew
+    GET  /stats[/<model>]   -> 200 per-model ServingMetrics snapshot(s)
+
+Hot weight reload (serve/reload.py): models constructed with a workdir are
+watched for new integrity-verified epochs, which swap in atomically with
+zero downtime and zero recompiles; `reload_every_s > 0` arms the poller.
 
 Graceful drain reuses the resilience SIGTERM/SIGINT contract
 (core/resilience.GracefulShutdown — same handler the trainer installs):
 the first signal stops the accept path (new submits get 503), every
-request already accepted finishes and is answered, metrics flush, and the
-process exits 0 — a preempted serving replica under a grace window answers
-everything it promised and leaves cleanly. A second signal aborts
-immediately, same as training.
+request already accepted finishes and is answered, the reloader stops,
+metrics flush, and the process exits 0 — a preempted serving replica under
+a grace window answers everything it promised and leaves cleanly. A second
+signal aborts immediately, same as training.
 """
 
 from __future__ import annotations
@@ -32,9 +44,10 @@ import numpy as np
 
 from ..core.metrics import MetricsLogger
 from ..core.resilience import GracefulShutdown
-from .batcher import Draining, DynamicBatcher, Overloaded
+from .batcher import Draining, Overloaded
 from .engine import PredictEngine
-from .metrics import ServingMetrics
+from .fleet import ModelFleet, UnknownModel
+from .reload import WeightReloader
 
 DRAIN_WHAT = ("finishing in-flight batches, rejecting new work, "
               "then exiting 0")
@@ -42,22 +55,39 @@ DRAIN_WHAT = ("finishing in-flight batches, rejecting new work, "
 
 class InferenceServer:
     """Owns the serving stack's lifecycle; `serve()` blocks until a signal
-    (or `stop()`), drains, and returns the final metrics snapshot."""
+    (or `stop()`), drains, and returns the final metrics snapshot.
 
-    def __init__(self, engine: PredictEngine, *,
+    Construct with a single `engine` (the PR 3 surface — a one-model fleet
+    is built around it) or a pre-built multi-model `fleet`; `engine`,
+    `batcher`, and `metrics` always alias the DEFAULT model so existing
+    single-model callers read the same attributes they always did."""
+
+    def __init__(self, engine: Optional[PredictEngine] = None, *,
+                 fleet: Optional[ModelFleet] = None,
                  max_batch: Optional[int] = None,
                  max_delay_ms: float = 5.0,
                  max_queue_examples: int = 1024,
                  workdir: Optional[str] = None,
-                 flush_every_s: float = 10.0):
-        self.engine = engine
-        self.metrics = ServingMetrics()
-        self.batcher = DynamicBatcher(
-            engine, max_batch=max_batch, max_delay_ms=max_delay_ms,
-            max_queue_examples=max_queue_examples, metrics=self.metrics)
+                 flush_every_s: float = 10.0,
+                 reload_every_s: float = 0.0,
+                 log_dir: Optional[str] = None):
+        if (engine is None) == (fleet is None):
+            raise ValueError("pass exactly one of engine= or fleet=")
+        if fleet is None:
+            fleet = ModelFleet()
+            fleet.add(engine, workdir=workdir, max_batch=max_batch,
+                      max_delay_ms=max_delay_ms,
+                      max_queue_examples=max_queue_examples)
+        self.fleet = fleet
+        default = fleet.default
+        self.engine = default.engine
+        self.batcher = default.batcher
+        self.metrics = default.metrics
         # same stream as the trainer: JSONL + TB when a workdir is given,
         # console echo always (MetricsLogger is the one logging mechanism)
-        self.logger = MetricsLogger(workdir, name="serve")
+        self.logger = MetricsLogger(log_dir or workdir, name="serve")
+        self.reloader = WeightReloader(
+            fleet, poll_every_s=reload_every_s, logger=self.logger)
         self.flush_every_s = flush_every_s
         self._flush_step = 0
         self._wake = threading.Event()
@@ -68,12 +98,20 @@ class InferenceServer:
     # -- metrics -----------------------------------------------------------
 
     def flush_metrics(self, echo: bool = True, reset: bool = True) -> dict:
-        """Flush one per-interval snapshot to the metrics stream."""
+        """Flush one per-interval snapshot per model to the metrics stream;
+        returns the default model's (a one-model fleet keeps the PR 3
+        stream shape: bare `serve_` keys)."""
         self._flush_step += 1
-        snap = self.metrics.snapshot(queue_depth=self.batcher.queue_depth,
-                                     reset=reset)
-        self.logger.log(self._flush_step, snap, prefix="serve_", echo=echo)
-        return snap
+        single = len(self.fleet) == 1
+        out: dict = {}
+        for sm in self.fleet:
+            snap = sm.metrics.snapshot(queue_depth=sm.batcher.queue_depth,
+                                       reset=reset)
+            prefix = "serve_" if single else f"serve_{sm.name}_"
+            self.logger.log(self._flush_step, snap, prefix=prefix, echo=echo)
+            if sm is self.fleet.default:
+                out = snap
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -83,15 +121,18 @@ class InferenceServer:
         self._wake.set()
 
     def drain(self) -> dict:
-        """Reject new work, finish everything accepted, flush metrics."""
+        """Stop reloading, reject new work, finish everything accepted,
+        flush metrics."""
+        self.reloader.stop()
         print(f"[serve:{self.engine.name}] graceful drain: rejecting new "
-              f"work, finishing {self.batcher.queue_depth} queued examples",
-              flush=True)
-        self.batcher.drain()
+              f"work, finishing {self.fleet.queue_depth} queued examples "
+              f"across {len(self.fleet)} model(s)", flush=True)
+        self.fleet.drain()
         return self.flush_metrics(reset=False)
 
     def close(self) -> None:
-        self.batcher.drain()
+        self.reloader.stop()
+        self.fleet.drain()
         self.logger.close()
 
     def serve(self, port: int = 8700, host: str = "127.0.0.1") -> dict:
@@ -101,11 +142,13 @@ class InferenceServer:
                                        daemon=True, name="http-serve")
         with GracefulShutdown(on_signal=self._wake.set,
                               what=DRAIN_WHAT) as gs:
+            self.reloader.start()
             http_thread.start()
             self.ready.set()
             print(f"[serve:{self.engine.name}] listening on "
                   f"http://{host}:{self.bound_port} "
-                  f"buckets={list(self.engine.buckets)} "
+                  f"models={self.fleet.names()} "
+                  f"default={self.engine.name} "
                   f"max_delay_ms={self.batcher.max_delay * 1000:g}",
                   flush=True)
             while not (gs.requested or self._stop.is_set()):
@@ -139,32 +182,62 @@ def _make_handler(server: InferenceServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _resolve(self, root: str):
+            """Map `/<root>` or `/<root>/<model>` to a ServedModel; answers
+            the 404 (with the served-model list) itself and returns None
+            when the path doesn't resolve."""
+            name = None
+            if self.path != root:
+                if not self.path.startswith(root + "/"):
+                    return self._unknown_path()
+                name = self.path[len(root) + 1:]
+            try:
+                return server.fleet.get(name)
+            except UnknownModel as e:
+                self._json(404, {"error": str(e),
+                                 "served_models": e.served})
+                return None
+
+        def _unknown_path(self) -> None:
+            self._json(404, {"error": f"unknown path {self.path!r}",
+                             "served_models": server.fleet.names()})
+
         def do_GET(self):
             if self.path == "/healthz":
+                d = server.fleet.default
                 self._json(200, {
-                    "status": ("draining" if server.batcher.draining
+                    "status": ("draining" if server.fleet.draining
                                else "ok"),
-                    "model": server.engine.name,
-                    "buckets": list(server.engine.buckets),
-                    "max_batch": server.batcher.max_batch,
-                    # weight provenance (checkpoint epoch + integrity-
-                    # manifest hash + verified flag): diff it across
+                    # default-model fields first, exactly the PR 3 shape —
+                    # single-model probes keep working unchanged
+                    "model": d.name,
+                    "buckets": list(d.engine.buckets),
+                    "max_batch": d.batcher.max_batch,
+                    "weights": d.engine.provenance,
+                    # the fleet view: per-model weight provenance
+                    # (checkpoint epoch + integrity-manifest hash +
+                    # verified flag) and reload outcomes — diff across
                     # replicas to audit a fleet for weight skew
-                    "weights": server.engine.provenance,
+                    "served_models": server.fleet.names(),
+                    "models": server.fleet.describe(),
                 })
-            elif self.path == "/stats":
-                self._json(200, {
-                    **server.metrics.snapshot(
-                        queue_depth=server.batcher.queue_depth),
-                    "weights": server.engine.provenance,
-                })
+            elif self.path == "/stats" or self.path.startswith("/stats/"):
+                sm = self._resolve("/stats")
+                if sm is None:
+                    return
+                snap = sm.snapshot()
+                if self.path == "/stats":
+                    snap["models"] = server.fleet.snapshots()
+                self._json(200, snap)
             else:
-                self._json(404, {"error": f"unknown path {self.path!r}"})
+                self._unknown_path()
 
         def do_POST(self):
-            if self.path != "/predict":
-                return self._json(404, {"error": f"unknown path "
-                                                 f"{self.path!r}"})
+            sm = (self._resolve("/predict")
+                  if self.path.startswith("/predict") else
+                  self._unknown_path())
+            if sm is None:
+                return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(length) or b"{}")
@@ -174,7 +247,7 @@ def _make_handler(server: InferenceServer):
                     "error": f"body must be JSON {{'instances': "
                              f"[...]}}: {e}"})
             try:
-                fut = server.batcher.submit(x)
+                fut = sm.batcher.submit(x)
             except Overloaded as e:
                 return self._json(429, {"error": str(e)})
             except Draining as e:

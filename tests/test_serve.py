@@ -299,6 +299,14 @@ def test_http_server_roundtrip(engine):
                 urllib.request.Request(base + "/predict", data=b"{}"),
                 timeout=30)
         assert e.value.code == 400
+        # unknown-model route: 404 must NAME the served models, not be an
+        # opaque error (the fleet routing contract, single-model edition)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/predict/nosuch", data=b"{}"),
+                timeout=30)
+        assert e.value.code == 404
+        assert json.load(e.value)["served_models"] == ["lenet5"]
     finally:
         srv.stop()
         t.join(timeout=60)

@@ -1,0 +1,361 @@
+"""Serving fleet (serve/fleet.py + serve/reload.py) on the CPU backend.
+
+The contracts pinned here are the ones a multi-model deployment depends on:
+- routing: `POST /predict/<model>` reaches that model, bare `/predict` the
+  default, and an unknown name gets 404 WITH the served-model list;
+- per-model isolation: each model's batcher/metrics are its own;
+- hot weight reload under concurrent traffic: a newly committed,
+  integrity-verified epoch swaps in with ZERO failed requests, zero mixed
+  responses (every answer matches exactly one weight generation), zero
+  recompiles (the AOT bucket cache is reused), and /healthz provenance
+  advances;
+- a corrupt candidate (bitflip via DEEPVISION_FAULT_CKPT_CORRUPT, the PR 4
+  injector) is detected at the manifest, refused, logged to the
+  resilience metrics stream, and the old weights keep serving;
+- an architecture-changed candidate is refused as incompatible (a swap
+  must never force a recompile);
+- `--list-models` annotates what the runs root can actually serve.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config, trainer_class_for_config
+from deepvision_tpu.core.metrics import MetricsLogger
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet, UnknownModel
+from deepvision_tpu.serve.reload import WeightReloader
+from deepvision_tpu.serve.server import InferenceServer
+from deepvision_tpu.utils.faults import FaultInjector
+
+SAMPLE = (32, 32, 1)
+
+
+def _save_epoch(workdir, epoch, state, fault_env=None):
+    """Commit one checkpoint epoch the way training does (trainer-family
+    CheckpointManager: orbax commit, then the integrity manifest), with an
+    optional armed fault injector for post-commit corruption."""
+    trainer = trainer_class_for_config("lenet5")(get_config("lenet5"),
+                                                 workdir=workdir)
+    try:
+        trainer.init_state(SAMPLE)
+        if fault_env is not None:
+            trainer.ckpt.fault_injector = FaultInjector.from_env(fault_env)
+        trainer.ckpt.save(epoch, state if state is not None
+                          else trainer.state, {"best_metric": 0.0})
+        trainer.ckpt.flush()
+        return trainer.state
+    finally:
+        trainer.close()
+
+
+@pytest.fixture()
+def run_with_epoch1(tmp_path):
+    """A lenet5 run dir holding a committed, manifested epoch 1; returns
+    (workdir, state1) so later epochs can derive changed weights."""
+    workdir = str(tmp_path / "lenet5")
+    state1 = _save_epoch(workdir, 1, None)
+    return workdir, state1
+
+
+def _scaled(state, factor):
+    return state.replace(params=jax.tree_util.tree_map(
+        lambda a: a * factor, state.params))
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, *SAMPLE).astype(np.float32)
+
+
+# -- fleet routing ------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_model_fleet():
+    fleet = ModelFleet()
+    fleet.add(PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                        verbose=False), max_delay_ms=3.0)
+    fleet.add(PredictEngine.from_config("lenet5_digits", buckets=(1, 4),
+                                        verbose=False), max_delay_ms=3.0)
+    yield fleet
+    fleet.drain(timeout=30)
+
+
+def test_fleet_registry_contract(two_model_fleet):
+    fleet = two_model_fleet
+    assert fleet.names() == ["lenet5", "lenet5_digits"]
+    assert fleet.default.name == "lenet5"          # first added wins
+    assert fleet.get(None).name == "lenet5"
+    assert fleet.get("lenet5_digits").name == "lenet5_digits"
+    with pytest.raises(UnknownModel) as e:
+        fleet.get("resnet50")
+    assert e.value.served == ["lenet5", "lenet5_digits"]
+    with pytest.raises(ValueError, match="already served"):
+        fleet.add(PredictEngine.from_config("lenet5", buckets=(1,),
+                                            verbose=False))
+
+
+def test_fleet_http_routing(two_model_fleet):
+    """Named routes hit the named model; each model's metrics count only
+    its own traffic; unknown names 404 with the served list (the satellite
+    contract — never an opaque error)."""
+    srv = InferenceServer(fleet=two_model_fleet, flush_every_s=60.0)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+
+        def post(path, x):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps({"instances": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.load(urllib.request.urlopen(req, timeout=60))
+
+        x = _imgs(2, seed=1)
+        for path, name in [("/predict", "lenet5"),
+                           ("/predict/lenet5", "lenet5"),
+                           ("/predict/lenet5_digits", "lenet5_digits")]:
+            out = np.asarray(post(path, x)["predictions"], np.float32)
+            ref = two_model_fleet.get(name).engine.reference(x)
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+        stats = json.load(urllib.request.urlopen(
+            base + "/stats/lenet5_digits", timeout=30))
+        assert stats["requests"] >= 1           # its own traffic only
+        assert stats["weights"]["weights"] == "random-init"
+        health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                  timeout=30))
+        assert health["served_models"] == ["lenet5", "lenet5_digits"]
+        assert set(health["models"]) == {"lenet5", "lenet5_digits"}
+        assert "weights" in health["models"]["lenet5_digits"]
+
+        # unknown model name / unknown path: 404 naming what IS served
+        for path, method in [("/predict/nosuch", "POST"),
+                             ("/stats/nosuch", "GET"),
+                             ("/nosuch", "GET")]:
+            req = urllib.request.Request(
+                base + path, data=b"{}" if method == "POST" else None)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=30)
+            assert e.value.code == 404
+            body = json.load(e.value)
+            assert body["served_models"] == ["lenet5", "lenet5_digits"]
+    finally:
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+    assert not t.is_alive()
+
+
+# -- hot reload ---------------------------------------------------------------
+
+def test_hot_reload_under_concurrent_traffic(run_with_epoch1):
+    """Clients hammer /predict/lenet5 while epoch 2 lands and hot-swaps:
+    zero failed requests, every response matches exactly one weight
+    generation (old or new — never a mixture), /healthz provenance
+    advances to epoch 2, and the AOT bucket cache is reused (zero
+    recompiles)."""
+    workdir, state1 = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    assert engine.provenance["checkpoint_epoch"] == 1
+    fleet = ModelFleet()
+    fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0,
+                          reload_every_s=0.05)
+    x = _imgs(1, seed=7)
+    ref_old = engine.reference(x)
+    n_programs = len(engine.compile_log)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    stop = threading.Event()
+    results, failures = [], []
+
+    def client():
+        req_body = json.dumps({"instances": x.tolist()}).encode()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(base + "/predict/lenet5",
+                                             data=req_body)
+                out = json.load(urllib.request.urlopen(req, timeout=60))
+                results.append(np.asarray(out["predictions"], np.float32))
+            except Exception as e:  # noqa: BLE001 — every failure counts
+                failures.append(e)
+                return
+
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for c in clients:
+            c.start()
+        time.sleep(0.3)                       # traffic against epoch 1
+        _save_epoch(workdir, 2, _scaled(state1, 1.05))
+        deadline = time.monotonic() + 120
+        epoch = None
+        while time.monotonic() < deadline:    # provenance must advance
+            health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                      timeout=30))
+            epoch = (health["models"]["lenet5"]["weights"]
+                     ["checkpoint_epoch"])
+            if epoch == 2:
+                break
+            time.sleep(0.05)
+        assert epoch == 2, f"/healthz never advanced past {epoch}"
+        assert health["models"]["lenet5"]["weights"]["verified"] is True
+        assert health["models"]["lenet5"]["reload"]["reloads"] == 1
+        time.sleep(0.3)                       # traffic against epoch 2
+    finally:
+        stop.set()
+        for c in clients:
+            c.join(timeout=60)
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+
+    assert not failures, f"requests failed across the swap: {failures[:3]}"
+    assert len(engine.compile_log) == n_programs  # AOT cache reused
+    assert engine._jitted._cache_size() == 0      # no silent jit fallback
+    ref_new = engine.reference(x)
+    assert not np.allclose(ref_old, ref_new)      # the swap changed weights
+    n_old = n_new = 0
+    for out in results:
+        if np.allclose(out, ref_old, rtol=1e-4, atol=1e-5):
+            n_old += 1
+        elif np.allclose(out, ref_new, rtol=1e-4, atol=1e-5):
+            n_new += 1
+        else:
+            pytest.fail("a response matches NEITHER weight generation — "
+                        "mixed/torn weights reached a request")
+    assert n_old > 0 and n_new > 0, (n_old, n_new)  # both sides observed
+
+
+def test_corrupt_candidate_refused_and_logged(run_with_epoch1, tmp_path):
+    """A bitflipped candidate (DEEPVISION_FAULT_CKPT_CORRUPT, armed on the
+    writer) must be detected at the manifest, refused WITHOUT being
+    deserialized into the engine, logged to the resilience metrics stream,
+    and refused from cache on later sweeps; the old weights keep serving
+    byte-identical outputs."""
+    workdir, state1 = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    fleet = ModelFleet()
+    sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    logger = MetricsLogger(str(tmp_path / "logs"), name="serve")
+    reloader = WeightReloader(fleet, poll_every_s=0, logger=logger)
+    x = _imgs(2, seed=3)
+    ref_old = engine.predict(x)
+    try:
+        _save_epoch(workdir, 2, _scaled(state1, 1.05),
+                    fault_env={"DEEPVISION_FAULT_CKPT_CORRUPT": "2:bitflip"})
+        assert reloader.check_once() == 0
+        assert engine.provenance["checkpoint_epoch"] == 1   # not swapped
+        assert sm.reload_stats["refused_corrupt"] == 1
+        np.testing.assert_array_equal(engine.predict(x), ref_old)
+        # the refusal reached the resilience forensics stream
+        assert logger.history["resilience_reload_refused_corrupt"][
+            "value"] == [1.0]
+        assert logger.history["resilience_reload_refused_epoch"][
+            "value"] == [2.0]
+        # cached refusal: the next sweep neither re-verifies nor re-logs
+        assert reloader.check_once() == 0
+        assert sm.reload_stats["refused_corrupt"] == 1
+        # a GOOD epoch 3 still swaps in past the bad 2
+        _save_epoch(workdir, 3, _scaled(state1, 1.1))
+        assert reloader.check_once() == 1
+        assert engine.provenance["checkpoint_epoch"] == 3
+        assert engine.provenance["verified"] is True
+    finally:
+        fleet.drain(timeout=30)
+        logger.close()
+
+
+def test_incompatible_candidate_refused(two_model_fleet):
+    """swap_variables refuses weights whose signature differs from the
+    compiled one — shape, dtype, or tree-structure drift means a recompile,
+    which a hot swap must never trigger."""
+    engine = two_model_fleet.get("lenet5").engine
+    good = jax.device_get(engine._variables)
+    bad_shape = jax.tree_util.tree_map(
+        lambda a: np.zeros((2,) + a.shape, a.dtype), good)
+    with pytest.raises(ValueError, match="recompile"):
+        engine.swap_variables(bad_shape)
+    bad_tree = dict(good)
+    bad_tree["extra_collection"] = {"w": np.zeros((1,), np.float32)}
+    with pytest.raises(ValueError, match="recompile"):
+        engine.swap_variables(bad_tree)
+    bad_dtype = jax.tree_util.tree_map(
+        lambda a: np.asarray(a, np.float64), good)
+    with pytest.raises(ValueError, match="recompile"):
+        engine.swap_variables(bad_dtype)
+    # and the matching signature DOES swap (identity round-trip)
+    engine.swap_variables(good)
+
+
+def test_missing_manifest_candidate_waits(run_with_epoch1):
+    """An epoch committed without its manifest yet (the finalizer commits
+    it AFTER orbax) is 'save in flight', not corruption: the reloader
+    waits instead of refusing, and swaps once the manifest lands."""
+    from deepvision_tpu.core import integrity
+
+    workdir, state1 = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    fleet = ModelFleet()
+    sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    reloader = WeightReloader(fleet, poll_every_s=0)
+    try:
+        _save_epoch(workdir, 2, _scaled(state1, 1.05))
+        step_dir = os.path.join(workdir, "ckpt", "2")
+        manifest = integrity.manifest_path(step_dir)
+        hidden = manifest + ".inflight"
+        os.rename(manifest, hidden)           # simulate mid-finalize
+        assert reloader.check_once() == 0
+        assert engine.provenance["checkpoint_epoch"] == 1
+        assert sm.reload_stats["refused_corrupt"] == 0  # NOT a refusal
+        os.rename(hidden, manifest)           # finalizer catches up
+        assert reloader.check_once() == 1
+        assert engine.provenance["checkpoint_epoch"] == 2
+    finally:
+        fleet.drain(timeout=30)
+
+
+# -- CLI surfaces -------------------------------------------------------------
+
+def test_list_models_annotates_restorable_checkpoints(tmp_path, capsys):
+    """`--list-models` says which registry entries have a restorable
+    checkpoint under the runs root — the operator's what-can-this-fleet-
+    actually-serve view."""
+    from deepvision_tpu.serve.cli import main
+
+    (tmp_path / "lenet5" / "ckpt" / "7").mkdir(parents=True)
+    (tmp_path / "resnet50" / "ckpt").mkdir(parents=True)  # no epochs
+    assert main(["--list-models", "--runs-root", str(tmp_path)]) == 0
+    lines = {ln.split()[0]: ln for ln in
+             capsys.readouterr().out.strip().splitlines()}
+    assert "ckpt=epoch 7" in lines["lenet5"]
+    assert "ckpt=-" in lines["resnet50"]
+    assert "servable=-" in lines["dcgan"]        # gan: not servable at all
+    assert len(lines) >= 13                      # the whole registry listed
+
+
+def test_fleet_cli_rejects_ambiguous_flags():
+    from deepvision_tpu.serve.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["-m", "lenet5,lenet5_digits", "--workdir", "/tmp/x"])
+    with pytest.raises(SystemExit):
+        main(["-m", "lenet5,lenet5_digits", "-c", "3"])
+    with pytest.raises(SystemExit):
+        main(["-m", "lenet5,lenet5"])
