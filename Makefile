@@ -11,14 +11,29 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
         bench-input dryrun smoke serve-smoke serve-fleet-smoke preflight \
-        preflight-record lint lint-changed fsck
+        preflight-record lint lint-changed fsck check check-update-cost
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
 	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
 	## project — framework, tools, tests, per-model entrypoints AND the
 	## repo-root scripts (bench*.py, __graft_entry__.py); exit 1 on any
-	## finding
-	$(PY) -m deepvision_tpu.lint
+	## finding. Results are cached under .cache/jaxlint/ keyed by file
+	## mtimes (an unchanged tree relints in ~0.1s); NO_CACHE=1 bypasses
+	$(PY) -m deepvision_tpu.lint $(if $(NO_CACHE),--no-cache)
+
+check:       ## jaxvet: jaxpr-level audit of EVERY registered config
+	## (docs/CHECKING.md) — traces each real train/eval/predict step
+	## abstractly on CPU (zero FLOPs) and enforces the IR invariants:
+	## DTYPE (no f32 leak into a bf16 apply), DONATE (donation claimed ==
+	## donation traced, all aliasable), COLL (spatial collectives on the
+	## declared axes), COST (FLOPs/bytes vs CHECK_COST.json), SERVE
+	## (bucket coverage). Exit 1 on any finding
+	env $(CPU_ENV) $(PY) -m deepvision_tpu.check
+
+check-update-cost: ## refresh the committed jaxvet cost baseline
+	## (CHECK_COST.json) after an INTENDED model/step change — review the
+	## diff like a benchmark result
+	env $(CPU_ENV) $(PY) -m deepvision_tpu.check --update-cost
 
 lint-changed: ## jaxlint over only the files `git diff` touches (staged or
 	## not, vs HEAD) — seconds, for the inner loop; falls back to clean

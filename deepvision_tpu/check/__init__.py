@@ -1,0 +1,42 @@
+"""jaxvet — jaxpr-level static audit of every registered model.
+
+jaxlint (deepvision_tpu/lint) proves hazards at the AST level; the bug
+classes that actually bit this repo — donation-aliasing segfaults, f32 leaks
+into the bf16 compute path, mis-axed collectives — are ultimately facts
+about the *lowered IR*, not the source text. jaxvet closes that gap: for
+every registered `(config, model, step-factory)` combination it traces the
+REAL train/eval/predict step with abstract inputs (`jax.eval_shape` +
+`jit(...).trace` — zero data, zero FLOPs, CPU-safe) and walks the closed
+jaxpr to enforce IR-level invariants:
+
+  DTYPE   no f32 conv/dot equations reachable inside a declared-bf16 apply,
+          outside the deliberate f32 output heads — the ground-truth
+          complement to the AST rule DTY001
+  DONATE  the step donates what it claims (steps_per_dispatch == 1 ->
+          the whole state), and every donated argument is actually
+          aliasable (shape/dtype matches an output) — the PR 1/4 segfault
+          class, caught before XLA is
+  COLL    spatial shard_map collectives run over the axes
+          parallel/spatial_shard.py declares (ppermute halos over
+          'spatial', all_to_all transition over 'spatial', grad psum over
+          ('data','spatial')), and single-program GSPMD steps contain NO
+          explicit collectives
+  COST    per-step FLOPs / bytes-accessed derived from the jaxpr, diffed
+          against the committed CHECK_COST.json baseline so cost-model
+          regressions are visible PR-over-PR
+  SERVE   the PredictEngine bucket signatures {1, 8, 32, max_batch} cover
+          each servable config's input spec (shape, dtype, policy) —
+          config/bucket drift caught before it becomes a recompile storm
+
+CLI:      python -m deepvision_tpu.check [units...] [--format json|github]
+                                         [--select DTYPE,DONATE,...]
+Library:  audit([...]) -> ([Finding], n_steps)
+Division of labor vs jaxlint, rule table, and the cost-baseline workflow:
+docs/CHECKING.md. Contract matches the jaxlint CLI: exit 0 clean /
+1 findings / 2 usage error.
+"""
+
+from .cli import audit, main
+from .rules import ALL_CHECKS, Finding
+
+__all__ = ["ALL_CHECKS", "Finding", "audit", "main"]

@@ -1,0 +1,8 @@
+"""`python -m deepvision_tpu.check` — the jaxvet audit CLI."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

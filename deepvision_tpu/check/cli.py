@@ -1,0 +1,246 @@
+"""jaxvet CLI: `python -m deepvision_tpu.check [configs...] [options]`.
+
+With no positional args, sweeps EVERY registered config (the registry-wide
+mode CI runs) plus the spatial collective probes. Positional args name
+registered configs to audit alone.
+
+Exit codes (stable, matching the jaxlint CLI contract):
+  0 — clean
+  1 — findings reported
+  2 — usage error (unknown configs/checks, bad flags)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Sequence, Tuple
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE = 0, 1, 2
+
+# the committed cost baseline, PR-over-PR diffable (repo root)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "CHECK_COST.json")
+
+
+def audit(names: Optional[Sequence[str]] = None,
+          select: Optional[Sequence[str]] = None,
+          baseline_path: Optional[str] = None,
+          progress=None) -> Tuple[list, dict]:
+    """Library entry point: audit the named configs (default: the whole
+    registry + spatial probes). Returns (findings, report) where report
+    carries the cost table, per-unit status, and skip reasons."""
+    from .harness import build_units
+    from .rules import Finding, check_cost, cost_of, load_baseline, \
+        run_checks
+    from ..configs import CONFIGS
+
+    # registry aliases (configs equal in everything but their name, e.g.
+    # centernet / objects_as_points) audit identically — trace the first,
+    # re-emit its verdicts under the alias's unit names. The sweep still
+    # reports one unit set PER REGISTERED NAME (the registry-hygiene
+    # non-vacuity contract); it just doesn't pay for the same jaxpr twice.
+    # "spatial" is a pseudo-target: just the collective probes (they are
+    # part of every full sweep; naming them audits the spatial layer alone)
+    spatial_only = bool(names) and "spatial" in names
+    if spatial_only:
+        names = [n for n in names if n != "spatial"]
+    requested = (list(names) if names
+                 else ([] if spatial_only else CONFIGS.names()))
+    canonical: dict = {}     # config-identity -> first name seen
+    alias_of: dict = {}      # alias name -> canonical name
+    for n in requested:
+        key = repr(CONFIGS.get(n).replace(name="_"))
+        if key in canonical:
+            alias_of[n] = canonical[key]
+        else:
+            canonical[key] = n
+    sweep_names = [n for n in requested if n not in alias_of]
+
+    wants_cost = select is None or "COST" in {c.upper() for c in select}
+    baseline = None
+    if wants_cost:
+        baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
+    findings: list = []
+    cost_table: dict = {}
+    audited: List[str] = []
+    skipped: dict = {}
+    by_config: dict = {}     # canonical config -> [(unit suffix, findings,
+    #                           cost)] for alias re-emission
+    for unit in build_units(sweep_names, progress=progress,
+                            spatial=spatial_only or not names):
+        audited.append(unit.name)
+        if unit.skipped:
+            skipped[unit.name] = unit.skipped
+            continue
+        unit_findings = run_checks(unit, select)
+        findings.extend(unit_findings)
+        cost = cost_of(unit)
+        if cost is not None:
+            cost_table[unit.name] = cost
+        if unit.config_name:
+            suffix = unit.name.split("/", 1)[1] if "/" in unit.name else ""
+            by_config.setdefault(unit.config_name, []).append(
+                (suffix, unit_findings, cost))
+        unit.closed = None  # release the jaxpr before the next trace
+    for alias, canon in alias_of.items():
+        for suffix, unit_findings, cost in by_config.get(canon, []):
+            uname = f"{alias}/{suffix}"
+            audited.append(uname)
+            findings.extend(Finding(uname, f.check, f.message, f.severity)
+                            for f in unit_findings)
+            if cost is not None:
+                cost_table[uname] = cost
+    if wants_cost:
+        for uname, cost in cost_table.items():
+            findings.extend(check_cost(uname, cost, baseline))
+    findings.sort(key=lambda f: (f.unit, f.check, f.message))
+    report = {"units": audited, "skipped": skipped, "cost": cost_table,
+              "aliases": alias_of, "n_units": len(audited)}
+    return findings, report
+
+
+def write_baseline(cost_table: dict, path: str) -> None:
+    from .harness import AUDIT_BATCH
+    payload = {
+        "version": 1,
+        "audit_batch": AUDIT_BATCH,
+        "comment": "jaxvet cost model per traced step (mesh=None, abstract "
+                   "batch above): 2*MAC FLOPs over conv/dot, fusion-blind "
+                   "bytes proxy, trip-weighted eqn count. Regenerate with "
+                   "`python -m deepvision_tpu.check --update-cost` and "
+                   "review the diff like a benchmark.",
+        "units": {k: cost_table[k] for k in sorted(cost_table)},
+    }
+    with open(path, "w") as fp:
+        json.dump(payload, fp, indent=1, sort_keys=False)
+        fp.write("\n")
+
+
+def _render_text(findings, report, dt) -> str:
+    lines = [f.format() for f in findings]
+    for name, why in sorted(report["skipped"].items()):
+        lines.append(f"# skipped {name}: {why}")
+    if findings:
+        by_check: dict = {}
+        for f in findings:
+            by_check[f.check] = by_check.get(f.check, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_check.items()))
+        lines.append(f"jaxvet: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''} ({summary}) "
+                     f"over {report['n_units']} units in {dt:.1f}s")
+    else:
+        lines.append(f"jaxvet: clean ({report['n_units']} units, "
+                     f"{len(report['skipped'])} skipped) in {dt:.1f}s")
+    return "\n".join(lines)
+
+
+def _render_json(findings, report, dt) -> str:
+    by_check: dict = {}
+    for f in findings:
+        by_check[f.check] = by_check.get(f.check, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_json() for f in findings],
+        "cost": report["cost"],
+        "skipped": report["skipped"],
+        "summary": {"units": report["n_units"],
+                    "findings": len(findings), "by_check": by_check,
+                    "seconds": round(dt, 1)},
+    }, indent=2)
+
+
+def _render_github(findings, report, dt) -> str:
+    lines = []
+    for f in findings:
+        msg = f.message.replace("%", "%25").replace("\n", "%0A")
+        lines.append(f"::error title=jaxvet {f.check} ({f.unit})::{msg}")
+    if findings:
+        lines.append(f"jaxvet: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''}")
+    else:
+        lines.append(f"jaxvet: clean ({report['n_units']} units) "
+                     f"in {dt:.1f}s")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from .rules import ALL_CHECKS
+
+    parser = argparse.ArgumentParser(
+        prog="python -m deepvision_tpu.check",
+        description="jaxvet: jaxpr-level audit of every registered model — "
+                    "traces each real train/eval/predict step abstractly "
+                    "(zero FLOPs, CPU-safe) and verifies IR invariants. "
+                    "Checks: " + "; ".join(
+                        f"{cid}: {doc}" for cid, doc in ALL_CHECKS.items()))
+    parser.add_argument("configs", nargs="*",
+                        help="registered config names to audit "
+                             "(default: the whole registry + spatial "
+                             "collective probes)")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text",
+                        help="github emits ::error workflow annotations")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated check families to run "
+                             "(default: all)")
+    parser.add_argument("--baseline", default=None,
+                        help="cost baseline JSON (default: repo-root "
+                             "CHECK_COST.json)")
+    parser.add_argument("--update-cost", action="store_true",
+                        help="rewrite the cost baseline from this sweep "
+                             "instead of diffing against it")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="progress lines per config on stderr")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_USAGE if e.code not in (0, None) else 0
+
+    select = None
+    if args.select:
+        select = [c.strip().upper() for c in args.select.split(",")
+                  if c.strip()]
+        unknown = [c for c in select if c not in ALL_CHECKS]
+        if unknown:
+            print(f"usage error: unknown check(s): {', '.join(unknown)}; "
+                  f"known: {', '.join(ALL_CHECKS)}", file=sys.stderr)
+            return EXIT_USAGE
+
+    from ..configs import CONFIGS
+    bad = [n for n in args.configs if n not in CONFIGS and n != "spatial"]
+    if bad:
+        print(f"usage error: unknown config(s): {', '.join(bad)}; known: "
+              f"spatial, {', '.join(CONFIGS.names())}", file=sys.stderr)
+        return EXIT_USAGE
+    if args.update_cost and args.configs:
+        print("usage error: --update-cost rewrites the whole-registry "
+              "baseline; run it without config arguments", file=sys.stderr)
+        return EXIT_USAGE
+
+    progress = ((lambda name: print(f"[jaxvet] {name}", file=sys.stderr,
+                                    flush=True))
+                if args.verbose else None)
+    t0 = time.perf_counter()
+    findings, report = audit(args.configs or None, select,
+                             args.baseline, progress=progress)
+    dt = time.perf_counter() - t0
+    if args.update_cost:
+        path = args.baseline or DEFAULT_BASELINE
+        write_baseline(report["cost"], path)
+        findings = [f for f in findings if f.check != "COST"]
+        print(f"wrote {len(report['cost'])} cost rows to {path}",
+              file=sys.stderr)
+
+    render = {"json": _render_json, "github": _render_github,
+              "text": _render_text}[args.format]
+    print(render(findings, report, dt))
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""Closed-jaxpr walking: equation iteration, collectives, and the cost model.
+
+Everything operates on abstract values only — shapes and dtypes from a
+`jit(...).trace(...)` of the real step on `ShapeDtypeStruct` inputs — so a
+whole-registry sweep costs zero FLOPs and no device memory.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+from jax.core import ClosedJaxpr, Jaxpr, JaxprEqn, Literal
+
+# Collective primitives a jaxpr can carry explicitly (shard_map/pmap
+# regions). GSPMD-partitioned jitted steps never contain these — the
+# partitioner inserts its collectives at compile time — which is exactly
+# what the COLL single-program check pins.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "ppermute", "pmax", "pmin", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "pgather", "pbroadcast",
+})
+
+# Heavy compute: the MXU-shaped equations whose dtype IS the compute policy.
+HEAVY_PRIMS = frozenset({"conv_general_dilated", "dot_general"})
+
+
+def _sub_jaxprs(eqn: JaxprEqn) -> Iterator[Tuple[Jaxpr, int]]:
+    """(inner jaxpr, trip multiplier) pairs nested in an equation's params.
+    scan bodies multiply by `length`; everything else counts once (while
+    bodies have no static trip count — counted once, an explicit floor)."""
+    mult = eqn.params.get("length", 1) if eqn.primitive.name == "scan" else 1
+    for value in eqn.params.values():
+        for item in (value if isinstance(value, (list, tuple)) else (value,)):
+            if isinstance(item, ClosedJaxpr):
+                yield item.jaxpr, mult
+            elif isinstance(item, Jaxpr):
+                yield item, mult
+
+
+def iter_eqns(jaxpr: Jaxpr, _mult: int = 1) -> Iterator[Tuple[JaxprEqn, int]]:
+    """Depth-first (eqn, trip multiplier) over a jaxpr and every nested
+    sub-jaxpr (pjit bodies, scan/while/cond, custom_vjp, remat)."""
+    for eqn in jaxpr.eqns:
+        yield eqn, _mult
+        for sub, mult in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, _mult * mult)
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (typed PRNG keys, `key<fry>`) have no numpy
+        # equivalent; their physical payload is a pair of uint32s
+        itemsize = 8
+    return int(math.prod(shape)) * itemsize
+
+
+def _axes_of(eqn: JaxprEqn) -> Tuple[str, ...]:
+    """Normalized mesh-axis tuple of a collective equation."""
+    axes = (eqn.params.get("axes") or eqn.params.get("axis_name")
+            or eqn.params.get("axis_names") or ())
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def collect_collectives(closed: ClosedJaxpr) -> Dict[Tuple[str, Tuple[str, ...]], int]:
+    """{(primitive, axes): count} over the whole (nested) jaxpr."""
+    out: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+    for eqn, mult in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            key = (eqn.primitive.name, _axes_of(eqn))
+            out[key] = out.get(key, 0) + mult
+    return out
+
+
+def _conv_flops(eqn: JaxprEqn) -> int:
+    """2 * |out| * taps-per-output for conv_general_dilated, taps =
+    kernel_spatial_elems * C_in / feature_groups, read off the rhs shape via
+    the equation's dimension numbers."""
+    out = eqn.outvars[0].aval
+    dnums = eqn.params["dimension_numbers"]
+    rhs = eqn.invars[1].aval.shape
+    spatial = [rhs[d] for d in dnums.rhs_spec[2:]]
+    c_in = rhs[dnums.rhs_spec[1]]  # per-group input channels
+    return 2 * int(math.prod(out.shape)) * int(math.prod(spatial)) * int(c_in)
+
+
+def _dot_flops(eqn: JaxprEqn) -> int:
+    """2 * |out| * K for dot_general (K = product of lhs contracting dims)."""
+    out = eqn.outvars[0].aval
+    (lhs_c, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    k = math.prod(lhs[d] for d in lhs_c) if lhs_c else 1
+    return 2 * int(math.prod(out.shape)) * int(k)
+
+
+def heavy_eqns(closed: ClosedJaxpr) -> List[Tuple[JaxprEqn, int, int]]:
+    """(eqn, trip multiplier, flops) for every conv/dot in the jaxpr."""
+    out = []
+    for eqn, mult in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name not in HEAVY_PRIMS:
+            continue
+        flops = _conv_flops(eqn) if name == "conv_general_dilated" \
+            else _dot_flops(eqn)
+        out.append((eqn, mult, flops))
+    return out
+
+
+def cost_summary(closed: ClosedJaxpr) -> Dict[str, int]:
+    """The jaxvet cost model of one traced step.
+
+    - `flops`: 2*MACs summed over every conv/dot (the MXU work; elementwise
+      ops are noise next to it and fuse anyway).
+    - `bytes`: every equation's operand + result footprint summed — a
+      deliberately fusion-blind upper proxy. The ABSOLUTE number overcounts
+      what a compiled program moves through HBM (XLA fuses elementwise
+      chains); the DIFF between two revisions of the same step is exactly
+      the signal BENCH chases (r05: bf16 BN/residual joins cut cost-model
+      bytes 8.3%), and this proxy moves with it deterministically.
+    - `eqns`: equation count (trip-weighted) — a retrace/graph-bloat canary.
+
+    Literals (inline scalars) are skipped; consts are counted once via the
+    outer jaxpr's constvars.
+    """
+    flops = 0
+    nbytes = 0
+    n_eqns = 0
+    for eqn, mult in iter_eqns(closed.jaxpr):
+        n_eqns += mult
+        io = sum(_aval_bytes(v.aval) for v in eqn.invars
+                 if not isinstance(v, Literal))
+        io += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        nbytes += mult * io
+    for eqn, mult, f in heavy_eqns(closed):
+        flops += mult * f
+    nbytes += sum(_aval_bytes(v.aval) for v in closed.jaxpr.constvars)
+    return {"flops": int(flops), "bytes": int(nbytes), "eqns": int(n_eqns)}

@@ -1,0 +1,315 @@
+"""jaxvet check families: IR-level invariants over traced audit units.
+
+Each check walks facts the harness extracted from the REAL step's closed
+jaxpr (or eval_shape output specs) and compares them against the claim the
+factory itself attached via `core.steps.annotate_step` — so what is
+verified is exactly what the construction site declared, and neither side
+can drift alone. Division of labor vs the AST linter: docs/CHECKING.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from .harness import TracedUnit
+from .jaxpr_walk import collect_collectives, cost_summary, heavy_eqns
+
+ALL_CHECKS: Dict[str, str] = {
+    "DTYPE": "no f32 conv/dot reachable inside a declared-bf16 apply "
+             "outside the deliberate f32 heads (IR ground truth of the "
+             "AST rule DTY001); f32 steps must not silently drop to bf16",
+    "DONATE": "the step donates exactly what its factory claims, and every "
+              "donated argument is aliasable (shape/dtype matches an "
+              "output) — the donation-aliasing segfault class, caught "
+              "before XLA",
+    "COLL": "spatial shard_map code carries the collectives "
+            "parallel/spatial_shard.py declares (ppermute/all_to_all/psum "
+            "over the right mesh axes); single-program jit steps carry "
+            "none",
+    "COST": "per-step FLOPs / bytes-accessed / equation count from the "
+            "jaxpr, diffed against the committed CHECK_COST.json baseline",
+    "SERVE": "PredictEngine bucket signatures {1, 8, 32, max_batch} cover "
+             "each servable config's input spec with f32 outputs",
+    "TRACE": "every registered (config, model, step-factory) combination "
+             "builds and traces abstractly at all",
+}
+
+# COST drift tolerances (relative). FLOPs from abstract shapes are exact,
+# so any drift is a real model/step change; the bytes proxy may wobble a
+# hair with jax's trace-level canonicalization, eqn counts a bit more.
+COST_TOLERANCE = {"flops": 1e-6, "bytes": 0.01, "eqns": 0.05}
+
+
+@dataclasses.dataclass
+class Finding:
+    unit: str
+    check: str
+    message: str
+    severity: str = "error"
+
+    def format(self) -> str:
+        return f"{self.unit}: {self.check} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _is_f32(dtype) -> bool:
+    return jnp.dtype(dtype) == jnp.float32
+
+
+def _eqn_dims(eqn) -> set:
+    dims = set()
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            dims.update(int(d) for d in aval.shape)
+    return dims
+
+
+def check_dtype(unit: TracedUnit) -> List[Finding]:
+    findings: List[Finding] = []
+    policy = unit.meta.get("compute_dtype")
+    if unit.closed is None or policy is None:
+        # eval_shape units: the serving contract is f32 float outputs
+        for aval in unit.out_avals:
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating) \
+                    and not _is_f32(dt):
+                findings.append(Finding(
+                    unit.name, "DTYPE",
+                    f"float output is {dt}, not float32 — serving/predict "
+                    f"outputs must be f32 (engine contract, serve/engine.py)"))
+        return findings
+    policy = jnp.dtype(policy)
+    for eqn, _mult, _flops in heavy_eqns(unit.closed):
+        out_dt = jnp.dtype(eqn.outvars[0].aval.dtype)
+        if policy == jnp.bfloat16 and out_dt == jnp.float32:
+            if unit.head_dims & _eqn_dims(eqn):
+                continue  # deliberate f32 head (models/*.py dtype=f32)
+            shape = tuple(eqn.outvars[0].aval.shape)
+            findings.append(Finding(
+                unit.name, "DTYPE",
+                f"f32 {eqn.primitive.name} {shape} inside a declared-"
+                f"bfloat16 step (head dims {sorted(unit.head_dims)} not "
+                f"involved) — an f32 leak into the compute path, the HBM-"
+                f"traffic regression class r05 measured"))
+        elif policy == jnp.float32 and out_dt == jnp.bfloat16:
+            shape = tuple(eqn.outvars[0].aval.shape)
+            findings.append(Finding(
+                unit.name, "DTYPE",
+                f"bf16 {eqn.primitive.name} {shape} inside a declared-"
+                f"float32 step — compute silently below the config's "
+                f"precision"))
+    return findings
+
+
+def check_donate(unit: TracedUnit) -> List[Finding]:
+    if unit.closed is None:
+        return []
+    findings: List[Finding] = []
+    if "donate" not in unit.meta:
+        return [Finding(unit.name, "DONATE",
+                        "step carries no _jaxvet claim (factory not built "
+                        "through core.steps.annotate_step) — the audit "
+                        "cannot verify donation against intent")]
+    claimed = bool(unit.meta["donate"])
+    if claimed and not unit.donated_avals:
+        findings.append(Finding(
+            unit.name, "DONATE",
+            "factory claims donate=True but the traced step donates no "
+            "argument — the state buffers will be copied every step "
+            "(double HBM for the largest pytree in the program)"))
+    if not claimed and unit.donated_avals:
+        findings.append(Finding(
+            unit.name, "DONATE",
+            f"factory claims donate=False but {len(unit.donated_avals)} "
+            f"arguments are donated — a caller reusing its input after "
+            f"this step reads freed memory (the PR 1 segfault class)"))
+    # aliasability: every donated buffer must have a (shape, dtype)-equal
+    # output to alias into, each output absorbing at most one input —
+    # otherwise XLA either warns 'donated buffers not usable' or, worse,
+    # dies at dispatch with an INTERNAL aliasing size mismatch (the exact
+    # failure tests/test_centernet.py shows on jax 0.4.37).
+    pool: Dict[tuple, int] = {}
+    for aval in unit.out_avals:
+        key = (tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype",
+                                                             "?")))
+        pool[key] = pool.get(key, 0) + 1
+    for aval in unit.donated_avals:
+        key = (tuple(aval.shape), str(aval.dtype))
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+        else:
+            findings.append(Finding(
+                unit.name, "DONATE",
+                f"donated argument {key[1]}{list(key[0])} has no matching "
+                f"output to alias (shape/dtype mismatch) — donation is "
+                f"output aliasing, so this buffer is freed for nothing"))
+    return findings
+
+
+def check_coll(unit: TracedUnit) -> List[Finding]:
+    findings: List[Finding] = []
+    if unit.kind == "probe":
+        if unit.skipped or unit.traced_collectives is None:
+            return []
+        declared = {(p, tuple(a)): n
+                    for (p, a), n in (unit.declared_collectives or {}).items()}
+        traced = dict(unit.traced_collectives)
+        if declared != traced:
+            findings.append(Finding(
+                unit.name, "COLL",
+                f"traced collectives {_fmt_colls(traced)} != declared "
+                f"{_fmt_colls(declared)} (parallel/spatial_shard.py "
+                f"DECLARED_COLLECTIVES) — a mis-axed collective reduces "
+                f"over the wrong ranks and corrupts gradients silently"))
+        return findings
+    if unit.closed is None:
+        return []
+    if unit.traced_collectives is not None:
+        # full shard_map step: the grad psum over both manual axes must be
+        # present, and every collective must run over known spatial axes
+        traced = unit.traced_collectives
+        if not any(p == "psum" and set(a) == {"data", "spatial"}
+                   for (p, a) in traced):
+            findings.append(Finding(
+                unit.name, "COLL",
+                f"shard_map train step carries no psum over "
+                f"('data', 'spatial') — the controlled gradient reduction "
+                f"is missing; found {_fmt_colls(traced)}"))
+        for (p, axes) in traced:
+            if not set(axes) <= {"data", "spatial"}:
+                findings.append(Finding(
+                    unit.name, "COLL",
+                    f"collective {p} over unknown mesh axes {axes} — the "
+                    f"manual axes are ('data', 'spatial')"))
+        return findings
+    colls = collect_collectives(unit.closed)
+    if colls:
+        findings.append(Finding(
+            unit.name, "COLL",
+            f"single-program jit step carries explicit collectives "
+            f"{_fmt_colls(colls)} — GSPMD steps must leave collective "
+            f"placement to the partitioner"))
+    return findings
+
+
+def _fmt_colls(colls: dict) -> str:
+    return "{" + ", ".join(
+        f"{p}@{','.join(a)}x{n}" for (p, a), n in sorted(colls.items())) + "}"
+
+
+def check_serve(unit: TracedUnit) -> List[Finding]:
+    if unit.serve is None:
+        return []
+    findings: List[Finding] = []
+    s = unit.serve
+    buckets, max_batch = list(s["buckets"]), s["max_batch"]
+    if buckets != sorted(set(buckets)) or any(b <= 0 for b in buckets):
+        findings.append(Finding(
+            unit.name, "SERVE",
+            f"bucket signature {buckets} is not strictly ascending "
+            f"positive — pick_bucket's search contract"))
+    if 1 not in buckets:
+        findings.append(Finding(
+            unit.name, "SERVE",
+            f"bucket signature {buckets} lacks the batch-of-1 bucket — "
+            f"single-example requests would pad to {buckets[0]}x"))
+    if max_batch < buckets[-1]:
+        findings.append(Finding(
+            unit.name, "SERVE",
+            f"max_batch {max_batch} < largest bucket {buckets[-1]} — the "
+            f"batcher would flush batches no compiled program accepts "
+            f"(a recompile per oversize flush: the recompile-storm drift)"))
+    for bkt, outs in s["probe_outs"].items():
+        for aval in outs:
+            shape = tuple(getattr(aval, "shape", ()))
+            if shape and shape[0] != bkt:
+                findings.append(Finding(
+                    unit.name, "SERVE",
+                    f"predict output {shape} at bucket {bkt} does not keep "
+                    f"the batch dim — per-row slicing after padded dispatch "
+                    f"would return wrong rows"))
+    return findings
+
+
+def check_trace(unit: TracedUnit) -> List[Finding]:
+    if unit.error:
+        return [Finding(unit.name, "TRACE",
+                        f"unit failed to build/trace: {unit.error}")]
+    return []
+
+
+def cost_of(unit: TracedUnit) -> Optional[dict]:
+    if unit.closed is None or unit.name.startswith("spatial/"):
+        return None
+    return cost_summary(unit.closed)
+
+
+def check_cost(unit_name: str, cost: dict,
+               baseline_units: Optional[dict]) -> List[Finding]:
+    """Diff one unit's cost row against the committed baseline. `None`
+    baseline (file absent / --update-cost run) checks nothing."""
+    if baseline_units is None:
+        return []
+    base = baseline_units.get(unit_name)
+    if base is None:
+        return [Finding(unit_name, "COST",
+                        "no baseline row in CHECK_COST.json — run "
+                        "`python -m deepvision_tpu.check --update-cost` "
+                        "and commit the diff")]
+    findings = []
+    for field, tol in COST_TOLERANCE.items():
+        want, got = base.get(field), cost.get(field)
+        if want is None or got is None:
+            continue
+        denom = max(abs(want), 1)
+        if abs(got - want) / denom > tol:
+            findings.append(Finding(
+                unit_name, "COST",
+                f"{field} drifted {want} -> {got} "
+                f"({(got - want) / denom:+.2%}, tolerance {tol:.0%}) — if "
+                f"intended, refresh the baseline with --update-cost and "
+                f"put the diff in the PR"))
+    return findings
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    try:
+        with open(path) as fp:
+            data = json.load(fp)
+    except (OSError, ValueError):
+        return None
+    return data.get("units", {})
+
+
+def run_checks(unit: TracedUnit, select=None) -> List[Finding]:
+    """All non-COST families over one traced unit (COST needs the cross-
+    unit baseline and runs in the sweep loop)."""
+    out: List[Finding] = []
+    wanted = {c.upper() for c in select} if select else None
+
+    def on(check):
+        return wanted is None or check in wanted
+
+    if on("TRACE"):
+        out.extend(check_trace(unit))
+    if unit.error:
+        return out
+    if unit.kind != "probe":
+        # collective probes are bare shard_map bodies, not jitted steps —
+        # only COLL speaks about them
+        if on("DTYPE"):
+            out.extend(check_dtype(unit))
+        if on("DONATE"):
+            out.extend(check_donate(unit))
+        if on("SERVE"):
+            out.extend(check_serve(unit))
+    if on("COLL"):
+        out.extend(check_coll(unit))
+    return out

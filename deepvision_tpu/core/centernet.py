@@ -17,7 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import centernet as cn_ops
 from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input, maybe_grad_norm
+from .steps import _normalize_input, annotate_step, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 
@@ -68,7 +68,8 @@ def make_centernet_train_step(*, num_classes: int, grid: int,
         jit_kwargs["donate_argnums"] = (0,)
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="train")
 
 
 def make_centernet_eval_step(*, num_classes: int, grid: int,
@@ -87,7 +88,8 @@ def make_centernet_eval_step(*, num_classes: int, grid: int,
     jit_kwargs = {}
     if mesh is not None:
         jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="eval")
 
 
 class CenterNetTrainer(LossWatchedTrainer):
@@ -150,7 +152,9 @@ def make_centernet_predict_step(*, compute_dtype=jnp.bfloat16,
                                                max_detections=max_detections)
         return boxes, scores, classes
 
-    return jax.jit(step)
+    return annotate_step(jax.jit(step), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="predict")
 
 
 def evaluate_map(state, batches, *, num_classes: int, metric: str = "coco",

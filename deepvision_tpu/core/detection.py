@@ -23,7 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import yolo as yolo_ops
 from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input, maybe_grad_norm
+from .steps import _normalize_input, annotate_step, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 
@@ -108,7 +108,8 @@ def make_yolo_train_step(*, num_classes: int, grid_sizes: Sequence[int],
         jit_kwargs["donate_argnums"] = (0,)
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="train")
 
 
 def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
@@ -130,7 +131,8 @@ def make_yolo_eval_step(*, num_classes: int, grid_sizes: Sequence[int],
     jit_kwargs = {}
     if mesh is not None:
         jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="eval")
 
 
 def make_predict_step(*, compute_dtype=jnp.bfloat16, iou_thresh: float = 0.5,
@@ -163,7 +165,9 @@ def make_predict_step(*, compute_dtype=jnp.bfloat16, iou_thresh: float = 0.5,
                            iou_thresh=iou_thresh, score_thresh=score_thresh,
                            max_detection=max_detection)
 
-    return jax.jit(step)
+    return annotate_step(jax.jit(step), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="predict")
 
 
 def evaluate_map(state, batches, *, num_classes: int, metric: str = "coco",

@@ -39,6 +39,7 @@ from .metrics import MetricsLogger
 from .optim import build_optimizer, set_lr_scale
 from .resilience import (GracefulShutdown, PreemptionExit, RetryPolicy,
                          resilient_batches)
+from .steps import annotate_step
 from .train_state import TrainState, init_model
 
 
@@ -331,7 +332,8 @@ def make_dcgan_train_step(gen_apply: Callable, disc_apply: Callable,
         jit_kwargs["donate_argnums"] = (0, 1)
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(jnp.float32), kind="train")
 
 
 class DCGANTrainer(AdversarialTrainer):
@@ -499,7 +501,8 @@ def make_cyclegan_generator_step(gen_apply: Callable, disc_apply: Callable,
         repl = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         jit_kwargs["out_shardings"] = (None, repl, data, data, repl)
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=True,
+                         compute_dtype=jnp.dtype(jnp.float32), kind="train")
 
 
 def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None,
@@ -540,7 +543,8 @@ def make_cyclegan_discriminator_step(disc_apply: Callable, mesh=None,
     jit_kwargs = {"donate_argnums": (0,)}
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=True,
+                         compute_dtype=jnp.dtype(jnp.float32), kind="train")
 
 
 class CycleGANTrainer(AdversarialTrainer):

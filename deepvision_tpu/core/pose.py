@@ -25,7 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.heatmap import render_gaussian_heatmaps
 from ..parallel import mesh as mesh_lib
 from .config import TrainConfig, UNIT_RANGE_NORM
-from .steps import _normalize_input, maybe_grad_norm
+from .steps import _normalize_input, annotate_step, maybe_grad_norm
 from .trainer import LossWatchedTrainer
 
 FOREGROUND_WEIGHT = 81.0  # `Hourglass/tensorflow/train.py:69`
@@ -90,7 +90,8 @@ def make_pose_train_step(*, heatmap_size: Tuple[int, int],
         jit_kwargs["donate_argnums"] = (0,)
     if mesh is not None:
         jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="train")
 
 
 def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
@@ -112,7 +113,8 @@ def make_pose_eval_step(*, heatmap_size: Tuple[int, int],
     jit_kwargs = {}
     if mesh is not None:
         jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="eval")
 
 
 class PoseTrainer(LossWatchedTrainer):

@@ -1,0 +1,139 @@
+"""mtime-keyed jaxlint result cache (`.cache/jaxlint/` under the project).
+
+Soundness model. A file's findings are a pure function of (its source, the
+project-wide ProjectIndex, the [tool.jaxlint] config, the linter's own
+code). The index is built from EVERY file, so per-file reuse is only sound
+when the index inputs are provably unchanged:
+
+  * full skip — every file's (mtime_ns, size) stamp matches the cache:
+    return the stored findings without parsing anything (make semantics;
+    the `make lint` / preflight double-run path, ~6s -> ~0.3s of work).
+  * per-file reuse — some stamps changed: parse everything, rebuild the
+    index, and hash every file's CONTENT into one project key. Files whose
+    own stamp matches AND whose stored project key equals the fresh one
+    reuse their stored findings — this is exactly the touch-without-edit
+    case (mtime moved, content didn't, index provably identical). Any real
+    content change anywhere changes the project key and re-runs the rules
+    everywhere (conservative: interprocedural rules mean a change in file
+    B may alter findings in file A).
+
+The cache key also folds in the linter package's own file stamps and the
+pyproject's content, so upgrading a rule or editing config invalidates
+everything. `--no-cache` bypasses reads and writes entirely; `--select`
+runs never touch the cache (their findings are a subset).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .framework import Finding
+
+CACHE_VERSION = 1
+CACHE_DIR = os.path.join(".cache", "jaxlint")
+CACHE_NAME = "cache.json"
+
+
+def cache_file(root: str) -> str:
+    return os.path.join(root, CACHE_DIR, CACHE_NAME)
+
+
+def file_stamp(path: str) -> Optional[Tuple[int, int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def _lint_pkg_stamp() -> str:
+    """Stamp of the linter's own sources — a rule edit must invalidate."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    parts = []
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            parts.append((fn, file_stamp(os.path.join(pkg, fn))))
+    return hashlib.sha1(repr(parts).encode()).hexdigest()
+
+
+def meta_key(config_path: Optional[str]) -> str:
+    """Environment half of the cache key: linter code + config content."""
+    cfg = ""
+    if config_path and os.path.isfile(config_path):
+        with open(config_path, "rb") as fp:
+            cfg = hashlib.sha1(fp.read()).hexdigest()
+    return f"v{CACHE_VERSION}:{_lint_pkg_stamp()}:{cfg}"
+
+
+def project_key(root: str, contents: Dict[str, bytes]) -> str:
+    """Content hash over every linted file — equality proves the
+    ProjectIndex inputs (and therefore the index) are unchanged."""
+    h = hashlib.sha1()
+    for path in sorted(contents):
+        rel = os.path.relpath(path, root)
+        h.update(rel.encode())
+        h.update(hashlib.sha1(contents[path]).digest())
+    return h.hexdigest()
+
+
+class LintCache:
+    def __init__(self, root: str, config_path: Optional[str]):
+        self.root = root
+        self.path = cache_file(root)
+        self.meta = meta_key(config_path)
+        self._data: dict = {}
+        try:
+            with open(self.path) as fp:
+                data = json.load(fp)
+            if data.get("meta") == self.meta:
+                self._data = data
+        except (OSError, ValueError):
+            pass
+
+    # -- reads ---------------------------------------------------------------
+    def full_skip(self, files: Sequence[str]) -> Optional[List[Finding]]:
+        """All stamps match -> the stored findings verbatim, else None."""
+        entries = self._data.get("files", {})
+        if set(entries) != set(files):
+            return None
+        findings: List[Finding] = []
+        for path in files:
+            e = entries[path]
+            if file_stamp(path) != tuple(e["stamp"]):
+                return None
+            findings.extend(Finding(**f) for f in e["findings"])
+        return findings
+
+    def reusable(self, path: str, fresh_project_key: str) -> Optional[list]:
+        """Stored findings for one file, iff its own stamp matches AND the
+        project content key proves the index unchanged."""
+        if self._data.get("project_key") != fresh_project_key:
+            return None
+        e = self._data.get("files", {}).get(path)
+        if e is None or file_stamp(path) != tuple(e["stamp"]):
+            return None
+        return [Finding(**f) for f in e["findings"]]
+
+    # -- writes --------------------------------------------------------------
+    def store(self, fresh_project_key: str,
+              per_file: Dict[str, List[Finding]]) -> None:
+        payload = {
+            "meta": self.meta,
+            "project_key": fresh_project_key,
+            "files": {
+                path: {"stamp": list(file_stamp(path) or (0, 0)),
+                       "findings": [f.to_json() for f in findings]}
+                for path, findings in per_file.items()
+            },
+        }
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fp:
+                json.dump(payload, fp)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a read-only tree lints fine, just uncached

@@ -19,6 +19,7 @@ import os
 import sys
 from typing import List, Optional, Sequence, Tuple
 
+from .cache import LintCache, project_key
 from .donation import ProjectIndex
 from .framework import Config, Finding, Module, find_pyproject, load_config
 from .rules import ALL_RULES
@@ -51,7 +52,9 @@ def collect_files(paths: Sequence[str], config: Config,
 
 def _lint(paths: Sequence[str], config: Optional[Config],
           select: Optional[Sequence[str]],
-          root: Optional[str]) -> Tuple[List[Finding], int]:
+          root: Optional[str],
+          use_cache: bool = True) -> Tuple[List[Finding], int]:
+    pyproject = None
     if config is None:
         pyproject = find_pyproject(os.path.abspath(paths[0]) if paths
                                    else os.getcwd())
@@ -59,37 +62,72 @@ def _lint(paths: Sequence[str], config: Optional[Config],
         if root is None and pyproject:
             root = os.path.dirname(pyproject)
     root = root or os.getcwd()
+    if pyproject is None:
+        guess = os.path.join(root, "pyproject.toml")
+        pyproject = guess if os.path.isfile(guess) else None
     files = collect_files(paths, config, root)
+
+    # mtime-keyed result cache (lint/cache.py): a --select run checks a
+    # subset of rules, so its findings never enter or leave the cache
+    cache = None
+    if use_cache and select is None:
+        cache = LintCache(root, pyproject)
+        stored = cache.full_skip(files)
+        if stored is not None:
+            stored.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+            return stored, len(files)
 
     modules: List[Module] = []
     findings: List[Finding] = []
+    per_file: dict = {}
+    contents: dict = {}
     for path in files:
+        if cache is not None:
+            try:
+                with open(path, "rb") as fp:
+                    contents[path] = fp.read()
+            except OSError:
+                contents[path] = b""
         try:
             modules.append(Module.from_path(path))
         except SyntaxError as e:
-            findings.append(Finding(path, e.lineno or 1, e.offset or 1,
-                                    "SYNTAX", "error",
-                                    f"cannot parse file: {e.msg}"))
+            bad = Finding(path, e.lineno or 1, e.offset or 1,
+                          "SYNTAX", "error",
+                          f"cannot parse file: {e.msg}")
+            findings.append(bad)
+            per_file[path] = [bad]
 
     index = ProjectIndex().build(modules)
+    fresh_key = project_key(root, contents) if cache is not None else ""
     wanted = {r.upper() for r in select} if select else None
     for module in modules:
-        for rule_id, (_, check, _doc) in ALL_RULES.items():
-            if wanted is not None and rule_id not in wanted:
-                continue
-            if not config.rule_enabled(rule_id):
-                continue
-            findings.extend(check(module, index, config))
+        reused = (cache.reusable(module.path, fresh_key)
+                  if cache is not None else None)
+        if reused is not None:
+            module_findings = reused
+        else:
+            module_findings = []
+            for rule_id, (_, check, _doc) in ALL_RULES.items():
+                if wanted is not None and rule_id not in wanted:
+                    continue
+                if not config.rule_enabled(rule_id):
+                    continue
+                module_findings.extend(check(module, index, config))
+        per_file[module.path] = module_findings
+        findings.extend(module_findings)
+    if cache is not None:
+        cache.store(fresh_key, per_file)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, len(files)
 
 
 def lint_paths(paths: Sequence[str], config: Optional[Config] = None,
                select: Optional[Sequence[str]] = None,
-               root: Optional[str] = None) -> List[Finding]:
+               root: Optional[str] = None,
+               use_cache: bool = True) -> List[Finding]:
     """Library entry point: lint files/directories, return sorted findings.
     `config=None` loads `[tool.jaxlint]` from the nearest pyproject.toml."""
-    return _lint(paths, config, select, root)[0]
+    return _lint(paths, config, select, root, use_cache=use_cache)[0]
 
 
 def _render_github(findings: List[Finding], n_files: int) -> str:
@@ -157,6 +195,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--config", default=None,
                         help="pyproject.toml to read [tool.jaxlint] from "
                              "(default: nearest to the first path)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the mtime-keyed result cache under "
+                             ".cache/jaxlint/ (reads and writes)")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
@@ -195,7 +236,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         config = load_config(args.config)
         root = os.path.dirname(os.path.abspath(args.config))
 
-    findings, n_files = _lint(args.paths, config, select, root)
+    findings, n_files = _lint(args.paths, config, select, root,
+                              use_cache=not args.no_cache)
     render = {"json": _render_json, "github": _render_github,
               "text": _render_text}[args.format]
     print(render(findings, n_files))

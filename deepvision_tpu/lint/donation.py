@@ -107,11 +107,28 @@ def _dict_donations(scope: ast.AST) -> Dict[str, Donation]:
     return dicts
 
 
+# Transparent step-metadata wrappers: `return annotate_step(jax.jit(...),
+# donate=...)` (core/steps.py — the claim side of jaxvet's IR audit) returns
+# the jit callable unchanged, so both indexes must look through it.
+STEP_ANNOTATORS = frozenset({"annotate_step"})
+
+
+def unwrap_annotator(node: ast.AST) -> ast.AST:
+    """Peel `annotate_step(<call>, ...)` wrappers off a returned value."""
+    while (isinstance(node, ast.Call)
+           and terminal_name(node.func) in STEP_ANNOTATORS
+           and node.args):
+        node = node.args[0]
+    return node
+
+
 def donating_jit_call(call: ast.Call, module: Module,
                       dicts: Dict[str, Donation]) -> Optional[Donation]:
-    """Donation of a `jax.jit(...)` call, or None if it doesn't donate (or
-    isn't a jit call at all)."""
-    if module.resolve(call.func) not in JIT_FNS:
+    """Donation of a `jax.jit(...)` call (possibly behind an annotate_step
+    wrapper), or None if it doesn't donate (or isn't a jit call at all)."""
+    call = unwrap_annotator(call)
+    if not isinstance(call, ast.Call) \
+            or module.resolve(call.func) not in JIT_FNS:
         return None
     don = Donation()
     for kw in call.keywords:
@@ -153,9 +170,11 @@ class JittedIndex:
                     continue
                 for sub in walk_scope(node):
                     if isinstance(sub, ast.Return) \
-                            and isinstance(sub.value, ast.Call) \
-                            and module.resolve(sub.value.func) in JIT_FNS:
-                        self.factories.add(node.name)
+                            and isinstance(sub.value, ast.Call):
+                        ret = unwrap_annotator(sub.value)
+                        if isinstance(ret, ast.Call) \
+                                and module.resolve(ret.func) in JIT_FNS:
+                            self.factories.add(node.name)
         for _ in range(3):  # attrs may chain through factories found above
             changed = False
             for module in modules:

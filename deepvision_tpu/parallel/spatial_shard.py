@@ -43,8 +43,36 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, SPATIAL_AXIS
+from ..core.steps import annotate_step
 
 MANUAL_AXES = (DATA_AXIS, SPATIAL_AXIS)
+
+# The collective contract of this module's spatial primitives, keyed by
+# probe name — consumed by `deepvision_tpu/check` (jaxvet's COLL family),
+# which traces the REAL functions below over a virtual spatial mesh and
+# diffs the collectives it finds in the jaxpr against this declaration. A
+# mis-axed collective (the `all_to_all(x, "data", ...)` class of typo that
+# jaxlint's SHD001 cannot see, because "data" IS a known axis) shows up as
+# a declared-vs-traced mismatch. Keys: (primitive name, axis tuple) ->
+# occurrence count in one probe trace.
+DECLARED_COLLECTIVES = {
+    # halo_exchange(x, 1, 1): one ppermute shifting rows forward, one back
+    "halo_exchange": {("ppermute", (SPATIAL_AXIS,)): 2},
+    # the transition handoff: one tiled all_to_all over 'spatial'
+    "transition": {("all_to_all", (SPATIAL_AXIS,)): 1},
+    # reduce_grads on a single-leaf tree over both manual axes
+    "grad_psum": {("psum", (DATA_AXIS, SPATIAL_AXIS)): 1},
+}
+
+
+def reduce_grads(grads, axes, n_ranks: int):
+    """THE controlled cross-rank gradient reduction (VERDICT r3 item 7),
+    shared by every shard_map train step in this module: each rank computed
+    a disjoint slice of the batch-x-rows work, so sum/n_ranks of the local
+    grads of local mean losses is exactly the global-batch gradient — for
+    every leaf, in both regimes, on any model."""
+    return jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axes) / n_ranks, grads)
 
 
 # -- geometry -------------------------------------------------------------------
@@ -429,12 +457,7 @@ def make_shardmap_classification_train_step(
 
             (loss, (outputs, mutated)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            # THE controlled psum (VERDICT r3 item 7): every rank computed a
-            # disjoint slice of the batch-x-rows work, so sum/n_ranks of the
-            # local grads of local mean losses is exactly the global-batch
-            # gradient — for every leaf, in both regimes, on any model.
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            grads = reduce_grads(grads, axes, n_ranks)
             metrics = {"loss": loss,
                        **losses.topk_accuracies(outputs, labels)}
             metrics = {k: lax.pmean(v, axes)
@@ -458,7 +481,9 @@ def make_shardmap_classification_train_step(
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="train", spatial=True)
 
 
 def make_shardmap_yolo_train_step(
@@ -538,8 +563,7 @@ def make_shardmap_yolo_train_step(
 
             (loss, (comp, mutated)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            grads = reduce_grads(grads, axes, n_ranks)
             metrics = {"loss": loss,
                        **{f"{k}_loss": jnp.mean(v)
                           for k, v in comp.items() if k != "total"}}
@@ -563,7 +587,9 @@ def make_shardmap_yolo_train_step(
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="train", spatial=True)
 
 
 def make_shardmap_pose_train_step(
@@ -632,8 +658,7 @@ def make_shardmap_pose_train_step(
 
             (loss, mutated), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            grads = reduce_grads(grads, axes, n_ranks)
             metrics = {"loss": lax.pmean(loss, axes)}
             new_bs = mutated.get("batch_stats", batch_stats)
             return grads, new_bs, metrics
@@ -654,7 +679,9 @@ def make_shardmap_pose_train_step(
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="train", spatial=True)
 
 
 def make_shardmap_centernet_train_step(
@@ -725,8 +752,7 @@ def make_shardmap_centernet_train_step(
 
             (loss, (comp, mutated)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, axes) / n_ranks, grads)
+            grads = reduce_grads(grads, axes, n_ranks)
             metrics = {"loss": loss,
                        **{f"{k}_loss": jnp.mean(v) for k, v in comp.items()
                           if k != "total"}}
@@ -750,4 +776,6 @@ def make_shardmap_centernet_train_step(
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
     jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
-    return jax.jit(step, **jit_kwargs)
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="train", spatial=True)

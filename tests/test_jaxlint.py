@@ -594,3 +594,75 @@ def test_toml_subset_parser():
     assert section["exclude"] == ["a", "b/c"]
     assert section["disable"] == ["DON001", "JIT001"]
     assert section["flag"] is True and section["n"] == 3
+
+
+# -- the mtime-keyed result cache (lint/cache.py) ----------------------------
+
+def _counting_rules(monkeypatch):
+    """Wrap every rule's check fn to record which module paths it analyzed
+    — the observable for 'only changed files re-run the rules'."""
+    analyzed = []
+
+    def wrap(check):
+        def counting(module, index, config):
+            analyzed.append(module.path)
+            return check(module, index, config)
+        return counting
+
+    for rule_id, (a, check, doc) in list(ALL_RULES.items()):
+        monkeypatch.setitem(ALL_RULES, rule_id, (a, wrap(check), doc))
+    return analyzed
+
+
+def test_lint_cache_touch_then_relint(tmp_path, monkeypatch):
+    """The cache contract: a second identical run analyzes nothing, a
+    touched-but-unchanged file re-analyzes ONLY itself (same findings),
+    and a real content edit re-analyzes everything (interprocedural rules:
+    file B can change findings in file A) and surfaces the new finding."""
+    import shutil
+    import time
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text("[tool.jaxlint]\n")
+    shutil.copy(os.path.join(DATA, "jit001_pos.py"), proj / "hot.py")
+    (proj / "clean.py").write_text("import jax\n\n\ndef f(x):\n"
+                                   "    return x + 1\n")
+    analyzed = _counting_rules(monkeypatch)
+
+    # cold: both files analyzed, the fixture's JIT001 reported, cache lands
+    first = lint_paths([str(proj)], root=str(proj))
+    assert [f.rule for f in first] == ["JIT001"]
+    assert set(analyzed) == {str(proj / "hot.py"), str(proj / "clean.py")}
+    assert os.path.exists(proj / ".cache" / "jaxlint" / "cache.json")
+
+    # warm, untouched: full skip — zero rule executions, identical findings
+    analyzed.clear()
+    assert [f.to_json() for f in lint_paths([str(proj)], root=str(proj))] \
+        == [f.to_json() for f in first]
+    assert analyzed == []
+
+    # touch without edit: only the touched file re-runs, findings identical
+    now = time.time() + 10
+    os.utime(proj / "hot.py", (now, now))
+    analyzed.clear()
+    again = lint_paths([str(proj)], root=str(proj))
+    assert [f.to_json() for f in again] == [f.to_json() for f in first]
+    assert set(analyzed) == {str(proj / "hot.py")}
+
+    # real edit: a second jit-in-loop in clean.py — everything re-analyzes
+    # (project content key changed) and the new finding appears; the cache
+    # must never serve stale silence
+    (proj / "clean.py").write_text(
+        "import jax\n\n\ndef g(batches):\n    out = []\n"
+        "    for b in batches:\n"
+        "        out.append(jax.jit(lambda x: x * 2)(b))\n    return out\n")
+    analyzed.clear()
+    edited = lint_paths([str(proj)], root=str(proj))
+    assert sorted(f.rule for f in edited) == ["JIT001", "JIT001"]
+    assert set(analyzed) == {str(proj / "hot.py"), str(proj / "clean.py")}
+
+    # --no-cache bypasses reads and writes: rules always run
+    analyzed.clear()
+    lint_paths([str(proj)], root=str(proj), use_cache=False)
+    assert set(analyzed) == {str(proj / "hot.py"), str(proj / "clean.py")}

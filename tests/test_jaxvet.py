@@ -1,0 +1,258 @@
+"""jaxvet (deepvision_tpu/check): registry hygiene, CLI contract, and the
+mutation tests that replant each real bug shape into a copy of the actual
+package and prove the IR audit fires where the AST linter cannot see.
+
+Mutation protocol: copy `deepvision_tpu/` + `CHECK_COST.json` into tmp,
+apply one surgical source mutation, and run `python -m deepvision_tpu.check`
+as a subprocess with the mutated tree first on PYTHONPATH. The unmutated
+halves run in-process against the real package (`check.audit`), which is
+the strongest "clean tree is silent" statement available.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- registry hygiene (the sweep's non-vacuity contract) ---------------------
+
+def test_registry_hygiene_every_config_resolves():
+    """Every CONFIGS entry must resolve to a registered MODELS entry, a
+    trainer family (or the adversarial machinery), a harness builder, and
+    a non-empty audit-unit plan — a config that silently resolves to
+    nothing would make the jaxvet sweep quietly smaller than the registry."""
+    from deepvision_tpu.check.harness import (_FAMILY_BUILDERS,
+                                              config_unit_names)
+    from deepvision_tpu.configs import CONFIGS, trainer_class_for_config
+    from deepvision_tpu.models import MODELS
+
+    assert CONFIGS.names(), "empty registry"
+    for name in CONFIGS.names():
+        cfg = CONFIGS.get(name)
+        assert cfg.model in MODELS, f"{name}: model {cfg.model!r} unregistered"
+        trainer = trainer_class_for_config(name)
+        if cfg.family == "gan":
+            assert trainer is None
+        else:
+            assert trainer is not None, f"{name}: no trainer class"
+        assert cfg.family in _FAMILY_BUILDERS, \
+            f"{name}: family {cfg.family!r} has no jaxvet builder"
+        units = config_unit_names(name)
+        assert units and all(u.startswith(name + "/") for u in units)
+
+
+def test_cost_baseline_covers_whole_registry():
+    """CHECK_COST.json (written by the registry-wide sweep) must carry a
+    cost row for every traced unit of every registered config — the
+    committed artifact IS the proof that sweep count equals registry
+    count, refreshed every time the baseline is."""
+    from deepvision_tpu.check.harness import config_unit_names
+    from deepvision_tpu.configs import CONFIGS
+
+    with open(os.path.join(REPO, "CHECK_COST.json")) as fp:
+        baseline = json.load(fp)
+    expected = set()
+    for name in CONFIGS.names():
+        # cost rows exist for jaxpr-traced units (train/eval); predict and
+        # serve units are eval_shape-only
+        expected.update(u for u in config_unit_names(name)
+                        if u.rsplit("/", 1)[1].startswith(("train", "eval")))
+    assert set(baseline["units"]) == expected
+
+
+# -- in-process clean halves + spatial probes --------------------------------
+
+def test_clean_tree_lenet5_and_spatial_silent():
+    """The unmutated package audits clean on the exact units the mutation
+    tests target (lenet5 DONATE, spatial COLL) — the silent halves of the
+    mutation pairs below."""
+    from deepvision_tpu.check import audit
+
+    findings, report = audit(["lenet5", "spatial"])
+    assert findings == [], [f.format() for f in findings]
+    assert {u for u in report["units"] if u.startswith("lenet5/")} == \
+        {"lenet5/train", "lenet5/eval", "lenet5/serve"}
+    probe_names = {u for u in report["units"] if u.startswith("spatial/")}
+    assert {"spatial/halo_exchange", "spatial/transition",
+            "spatial/grad_psum"} <= probe_names
+
+
+def test_clean_tree_resnet34_silent():
+    """Silent half for the DTYPE and COST mutations (resnet34)."""
+    from deepvision_tpu.check import audit
+
+    findings, _ = audit(["resnet34"], select=["DTYPE", "COST"])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_alias_config_reuses_trace():
+    """objects_as_points is centernet under another name: the audit
+    reports units for BOTH names (sweep count == registry count) from one
+    trace."""
+    from deepvision_tpu.check import audit
+
+    findings, report = audit(["centernet", "objects_as_points"],
+                             select=["DONATE"])
+    assert findings == [], [f.format() for f in findings]
+    assert report["aliases"] == {"objects_as_points": "centernet"}
+    prefixes = {u.split("/")[0] for u in report["units"]}
+    assert prefixes == {"centernet", "objects_as_points"}
+
+
+def test_serve_rule_catches_bucket_drift():
+    """SERVE fires on a bucket signature that cannot cover the input spec
+    (max_batch below the largest bucket = a recompile per oversize flush)."""
+    from deepvision_tpu.check.harness import TracedUnit
+    from deepvision_tpu.check.rules import check_serve
+
+    unit = TracedUnit("x/serve", "x", "predict", serve={
+        "buckets": (1, 8, 32), "max_batch": 16,
+        "example_shape": (32, 32, 1), "input_dtype": "float32",
+        "probe_outs": {1: []}})
+    assert any("max_batch 16" in f.message for f in check_serve(unit))
+    unit.serve["max_batch"] = 32
+    unit.serve["buckets"] = (8, 32)   # no batch-of-1 bucket
+    assert any("batch-of-1" in f.message for f in check_serve(unit))
+
+
+# -- mutation harness --------------------------------------------------------
+
+def _mutated_tree(tmp_path, mutate):
+    """Copy the package + cost baseline, apply `mutate(tree_root)`."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copytree(os.path.join(REPO, "deepvision_tpu"),
+                    tree / "deepvision_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copy(os.path.join(REPO, "CHECK_COST.json"),
+                tree / "CHECK_COST.json")
+    mutate(str(tree))
+    return tree
+
+
+def _edit(tree, relpath, old, new, count=1):
+    path = os.path.join(tree, relpath)
+    with open(path) as fp:
+        src = fp.read()
+    assert src.count(old) >= count, f"mutation anchor drifted in {relpath}"
+    with open(path, "w") as fp:
+        fp.write(src.replace(old, new))
+
+
+def _run_check(tree, *args):
+    env = dict(os.environ, PYTHONPATH=str(tree), JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepvision_tpu.check", *args,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(str(tree)))
+    return proc
+
+
+def _findings(proc):
+    assert proc.stdout.strip(), proc.stderr[-2000:]
+    return json.loads(proc.stdout)["findings"]
+
+
+def test_mutation_donate_stripped(tmp_path):
+    """PR 1/4 bug shape: the donation line vanishes from the real
+    classification factory while the factory still claims donate=True —
+    jaxlint sees nothing wrong (no use-after-donate in source), jaxvet's
+    DONATE sees the traced step donating nothing."""
+    tree = _mutated_tree(tmp_path, lambda t: _edit(
+        t, "deepvision_tpu/core/steps.py",
+        '    jit_kwargs = {}\n    if donate:\n'
+        '        jit_kwargs["donate_argnums"] = (0,)\n'
+        '    if mesh is not None:\n        repl = NamedSharding(mesh, P())',
+        '    jit_kwargs = {}\n'
+        '    if mesh is not None:\n        repl = NamedSharding(mesh, P())'))
+    proc = _run_check(tree, "lenet5", "--select", "DONATE")
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    found = _findings(proc)
+    assert any(f["check"] == "DONATE" and f["unit"] == "lenet5/train"
+               and "donates no argument" in f["message"] for f in found)
+
+
+def test_mutation_dtype_f32_backbone(tmp_path):
+    """r05 bug shape: the resnet backbone's Conv+BN block math upcast to
+    f32 under a declared-bf16 config — source still reads plausibly
+    (`dtype=jnp.float32` is exactly what the heads legitimately do), but
+    the traced jaxpr shows f32 conv equations off the head path."""
+    tree = _mutated_tree(tmp_path, lambda t: _edit(
+        t, "deepvision_tpu/models/resnet.py",
+        "conv = partial(nn.Conv, use_bias=False, "
+        "kernel_init=he_normal_fanout,\n                       "
+        "dtype=self.dtype)",
+        "conv = partial(nn.Conv, use_bias=False, "
+        "kernel_init=he_normal_fanout,\n                       "
+        "dtype=jnp.float32)", count=2))
+    proc = _run_check(tree, "resnet34", "--select", "DTYPE")
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    found = _findings(proc)
+    assert any(f["check"] == "DTYPE" and "f32 conv_general_dilated"
+               in f["message"] for f in found)
+
+
+def test_mutation_coll_mesh_axis_typo(tmp_path):
+    """The SHD001 blind spot: halo_exchange's ppermute axis typo'd to
+    'data' — a REGISTERED axis, so the AST linter accepts it, but the
+    traced collective no longer matches DECLARED_COLLECTIVES."""
+    tree = _mutated_tree(tmp_path, lambda t: _edit(
+        t, "deepvision_tpu/parallel/spatial_shard.py",
+        "def halo_exchange(x, lo: int, hi: int, *, "
+        "axis_name: str = SPATIAL_AXIS,",
+        "def halo_exchange(x, lo: int, hi: int, *, "
+        "axis_name: str = DATA_AXIS,"))
+    proc = _run_check(tree, "spatial", "--select", "COLL")
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    found = _findings(proc)
+    assert any(f["check"] == "COLL" and f["unit"] == "spatial/halo_exchange"
+               and "ppermute@data" in f["message"] for f in found)
+
+
+def test_mutation_cost_stem_drift(tmp_path):
+    """Cost-model regression shape: the resnet stem silently widened 2x —
+    correct code, correct dtypes, nothing for any hazard rule to say, but
+    FLOPs/bytes drift past the committed CHECK_COST.json tolerance and
+    COST turns it into a PR-diff-visible finding."""
+    tree = _mutated_tree(tmp_path, lambda t: _edit(
+        t, "deepvision_tpu/models/resnet.py",
+        "x = nn.Conv(self.width, (7, 7), strides=(2, 2),",
+        "x = nn.Conv(self.width * 2, (7, 7), strides=(2, 2),"))
+    proc = _run_check(tree, "resnet34", "--select", "COST")
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    found = _findings(proc)
+    assert any(f["check"] == "COST" and "drifted" in f["message"]
+               for f in found)
+
+
+# -- CLI contract ------------------------------------------------------------
+
+def test_cli_usage_errors():
+    from deepvision_tpu.check.cli import main
+
+    assert main(["definitely_not_a_config"]) == 2
+    assert main(["--select", "BOGUS"]) == 2
+    assert main(["--update-cost", "lenet5"]) == 2
+
+
+def test_cli_clean_json(capsys):
+    """Library main on one config: exit 0, json schema with cost rows for
+    the traced units and an empty findings list."""
+    from deepvision_tpu.check.cli import main
+
+    rc = main(["lenet5_digits", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == []
+    assert set(out["cost"]) == {"lenet5_digits/train", "lenet5_digits/eval"}
+    assert {"flops", "bytes", "eqns"} <= set(
+        out["cost"]["lenet5_digits/train"])
+    assert out["summary"]["units"] == 3

@@ -10,6 +10,10 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
   lint        jaxlint static analysis over the framework + tools
               (docs/LINTING.md): a donation-aliasing or host-sync hazard
               must stop a launch BEFORE it burns pod-hours
+  check       jaxvet IR audit (docs/CHECKING.md) of the fixed lenet5
+              config + the spatial collective probes: the traced step
+              must honor its declared dtype/donation/collective/cost
+              invariants (the registry-wide sweep runs in CI)
   serve       serving-stack smoke (docs/SERVING.md): bucketed AOT predict
               cache + dynamic micro-batcher + graceful drain on the tiny
               fixed lenet5 config — concurrent requests must coalesce,
@@ -99,6 +103,25 @@ def check_lint(args):
             f"{len(findings)} jaxlint finding(s) — fix or `# jaxlint: "
             f"disable=RULE` with a justification before launching: {head}")
     return "jaxlint clean (project-wide)"
+
+
+@check("check")
+def check_check(args):
+    # jaxvet IR audit (docs/CHECKING.md) on the tiny fixed lenet5 config +
+    # the spatial collective probes: the step must trace abstractly and
+    # honor the dtype/donation/collective/cost invariants BEFORE a launch
+    # trusts it. The registry-wide sweep is CI's job (`make check`); one
+    # config keeps this gate seconds, same trade as check_serve.
+    from deepvision_tpu.check import audit
+
+    findings, report = audit(["lenet5", "spatial"])
+    if findings:
+        head = "; ".join(f.format() for f in findings[:3])
+        raise RuntimeError(
+            f"{len(findings)} jaxvet finding(s) — the traced IR violates a "
+            f"declared invariant (docs/CHECKING.md): {head}")
+    return (f"jaxvet clean ({report['n_units']} units, "
+            f"{len(report['skipped'])} skipped)")
 
 
 @check("serve")
@@ -520,6 +543,7 @@ def main(argv=None):
         args.image_size = 224 if platform == "tpu" else 64
 
     check_lint(args)
+    check_check(args)
     check_serve(args)
     check_fleet(args)
     check_devices(args)
