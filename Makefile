@@ -11,7 +11,8 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
         bench-input dryrun smoke serve-smoke serve-fleet-smoke preflight \
-        preflight-record lint lint-changed fsck check check-update-cost
+        preflight-record lint lint-changed fsck check check-update-cost \
+        reshard-parity
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
 	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
@@ -42,6 +43,16 @@ lint-changed: ## jaxlint over only the files `git diff` touches (staged or
 	  --exclude-standard) | sort -u | grep '\.py$$' | grep -v '^tests/data/lint/' ); \
 	if [ -z "$$files" ]; then echo "lint-changed: no changed .py files"; \
 	else $(PY) -m deepvision_tpu.lint $$files; fi
+
+reshard-parity: ## elastic-resume N->M parity matrix (docs/FAILURES.md
+	## "Elastic resume"): train on the 8-virtual-device mesh, resume on
+	## M in {1, N/2, 2N incl. SIGKILL} and across data->model-parallel
+	## and data->spatial-parallel switches, and pin that the resumed
+	## loss trajectory matches the uninterrupted run — plus the quick
+	## leaf-exact save-on-8/restore-on-2 self-check
+	env $(CPU_ENV) $(PY) tools/verify_reshard.py
+	env $(CPU_ENV) $(PY) -m pytest -x -q -m "" tests/test_reshard.py \
+	    -k "parity or elastic"
 
 RUN_DIR ?= runs
 fsck:        ## checkpoint-integrity audit (docs/FAILURES.md): verify every

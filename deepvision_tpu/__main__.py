@@ -7,9 +7,10 @@
 fsck walks every checkpoint directory it can find under the given path (the
 path itself when it holds committed epochs, its `ckpt/` child for a run
 workdir, else every `<child>/ckpt` one level down) and prints one line per
-epoch:
+epoch, including the mesh topology each epoch was SAVED under (the shape an
+elastic restore reshards from — docs/FAILURES.md "Elastic resume"):
 
-    OK                epoch 3   9 files  1.2 MB  manifest=ab12cd34
+    OK                epoch 3   1.2 MB  manifest=ab12cd34  mesh=data:4,model:2
     CORRUPT           epoch 2   state/d/...: content hash mismatch (bit rot?)
     MISSING-MANIFEST  epoch 1   no integrity manifest
     QUARANTINED       corrupt-2
@@ -20,7 +21,10 @@ a clean 0), 2 = usage error (path does not exist). `--quarantine` renames
 corrupt epochs (and missing-manifest epochs in dirs whose siblings carry
 manifests — an interrupted save) to `corrupt-<epoch>/` so restores stop
 considering them; `tools/preflight.py` runs the same audit as its fsck
-check. Contract: docs/FAILURES.md.
+check. `--format json` emits ONE machine-readable JSON document (summary +
+full per-epoch reports, no human lines) with the same exit codes — the
+jaxlint/jaxvet machine-readable contract for CI and fleet tooling.
+Contract: docs/FAILURES.md.
 
 The audit is file-level (sizes + sha256 against the manifest) and stdlib-
 only — no jax import, so it is safe and fast on a login host.
@@ -45,31 +49,50 @@ def _human_bytes(n) -> str:
     return f"{n:.1f} TB"
 
 
+def _fmt_mesh(mesh) -> str:
+    """Compact saved-topology tag for the per-epoch line: 'data:4,model:2'
+    (size-1 axes elided — they place nothing); '' when the manifest predates
+    the elastic layer. Pure dict formatting — fsck stays jax-free."""
+    axes = (mesh or {}).get("axes") or {}
+    shown = {k: v for k, v in axes.items() if v > 1} or axes
+    return ",".join(f"{k}:{v}" for k, v in shown.items())
+
+
 def _cmd_fsck(args: argparse.Namespace) -> int:
     from .core import integrity
 
+    machine = args.format == "json"
     path = os.path.abspath(args.path)
     if not os.path.isdir(path):
         print(f"fsck: {args.path!r} is not a directory", file=sys.stderr)
         return 2
     ckpt_dirs = integrity.find_checkpoint_dirs(path)
     if not ckpt_dirs:
-        print(f"fsck: no checkpoint directories under {args.path} "
-              f"(nothing to audit)")
+        if machine:
+            print(json.dumps({"fsck": "ok", "checkpoint_dirs": 0,
+                              "epochs_audited": 0, "corrupt": 0,
+                              "quarantined": False, "reports": []}))
+        else:
+            print(f"fsck: no checkpoint directories under {args.path} "
+                  f"(nothing to audit)")
         return 0
     all_records = []
     n_corrupt = 0
     for d in ckpt_dirs:
         records = integrity.audit(d, quarantine=args.quarantine)
         all_records.append({"dir": d, "epochs": records})
-        print(f"== {d}")
-        if not records:
-            print("   (no committed epochs)")
+        if not machine:
+            print(f"== {d}")
+            if not records:
+                print("   (no committed epochs)")
         for r in records:
             status = r["status"].upper().replace("_", "-")
             if r["status"] == integrity.OK:
                 detail = (f"{_human_bytes(r.get('total_bytes'))}  "
                           f"manifest={r.get('manifest_sha256', '')[:12]}")
+                mesh = _fmt_mesh(r.get("mesh"))
+                if mesh:
+                    detail += f"  mesh={mesh}"
             elif r["status"] == integrity.QUARANTINED:
                 detail = r["detail"]
             else:
@@ -77,7 +100,8 @@ def _cmd_fsck(args: argparse.Namespace) -> int:
                 if "quarantined_to" in r:
                     detail += f" -> {r['quarantined_to']}"
             epoch = f"epoch {r['epoch']}" if r["epoch"] is not None else ""
-            print(f"{status:17s} {epoch:9s} {detail}")
+            if not machine:
+                print(f"{status:17s} {epoch:9s} {detail}")
             n_corrupt += r["status"] == integrity.CORRUPT
     summary = {"fsck": "corrupt" if n_corrupt else "ok",
                "checkpoint_dirs": len(ckpt_dirs),
@@ -86,7 +110,7 @@ def _cmd_fsck(args: argparse.Namespace) -> int:
                    if r["epoch"] is not None),
                "corrupt": n_corrupt,
                "quarantined": args.quarantine and n_corrupt > 0}
-    if args.json:
+    if machine or args.json:
         summary["reports"] = all_records
     print(json.dumps(summary))
     return 1 if n_corrupt else 0
@@ -110,7 +134,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            "restores stop considering them (repair)")
     fsck.add_argument("--json", action="store_true",
                       help="append full per-epoch reports to the summary "
-                           "JSON line")
+                           "JSON line (text mode; see also --format json)")
+    fsck.add_argument("--format", choices=["text", "json"], default="text",
+                      help="'json' emits one machine-readable document "
+                           "(summary + per-epoch reports incl. the saved "
+                           "mesh topology) and no human lines — the "
+                           "jaxlint/jaxvet CLI contract; exit codes "
+                           "unchanged (0/1/2)")
     fsck.set_defaults(fn=_cmd_fsck)
     args = parser.parse_args(argv)
     return args.fn(args)

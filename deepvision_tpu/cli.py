@@ -88,7 +88,13 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                    help="override the config's PRNG seed")
     p.add_argument("--auto-resume", action="store_true",
                    help="resume from the latest checkpoint if one exists "
-                        "(preemption recovery; starts fresh otherwise)")
+                        "(preemption recovery; starts fresh otherwise). "
+                        "Elastic: the checkpoint may come from a DIFFERENT "
+                        "mesh shape — a run preempted on N chips resumes on "
+                        "M, or with --model-parallel/--spatial-parallel "
+                        "changed; restore reshards against the integrity "
+                        "manifest and the next save re-stamps the current "
+                        "mesh (docs/FAILURES.md 'Elastic resume')")
     p.add_argument("--resume", choices=["strict", "fallback"], default=None,
                    help="checkpoint integrity mode for -c/--auto-resume: "
                         "'fallback' (default) verifies the integrity "
@@ -96,7 +102,10 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
                         "epoch (corrupt-<N>/) and resumes from the next-"
                         "newest epoch that verifies; 'strict' refuses to "
                         "restore an unverified checkpoint (docs/FAILURES.md; "
-                        "audit with `python -m deepvision_tpu fsck`)")
+                        "audit with `python -m deepvision_tpu fsck`). Both "
+                        "modes reshard a checkpoint saved under a different "
+                        "mesh shape — the manifest's verified per-leaf "
+                        "shapes/hashes are the re-slicing source of truth")
     p.add_argument("--recover-on-divergence", type=int, default=None,
                    metavar="N",
                    help="when an epoch's loss goes non-finite, roll back to "

@@ -19,6 +19,17 @@ instead of dying on an opaque deserialization error. Failures inside the
 async background write (previously lost until `close()`) are captured by the
 finalizer and re-raised through the `what="ckpt_save"` retry path at the
 next `save()`/`flush()` barrier.
+
+Elastic restore (core/reshard.py): every save also stamps the mesh topology
+and per-leaf sharding specs into that manifest, and `restore()` accepts a
+checkpoint saved under a DIFFERENT mesh than the manager's target mesh:
+the payload is restored host-side, deep-verified against the manifest's
+shape/dtype/hash source of truth, and re-placed under the template's target
+shardings — so a run preempted on N chips resumes on M (or with
+--model-parallel/--spatial-parallel flipped), and the next save re-stamps
+the current mesh so later restores are native again. A mismatch that cannot
+be resolved (e.g. a legacy dir with no manifest whose native restore fails)
+raises a typed `MeshMismatch` naming both topologies.
 """
 
 from __future__ import annotations
@@ -34,9 +45,10 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
-from . import integrity
+from . import integrity, reshard
 from .integrity import CheckpointCorruptionError  # noqa: F401 — re-export:
 # callers catch it from the module that raised it
+from .reshard import MeshMismatch  # noqa: F401 — re-export, same contract
 from .resilience import RetryPolicy, call_with_retry
 from .train_state import TrainState
 
@@ -51,7 +63,7 @@ class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, keep_best: bool = True,
                  best_mode: str = "max", async_save: bool = True,
                  retry_policy: Optional[RetryPolicy] = None,
-                 on_retry=None, fault_injector=None):
+                 on_retry=None, fault_injector=None, mesh=None):
         """`async_save=True` (SURVEY.md §5.4's async-save goal): `save()`
         kicks off the write in a background thread and training continues on
         device; `restore()`/`close()` barrier on any in-flight save.
@@ -61,7 +73,14 @@ class CheckpointManager:
         `on_retry(what, attempt, exc, delay)` is the trainers' logging hook,
         and `fault_injector` (utils/faults.py) provides the deterministic
         checkpoint-write failures AND post-commit corruption the resilience
-        and integrity tests inject."""
+        and integrity tests inject.
+
+        `mesh` is the owner's device mesh — the RESTORE TARGET for elastic
+        resume (core/reshard.py) and the topology every save stamps into
+        its manifest. None (plain payload callers) keeps the pre-elastic
+        behavior: topology is still derived from NamedSharding leaves when
+        present, and restore is native-only."""
+        self.mesh = mesh
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
@@ -86,6 +105,10 @@ class CheckpointManager:
         # "manifest_sha256", "fallback_skipped"/"legacy"/"mode"} — serving
         # reports it on /healthz so replicas can be audited for weight skew
         self.last_restore_info: Optional[Dict[str, Any]] = None
+        # per-restore elastic record ({"resharded", "saved_mesh"}), written
+        # by _restore_epoch and merged into last_restore_info by restore()
+        self._last_reshard_info: Dict[str, Any] = {"resharded": False,
+                                                   "saved_mesh": None}
         # Integrity finalizer: one worker thread waits for each Orbax commit
         # off the training thread, writes the manifest into the committed
         # epoch dir, and CAPTURES background-write failures (previously those
@@ -163,7 +186,12 @@ class CheckpointManager:
                     files=integrity.hash_tree_files(step_dir),
                     writer={"async_save": self.async_save,
                             "process_index": jax.process_index(),
-                            "host_state_keys": sorted(host_state)})
+                            "host_state_keys": sorted(host_state)},
+                    # mesh topology + per-leaf specs: what elastic restore
+                    # reshards against when the pod size changes (the leaves
+                    # here are the save-time device arrays — the async
+                    # snapshot copy preserves their shardings)
+                    sharding=reshard.sharding_section(payload, self.mesh))
                 integrity.write_manifest(step_dir, manifest)
                 if self.fault_injector is not None:
                     self.fault_injector.corrupt_checkpoint(
@@ -279,7 +307,8 @@ class CheckpointManager:
         if mode == "off":
             new_state, host, got, _ = self._restore_epoch(state, candidates[0])
             self.last_restore_info = {"epoch": got, "verified": False,
-                                      "mode": mode, "manifest_sha256": None}
+                                      "mode": mode, "manifest_sha256": None,
+                                      **self._last_reshard_info}
             return new_state, host, got
         any_manifest = any(
             os.path.exists(integrity.manifest_path(self._step_dir(s)))
@@ -297,7 +326,8 @@ class CheckpointManager:
                 new_state, host, got, _ = self._restore_epoch(state, s)
                 self.last_restore_info = {
                     "epoch": got, "verified": False, "mode": mode,
-                    "legacy": True, "manifest_sha256": None}
+                    "legacy": True, "manifest_sha256": None,
+                    **self._last_reshard_info}
                 return new_state, host, got
             problem = None
             if status == integrity.MISSING_MANIFEST:
@@ -317,7 +347,8 @@ class CheckpointManager:
                     self.last_restore_info = {
                         "epoch": got, "verified": True, "mode": mode,
                         "manifest_sha256": integrity.manifest_digest(manifest),
-                        "fallback_skipped": skipped}
+                        "fallback_skipped": skipped,
+                        **self._last_reshard_info}
                     if skipped:
                         self._log(f"restored epoch {got} after skipping "
                                   f"{skipped} bad generation(s)")
@@ -339,46 +370,79 @@ class CheckpointManager:
 
     def _restore_epoch(self, state, epoch: int):
         """One epoch's raw restore (retry-wrapped, EMA-slot tolerant,
-        donation-safe): returns (new_state, host_state, epoch, payload)
-        where `payload` is the copied on-disk tree for deep verification."""
+        donation-safe, mesh-aware): returns (new_state, host_state, epoch,
+        payload) where `payload` is the copied on-disk tree for deep
+        verification. When the manifest records a mesh topology that differs
+        from this manager's target mesh, the restore takes the RESHARDING
+        path (core/reshard.py): host-side deserialization against a numpy
+        template, then device_put under the template's target shardings —
+        the elastic save-on-N/restore-on-M contract."""
         template = self._payload(state)
-
-        def _restore(tmpl):
-            return self._mgr.restore(
-                epoch,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(tmpl),
-                    host=ocp.args.JsonRestore(),
-                ),
-            )
-
+        step_dir = self._step_dir(epoch)
         try:
-            restored = call_with_retry(
-                lambda: _restore(template), self.retry_policy,
-                what="ckpt_restore", on_retry=self.on_retry)
-        except ValueError as e:
-            # Orbax requires template == on-disk structure; the EMA slot is
-            # the one legitimately run-dependent key. Retry with it toggled:
-            # a checkpoint WITHOUT EMA restored into an EMA run (ema then
-            # seeds from params below), or a checkpoint WITH EMA restored
-            # into a non-EMA run (eval-only / classify of an EMA-trained
-            # model — restored alongside and dropped below). Any other
-            # structure mismatch (wrong architecture, num_classes...) must
-            # surface as-is, not as a confusing ema-flipped diff.
-            if not isinstance(state, TrainState) or "ema_params" not in str(e):
+            manifest = integrity.load_manifest(step_dir)
+        except (OSError, ValueError):
+            manifest = None  # torn manifest: verified modes already refused
+            # it upstream; verify='off' proceeds natively, as before
+        saved_topo = reshard.manifest_topology(manifest)
+        target_topo = (reshard.mesh_topology(self.mesh)
+                       if self.mesh is not None else None)
+        resharding = (saved_topo is not None and target_topo is not None
+                      and reshard.topologies_differ(saved_topo, target_topo))
+        self._last_reshard_info = {
+            "resharded": resharding,
+            "saved_mesh": (saved_topo or {}).get("axes")
+            if saved_topo else None}
+
+        if resharding:
+            self._log(f"epoch {epoch}: mesh changed since save — resharding "
+                      f"{reshard.describe_topology(saved_topo)} -> "
+                      f"{reshard.describe_topology(target_topo)}")
+            host = self._restore_composite(
+                epoch, reshard.host_template(template), state)
+            # host-side numpy leaves, re-placed under the template's target
+            # shardings (params rules / replication / EMA-like-params); the
+            # caller deep-verifies this payload against the manifest before
+            # trusting it — the hashes were taken over host buffers, so the
+            # check is layout-independent
+            payload = reshard.put_like(host["state"], template)
+            # Donation safety, reshard flavor: on CPU `device_put` of a host
+            # array is zero-copy — the placed jax.Array ALIASES the numpy
+            # buffer Orbax restored into, and the first post-resume train
+            # step DONATES the state, freeing that shared memory out from
+            # under the host reference (measured: segfault in the first
+            # `run_single` after an 8->1-device resume, the same class the
+            # native path's copy fixes). One device-side sharding-preserving
+            # copy severs the aliasing.
+            payload = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                payload)
+            return (self._payload_to_state(state, payload),
+                    dict(host["host"] or {}), epoch, payload)
+
+        if manifest is None and self.mesh is not None:
+            # legacy epoch dir (or pre-elastic manifest): nothing to reshard
+            # against — the PR 4 legacy-restore contract extends to elastic
+            # resume as a same-mesh-only attempt, loudly
+            self._log(f"epoch {epoch}: cannot reshard without an integrity "
+                      f"manifest — restoring same-mesh only (target "
+                      f"{reshard.describe_topology(target_topo)}); if this "
+                      f"checkpoint was saved on a different mesh, restore "
+                      f"it on a matching device count once and re-save")
+        try:
+            restored = self._restore_composite(epoch, template, state)
+        except (ValueError, TypeError, RuntimeError) as e:
+            if isinstance(e, reshard.MeshMismatch):
                 raise
-            flipped = dict(template)
-            if "ema_params" in flipped:
-                flipped.pop("ema_params")
-            else:
-                flipped["ema_params"] = flipped["params"]
-            try:
-                restored = _restore(flipped)
-            except ValueError:
-                # the mismatch wasn't (only) the EMA slot — e.g. a genuinely
-                # different architecture; the ORIGINAL error describes the
-                # user's real template, not the flipped one
-                raise e
+            if manifest is None and self.mesh is not None:
+                # the opaque-deserialization-error case elastic resume
+                # exists to kill: name the topologies instead
+                raise reshard.MeshMismatch(
+                    saved_topo, target_topo,
+                    f"native restore of epoch {epoch} failed ({e}) and no "
+                    f"manifest records the saved topology to reshard "
+                    f"against") from e
+            raise
         # Donation safety: the arrays Orbax hands back can share buffers with
         # its own deserialization machinery (and with the restore template);
         # feeding them straight into a train step that DONATES its state
@@ -391,6 +455,54 @@ class CheckpointManager:
         payload = jax.tree_util.tree_map(
             lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
             restored["state"])
+        return (self._payload_to_state(state, payload),
+                dict(restored["host"] or {}), epoch, payload)
+
+    def _restore_composite(self, epoch: int, template, state):
+        """Retry-wrapped Orbax composite restore with the EMA-flip fallback,
+        shared by the native and resharding paths so the two can never
+        diverge on the structure contract."""
+        def _restore(tmpl):
+            return self._mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(tmpl),
+                    host=ocp.args.JsonRestore(),
+                ),
+            )
+
+        try:
+            return call_with_retry(
+                lambda: _restore(template), self.retry_policy,
+                what="ckpt_restore", on_retry=self.on_retry)
+        except ValueError as e:
+            # Orbax requires template == on-disk structure; the EMA slot is
+            # the one legitimately run-dependent key. Retry with it toggled:
+            # a checkpoint WITHOUT EMA restored into an EMA run (ema then
+            # seeds from params in _payload_to_state), or a checkpoint WITH
+            # EMA restored into a non-EMA run (eval-only / classify of an
+            # EMA-trained model — restored alongside and dropped later). Any
+            # other structure mismatch (wrong architecture, num_classes...)
+            # must surface as-is, not as a confusing ema-flipped diff.
+            if not isinstance(state, TrainState) or "ema_params" not in str(e):
+                raise
+            flipped = dict(template)
+            if "ema_params" in flipped:
+                flipped.pop("ema_params")
+            else:
+                flipped["ema_params"] = flipped["params"]
+            try:
+                return _restore(flipped)
+            except ValueError:
+                # the mismatch wasn't (only) the EMA slot — e.g. a genuinely
+                # different architecture; the ORIGINAL error describes the
+                # user's real template, not the flipped one
+                raise e
+
+    def _payload_to_state(self, state, payload):
+        """Rebuild the caller's state object from a restored payload tree —
+        shared tail of the native and resharding paths (EMA seeding/keeping
+        semantics live here exactly once)."""
         if isinstance(state, TrainState):
             ema = payload.get("ema_params")
             if ema is None:
@@ -411,7 +523,7 @@ class CheckpointManager:
                 ema_params=ema)
         else:
             new_state = payload
-        return new_state, dict(restored["host"] or {}), epoch, payload
+        return new_state
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -113,7 +113,11 @@ class AdversarialTrainer:
                                       on_retry=self._log_retry,
                                       fault_injector=(self.faults
                                                       if self.faults.active
-                                                      else None))
+                                                      else None),
+                                      # elastic resume: both adversarial
+                                      # trainers set self.mesh before
+                                      # calling _init_logging
+                                      mesh=getattr(self, "mesh", None))
         self.start_epoch = 1
 
     def _log_retry(self, what: str, attempt: int, exc: BaseException,

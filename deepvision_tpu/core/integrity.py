@@ -123,8 +123,14 @@ def hash_tree_files(step_dir: str) -> Dict[str, dict]:
 
 def build_manifest(*, epoch: int, leaves: Dict[str, dict],
                    files: Dict[str, dict],
-                   writer: Optional[dict] = None) -> dict:
-    return {
+                   writer: Optional[dict] = None,
+                   sharding: Optional[dict] = None) -> dict:
+    """`sharding` (core/reshard.sharding_section) records the mesh topology
+    and per-leaf PartitionSpecs the payload was saved under — the metadata
+    elastic restore reshards against. Optional: plain host payloads (and
+    manifests written before this field existed) simply omit it and restore
+    same-mesh only."""
+    manifest = {
         "format_version": MANIFEST_VERSION,
         "epoch": int(epoch),
         "created_unix": time.time(),
@@ -134,6 +140,20 @@ def build_manifest(*, epoch: int, leaves: Dict[str, dict],
         "files": files,
         "leaves": leaves,
     }
+    if sharding is not None:
+        manifest["sharding"] = sharding
+    return manifest
+
+
+def sharding_digest(section: dict) -> str:
+    """Self-digest of a manifest's sharding section (the `digest` key
+    excluded): the section steers how restored bytes are laid out across a
+    DIFFERENT mesh than they were saved on, so it must not be silently
+    editable — `verify_files` recomputes this and reports a mismatch as
+    corruption. stdlib-only (fsck's no-jax constraint)."""
+    blob = json.dumps({k: v for k, v in section.items() if k != "digest"},
+                      sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
 
 
 def manifest_digest(manifest: dict) -> str:
@@ -200,6 +220,12 @@ def verify_files(step_dir: str) -> Tuple[str, str]:
             continue
         if file_sha256(path)[1] != rec["sha256"]:
             problems.append(f"{rel}: content hash mismatch (bit rot?)")
+    section = manifest.get("sharding")
+    if section is not None and section.get("digest") != \
+            sharding_digest(section):
+        problems.append("sharding section tampered (self-digest mismatch — "
+                        "mesh topology / per-leaf specs not trustworthy for "
+                        "an elastic restore)")
     if problems:
         head = "; ".join(problems[:4])
         more = f" (+{len(problems) - 4} more)" if len(problems) > 4 else ""
@@ -295,6 +321,11 @@ def audit(ckpt_dir: str, quarantine: bool = False) -> List[dict]:
             manifest = load_manifest(step_dir)
             rec["manifest_sha256"] = manifest_digest(manifest)
             rec["total_bytes"] = manifest.get("total_bytes")
+            # saved mesh topology (core/reshard.py): fsck reports what shape
+            # each epoch expects so an operator planning an elastic resume
+            # can see which epochs need resharding — None for pre-elastic
+            # manifests and plain host payloads
+            rec["mesh"] = (manifest.get("sharding") or {}).get("mesh")
         suspect = status == CORRUPT or (status == MISSING_MANIFEST
                                         and any_manifest)
         if quarantine and suspect:

@@ -346,7 +346,10 @@ class Trainer:
             self.workdir + "/ckpt", keep=self.config.keep_checkpoints,
             keep_best=self.config.keep_best, best_mode=mode,
             retry_policy=self.retry_policy, on_retry=self._log_retry,
-            fault_injector=self.faults if self.faults.active else None)
+            fault_injector=self.faults if self.faults.active else None,
+            # elastic resume (core/reshard.py): saves stamp this mesh into
+            # the manifest, restores reshard checkpoints saved on another
+            mesh=self.mesh)
 
     def _log_retry(self, what: str, attempt: int, exc: BaseException,
                    delay: float) -> None:
@@ -615,8 +618,20 @@ class Trainer:
                              "ckpt_verified":
                                 1.0 if info.get("verified") else 0.0},
                             prefix="resilience_", echo=False)
+        if _is_main_process() and info.get("resharded"):
+            # elastic resume took the resharding path: the next save
+            # re-stamps the CURRENT mesh, so later restores are native —
+            # leave the one-time event in the metrics stream for forensics
+            self.logger.log(self._host_step, {"ckpt_resharded": 1.0},
+                            prefix="resilience_", echo=False)
         if _is_main_process():
-            print(f"[{self.config.name}] resumed from epoch {got}", flush=True)
+            note = ""
+            if info.get("resharded"):
+                saved = info.get("saved_mesh") or {}
+                note = (" (resharded from mesh "
+                        f"{saved or 'unknown'} to {dict(self.mesh.shape)})")
+            print(f"[{self.config.name}] resumed from epoch {got}{note}",
+                  flush=True)
         return got
 
     # -- loops ------------------------------------------------------------

@@ -353,6 +353,13 @@ def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
     matching the reference's replicated-weights semantics. For wide final projections
     (e.g. the 2048x1000 ResNet-50 head) a model axis > 1 shards the weight so the
     matmul runs as a partial-K/N matmul with an all-reduce inserted by GSPMD.
+
+    Contract (elastic resume, core/reshard.py): this is a PURE function of
+    (mesh topology, leaf shapes) — no device identities, no history — so
+    the same params re-place deterministically on ANY target mesh. That
+    determinism is what lets a resharding restore recompute placement from
+    the restore template instead of persisting device assignments in the
+    checkpoint; changing the rule only changes layout, never values.
     """
     model_size = mesh.shape[MODEL_AXIS]
 

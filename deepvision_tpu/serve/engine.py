@@ -53,9 +53,16 @@ def load_checkpoint_weights(name: str, workdir: str, *,
     Returns `(apply_fn, variables, provenance, cfg)` where `variables` is
     the host-side `{params[, batch_stats]}` dict an engine dispatches with
     and `provenance` is the `{weights, checkpoint_epoch, verified,
-    manifest_sha256}` record /healthz reports. Shared by
+    manifest_sha256, resharded}` record /healthz reports. Shared by
     `PredictEngine.from_config` (startup) and `reload.WeightReloader`
-    (hot swap) so the two paths can never verify differently."""
+    (hot swap) so the two paths can never verify differently.
+
+    Elastic wire-through (core/reshard.py): the restore runs through the
+    trainer's mesh-aware CheckpointManager, so a checkpoint saved on a
+    multi-chip pod loads (and hot-reloads) on this host's device count
+    without manual surgery — the manifest's verified shapes/hashes are the
+    re-slicing source of truth, and `resharded: true` lands in the
+    provenance so a fleet audit can see which replicas crossed a mesh."""
     from ..configs import get_config, trainer_class_for_config
     cfg = get_config(name)
     if cfg.family == "gan":
@@ -81,6 +88,7 @@ def load_checkpoint_weights(name: str, workdir: str, *,
             "checkpoint_epoch": got,
             "verified": bool(info.get("verified", False)),
             "manifest_sha256": info.get("manifest_sha256"),
+            "resharded": bool(info.get("resharded", False)),
         }
         if (got is not None and not provenance["verified"]
                 and verbose):
@@ -171,7 +179,7 @@ class PredictEngine:
         # verified?) — filled by from_config when restoring a checkpoint
         self.provenance = dict(provenance or {
             "weights": "random-init", "checkpoint_epoch": None,
-            "verified": False, "manifest_sha256": None})
+            "verified": False, "manifest_sha256": None, "resharded": False})
         self.input_dtype = np.dtype(np.uint8 if input_norm is not None
                                     else np.float32)
         # params live on ONE device, committed once — compiled calls reuse

@@ -17,6 +17,9 @@ newer than the weights currently live, and for each candidate:
    (`engine.load_checkpoint_weights` — the exact code path startup uses,
    including the deep per-leaf hash check and EMA-weights-win), entirely
    on the poller thread. Request threads never block on I/O or hashing.
+   This is the mesh-aware restore (core/reshard.py): a checkpoint the
+   training pod saved on N chips hot-reloads on this host's device count
+   with no manual surgery, and the swap provenance records `resharded`.
 3. **Swap atomically.** `PredictEngine.swap_variables` stages the new
    weights on device, checks them against the compiled signature (same
    tree/shapes/dtypes — so the AOT bucket cache is reused and NOTHING
@@ -167,8 +170,10 @@ class WeightReloader:
         _log(sm.name, f"hot-swapped weights: epoch {current if current >= 0 else 'random-init'} "
                       f"-> {epoch} (manifest "
                       f"{(provenance.get('manifest_sha256') or '')[:12]}, "
-                      f"verified={provenance.get('verified')}; AOT bucket "
-                      f"cache reused, zero recompiles)")
+                      f"verified={provenance.get('verified')}"
+                      + (", resharded from the saved mesh to this host"
+                         if provenance.get("resharded") else "")
+                      + "; AOT bucket cache reused, zero recompiles)")
         return True
 
     def _refuse(self, sm: ServedModel, epoch: int, counter: str,

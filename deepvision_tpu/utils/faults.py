@@ -40,7 +40,13 @@ and in-process tests configure it the same way:
                                              `bitflip` (flip one bit in its
                                              middle), `delete_manifest` (what
                                              a kill between data commit and
-                                             manifest commit leaves behind)
+                                             manifest commit leaves behind),
+                                             `tamper_sharding` (edit the
+                                             manifest's mesh-topology/sharding
+                                             section without refreshing its
+                                             self-digest — the metadata an
+                                             ELASTIC restore reshards against;
+                                             verification must refuse it)
 
 An unset environment yields an inert injector (`active` False) whose hooks
 are cheap no-ops — production runs pay two integer compares per batch.
@@ -54,7 +60,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-CORRUPT_MODES = ("truncate", "bitflip", "delete_manifest")
+CORRUPT_MODES = ("truncate", "bitflip", "delete_manifest", "tamper_sharding")
 
 
 def _parse_step_count(raw: Optional[str]) -> Tuple[Optional[int], int]:
@@ -187,6 +193,22 @@ class FaultInjector:
         if mode == "delete_manifest":
             target = os.path.join(step_dir, manifest_name)
             os.remove(target)
+        elif mode == "tamper_sharding":
+            # rewrite the manifest with its mesh-topology section edited but
+            # the self-digest left stale — an elastic restore steered by this
+            # section would re-slice wrong, so verification must catch it
+            import json
+            target = os.path.join(step_dir, manifest_name)
+            with open(target) as fp:
+                manifest = json.load(fp)
+            section = manifest.setdefault(
+                "sharding", {"mesh": None, "leaves": {}, "digest": ""})
+            mesh = section.get("mesh") or {}
+            axes = dict(mesh.get("axes") or {})
+            axes["data"] = int(axes.get("data", 1)) * 2  # a plausible lie
+            section["mesh"] = {**mesh, "axes": axes}
+            with open(target, "w") as fp:
+                json.dump(manifest, fp, sort_keys=True, indent=1)
         else:
             candidates = sorted(
                 (os.path.join(root, f)

@@ -1,0 +1,512 @@
+"""Elastic training (core/reshard.py): save on N chips, restore on M.
+
+Mesh-metadata roundtrip (the manifest stamps topology + per-leaf specs and
+tampering reads as corruption), leaf-exact host-side re-slicing across mesh
+shapes, the typed MeshMismatch contract, legacy no-manifest behavior, the
+N->M training-parity matrix (resume on 1 / N/2 devices and across a
+data->model-parallel switch must reproduce the uninterrupted loss
+trajectory), a SIGKILL + resume-on-2N subprocess case, corruption injected
+DURING an elastic resume falling back through the verified-generation
+chain, and the serve-side wire-through (multi-chip checkpoint -> 1-process
+engine with `resharded` provenance)."""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu.core import integrity, reshard
+from deepvision_tpu.core.checkpoint import CheckpointManager, MeshMismatch
+from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                        ScheduleConfig, TrainConfig)
+from deepvision_tpu.core.resilience import RetryPolicy
+from deepvision_tpu.data.synthetic import SyntheticClassification
+from deepvision_tpu.parallel import mesh as mesh_lib
+from deepvision_tpu.utils.faults import FaultInjector
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FAST = RetryPolicy(max_retries=3, base_delay=0.01, max_delay=0.02)
+
+# Cross-mesh float tolerance: the same global batch reduces in a different
+# order on a different device count (and GSPMD may refuse/fuse differently),
+# so per-epoch losses agree to reassociation noise, not bit-exactly — same
+# discipline as test_device_augment's trajectory-parity bound, with headroom
+# for the deeper (4-epoch adam) trajectories compared here.
+RTOL, ATOL = 1e-3, 1e-6
+
+
+def _payload(scale=1.0):
+    """A TrainState-shaped dict with one genuinely model-shardable tensor
+    (1024x1024 f32 == param_sharding_rules' min_size_to_shard)."""
+    return {"step": np.asarray(int(scale), np.int32),
+            "params": {"w": (np.arange(1024 * 1024, dtype=np.float32)
+                             .reshape(1024, 1024) * scale),
+                       "b": np.linspace(-1, 1, 16).astype(np.float32)
+                       * scale}}
+
+
+def _place(payload, mesh):
+    return {"step": jax.device_put(jnp.asarray(payload["step"]),
+                                   mesh_lib.replicated(mesh)),
+            "params": jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, payload["params"]),
+                mesh_lib.param_sharding_rules(mesh, payload["params"]))}
+
+
+def _save_epochs(path, mesh, *epochs, **kw):
+    kw.setdefault("keep", 8)
+    kw.setdefault("keep_best", False)
+    kw.setdefault("retry_policy", FAST)
+    m = CheckpointManager(str(path), mesh=mesh, **kw)
+    for e in epochs:
+        m.save(e, _place(_payload(e), mesh))
+    m.flush()
+    return m
+
+
+# -- mesh-metadata roundtrip --------------------------------------------------
+
+def test_manifest_stamps_mesh_topology_and_specs(tmp_path, mesh_4x2):
+    """Every save records the mesh topology and per-leaf PartitionSpecs in
+    the integrity manifest, self-digested; verify/audit accept the intact
+    section and fsck's audit surfaces the topology per epoch."""
+    _save_epochs(tmp_path / "ckpt", mesh_4x2, 1).close()
+    step_dir = str(tmp_path / "ckpt" / "1")
+    manifest = integrity.load_manifest(step_dir)
+    section = manifest["sharding"]
+    assert section["mesh"]["axes"] == {"data": 4, "model": 2}
+    assert section["mesh"]["device_count"] == 8
+    assert section["leaves"]["['params']['w']"] == [None, "model"]
+    assert section["leaves"]["['params']['b']"] is not None  # replicated: []
+    assert section["digest"] == integrity.sharding_digest(section)
+    assert integrity.verify_files(step_dir)[0] == integrity.OK
+    status, _, digest = integrity.verify_epoch(str(tmp_path / "ckpt"), 1)
+    assert status == integrity.OK and digest == integrity.manifest_digest(
+        manifest)
+    rec = integrity.audit(str(tmp_path / "ckpt"))[0]
+    assert rec["mesh"]["axes"] == {"data": 4, "model": 2}
+
+
+def test_topology_normalization_and_describe():
+    """Size-1 axes place nothing: (data=8, model=1) and (data=8) are the
+    SAME topology (no spurious reshard on every resume), while any real
+    shape/process change differs."""
+    a = {"axes": {"data": 8, "model": 1}, "device_count": 8,
+         "process_count": 1}
+    b = {"axes": {"data": 8}, "device_count": 8, "process_count": 1}
+    assert not reshard.topologies_differ(a, b)
+    assert reshard.topologies_differ(
+        a, {**a, "axes": {"data": 4, "model": 2}})
+    assert reshard.topologies_differ(a, {**a, "device_count": 4})
+    assert reshard.topologies_differ(a, {**a, "process_count": 2})
+    assert "data=4 x model=2" in reshard.describe_topology(
+        {"axes": {"data": 4, "model": 2}, "device_count": 8,
+         "process_count": 1})
+    assert "unknown" in reshard.describe_topology(None)
+
+
+def test_sharding_tamper_detected_and_quarantined(tmp_path, mesh_4x2):
+    """A manifest whose sharding section was edited without refreshing the
+    self-digest reads as CORRUPT (verify_epoch — the hot-reload gate — and
+    verify_files both refuse it), and fallback restore quarantines the
+    epoch instead of resharding by untrustworthy metadata."""
+    m = _save_epochs(tmp_path / "ckpt", mesh_4x2, 1, 2)
+    mp = integrity.manifest_path(str(tmp_path / "ckpt" / "2"))
+    with open(mp) as fp:
+        manifest = json.load(fp)
+    manifest["sharding"]["mesh"]["axes"]["data"] = 99
+    with open(mp, "w") as fp:
+        json.dump(manifest, fp)
+    status, detail, digest = integrity.verify_epoch(str(tmp_path / "ckpt"), 2)
+    assert status == integrity.CORRUPT and "sharding" in detail
+    assert digest is None
+    _, _, epoch = m.restore(_place(_payload(0), mesh_4x2))
+    assert epoch == 1
+    assert (tmp_path / "ckpt" / "corrupt-2").is_dir()
+    m.close()
+
+
+def test_fault_injector_tamper_sharding_mode(tmp_path, mesh8, monkeypatch):
+    """DEEPVISION_FAULT_CKPT_CORRUPT=k:tamper_sharding — the chaos hook for
+    the metadata an elastic restore is steered by: the save commits clean,
+    the injector edits the topology in place, verification must catch it."""
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:tamper_sharding")
+    inj = FaultInjector.from_env()
+    assert inj.active
+    m = _save_epochs(tmp_path / "ckpt", mesh8, 1, 2, fault_injector=inj)
+    status, detail = integrity.verify_files(str(tmp_path / "ckpt" / "2"))
+    assert status == integrity.CORRUPT and "sharding" in detail
+    _, _, epoch = m.restore(_place(_payload(0), mesh8))
+    assert epoch == 1
+    assert (tmp_path / "ckpt" / "corrupt-2").is_dir()
+    m.close()
+
+
+# -- leaf-exact re-slicing ----------------------------------------------------
+
+def test_reshard_restore_leaf_exact(tmp_path, mesh_4x2):
+    """Save with a leaf actually SHARDED over 'model' on 8 devices; strict-
+    restore on a 2-device data mesh: values bit-exact, leaves land under
+    the template's target shardings, provenance says resharded."""
+    _save_epochs(tmp_path / "ckpt", mesh_4x2, 3).close()
+    mesh2 = mesh_lib.make_mesh(jax.devices()[:2])
+    template = _place(_payload(0), mesh2)
+    m = CheckpointManager(str(tmp_path / "ckpt"), keep=8, keep_best=False,
+                          retry_policy=FAST, mesh=mesh2)
+    restored, _, epoch = m.restore(template, verify="strict")
+    assert epoch == 3
+    want = _payload(3)
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  want["params"]["w"])
+    np.testing.assert_array_equal(np.asarray(restored["params"]["b"]),
+                                  want["params"]["b"])
+    assert (restored["params"]["w"].sharding
+            == template["params"]["w"].sharding)
+    info = m.last_restore_info
+    assert info["resharded"] is True and info["verified"] is True
+    assert info["saved_mesh"] == {"data": 4, "model": 2}
+    # native restores on the SAME topology stay native (no reshard flag)
+    m.close()
+    m2 = CheckpointManager(str(tmp_path / "ckpt"), keep=8, keep_best=False,
+                           retry_policy=FAST, mesh=mesh_4x2)
+    m2.restore(_place(_payload(0), mesh_4x2), verify="strict")
+    assert m2.last_restore_info["resharded"] is False
+    m2.close()
+
+
+def test_legacy_no_manifest_warns_and_restores_same_mesh(tmp_path, mesh8,
+                                                         capfd):
+    """Legacy epoch dirs (no manifest anywhere) hitting a mesh-aware
+    manager restore same-mesh with the explicit 'cannot reshard without
+    manifest' warning instead of a traceback — the PR 4 legacy contract
+    extended to elastic resume."""
+    m = _save_epochs(tmp_path / "ckpt", mesh8, 1)
+    os.remove(integrity.manifest_path(str(tmp_path / "ckpt" / "1")))
+    restored, _, epoch = m.restore(_place(_payload(0), mesh8))
+    assert epoch == 1
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  _payload(1)["params"]["w"])
+    info = m.last_restore_info
+    assert info.get("legacy") is True and info["resharded"] is False
+    err = capfd.readouterr().err
+    assert "cannot reshard without an integrity manifest" in err
+    assert "restoring same-mesh only" in err
+    m.close()
+
+
+def test_mesh_mismatch_typed_error(tmp_path, mesh8):
+    """When a legacy (manifest-less) native restore fails, the opaque
+    deserialization error becomes a typed MeshMismatch naming the target
+    topology and the remedy."""
+    m = _save_epochs(tmp_path / "ckpt", mesh8, 1)
+    os.remove(integrity.manifest_path(str(tmp_path / "ckpt" / "1")))
+
+    def boom(epoch, template, state):
+        raise ValueError("simulated orbax sharding/shape mismatch")
+
+    m._restore_composite = boom
+    with pytest.raises(MeshMismatch, match="data=8") as ei:
+        m.restore(_place(_payload(0), mesh8))
+    assert "no manifest" in str(ei.value)
+    assert ei.value.saved is None and ei.value.target["device_count"] == 8
+    m.close()
+
+
+# -- training parity: resume on M after training on N ------------------------
+
+def _config(tmp_path, **kw):
+    base = dict(
+        name="elastic", model="lenet5",
+        batch_size=16, total_epochs=4,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        train_examples=16 * 2),
+        dtype="float32",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_every_steps=1,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(epoch):
+    # seeded per epoch exactly like cli._synthetic_data: the batch stream is
+    # a function of (epoch) alone, so every mesh sees identical global data
+    return SyntheticClassification(batch_size=16, image_size=32, channels=1,
+                                   num_classes=10, num_batches=2, seed=epoch)
+
+
+def _epoch_losses(trainer):
+    h = trainer.logger.history["epoch_train_loss"]
+    return dict(zip(h["epochs"], h["value"]))
+
+
+def test_elastic_resume_parity_matrix(tmp_path):
+    """Acceptance: train 2 of 4 epochs on the 8-device mesh, stop, resume
+    on M in {1, N/2} and across data->model-parallel and data->spatial-
+    parallel axis switches; each resumed run's epoch-3/4 loss trajectory
+    must match the uninterrupted 8-device run within cross-mesh float
+    tolerance (the resharded state IS the saved state, just laid out
+    differently). The 2N case runs end-to-end through the CLI in
+    test_elastic_resume_parity_after_sigkill_on_2N."""
+    from deepvision_tpu.core.trainer import Trainer
+
+    devs = jax.devices()
+    base = Trainer(_config(tmp_path), workdir=str(tmp_path / "base"))
+    base.fit(_data, None, sample_shape=(32, 32, 1))
+    want = _epoch_losses(base)
+    base.close()
+    assert set(want) == {1, 2, 3, 4}
+
+    part = Trainer(_config(tmp_path), workdir=str(tmp_path / "part"))
+    part.fit(_data, None, sample_shape=(32, 32, 1), total_epochs=2)
+    part.close()
+
+    cases = {
+        "m1": (None, mesh_lib.make_mesh(devs[:1])),          # M = 1
+        "m4": (None, mesh_lib.make_mesh(devs[:4])),          # M = N/2
+        "mp2": ({"model_parallel": 2}, None),                # data -> model
+        "sp2": ({"spatial_parallel": 2}, None),              # data -> spatial
+    }
+    for name, (cfg_kw, mesh) in cases.items():
+        wd = str(tmp_path / f"resume_{name}")
+        shutil.copytree(str(tmp_path / "part"), wd)
+        tr = Trainer(_config(tmp_path, **(cfg_kw or {})), mesh=mesh,
+                     workdir=wd)
+        tr.init_state((32, 32, 1))
+        assert tr.resume() == 2, name
+        info = tr.ckpt.last_restore_info
+        assert info["resharded"] is True, (name, info)
+        assert info["verified"] is True, (name, info)
+        tr.fit(_data, None, sample_shape=(32, 32, 1))
+        got = _epoch_losses(tr)
+        for epoch in (3, 4):
+            assert np.isfinite(got[epoch]), (name, got)
+            np.testing.assert_allclose(
+                got[epoch], want[epoch], rtol=RTOL, atol=ATOL,
+                err_msg=f"{name}: epoch {epoch} loss diverged from the "
+                        f"uninterrupted N-device run")
+        # the resumed run re-saved under ITS mesh: the next restore from
+        # this workdir on the same mesh is native again
+        manifest = integrity.load_manifest(
+            os.path.join(wd, "ckpt", "4"))
+        assert manifest["sharding"]["mesh"]["axes"] == dict(
+            tr.mesh.shape), name
+        # resilience stream recorded the one-time reshard event
+        assert tr.logger.history["resilience_ckpt_resharded"]["value"] == [1.0]
+        tr.close()
+
+
+def test_elastic_resume_with_ema_flip_across_mesh(tmp_path):
+    """The EMA structure-flip contract survives the resharding path: a
+    non-EMA checkpoint from the 8-device mesh restores into an EMA-enabled
+    run on 4 devices, seeding the average from the restored params."""
+    from deepvision_tpu.core.trainer import Trainer
+
+    tr = Trainer(_config(tmp_path), workdir=str(tmp_path / "wd"))
+    tr.fit(_data, None, sample_shape=(32, 32, 1), total_epochs=1)
+    tr.close()
+    tr2 = Trainer(_config(tmp_path, ema_decay=0.999),
+                  mesh=mesh_lib.make_mesh(jax.devices()[:4]),
+                  workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    assert tr2.resume() == 1
+    assert tr2.ckpt.last_restore_info["resharded"] is True
+    flat_e = jax.tree_util.tree_leaves(tr2.state.ema_params)
+    flat_p = jax.tree_util.tree_leaves(tr2.state.params)
+    assert flat_e and all(np.array_equal(np.asarray(e), np.asarray(p))
+                          for e, p in zip(flat_e, flat_p))
+    tr2.close()
+
+
+def test_corrupt_epoch_during_elastic_resume_falls_back(tmp_path,
+                                                        monkeypatch):
+    """Chaos acceptance: the injector corrupts the newest epoch after its
+    save commits; an ELASTIC resume on a different mesh quarantines it,
+    reshards the next-newest verified generation, and trains on — the
+    PR 4 fallback chain holds across mesh changes."""
+    monkeypatch.setenv("DEEPVISION_IO_RETRY_DELAY", "0.01")
+    monkeypatch.setenv("DEEPVISION_FAULT_CKPT_CORRUPT", "2:bitflip")
+    from deepvision_tpu.core.trainer import Trainer
+
+    tr = Trainer(_config(tmp_path), workdir=str(tmp_path / "wd"))
+    tr.fit(_data, None, sample_shape=(32, 32, 1), total_epochs=2)
+    tr.close()
+    ckpt_root = tmp_path / "wd" / "ckpt"
+    assert integrity.verify_files(str(ckpt_root / "2"))[0] == integrity.CORRUPT
+
+    monkeypatch.delenv("DEEPVISION_FAULT_CKPT_CORRUPT")
+    tr2 = Trainer(_config(tmp_path, total_epochs=3),
+                  mesh=mesh_lib.make_mesh(jax.devices()[:4]),
+                  workdir=str(tmp_path / "wd"))
+    tr2.init_state((32, 32, 1))
+    assert tr2.resume() == 1  # epoch 2 quarantined, epoch 1 resharded in
+    assert (ckpt_root / "corrupt-2").is_dir()
+    info = tr2.ckpt.last_restore_info
+    assert info["resharded"] is True and info["fallback_skipped"] == 1
+    result = tr2.fit(_data, None, sample_shape=(32, 32, 1))
+    assert result["best_metric"] is not None
+    assert tr2.ckpt.latest_epoch() == 3
+    assert np.isfinite(_epoch_losses(tr2)[3])
+    tr2.close()
+
+
+# -- SIGKILL on N, resume on 2N (subprocess, the pod-resize shape) ------------
+
+def _run_lenet(workdir, epochs, n_devices, check=True, **popen_kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={n_devices}",
+               PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.join(REPO, "LeNet", "jax", "train.py"),
+           "-m", "lenet5", "--synthetic", "--epochs", str(epochs),
+           "--steps-per-epoch", "2", "--batch-size", "16",
+           "--workdir", str(workdir), "--auto-resume"]
+    if popen_kw.pop("background", False):
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=600)
+    if check:
+        assert out.returncode == 0, out.stderr[-2000:]
+    return out
+
+
+def _jsonl_epoch_losses(workdir):
+    losses = {}
+    with open(os.path.join(workdir, "lenet5.jsonl")) as fp:
+        for line in fp:
+            rec = json.loads(line)
+            if "epoch_train_loss" in rec:
+                losses[rec["epoch"]] = rec["epoch_train_loss"]
+    return losses
+
+
+def test_elastic_resume_parity_after_sigkill_on_2N(tmp_path):
+    """The pod-resize acceptance shape end-to-end through the CLI: a run
+    SIGKILLed mid-training on 8 devices auto-resumes on 16 (2N) and its
+    post-resume loss trajectory matches an uninterrupted 8-device run.
+    8 epochs + kill at the FIRST committed checkpoint: warm-cache epochs
+    are sub-second, so a short run can race to completion before the
+    signal lands (seen with 3 epochs) — the budget keeps post-resume
+    epochs to compare."""
+    epochs = 8
+    base_wd = tmp_path / "base"
+    _run_lenet(base_wd, epochs, 8)
+    want = _jsonl_epoch_losses(base_wd)
+    assert set(want) == set(range(1, epochs + 1))
+
+    victim_wd = tmp_path / "victim"
+    proc = _run_lenet(victim_wd, epochs, 8, background=True)
+    try:
+        ckpt_root = victim_wd / "ckpt"
+
+        def committed():
+            if not ckpt_root.is_dir():
+                return []
+            return [int(d.name) for d in ckpt_root.iterdir()
+                    if d.is_dir() and d.name.isdigit()]
+
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if committed():
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no committed checkpoint appeared within 420s")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    out = _run_lenet(victim_wd, epochs, 16)  # 2N devices
+    assert "resumed from epoch" in out.stdout
+    assert "resharded from mesh" in out.stdout
+    assert "resharding" in out.stderr  # the checkpoint layer's loud log
+    resumed_from = int(out.stdout.split("resumed from epoch")[1].split()[0])
+    got = _jsonl_epoch_losses(victim_wd)
+    post = [e for e in sorted(want) if e > resumed_from]
+    assert post, f"kill landed after the final epoch ({resumed_from})"
+    for epoch in post:
+        np.testing.assert_allclose(
+            got[epoch], want[epoch], rtol=RTOL, atol=ATOL,
+            err_msg=f"epoch {epoch} loss after 8->16-device resume "
+                    f"diverged from the uninterrupted run")
+
+
+# -- serve-side wire-through --------------------------------------------------
+
+def test_serve_engine_reshards_multichip_checkpoint(tmp_path):
+    """A checkpoint trained on a (data x model) mesh serves through
+    PredictEngine.from_config on this host's default mesh with no manual
+    surgery: strict verify passes, predictions are finite, and the
+    provenance (what /healthz reports) records resharded=True."""
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.serve.engine import PredictEngine
+
+    wd = str(tmp_path / "wd")
+    cfg = get_config("lenet5").replace(
+        batch_size=16, total_epochs=1, model_parallel=2,
+        data=DataConfig(dataset="synthetic", image_size=32, channels=1,
+                        num_classes=10, train_examples=16 * 2),
+        device_augment=False)
+    tr = Trainer(cfg, workdir=wd)
+    tr.fit(_data, None, sample_shape=(32, 32, 1))
+    tr.close()
+
+    engine = PredictEngine.from_config("lenet5", workdir=wd, buckets=(1,),
+                                       verbose=False)
+    prov = engine.provenance
+    assert prov["weights"] == "checkpoint" and prov["checkpoint_epoch"] == 1
+    assert prov["verified"] is True and prov["resharded"] is True
+    out = engine.predict(np.zeros((1, 32, 32, 1), np.float32))
+    assert np.all(np.isfinite(out))
+
+
+# -- fsck surface -------------------------------------------------------------
+
+def test_fsck_reports_mesh_and_format_json(tmp_path, capsys, mesh_4x2):
+    """fsck prints the saved topology per epoch and `--format json` emits
+    one machine-readable document (summary + reports, no human lines) with
+    the unchanged 0/1/2 exit codes."""
+    from deepvision_tpu.__main__ import main
+
+    wd = tmp_path / "run"
+    _save_epochs(wd / "ckpt", mesh_4x2, 1, 2).close()
+
+    assert main(["fsck", str(wd)]) == 0
+    out = capsys.readouterr().out
+    assert out.count("mesh=data:4,model:2") == 2
+
+    assert main(["fsck", str(wd), "--format", "json"]) == 0
+    out = capsys.readouterr().out.strip()
+    doc = json.loads(out)  # the WHOLE output is one JSON document
+    assert doc["fsck"] == "ok" and doc["corrupt"] == 0
+    epochs = doc["reports"][0]["epochs"]
+    assert [r["epoch"] for r in epochs] == [1, 2]
+    assert all(r["mesh"]["axes"] == {"data": 4, "model": 2} for r in epochs)
+
+    # corruption: same exit-code contract in json mode, machine-readable
+    mp = integrity.manifest_path(str(wd / "ckpt" / "2"))
+    with open(mp) as fp:
+        manifest = json.load(fp)
+    manifest["sharding"]["mesh"]["axes"]["model"] = 7
+    with open(mp, "w") as fp:
+        json.dump(manifest, fp)
+    assert main(["fsck", str(wd), "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["fsck"] == "corrupt" and doc["corrupt"] == 1
+    statuses = {r["epoch"]: r["status"]
+                for r in doc["reports"][0]["epochs"]}
+    assert statuses == {1: integrity.OK, 2: integrity.CORRUPT}

@@ -40,6 +40,11 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               must carry a verifying manifest, AND an injected bit-flip
               must be detected as CORRUPT — the auditor a resumed run's
               fallback restore depends on has to actually catch damage
+  reshard     elastic restore (docs/FAILURES.md "Elastic resume"): save
+              under an 8-device (data x model) mesh, restore strictly on
+              2 devices, assert leaf-exact params under the new mesh —
+              the save-on-N/resume-on-M path a preempted pod relaunch
+              (or a 1-chip serving host) depends on
   mesh_parity (--verify-mesh only) one seeded train step on the requested
               spatial/model mesh matches the pure-DP oracle per-leaf
               (tools/verify_mesh.py — run before the first run on a new
@@ -508,6 +513,35 @@ def check_fsck(args):
     return "2 epochs manifest-verified; injected bit-flip reported CORRUPT"
 
 
+@check("reshard")
+def check_reshard(args):
+    import subprocess
+
+    # subprocess on a CPU-virtual backend, like check_mesh_parity: the check
+    # needs 8 devices regardless of this host's hardware, must not fight the
+    # parent for an in-process TPU, and reshard correctness is device-count
+    # logic — identical on the virtual mesh
+    argv = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "verify_reshard.py"),
+            "--save-devices", "8", "--restore-devices", "2",
+            "--model-parallel", "2"]
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env["XLA_FLAGS"] = (
+        child_env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(argv, capture_output=True, text=True, env=child_env,
+                          timeout=600)
+    if proc.returncode != 0:
+        lines = ((proc.stderr.strip() + "\n" + proc.stdout.strip())
+                 .strip().splitlines())
+        raise RuntimeError("; ".join(lines[-3:]) if lines else
+                           f"verify_reshard exited {proc.returncode}")
+    lines = proc.stdout.strip().splitlines()
+    return lines[-1] if lines else "ok"
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Validate a host before a pod run (see module docstring).")
@@ -554,6 +588,7 @@ def main(argv=None):
         check_mesh_parity(args)
     check_checkpoint(args)
     check_fsck(args)
+    check_reshard(args)
 
     ok = all(RESULTS)
     print(json.dumps({"preflight": "pass" if ok else "fail",
