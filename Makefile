@@ -10,9 +10,9 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
-        bench-input dryrun smoke serve-smoke serve-fleet-smoke preflight \
-        preflight-record lint lint-changed fsck check check-update-cost \
-        reshard-parity
+        bench-input dryrun smoke seg-smoke serve-smoke serve-fleet-smoke \
+        preflight preflight-record lint lint-changed fsck check \
+        check-update-cost reshard-parity
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
 	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
@@ -123,3 +123,8 @@ dryrun:      ## 8-virtual-device multichip compile/exec check
 
 smoke:       ## one synthetic epoch of the flagship trainer
 	env $(CPU_ENV) $(PY) LeNet/jax/train.py -m lenet5 --synthetic --epochs 1
+
+seg-smoke:   ## one epoch of the segmentation family on synthetic
+	## shapes-and-masks scenes (docs/SEGMENTATION.md) — prints val mIoU
+	env $(CPU_ENV) $(PY) UNet/jax/train.py -m unet_synthetic --epochs 1 \
+	    --batch-size 16

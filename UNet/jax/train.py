@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Train semantic segmentation (U-Net over ResNet backbones) on TPU —
+`python train.py -m unet_synthetic` / `-m unet_resnet50`.
+
+The reference zoo has no dense-prediction family (PAPER.md §0); this
+entrypoint runs the completed TPU-native implementation: pixel-wise CE
+(+ optional dice), streaming confusion-matrix mIoU eval, paired device
+augmentation, and end-to-end H-sharded training on the spatial mesh
+(`-m unet_synthetic --spatial-parallel 2`, or the pre-wired
+`unet_synthetic_sp2`). docs/SEGMENTATION.md.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_segmentation
+
+MODELS = ["unet_resnet50", "unet_synthetic", "unet_synthetic_sp2",
+          "unet_digits"]
+
+if __name__ == "__main__":
+    run_segmentation("UNet", MODELS)

@@ -120,6 +120,8 @@ def _head_dims(cfg) -> frozenset:
         dims.update({nc, 2, 64})         # the shared 64-wide f32 head conv
     if cfg.family == "pose":             # per-stack heatmap heads
         dims.add(nc)
+    if cfg.family == "segmentation":     # the f32 1x1 class-logit head
+        dims.add(nc)
     return frozenset(d for d in dims if d)
 
 
@@ -280,6 +282,47 @@ def _centernet_units(name, cfg) -> List[TracedUnit]:
     return units
 
 
+def _segmentation_units(name, cfg) -> List[TracedUnit]:
+    from ..core import segment as seg_lib
+
+    model, cfg, images, input_norm = _family_setup(cfg)
+    dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+    dice = seg_lib.dice_weight_for(cfg)
+    tx = _optimizer_for(cfg)
+    state = _abstract_state(model, tx, images)
+    b, sz = AUDIT_BATCH, cfg.data.image_size
+    masks = S((b, sz, sz), jnp.int32)
+    rng = S((2,), jnp.uint32)
+    head = _head_dims(cfg)
+    units = []
+
+    step = seg_lib.make_segmentation_train_step(
+        num_classes=cfg.data.num_classes, compute_dtype=dt, mesh=None,
+        remat=cfg.remat, input_norm=input_norm, dice_weight=dice,
+        log_grad_norm=cfg.log_grad_norm, donate=cfg.steps_per_dispatch == 1)
+    closed, donated, outs = _trace(step, state, images, masks, rng)
+    units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
+                            outs, dict(getattr(step, "_jaxvet", {})),
+                            head_dims=head))
+
+    estep = seg_lib.make_segmentation_eval_step(
+        num_classes=cfg.data.num_classes, compute_dtype=dt, mesh=None,
+        input_norm=input_norm, dice_weight=dice)
+    closed, donated, outs = _trace(estep, state, images, masks)
+    units.append(TracedUnit(f"{name}/eval", name, "eval", closed, donated,
+                            outs, dict(getattr(estep, "_jaxvet", {})),
+                            head_dims=head))
+
+    pstep = seg_lib.make_segmentation_predict_step(
+        compute_dtype=dt, input_norm=input_norm)
+    outs = jax.eval_shape(pstep, state, S(images.shape, jnp.float32))
+    units.append(TracedUnit(
+        f"{name}/predict", name, "predict",
+        out_avals=list(jax.tree_util.tree_leaves(outs)),
+        meta=dict(getattr(pstep, "_jaxvet", {})), head_dims=head))
+    return units
+
+
 def _gan_units(name, cfg) -> List[TracedUnit]:
     from ..core import gan as gan_lib
     from ..core.train_state import TrainState, init_model
@@ -368,6 +411,7 @@ def _serve_unit(name, cfg) -> TracedUnit:
     buckets = (1, 8, 32)
     max_batch = buckets[-1]
     take_first = cfg.family == "classification"
+    argmax_mask = cfg.family == "segmentation"  # class-id mask payload
 
     variables = jax.eval_shape(
         lambda r, x: model.init({"params": r,
@@ -380,7 +424,11 @@ def _serve_unit(name, cfg) -> TracedUnit:
         out = model.apply(vars_, x, train=False)
         if take_first and isinstance(out, (tuple, list)):
             out = out[0]
-        return jax.tree_util.tree_map(lambda y: y.astype(jnp.float32), out)
+        if argmax_mask:
+            out = jnp.argmax(out, axis=-1).astype(jnp.int32)
+        return jax.tree_util.tree_map(
+            lambda y: y.astype(jnp.float32)
+            if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
 
     # one abstract forward at the smallest bucket proves the serving input
     # spec traces end to end; shape/dtype facts at the other buckets follow
@@ -510,6 +558,7 @@ _FAMILY_BUILDERS: Dict[str, Callable] = {
     "detection": _detection_units,
     "pose": _pose_units,
     "centernet": _centernet_units,
+    "segmentation": _segmentation_units,
     "gan": _gan_units,
 }
 
@@ -523,7 +572,7 @@ def config_unit_names(name: str) -> List[str]:
         return ([f"{name}/train"] if cfg.model == "dcgan"
                 else [f"{name}/train_gen", f"{name}/train_disc"])
     base = [f"{name}/train", f"{name}/eval", f"{name}/serve"]
-    if cfg.family in ("detection", "centernet"):
+    if cfg.family in ("detection", "centernet", "segmentation"):
         base.insert(2, f"{name}/predict")
     return base
 

@@ -718,3 +718,67 @@ def run_pose(family: str, models: Sequence[str],
     from .core.pose import PoseTrainer
     return _run(family, models, lambda c, w: PoseTrainer(c, workdir=w),
                 _pose_data, argv, synthetic_image_size=64)
+
+
+# -- segmentation ---------------------------------------------------------------
+
+def _segmentation_data(cfg, args):
+    from .data import segmentation as seg_data
+    data = cfg.data
+    if args.synthetic or data.dataset in ("synthetic", "seg_synthetic"):
+        if data.normalize_on_device and not cfg.device_augment:
+            raise SystemExit("--device-normalize is incompatible with the "
+                             "synthetic segmentation backend (scenes are "
+                             "already float [-1,1]); use --device-augment "
+                             "for the uint8 pair staging contract")
+        if cfg.device_augment:
+            from .core.config import decode_image_size
+            # paired uint8 image+mask at the padded decode size — the
+            # staging contract of make_paired_train_augment
+            return _synthetic_data(
+                cfg, lambda steps, seed: seg_data.SyntheticSegmentation(
+                    cfg.batch_size, decode_image_size(data.image_size),
+                    data.channels, data.num_classes, steps, seed=seed,
+                    emit_uint8=True))
+        return _synthetic_data(
+            cfg, lambda steps, seed: seg_data.SyntheticSegmentation(
+                cfg.batch_size, data.image_size, data.channels,
+                data.num_classes, steps, seed=seed))
+    if data.dataset == "digits_seg":
+        # real handwriting composed into segmentation scenes — the offline
+        # real-data gate (data/segmentation.py). Train scenes re-compose
+        # FRESH each epoch (scene diversity is the regularizer, exactly the
+        # digits_detect convention); the val set stays pinned at seed 2.
+        if cfg.device_augment or data.normalize_on_device:
+            raise SystemExit("digits_seg ships float [-1,1] scenes — "
+                             "--device-augment/--device-normalize need the "
+                             "uint8 staging backends (seg_synthetic)")
+        from .data.digits import scan_splits
+        (tr_x, tr_y), _ = scan_splits()
+        va = seg_data.segmentation_val_scenes(canvas=data.image_size,
+                                              n_scenes=data.val_examples)
+
+        def _train(epoch):
+            tr = seg_data.segmentation_scenes(
+                tr_x, tr_y, n_scenes=data.train_examples,
+                canvas=data.image_size, seed=1000 + epoch)
+            return seg_data.segmentation_batches(
+                tr, batch_size=cfg.batch_size, shuffle_seed=epoch)
+
+        return _train, lambda epoch: seg_data.segmentation_batches(
+            va, batch_size=cfg.eval_batch_size or cfg.batch_size)
+    raise ValueError(f"segmentation families read 'seg_synthetic' or "
+                     f"'digits_seg' data, not dataset={data.dataset!r}")
+
+
+def run_segmentation(family: str, models: Sequence[str],
+                     argv: Optional[Sequence[str]] = None) -> dict:
+    """Segmentation (U-Net) entrypoint — the dense-prediction family the
+    reference zoo never had; same shared `-m/-c` surface as every other
+    family (docs/SEGMENTATION.md)."""
+    from .core.segment import SegmentationTrainer
+    # 64px minimum: the unet_small encoder needs H/W divisible by 8, the
+    # ResNet-50 encoder by 64 (stem + stages + stride-1 decoder alignment)
+    return _run(family, models,
+                lambda c, w: SegmentationTrainer(c, workdir=w),
+                _segmentation_data, argv, synthetic_image_size=64)

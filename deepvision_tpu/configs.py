@@ -274,6 +274,68 @@ CONFIGS.register("centernet_digits", _CENTERNET.replace(
 ))
 
 
+# -- Semantic segmentation (U-Net decoder over the ResNet backbones —
+#    models/segment.py; the zoo's first dense-prediction family, beyond the
+#    reference's classification/detection/pose/GAN coverage, PAPER.md §0).
+#    Flagship: ResNet-50 encoder at 224px, 21 classes (the VOC convention),
+#    the standard momentum/poly-ish cosine recipe. The dataset defaults to
+#    the synthetic shapes backend (data/segmentation.py) — point --data-dir
+#    at a real corpus once a TFRecord seg recipe lands; the REAL-pixel gate
+#    meanwhile is unet_digits below, the exact yolov3_digits pattern. -------
+CONFIGS.register("unet_resnet50", TrainConfig(
+    name="unet_resnet50", model="unet_resnet50", family="segmentation",
+    batch_size=32, total_epochs=60,
+    optimizer=OptimizerConfig(name="momentum", learning_rate=0.02,
+                              momentum=0.9, weight_decay=1e-4,
+                              base_batch_size=32),
+    schedule=ScheduleConfig(name="cosine", warmup_epochs=2),
+    data=DataConfig(dataset="seg_synthetic", image_size=224, num_classes=21,
+                    train_examples=2048, val_examples=256,
+                    mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+))
+
+# -- CPU-feasible synthetic recipe: the smoke/parity/preflight surface (the
+#    lenet5-of-segmentation). Tiny BasicBlock encoder (models/segment.py
+#    unet_small), 64px shapes-and-masks scenes. f32 so the virtual-mesh
+#    parity pins are tight. ---------------------------------------------------
+CONFIGS.register("unet_synthetic", TrainConfig(
+    name="unet_synthetic", model="unet_small", family="segmentation",
+    batch_size=32, total_epochs=8,
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+    schedule=ScheduleConfig(name="constant"),
+    data=DataConfig(dataset="seg_synthetic", image_size=64, num_classes=6,
+                    train_examples=256, val_examples=64,
+                    mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    dtype="float32",
+))
+
+# -- The H-sharded variant BY NAME: same recipe with the spatial mesh and the
+#    owned-collectives backend pre-selected — `-m unet_synthetic_sp2` on any
+#    host whose per-process device count divides by 2 trains with H sharded
+#    end to end (images, masks, logits; parallel/spatial_shard.py). The
+#    equivalent ad-hoc launch is `-m unet_synthetic --spatial-parallel 2`. ----
+CONFIGS.register("unet_synthetic_sp2", CONFIGS.get("unet_synthetic").replace(
+    name="unet_synthetic_sp2", spatial_parallel=2,
+    spatial_backend="shard_map"))
+
+# -- Real scanned-digit segmentation scenes: the zero-egress REAL-data gate
+#    for the family (data/segmentation.py::segmentation_scenes — real UCI
+#    handwriting pasted into scenes, per-pixel ground truth from the digit's
+#    own stroke pixels; 11 classes = background + 10 digits). Follows the
+#    yolov3_digits recipe shape; exercises the xent+dice loss. ---------------
+CONFIGS.register("unet_digits", TrainConfig(
+    name="unet_digits", model="unet_small", family="segmentation",
+    batch_size=32, total_epochs=30, loss="xent_dice",
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+    schedule=ScheduleConfig(name="step", boundaries_epochs=(20, 26),
+                            decay_factor=0.1),
+    data=DataConfig(dataset="digits_seg", image_size=64, num_classes=11,
+                    train_examples=512, val_examples=128,
+                    mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    dtype="float32",
+))
+
+
 def get_config(name: str) -> TrainConfig:
     return CONFIGS.get(name)
 
@@ -297,9 +359,11 @@ def trainer_class_for_config(name: str):
     from .core.centernet import CenterNetTrainer
     from .core.detection import DetectionTrainer
     from .core.pose import PoseTrainer
+    from .core.segment import SegmentationTrainer
     from .core.trainer import Trainer
     classes = {"classification": Trainer, "detection": DetectionTrainer,
-               "pose": PoseTrainer, "centernet": CenterNetTrainer}
+               "pose": PoseTrainer, "centernet": CenterNetTrainer,
+               "segmentation": SegmentationTrainer}
     if family not in classes:
         raise ValueError(
             f"config {name!r} declares unknown trainer family {family!r}; "

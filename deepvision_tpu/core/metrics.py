@@ -137,3 +137,80 @@ def _make_tb_writer(path: str):
 
 def device_get_metrics(metrics) -> Dict[str, float]:
     return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+
+# -- dense-prediction metrics (segmentation family, core/segment.py) -----------
+
+def confusion_matrix(preds, labels, num_classes: int, weights=None):
+    """jit-safe (num_classes, num_classes) confusion COUNTS: rows are true
+    classes, columns predicted. Pure jnp scatter-add over the flattened
+    pixels, so it traces inside the segmentation eval step (one fused
+    program, no host round trip per batch); `weights` (same shape as labels,
+    0/1 float) drops padded pixels from the counts. Sums across batches add
+    elementwise — the streaming accumulator below (and serve's /stats) just
+    keeps adding returned matrices."""
+    import jax.numpy as jnp
+
+    preds = jnp.reshape(preds, (-1,)).astype(jnp.int32)
+    labels = jnp.reshape(labels, (-1,)).astype(jnp.int32)
+    idx = labels * num_classes + preds
+    w = (jnp.ones(idx.shape, jnp.float32) if weights is None
+         else jnp.reshape(weights, (-1,)).astype(jnp.float32))
+    flat = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(w)
+    return flat.reshape(num_classes, num_classes)
+
+
+def segmentation_scores(cm) -> Dict[str, np.ndarray]:
+    """Derive {pixel_acc, miou, per_class_iou, present} from a summed
+    confusion matrix (host-side numpy — runs on accumulated sums, once per
+    eval pass, not per batch). IoU_c = TP_c / (row_c + col_c - TP_c); mIoU
+    averages over classes PRESENT in the ground truth (absent classes carry
+    IoU nan in `per_class_iou` and are excluded — the standard convention,
+    so a 3-class val shard doesn't deflate a 21-class model's mIoU)."""
+    cm = np.asarray(cm, np.float64)
+    tp = np.diag(cm)
+    gt = cm.sum(axis=1)           # true-class pixel counts
+    pred = cm.sum(axis=0)         # predicted-class pixel counts
+    union = gt + pred - tp
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_class = np.where(union > 0, tp / np.maximum(union, 1), np.nan)
+    present = gt > 0
+    total = cm.sum()
+    return {
+        "pixel_acc": float(tp.sum() / total) if total else 0.0,
+        "miou": float(np.nanmean(np.where(present, per_class, np.nan)))
+                if present.any() else 0.0,
+        "per_class_iou": per_class,
+        "present": present,
+    }
+
+
+class StreamingConfusion:
+    """Host-side streaming confusion-matrix accumulator: feed per-batch
+    (C, C) count matrices (from `confusion_matrix`) or raw pred/label
+    arrays; `result()` derives pixel-accuracy / mIoU / per-class IoU from
+    the running sums. Used by the segmentation trainer's evaluate and
+    available to serving's /stats; cheap enough to keep per-model."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.cm = np.zeros((num_classes, num_classes), np.float64)
+
+    def update(self, cm) -> None:
+        cm = np.asarray(cm, np.float64)
+        if cm.shape != self.cm.shape:
+            raise ValueError(f"confusion matrix shape {cm.shape} != "
+                             f"({self.num_classes}, {self.num_classes})")
+        self.cm += cm
+
+    def update_preds(self, preds, labels, weights=None) -> None:
+        self.update(np.asarray(confusion_matrix(
+            jax.numpy.asarray(preds), jax.numpy.asarray(labels),
+            self.num_classes,
+            None if weights is None else jax.numpy.asarray(weights))))
+
+    def result(self) -> Dict[str, np.ndarray]:
+        return segmentation_scores(self.cm)
+
+    def reset(self) -> None:
+        self.cm[:] = 0.0

@@ -1,0 +1,343 @@
+"""Semantic segmentation SPMD steps + trainer — the zoo's first
+dense-prediction family.
+
+The reference covers classification/detection/pose/GANs (PAPER.md §0);
+segmentation is the workload the spatial mesh machinery was built for
+(ROADMAP open item 4): dense per-pixel targets are row-sliceable exactly like
+CenterNet's heatmaps, so the same halo/synced-BN/row-sliced-target recipe
+carries a U-Net end to end under H-sharding (`parallel/spatial_shard.py::
+make_shardmap_segmentation_train_step` for combined meshes; the GSPMD
+`spatial_activation_constraints` path for plain (data, spatial) meshes).
+
+Same shape as core/centernet.py: one jitted step over the mesh, pixel-wise
+cross-entropy (+ optional soft-dice) computed on device, a streaming
+confusion-matrix eval (mIoU / per-class IoU / pixel accuracy via
+core/metrics.py), and a predict step returning int32 class-id masks — the
+contract the serving engine exposes over POST /predict/<model>.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+from . import metrics as metrics_lib
+from .config import TrainConfig
+from .steps import _normalize_input, annotate_step, maybe_grad_norm
+from .trainer import Trainer
+
+# TrainConfig.loss values this family understands; "xent_dice" adds the soft
+# dice term at this weight (the boundary-sensitive complement of pixel CE)
+DICE_WEIGHT = 0.5
+DICE_EPS = 1.0
+
+
+def dice_weight_for(config: TrainConfig) -> float:
+    """Map the config's `loss` field to the dice weight: "softmax_xent"
+    (the zoo default) is pure CE; "xent_dice" blends in the soft-dice term.
+    Unknown values raise at trainer construction, not mid-epoch."""
+    if config.loss in ("softmax_xent", "xent"):
+        return 0.0
+    if config.loss == "xent_dice":
+        return DICE_WEIGHT
+    raise ValueError(
+        f"segmentation config {config.name!r} declares unknown loss "
+        f"{config.loss!r}; expected 'softmax_xent' or 'xent_dice'")
+
+
+def soft_dice_loss(logits: jnp.ndarray, masks: jnp.ndarray) -> jnp.ndarray:
+    """Mean (1 - dice) over classes and batch: dice_c = (2·Σ p_c·y_c + eps)
+    / (Σ p_c + Σ y_c + eps) with softmax probabilities p and one-hot ground
+    truth y, pixel sums per example. The eps makes absent classes score
+    dice 1 (no gradient pressure), the standard smooth-dice convention."""
+    num_classes = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(masks, num_classes, dtype=jnp.float32)
+    inter = jnp.sum(probs * onehot, axis=(1, 2))          # (B, C)
+    denom = jnp.sum(probs, axis=(1, 2)) + jnp.sum(onehot, axis=(1, 2))
+    dice = (2.0 * inter + DICE_EPS) / (denom + DICE_EPS)
+    return jnp.mean(1.0 - dice)
+
+
+def segmentation_loss(logits: jnp.ndarray, masks: jnp.ndarray,
+                      dice_weight: float = 0.0) -> dict:
+    """{'total', 'ce'[, 'dice']}: mean pixel-wise softmax cross-entropy over
+    the whole (batch × H × W) slab, plus `dice_weight` × soft dice. Logits
+    (B, H, W, C) — f32 by the model's head contract; masks (B, H, W) int32
+    class ids."""
+    masks = masks.astype(jnp.int32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), masks).mean()
+    comp = {"ce": ce, "total": ce}
+    if dice_weight > 0.0:
+        dice = soft_dice_loss(logits, masks)
+        comp["dice"] = dice
+        comp["total"] = ce + dice_weight * dice
+    return comp
+
+
+def pixel_accuracy(logits: jnp.ndarray, masks: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((jnp.argmax(logits, axis=-1)
+                     == masks.astype(jnp.int32)).astype(jnp.float32))
+
+
+def make_segmentation_train_step(*, num_classes: int,
+                                 compute_dtype=jnp.bfloat16,
+                                 donate: bool = True, mesh=None,
+                                 remat: bool = False, input_norm=None,
+                                 device_augment: Optional[Callable] = None,
+                                 dice_weight: float = 0.0,
+                                 log_grad_norm: bool = False,
+                                 grad_correction=None) -> Callable:
+    """(state, images, masks, rng) -> (state, metrics).
+
+    `device_augment` is the PAIRED stage (data/device_augment.
+    make_paired_train_augment): images arrive as uint8 at the padded
+    decode size WITH a same-size uint8 mask, and one folded per-step key
+    drives the crop/flip draw applied to BOTH tensors — it replaces
+    `input_norm` (the augment normalizes the image; passing both is an
+    error). On spatial meshes the augment runs BEFORE the H-shard
+    constraint, which is why this family passes the per-family capability
+    check that refuses classification there. `remat=True` recomputes
+    forward activations in backward (cf. steps.py)."""
+    del num_classes  # the loss derives C from the logits' last dim
+    if device_augment is not None and input_norm is not None:
+        raise ValueError("device_augment already normalizes; passing "
+                         "input_norm too would double-normalize")
+
+    def step(state, images, masks, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        if device_augment is not None:
+            # fold tag 2, the classification step's convention: the paired
+            # crop/flip draw is a pure function of (seed, step)
+            images, masks = device_augment(
+                images, masks, jax.random.fold_in(step_rng, 2))
+        else:
+            images = _normalize_input(images, input_norm, compute_dtype)
+        masks = masks.astype(jnp.int32)
+        if mesh is not None:
+            images = jax.lax.with_sharding_constraint(
+                images, mesh_lib.batch_sharding(mesh, images.ndim,
+                                                dim1=images.shape[1]))
+
+        def forward(params, images):
+            with mesh_lib.spatial_activation_constraints(mesh):
+                return state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=True, mutable=["batch_stats"])
+
+        if remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+        def loss_fn(params):
+            logits, mutated = forward(params, images)
+            comp = segmentation_loss(logits, masks, dice_weight)
+            return comp["total"], (logits, comp, mutated)
+
+        (loss, (logits, comp, mutated)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = mesh_lib.apply_grad_correction(grads, grad_correction)
+        new_state = state.apply_gradients(grads).replace(
+            batch_stats=mutated.get("batch_stats", state.batch_stats))
+        metrics = {"loss": loss,
+                   "pixel_acc": pixel_accuracy(logits, masks),
+                   **{f"{k}_loss": v for k, v in comp.items()
+                      if k != "total"},
+                   **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="train")
+
+
+def make_segmentation_eval_step(*, num_classes: int,
+                                compute_dtype=jnp.bfloat16, mesh=None,
+                                input_norm=None,
+                                device_augment: Optional[Callable] = None,
+                                dice_weight: float = 0.0) -> Callable:
+    """(state, images, masks) -> {'loss', 'confusion'}: batch-mean loss plus
+    the jit-safe (C, C) confusion COUNT matrix (core/metrics.py) — the host
+    accumulates matrices across batches and derives mIoU / per-class IoU /
+    pixel accuracy once per eval pass. `device_augment` here is the paired
+    EVAL stage (deterministic center crop on both tensors)."""
+    if device_augment is not None and input_norm is not None:
+        raise ValueError("device_augment already normalizes; passing "
+                         "input_norm too would double-normalize")
+
+    def step(state, images, masks):
+        if device_augment is not None:
+            images, masks = device_augment(images, masks)
+        else:
+            images = _normalize_input(images, input_norm, compute_dtype)
+        masks = masks.astype(jnp.int32)
+        if mesh is not None:
+            images = jax.lax.with_sharding_constraint(
+                images, mesh_lib.batch_sharding(mesh, images.ndim,
+                                                dim1=images.shape[1]))
+        with mesh_lib.spatial_activation_constraints(mesh):
+            logits = state.apply_fn(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                images, train=False)
+        comp = segmentation_loss(logits, masks, dice_weight)
+        preds = jnp.argmax(logits, axis=-1)
+        return {"loss": comp["total"],
+                "confusion": metrics_lib.confusion_matrix(
+                    preds, masks, num_classes)}
+
+    jit_kwargs = {}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, P())
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype), kind="eval")
+
+
+def make_segmentation_predict_step(*, compute_dtype=jnp.bfloat16,
+                                   input_norm=None) -> Callable:
+    """(state, images) -> int32 (B, H, W) class-id masks — argmax over the
+    f32 logits, the exact payload serving returns (serve/engine.py applies
+    the same argmax transform so the two can't drift in spirit; this step
+    is the library/eval-tool surface)."""
+
+    def step(state, images):
+        x = _normalize_input(images, input_norm, compute_dtype)
+        logits = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            x, train=False)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return annotate_step(jax.jit(step), donate=False,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="predict")
+
+
+class SegmentationTrainer(Trainer):
+    """U-Net family trainer: shared epoch/checkpoint/plateau machinery with
+    segmentation steps, a confusion-matrix evaluate (mIoU watched for
+    best-model selection), and paired device augmentation."""
+
+    default_watch = ("miou", "max")
+    has_own_shardmap_step = True  # make_shardmap_segmentation_train_step
+
+    def __init__(self, config: TrainConfig, model=None, mesh=None,
+                 workdir: Optional[str] = None):
+        if config.mixup_alpha or config.cutmix_alpha:
+            # blending class-id masks is meaningless; erroring beats a
+            # silent no-op (the LossWatchedTrainer convention)
+            raise ValueError(
+                "mixup_alpha/cutmix_alpha are classification-only; "
+                "SegmentationTrainer trains on per-pixel class ids — use "
+                "the paired device augmentation (--device-augment) instead")
+        super().__init__(config, model=model, mesh=mesh, workdir=workdir)
+        compute_dtype = (jnp.dtype(config.dtype) if config.dtype
+                         else jnp.bfloat16)
+        input_norm = ((config.data.mean, config.data.std)
+                      if config.data.normalize_on_device else None)
+        if config.device_augment:
+            input_norm = None  # the paired augment normalizes
+        dice_weight = dice_weight_for(config)
+        if self._use_shardmap_spatial():
+            # owned collectives: fully convolutional, H sharded end to end
+            # with row-sliced masks (transition None — the CenterNet recipe)
+            from ..parallel import spatial_shard
+            transition = spatial_shard.default_transition(self.model)
+            assert transition is None, type(self.model).__name__
+            self._step_factory = (
+                lambda m, corr: spatial_shard
+                .make_shardmap_segmentation_train_step(
+                    num_classes=config.data.num_classes,
+                    image_size=config.data.image_size,
+                    compute_dtype=compute_dtype, mesh=m,
+                    input_norm=input_norm,
+                    device_augment=self._train_augment,
+                    dice_weight=dice_weight,
+                    log_grad_norm=config.log_grad_norm,
+                    remat=config.remat,
+                    donate=config.steps_per_dispatch == 1))
+        else:
+            self._step_factory = (
+                lambda m, corr: make_segmentation_train_step(
+                    num_classes=config.data.num_classes,
+                    compute_dtype=compute_dtype, mesh=m, remat=config.remat,
+                    input_norm=input_norm,
+                    device_augment=self._train_augment,
+                    dice_weight=dice_weight,
+                    log_grad_norm=config.log_grad_norm,
+                    donate=config.steps_per_dispatch == 1,
+                    grad_correction=corr))
+        self.train_step = self._step_factory(self.mesh, None)
+        self.eval_step = make_segmentation_eval_step(
+            num_classes=config.data.num_classes, compute_dtype=compute_dtype,
+            mesh=self.mesh, input_norm=input_norm,
+            device_augment=self._eval_augment, dice_weight=dice_weight)
+
+    def _build_device_augment(self, compute_dtype) -> None:
+        """Paired image/mask stages (data/device_augment.py): one crop/flip
+        draw per example applied to both tensors."""
+        from ..data import device_augment as daug
+        config = self.config
+        mean = daug.channel_stats(config.data.mean, config.data.channels)
+        std = daug.channel_stats(config.data.std, config.data.channels)
+        self._train_augment = daug.make_paired_train_augment(
+            config.data.image_size, mean=mean, std=std,
+            compute_dtype=compute_dtype)
+        self._eval_augment = daug.make_paired_eval_augment(
+            config.data.image_size, mean=mean, std=std,
+            compute_dtype=compute_dtype)
+
+    def _calibration_batch(self, sample_shape, seed: int = 0):
+        rs = np.random.RandomState(seed)
+        b = self._calibration_batch_size()
+        s = sample_shape[0]
+        ch = sample_shape[-1]
+        num_classes = self.config.data.num_classes
+        if self.config.device_augment:
+            # the step's contract is PAIRED uint8 at the decode size; the
+            # jitted augment crops both down to sample_shape
+            from .config import decode_image_size
+            d = decode_image_size(s)
+            images = rs.randint(0, 256, (b, d, d, ch)).astype(np.uint8)
+            masks = rs.randint(0, num_classes, (b, d, d)).astype(np.uint8)
+            return (images, masks)
+        masks = rs.randint(0, num_classes, (b, s, s)).astype(np.int32)
+        if self.config.data.normalize_on_device:
+            images = rs.randint(0, 256, (b, *sample_shape)).astype(np.uint8)
+        else:
+            images = rs.rand(b, *sample_shape).astype(np.float32) * 2.0 - 1.0
+        return (images, masks)
+
+    def evaluate(self, data) -> dict:
+        """Streaming-confusion eval: per-batch (C, C) count matrices sum on
+        the host (core/metrics.StreamingConfusion) and mIoU / pixel accuracy
+        derive from the totals — the loss is the mean of finite per-batch
+        losses (the NaN-batch guard, like LossWatchedTrainer). Batches are
+        fixed-shape (drop-remainder pipelines), no padding."""
+        eval_state = self.eval_state()
+        stream = metrics_lib.StreamingConfusion(self.config.data.num_classes)
+        total, n = 0.0, 0
+        for batch in data:
+            sharded = mesh_lib.shard_batch_pytree(self.mesh, tuple(batch))
+            out = jax.device_get(self.eval_step(eval_state, *sharded))
+            loss = float(out["loss"])
+            if np.isfinite(loss):
+                total += loss
+                n += 1
+            stream.update(out["confusion"])
+        if n == 0:
+            return {}
+        scores = stream.result()
+        return {"loss": total / n, "count": float(n),
+                "miou": float(scores["miou"]),
+                "pixel_acc": float(scores["pixel_acc"])}

@@ -206,22 +206,14 @@ class Trainer:
         # double-normalize (the step factories also reject the combination).
         self._train_augment = self._eval_augment = None
         if config.device_augment:
-            if config.spatial_parallel > 1:
-                # per-example dynamic_slice crops cross the H shard; run
-                # device_augment on (data[, model]) meshes only
-                raise ValueError(
-                    "device_augment does not compose with spatial_parallel "
-                    "> 1 (the random crop would gather across the 'spatial' "
-                    "shards); use the host pipeline for spatial meshes")
+            # per-family capability policy lives with the augment code
+            # (data/device_augment.py): families whose steps fuse the crop
+            # inside the H-sharded forward are refused on spatial meshes;
+            # segmentation augments BEFORE the H-shard and passes
             from ..data import device_augment as daug
-            mean = daug.channel_stats(config.data.mean, config.data.channels)
-            std = daug.channel_stats(config.data.std, config.data.channels)
-            self._train_augment = daug.make_train_augment(
-                config.data.image_size, mean=mean, std=std,
-                compute_dtype=compute_dtype)
-            self._eval_augment = daug.make_eval_augment(
-                config.data.image_size, mean=mean, std=std,
-                compute_dtype=compute_dtype)
+            daug.check_spatial_capability(config.family,
+                                          config.spatial_parallel)
+            self._build_device_augment(compute_dtype)
             input_norm = None
         # A FACTORY, not just a step: on combined spatial×model meshes the
         # step must be rebuilt with the measured per-leaf grad correction
@@ -318,6 +310,24 @@ class Trainer:
             self._set_watch("loss", "min")
         else:
             self._set_watch("top1", "max")
+
+    def _build_device_augment(self, compute_dtype) -> None:
+        """Install this family's jitted device-augment stages on
+        self._train_augment / self._eval_augment (called only when
+        config.device_augment is set, AFTER the capability check). The base
+        builds the classification single-tensor stages; SegmentationTrainer
+        overrides with the paired image/mask factories
+        (data/device_augment.make_paired_train_augment)."""
+        from ..data import device_augment as daug
+        config = self.config
+        mean = daug.channel_stats(config.data.mean, config.data.channels)
+        std = daug.channel_stats(config.data.std, config.data.channels)
+        self._train_augment = daug.make_train_augment(
+            config.data.image_size, mean=mean, std=std,
+            compute_dtype=compute_dtype)
+        self._eval_augment = daug.make_eval_augment(
+            config.data.image_size, mean=mean, std=std,
+            compute_dtype=compute_dtype)
 
     # Families with their own owned-collectives step set this True
     # (CenterNetTrainer, PoseTrainer, DetectionTrainer) instead of

@@ -43,7 +43,36 @@ import jax.numpy as jnp
 from ..core.config import IMAGENET_MEAN, IMAGENET_STD, decode_image_size
 
 __all__ = ["decode_image_size", "make_train_augment", "make_eval_augment",
-           "channel_stats"]
+           "make_paired_train_augment", "make_paired_eval_augment",
+           "channel_stats", "check_spatial_capability"]
+
+# Families whose steps can fuse device augmentation at all (the Trainer
+# hierarchy enforces this: LossWatchedTrainer refuses for detection/pose/
+# centernet because their steps never call the augment), and the subset
+# whose augment composes with an H-sharded spatial mesh. Segmentation
+# qualifies for the spatial mesh because its steps run the paired
+# crop/flip BEFORE the H-shard (full-height uint8 in, cropped tensors are
+# then constrained/row-sliced); the classification step instead fuses the
+# per-example dynamic_slice inside the spatially-constrained forward, where
+# the crop would gather across the 'spatial' shards.
+DEVICE_AUGMENT_SPATIAL_FAMILIES = frozenset({"segmentation"})
+
+
+def check_spatial_capability(family: str, spatial_parallel: int) -> None:
+    """Per-family device-augment capability check for spatial meshes — the
+    one owner of the policy (the Trainer calls this instead of a blanket
+    rejection). Raises ValueError naming which families DO support device
+    augmentation on the spatial mesh."""
+    if spatial_parallel <= 1 or family in DEVICE_AUGMENT_SPATIAL_FAMILIES:
+        return
+    supported = ", ".join(sorted(DEVICE_AUGMENT_SPATIAL_FAMILIES))
+    raise ValueError(
+        f"device_augment with spatial_parallel={spatial_parallel} is "
+        f"supported for the {supported} family only (its steps augment "
+        f"BEFORE the H-shard); the {family!r} family fuses the per-example "
+        f"random crop inside the spatially-sharded forward, where the "
+        f"dynamic_slice would gather across the 'spatial' shards — use the "
+        f"host pipeline for {family!r} on spatial meshes")
 
 
 def channel_stats(values: Sequence[float], channels: int) -> Tuple[float, ...]:
@@ -94,6 +123,41 @@ def _factor(key, strength: float, batch: int) -> jnp.ndarray:
         minval=max(0.0, 1.0 - strength), maxval=1.0 + strength)
 
 
+def _crop_flip_draws(rng, b: int, h: int, w: int, image_size: int,
+                     flip_prob: float):
+    """THE per-example geometric randomness of the train augment — one
+    (tops, lefts, flip) draw plus the three ColorJitter keys, split in the
+    order `make_train_augment` has always used. The paired image/mask
+    factory consumes exactly these draws, so a mask's crop offsets and flip
+    decisions can never drift from its image's (the determinism contract
+    tests/test_device_augment.py pins per (seed, step))."""
+    k_crop, k_flip, k_b, k_c, k_s = jax.random.split(rng, 5)
+    offs = jax.random.randint(
+        k_crop, (2, b), 0, max(h - image_size, w - image_size) + 1)
+    tops = jnp.minimum(offs[0], h - image_size)
+    lefts = jnp.minimum(offs[1], w - image_size)
+    flip = jax.random.bernoulli(k_flip, flip_prob, (b,))
+    return tops, lefts, flip, (k_b, k_c, k_s)
+
+
+def _photometric(imgs: jnp.ndarray, jitter_keys, jitter, b: int
+                 ) -> jnp.ndarray:
+    """ColorJitter on [0,255] f32: brightness -> contrast -> saturation,
+    the host class's application order; factors drawn per example. Applied
+    to IMAGES only — masks are label fields, never jittered."""
+    brightness, contrast, saturation = jitter
+    k_b, k_c, k_s = jitter_keys
+    if brightness:
+        imgs = imgs * _factor(k_b, brightness, b)
+    if contrast:
+        m = imgs.mean(axis=(1, 2), keepdims=True)
+        imgs = (imgs - m) * _factor(k_c, contrast, b) + m
+    if saturation:
+        gray = imgs.mean(axis=3, keepdims=True)
+        imgs = (imgs - gray) * _factor(k_s, saturation, b) + gray
+    return jnp.clip(imgs, 0.0, 255.0)
+
+
 def make_train_augment(
     image_size: int,
     *,
@@ -118,31 +182,85 @@ def make_train_augment(
 
     def device_train_augment(images, rng):
         b, h, w = images.shape[0], images.shape[1], images.shape[2]
-        k_crop, k_flip, k_b, k_c, k_s = jax.random.split(rng, 5)
+        tops, lefts, flip, jkeys = _crop_flip_draws(rng, b, h, w, image_size,
+                                                    flip_prob)
         imgs = _to_unit_f32(images)
         # RandomCrop: uniform per-example offsets in [0, D - S]
-        offs = jax.random.randint(
-            k_crop, (2, b), 0, max(h - image_size, w - image_size) + 1)
-        tops = jnp.minimum(offs[0], h - image_size)
-        lefts = jnp.minimum(offs[1], w - image_size)
         imgs = _batched_crop(imgs, tops, lefts, image_size)
         # RandomHorizontalFlip, per example
-        flip = jax.random.bernoulli(k_flip, flip_prob, (b,))
         imgs = jnp.where(flip[:, None, None, None], imgs[:, :, ::-1, :], imgs)
-        # ColorJitter on [0,255]: brightness -> contrast -> saturation, the
-        # host class's application order; factors drawn per example
-        if brightness:
-            imgs = imgs * _factor(k_b, brightness, b)
-        if contrast:
-            m = imgs.mean(axis=(1, 2), keepdims=True)
-            imgs = (imgs - m) * _factor(k_c, contrast, b) + m
-        if saturation:
-            gray = imgs.mean(axis=3, keepdims=True)
-            imgs = (imgs - gray) * _factor(k_s, saturation, b) + gray
-        imgs = jnp.clip(imgs, 0.0, 255.0)
+        imgs = _photometric(imgs, jkeys, (brightness, contrast, saturation),
+                            b)
         return _normalize(imgs, mean, std).astype(compute_dtype)
 
     return device_train_augment
+
+
+def make_paired_train_augment(
+    image_size: int,
+    *,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    jitter: Tuple[float, float, float] = DEFAULT_JITTER,
+    flip_prob: float = 0.5,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> Callable:
+    """Build `paired_train_augment(images_u8, masks_u8, rng) -> (images,
+    masks)` for DENSE-prediction train steps (segmentation): the image takes
+    the full `make_train_augment` stack, and the mask takes EXACTLY the same
+    per-example crop offsets and flip decisions — both consumed from the one
+    `_crop_flip_draws` call, so the pairing is correct by construction, not
+    by parallel bookkeeping.
+
+    Masks are label fields: the crop is the same `dynamic_slice` (nearest-
+    neighbor by definition — no interpolation can invent class ids), the
+    flip the same axis reversal, and NO jitter or normalize is applied.
+    `masks_u8` is (B, D, D) uint8 (or any int dtype); returned masks are
+    (B, S, S) int32.
+    """
+    brightness, contrast, saturation = jitter
+
+    def paired_train_augment(images, masks, rng):
+        b, h, w = images.shape[0], images.shape[1], images.shape[2]
+        tops, lefts, flip, jkeys = _crop_flip_draws(rng, b, h, w, image_size,
+                                                    flip_prob)
+        imgs = _to_unit_f32(images)
+        imgs = _batched_crop(imgs, tops, lefts, image_size)
+        imgs = jnp.where(flip[:, None, None, None], imgs[:, :, ::-1, :], imgs)
+        imgs = _photometric(imgs, jkeys, (brightness, contrast, saturation),
+                            b)
+        m = masks.astype(jnp.int32)[..., None]
+        m = _batched_crop(m, tops, lefts, image_size)[..., 0]
+        m = jnp.where(flip[:, None, None], m[:, :, ::-1], m)
+        return _normalize(imgs, mean, std).astype(compute_dtype), m
+
+    return paired_train_augment
+
+
+def make_paired_eval_augment(
+    image_size: int,
+    *,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> Callable:
+    """Build `paired_eval_augment(images_u8, masks_u8) -> (images, masks)`:
+    the same deterministic centered crop on BOTH tensors + normalize on the
+    image only. Degenerate case (D == image_size) is the identity crop —
+    the image half then equals plain on-device normalization and the mask
+    passes through untouched (the eval-parity anchor pinned in tests)."""
+
+    def paired_eval_augment(images, masks):
+        h, w = images.shape[1], images.shape[2]
+        top = (h - image_size) // 2
+        left = (w - image_size) // 2
+        imgs = _to_unit_f32(
+            images[:, top:top + image_size, left:left + image_size, :])
+        m = masks.astype(jnp.int32)[:, top:top + image_size,
+                                    left:left + image_size]
+        return _normalize(imgs, mean, std).astype(compute_dtype), m
+
+    return paired_eval_augment
 
 
 def make_eval_augment(

@@ -1,0 +1,176 @@
+"""Segmentation datasets: synthetic shapes-and-masks + real digit scenes.
+
+The reference zoo has no dense-prediction workload (PAPER.md §0 covers
+classification/detection/pose/GANs), so this module supplies the two data
+recipes the segmentation family (core/segment.py) trains on, mirroring the
+conventions of the neighboring pipelines:
+
+- `SyntheticSegmentation` — the `SyntheticClassification` analog: deterministic
+  in-memory (image, mask) batches with a fixed learnable signal (each class has
+  a distinct mean color, so per-pixel classification is actually fittable —
+  loss-goes-down and mIoU-goes-up tests need that). Emits either normalized
+  float batches at the model's input size or raw uint8 image+mask pairs at the
+  padded decode size (the `--device-augment` staging contract,
+  `data/device_augment.py::make_paired_train_augment`).
+
+- digit scenes — the real-data recipe following the YOLO/CenterNet digits
+  pattern (`data/digits.py`): real UCI handwriting scans composed onto a
+  canvas, with the per-pixel ground truth derived from the pasted digit's own
+  intensity (class = digit + 1; background = 0). Real pixels, synthetic
+  composition, zero egress; train scenes compose only train-split scans and
+  the pinned val set only held-out handwriting, exactly like the detection
+  gate.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from .digits import SPLIT_SEED, scan_splits  # noqa: F401 (shared split seed)
+
+# Intensity threshold separating a pasted digit's foreground pixels from the
+# canvas when deriving the mask (scans are [0,1]; strokes sit well above it)
+DIGIT_FOREGROUND_THRESH = 0.25
+
+
+def class_palette(num_classes: int, channels: int = 3) -> np.ndarray:
+    """Deterministic (num_classes, channels) float palette in [0.15, 0.85]:
+    class 0 (background) is dark, the rest well-separated — the one color
+    table both the generator and any visualization tool read."""
+    rs = np.random.RandomState(20260804)
+    pal = 0.15 + 0.7 * rs.rand(max(num_classes, 1), channels)
+    pal[0] = 0.1
+    return pal.astype(np.float32)
+
+
+class SyntheticSegmentation:
+    """Deterministic fake (image, mask) batches with a learnable signal.
+
+    Each scene starts as background (class 0) and pastes 1-3 axis-aligned
+    rectangles of random foreground classes; pixels take the class's palette
+    color plus Gaussian noise, and the mask carries the class id — so a
+    pixel's color predicts its class and even a 1x1-conv head can fit it.
+
+    `emit_uint8=True` yields raw uint8 pixel images AND uint8 masks at the
+    constructor's `image_size` (pass the PADDED `config.decode_image_size`);
+    the paired jitted augment crops both back down to the model's input.
+    Default mode yields float32 images normalized to [-1, 1] (the detection
+    pipelines' convention) and int32 masks at `image_size`.
+    """
+
+    def __init__(self, batch_size: int, image_size: int = 64,
+                 channels: int = 3, num_classes: int = 6,
+                 num_batches: int = 8, seed: int = 0,
+                 emit_uint8: bool = False):
+        if num_classes < 2:
+            raise ValueError(f"segmentation needs >= 2 classes (background "
+                             f"+ 1), got {num_classes}")
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.channels = channels
+        self.num_classes = num_classes
+        self.num_batches = num_batches
+        self.seed = seed
+        self.emit_uint8 = emit_uint8
+        self._palette = class_palette(num_classes, channels)
+
+    def _scene(self, rs: np.random.RandomState
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        s = self.image_size
+        mask = np.zeros((s, s), np.int32)
+        image = np.broadcast_to(self._palette[0], (s, s, self.channels)).copy()
+        for _ in range(rs.randint(1, 4)):
+            c = rs.randint(1, self.num_classes)
+            h = rs.randint(s // 4, s // 2 + 1)
+            w = rs.randint(s // 4, s // 2 + 1)
+            y0 = rs.randint(0, s - h + 1)
+            x0 = rs.randint(0, s - w + 1)
+            mask[y0:y0 + h, x0:x0 + w] = c
+            image[y0:y0 + h, x0:x0 + w] = self._palette[c]
+        image = image + rs.randn(s, s, self.channels).astype(np.float32) * 0.05
+        return np.clip(image, 0.0, 1.0), mask
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        rs = np.random.RandomState(self.seed)
+        for _ in range(self.num_batches):
+            images = np.empty((self.batch_size, self.image_size,
+                               self.image_size, self.channels), np.float32)
+            masks = np.empty((self.batch_size, self.image_size,
+                              self.image_size), np.int32)
+            for i in range(self.batch_size):
+                images[i], masks[i] = self._scene(rs)
+            if self.emit_uint8:
+                yield (np.round(images * 255.0).astype(np.uint8),
+                       masks.astype(np.uint8))
+            else:
+                # [0,1] -> [-1,1], the detection/pose pipelines' convention
+                # (UNIT_RANGE_NORM); masks stay int32 class ids
+                yield images * 2.0 - 1.0, masks
+
+    def __len__(self):
+        return self.num_batches
+
+
+# -- real-pixel segmentation scenes (the digits recipe) ------------------------
+
+def segmentation_scenes(images: np.ndarray, labels: np.ndarray, *,
+                        n_scenes: int, canvas: int = 64, digit_px: int = 16,
+                        seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Compose raw scans (N, 8, 8) in [0,1] + labels into (scenes, masks).
+
+    Same quadrant placement as `digits.detection_scenes` (1-4 digits, one per
+    quadrant, jittered — regions can touch but never overlap), but the ground
+    truth is DENSE: mask = digit_class + 1 on the pasted digit's foreground
+    pixels (its own intensity above DIGIT_FOREGROUND_THRESH), 0 elsewhere.
+    Scenes are float32 [-1, 1] NHWC; masks int32 (S, canvas, canvas) with
+    num_classes = 11 (background + 10 digits).
+    """
+    if digit_px % 8 != 0:
+        raise ValueError(f"digit_px={digit_px} must be a multiple of the 8px "
+                         f"scan size (pixel-replication upsample)")
+    rs = np.random.RandomState(seed)
+    q = canvas // 2
+    jitter = q - digit_px
+    scale = digit_px // 8
+    scenes = np.zeros((n_scenes, canvas, canvas, 3), np.float32)
+    masks = np.zeros((n_scenes, canvas, canvas), np.int32)
+    for s in range(n_scenes):
+        n_digits = rs.randint(1, 5)
+        quads = rs.permutation(4)[:n_digits]
+        for quad in quads:
+            i = rs.randint(len(images))
+            digit = images[i].repeat(scale, axis=0).repeat(scale, axis=1)
+            qy, qx = divmod(int(quad), 2)
+            y0 = qy * q + rs.randint(0, jitter + 1)
+            x0 = qx * q + rs.randint(0, jitter + 1)
+            scenes[s, y0:y0 + digit_px, x0:x0 + digit_px, :] = digit[..., None]
+            fg = digit > DIGIT_FOREGROUND_THRESH
+            masks[s, y0:y0 + digit_px, x0:x0 + digit_px][fg] = labels[i] + 1
+    return scenes * 2.0 - 1.0, masks
+
+
+def segmentation_val_scenes(*, canvas: int, n_scenes: int
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """THE pinned validation scene set (seed 2, held-out scans only) — the
+    segmentation analog of `digits.detection_val_scenes`: val measures
+    generalization to unseen handwriting, not re-segmentation of seen
+    crops."""
+    _, (va_x, va_y) = scan_splits()
+    return segmentation_scenes(va_x, va_y, n_scenes=n_scenes, canvas=canvas,
+                               seed=2)
+
+
+def segmentation_batches(split: Tuple[np.ndarray, np.ndarray], *,
+                         batch_size: int, shuffle_seed: int = None
+                         ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Iterate a (scenes, masks) split in drop-remainder batches (the dense
+    trainers' fixed-shape contract, like `digits.detection_batches`)."""
+    scenes, masks = split
+    idx = np.arange(len(scenes))
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(idx)
+    for lo in range(0, len(idx) - batch_size + 1, batch_size):
+        sel = idx[lo:lo + batch_size]
+        yield scenes[sel], masks[sel]

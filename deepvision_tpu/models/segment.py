@@ -1,0 +1,100 @@
+"""U-Net-style semantic segmentation head over the ResNet backbone family.
+
+The first dense-prediction model of the zoo (the reference covers
+classification/detection/pose/GANs only — PAPER.md §0): a ResNet encoder
+(stem + 4 stages, reusing `models/resnet.py`'s BasicBlock/BottleneckBlock and
+the shared `_BN`) with a U-Net decoder that upsamples nearest-x2, concats the
+matching encoder skip, and refines with 3x3 conv+BN+ReLU at each level, ending
+in an f32 1x1 head emitting per-pixel class logits at the INPUT resolution.
+
+Spatial-mesh compatibility is a design constraint, not an afterthought: every
+decoder op is row-local under H-sharding — nearest-x2 `jax.image.resize` maps
+output row i to local input row i//2, channel concat and 1x1/3x3 SAME convs
+are handled by the halo machinery, and BatchNorm syncs over the mesh axes —
+so the whole network runs H-sharded end to end with NO all_to_all transition
+(`parallel/spatial_shard.default_transition` returns None for this class,
+like CenterNet and StackedHourglass).
+
+Dtype policy matches the zoo: bf16 compute convs, f32 BN + f32 head
+(`nn.Conv(num_classes, (1,1), dtype=jnp.float32)`) — the deliberate f32 head
+jaxvet's DTYPE family allowlists via the num_classes dimension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.registry import MODELS
+from .common import he_normal_fanout
+from .resnet import BasicBlock, BottleneckBlock, _BN
+
+# widest decoder level: full-size backbones carry 2048-wide stride-32
+# features; decoding at that width would dwarf the encoder for no mIoU
+DECODER_MAX_WIDTH = 256
+
+
+class UNetSegmenter(nn.Module):
+    """ResNet-encoder U-Net: stem/2 -> maxpool -> stages (strides 4..) ->
+    nearest-x2 decoder with skip concats -> f32 1x1 logits at stride 1."""
+    num_classes: int
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    block: type = BottleneckBlock
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        factor = 2 ** (len(self.stage_sizes) + 1)
+        if x.shape[1] % factor or x.shape[2] % factor:
+            # a misaligned size would only fail later as an opaque concat
+            # shape error deep in the decoder — name the contract instead
+            raise ValueError(
+                f"UNetSegmenter with {len(self.stage_sizes)} stages needs "
+                f"H/W divisible by {factor} (skip/upsample alignment), got "
+                f"{x.shape[1]}x{x.shape[2]}")
+        conv = partial(nn.Conv, use_bias=False, kernel_init=he_normal_fanout,
+                       dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2),
+                 padding=[(3, 3), (3, 3)], name="stem_conv")(x)
+        x = _BN()(x, train).astype(self.dtype)
+        skips = [x]                                   # stride 2
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.width * 2 ** i, strides=strides,
+                               dtype=self.dtype)(x, train=train)
+            skips.append(x)                           # strides 4, 8, 16, ...
+
+        def refine(y, features, name):
+            y = conv(features, (3, 3), padding=[(1, 1), (1, 1)],
+                     name=f"{name}_conv")(y)
+            return _BN()(y, train).astype(self.dtype)
+
+        y = skips.pop()
+        for level, skip in enumerate(reversed(skips)):
+            b, h, w, c = y.shape
+            y = jax.image.resize(y, (b, h * 2, w * 2, c), method="nearest")
+            y = jnp.concatenate([y, skip.astype(self.dtype)], axis=-1)
+            y = refine(y, min(DECODER_MAX_WIDTH, skip.shape[-1]),
+                       f"dec{level}")
+        b, h, w, c = y.shape                          # stride 2 now
+        y = jax.image.resize(y, (b, h * 2, w * 2, c), method="nearest")
+        y = refine(y, min(DECODER_MAX_WIDTH, self.width), "dec_full")
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
+                         name="head")(y)
+        return logits.astype(jnp.float32)
+
+
+MODELS.register("unet_resnet50", partial(
+    UNetSegmenter, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock))
+# CPU-feasible tiny variant for the synthetic/digits recipes — the segmentation
+# analog of centernet_digits' width-cut hourglass
+MODELS.register("unet_small", partial(
+    UNetSegmenter, stage_sizes=(1, 1), block=BasicBlock, width=8))

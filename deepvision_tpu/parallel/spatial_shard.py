@@ -362,6 +362,11 @@ def default_transition(model) -> Optional[str]:
       rows (7 rows/shard at sp=2) — so the last two blocks and the global
       mean run on full-height rows (the exact analogue of the ResNet
       plan's last-stage-entry rule).
+    - UNetSegmenter (segmentation): fully convolutional by construction
+      (SAME/explicit-pad convs, 3x3/2 maxpool via halo, nearest-x2
+      upsamples and channel concats are row-local, f32 1x1 head) — None
+      keeps H sharded end to end; the pixel-wise CE is dense and
+      row-sliceable (make_shardmap_segmentation_train_step).
     """
     name = type(model).__name__
     if name == "ResNet":
@@ -372,13 +377,13 @@ def default_transition(model) -> Optional[str]:
     if name == "MobileNetV1":
         from ..models.mobilenet import _V1_BODY
         return f"block{len(_V1_BODY) - 2}"
-    if name in ("ObjectsAsPoints", "StackedHourglass"):
+    if name in ("ObjectsAsPoints", "StackedHourglass", "UNetSegmenter"):
         return None
     raise NotImplementedError(
         f"spatial_backend='shard_map' has no transition plan for "
         f"{name}; supported: ResNet family, MobileNetV1, CenterNet, "
-        f"StackedHourglass (+ YOLO/pose via their trainers). Use the gspmd "
-        f"backend for this model.")
+        f"StackedHourglass, UNetSegmenter (+ YOLO/pose via their "
+        f"trainers). Use the gspmd backend for this model.")
 
 
 def resnet_transition(stage_sizes: Sequence[int],
@@ -671,6 +676,121 @@ def make_shardmap_pose_train_step(
             out_specs=(P(), P(), P()),
             check_vma=False,
         )(state.params, state.batch_stats, images, kp_x, kp_y, visibility)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
+        return new_state, metrics
+
+    jit_kwargs = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0,)
+    jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    return annotate_step(jax.jit(step, **jit_kwargs), donate=donate,
+                         compute_dtype=jnp.dtype(compute_dtype),
+                         kind="train", spatial=True)
+
+
+def make_shardmap_segmentation_train_step(
+    *,
+    num_classes: int,
+    image_size: int,
+    mesh: Mesh,
+    compute_dtype=jnp.bfloat16,
+    input_norm: Optional[tuple] = None,
+    device_augment=None,
+    dice_weight: float = 0.0,
+    log_grad_norm: bool = False,
+    donate: bool = True,
+    remat: bool = False,
+):
+    """Segmentation `(state, images, masks, rng)` step with owned spatial
+    semantics — the dense-prediction family the spatial backend was built
+    toward (ROADMAP item 4). The U-Net is fully convolutional (SAME convs,
+    3x3/2 maxpool via halo, nearest-x2 upsamples, channel concats and the
+    f32 1x1 head are all row-local), so H stays sharded END TO END through
+    encoder AND decoder (transition=None): the (B, S, S) class-id masks are
+    row-sliced to the shard exactly like CenterNet's dense targets, each
+    rank's pixel-CE is the mean over its disjoint (batch x rows) slice, and
+    the one controlled psum over ('data','spatial') / n_ranks is exactly the
+    global-batch gradient (equal slice sizes make the global mean the mean
+    of local means — the pose-step argument verbatim).
+
+    `device_augment` (the PAIRED image/mask stage) runs INSIDE the jit but
+    BEFORE the shard_map: the per-example crop sees full-height tensors
+    (only batch-sharded), which is precisely why segmentation passes the
+    per-family device-augment capability check that refuses classification
+    on spatial meshes. `dice_weight` is refused here: dice is a ratio of
+    per-class pixel SUMS, not row-local — use the gspmd backend for the
+    xent_dice recipe on spatial meshes."""
+    from ..core.segment import pixel_accuracy, segmentation_loss
+    from ..core.steps import _normalize_input, maybe_grad_norm
+
+    if dice_weight > 0.0:
+        raise NotImplementedError(
+            "xent_dice under spatial shard_map: the dice term needs global "
+            "per-class pixel sums (not row-local); use the gspmd backend "
+            "or loss='softmax_xent' for this mesh")
+    del num_classes  # the loss derives C from the logits' last dim
+    sp = dict(mesh.shape).get(SPATIAL_AXIS, 1)
+    dp = dict(mesh.shape)[DATA_AXIS]
+    n_ranks = sp * dp
+    axes = tuple(a for a in MANUAL_AXES if a in mesh.axis_names)
+    if sp > 1 and image_size % sp != 0:
+        raise ValueError(f"segmentation image size {image_size} must be "
+                         f"divisible by spatial={sp} (logits and masks are "
+                         f"H-sharded at full resolution)")
+
+    def step(state, images, masks, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        if device_augment is not None:
+            images, masks = device_augment(
+                images, masks, jax.random.fold_in(step_rng, 2))
+        else:
+            images = _normalize_input(images, input_norm, compute_dtype)
+        masks = masks.astype(jnp.int32)
+
+        def body(params, batch_stats, images, masks):
+            if sp > 1:
+                rows = image_size // sp
+                start = lax.axis_index(SPATIAL_AXIS) * rows
+                masks_local = lax.dynamic_slice_in_dim(masks, start, rows,
+                                                       axis=1)
+            else:
+                masks_local = masks
+
+            def forward(p, images):
+                ctx = SpatialShardContext(sp=sp, transition=None, axes=axes)
+                with ctx.active():
+                    return state.apply_fn(
+                        {"params": p, "batch_stats": batch_stats},
+                        images, train=True, mutable=["batch_stats"])
+
+            if remat:
+                forward = jax.checkpoint(
+                    forward, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+
+            def loss_fn(p):
+                logits, mutated = forward(p, images)
+                comp = segmentation_loss(logits, masks_local)
+                return comp["total"], (logits, comp, mutated)
+
+            (loss, (logits, comp, mutated)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = reduce_grads(grads, axes, n_ranks)
+            metrics = {"loss": loss,
+                       "pixel_acc": pixel_accuracy(logits, masks_local),
+                       "ce_loss": comp["ce"]}
+            metrics = {k: lax.pmean(v, axes) for k, v in metrics.items()}
+            new_bs = mutated.get("batch_stats", batch_stats)
+            return grads, new_bs, metrics
+
+        spatial_in = P(DATA_AXIS, SPATIAL_AXIS if sp > 1 else None)
+        grads, new_bs, metrics = jax.shard_map(
+            body, mesh=mesh, axis_names=set(axes),
+            in_specs=(P(), P(), spatial_in, P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(state.params, state.batch_stats, images, masks)
         new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
         metrics = {**metrics, **maybe_grad_norm(log_grad_norm, grads)}
         return new_state, metrics

@@ -159,6 +159,7 @@ class PredictEngine:
                  compute_dtype=jnp.bfloat16,
                  input_norm: Optional[Tuple] = None,
                  take_first_output: bool = False,
+                 output_transform: Optional[Callable] = None,
                  name: str = "model", verbose: bool = True,
                  provenance: Optional[dict] = None):
         bs = sorted({int(b) for b in buckets})
@@ -192,8 +193,18 @@ class PredictEngine:
             out = apply_fn(variables, x, train=False)
             if take_first_output and isinstance(out, (tuple, list)):
                 out = out[0]  # inception-style aux heads: primary logits
+            if output_transform is not None:
+                # family-level payload shaping compiled INTO the bucket
+                # programs (segmentation: f32 logits -> int32 class-id
+                # masks) — the argmax ships in the AOT executable, so the
+                # wire payload is C-fold smaller than the logits
+                out = output_transform(out)
+            # float leaves serve as f32 (the engine contract jaxvet's
+            # DTYPE family checks); integer payloads (class-id masks)
+            # keep their dtype
             return jax.tree_util.tree_map(
-                lambda y: y.astype(jnp.float32), out)
+                lambda y: y.astype(jnp.float32)
+                if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
 
         self._predict_fn = predict
         self._jitted = jax.jit(predict)
@@ -251,10 +262,19 @@ class PredictEngine:
                 variables["batch_stats"] = batch_stats
         input_norm = ((cfg.data.mean, cfg.data.std)
                       if cfg.data.normalize_on_device else None)
+        output_transform = None
+        if cfg.family == "segmentation":
+            # dense prediction serves CLASS-ID MASKS, not logits: argmax
+            # inside the compiled program (int32 (n, H, W) payload) — the
+            # same transform core/segment.make_segmentation_predict_step
+            # applies, mirrored by the jaxvet SERVE probe
+            def output_transform(out):
+                return jnp.argmax(out, axis=-1).astype(jnp.int32)
         return cls(apply_fn, variables, example_shape=sample_shape,
                    buckets=buckets, max_batch=max_batch,
                    compute_dtype=compute_dtype, input_norm=input_norm,
                    take_first_output=cfg.family == "classification",
+                   output_transform=output_transform,
                    name=cfg.name, verbose=verbose, provenance=provenance)
 
     # -- compilation -------------------------------------------------------

@@ -327,11 +327,22 @@ class TestDeviceAugmentedTraining:
         with pytest.raises(ValueError, match="classification-only"):
             DetectionTrainer(cfg, workdir=str(tmp_path / "wd"))
 
-    def test_spatial_mesh_rejected(self, tmp_path):
+    def test_spatial_mesh_rejected_per_family(self, tmp_path):
+        """The per-family capability check (data/device_augment.
+        check_spatial_capability): classification on a spatial mesh is
+        refused with a message NAMING which families DO support device
+        augmentation there — no more blanket rejection."""
         from deepvision_tpu.core.trainer import Trainer
         cfg = _cfg(tmp_path, spatial_parallel=2)
-        with pytest.raises(ValueError, match="spatial"):
+        with pytest.raises(ValueError,
+                           match="supported for the segmentation family"):
             Trainer(cfg, workdir=str(tmp_path / "wd"))
+        # the check itself is the one policy owner: segmentation passes,
+        # every other fusing family is refused by name
+        daug.check_spatial_capability("segmentation", 2)
+        daug.check_spatial_capability("classification", 1)
+        with pytest.raises(ValueError, match="'classification'"):
+            daug.check_spatial_capability("classification", 2)
 
 
 class TestCliWiring:
@@ -356,6 +367,119 @@ class TestCliWiring:
                 "lenet", ["lenet5"],
                 ["-m", "lenet5", "--dataset", "digits", "--epochs", "1",
                  "--device-augment", "--workdir", str(tmp_path / "wd")])
+
+
+class TestPairedAugment:
+    """Paired image/mask augmentation (make_paired_train_augment): the mask's
+    crop offsets and flip decisions must EXACTLY equal the image's for every
+    key — both consume the one `_crop_flip_draws` call — and the image path
+    must be bit-identical to the single-tensor `make_train_augment` under the
+    same key (no drift between the two factories)."""
+
+    def test_mask_offsets_exactly_equal_images(self):
+        """Identity normalization (mean 0, std 1/255) makes the image path
+        return raw cropped/flipped pixel values — encode pixel POSITION in
+        both tensors and the outputs must be elementwise equal, crop, flip
+        and all."""
+        import jax
+        import jax.numpy as jnp
+        b = 8
+        pos = (np.arange(D)[:, None] * D + np.arange(D)[None, :]) % 256
+        images = np.broadcast_to(pos[None, :, :, None],
+                                 (b, D, D, 3)).astype(np.uint8)
+        masks = np.broadcast_to(pos[None], (b, D, D)).astype(np.uint8)
+        fn = jax.jit(daug.make_paired_train_augment(
+            S, mean=(0.0, 0.0, 0.0), std=(1 / 255.0,) * 3,
+            jitter=(0.0, 0.0, 0.0), compute_dtype=jnp.float32))
+        for seed in (0, 1, 7):
+            imgs, m = fn(images, masks, jax.random.PRNGKey(seed))
+            assert m.shape == (b, S, S) and m.dtype == jnp.int32
+            np.testing.assert_array_equal(np.asarray(imgs[..., 0]),
+                                          np.asarray(m))
+
+    def test_image_path_identical_to_single_tensor_factory(self):
+        """Same key -> the paired factory's image output equals
+        make_train_augment's bit-for-bit (jitter, normalize and all): both
+        consume the same `_crop_flip_draws`, so neither can drift."""
+        import jax
+        import jax.numpy as jnp
+        images = _u8((8, D, D, 3), seed=3)
+        masks = _u8((8, D, D), seed=4)
+        single = daug.make_train_augment(S, compute_dtype=jnp.float32)
+        paired = daug.make_paired_train_augment(S, compute_dtype=jnp.float32)
+        key = jax.random.PRNGKey(5)
+        np.testing.assert_array_equal(
+            np.asarray(single(images, key)),
+            np.asarray(paired(images, masks, key)[0]))
+
+    def test_deterministic_per_key_and_key_sensitive(self):
+        import jax
+        import jax.numpy as jnp
+        images = _u8((4, D, D, 3), seed=0)
+        masks = _u8((4, D, D), seed=1)
+        fn = jax.jit(daug.make_paired_train_augment(
+            S, compute_dtype=jnp.float32))
+        a = fn(images, masks, jax.random.PRNGKey(0))
+        b = fn(images, masks, jax.random.PRNGKey(0))
+        c = fn(images, masks, jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        assert not np.array_equal(np.asarray(a[1]), np.asarray(c[1]))
+
+    def test_eval_degenerate_is_normalize_plus_identity_mask(self):
+        """The eval-parity anchor: with D == image_size the paired eval
+        stage is plain on-device normalization of the image and the IDENTITY
+        on the mask — the same `_normalize_input` contract the non-augment
+        path uses."""
+        import jax.numpy as jnp
+
+        from deepvision_tpu.core.steps import _normalize_input
+        images = _u8((4, S, S, 3), seed=0)
+        masks = _u8((4, S, S), seed=1)
+        mean, std = (0.5, 0.5, 0.5), (0.5, 0.5, 0.5)
+        fn = daug.make_paired_eval_augment(S, mean=mean, std=std,
+                                           compute_dtype=jnp.float32)
+        imgs, m = fn(images, masks)
+        want = _normalize_input(jnp.asarray(images), (mean, std),
+                                jnp.float32)
+        np.testing.assert_allclose(np.asarray(imgs), np.asarray(want),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m), masks.astype(np.int32))
+
+    def test_offsets_stable_per_seed_step_under_dispatch_scan(self, tmp_path):
+        """The (seed, step) determinism contract THROUGH the trainer: a
+        segmentation run with steps_per_dispatch=2 (the lax.scan wrapper)
+        must reproduce the per-step-dispatch run's epoch metrics — inside
+        the scan the augment key still folds from the advancing
+        TrainState.step, so the paired crop/flip draws are identical."""
+        import dataclasses
+
+        from deepvision_tpu.configs import get_config
+        from deepvision_tpu.core.segment import SegmentationTrainer
+        from deepvision_tpu.data.segmentation import SyntheticSegmentation
+
+        def run(k, tag):
+            cfg = get_config("unet_synthetic").replace(
+                batch_size=8, total_epochs=1, device_augment=True,
+                steps_per_dispatch=k,
+                checkpoint_dir=str(tmp_path / f"ckpt{tag}"))
+            cfg = cfg.replace(data=dataclasses.replace(
+                cfg.data, image_size=32, train_examples=8 * 4))
+            tr = SegmentationTrainer(cfg, workdir=str(tmp_path / f"wd{tag}"))
+            try:
+                tr.init_state((32, 32, 3))
+                d = decode_image_size(32)
+                metrics = tr.train_epoch(1, SyntheticSegmentation(
+                    8, d, 3, cfg.data.num_classes, 4, seed=0,
+                    emit_uint8=True))
+            finally:
+                tr.close()
+            return metrics
+
+        m1 = run(1, "a")
+        m2 = run(2, "b")
+        assert m1["loss"] == pytest.approx(m2["loss"], abs=2e-5)
+        assert m1["pixel_acc"] == pytest.approx(m2["pixel_acc"], abs=1e-4)
 
 
 def test_bench_input_schema(tmp_path, capsys):

@@ -1,0 +1,467 @@
+"""Segmentation family (core/segment.py, models/segment.py,
+data/segmentation.py): metrics, losses, model contract, end-to-end training
+with an mIoU-improves gate, spatial-mesh loss-trajectory parity, the
+shard_map factory's guards, jaxvet coverage, and serving class-id masks
+through the fleet."""
+
+import dataclasses
+import json
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config, trainer_class_for_config
+from deepvision_tpu.core import metrics as metrics_lib
+from deepvision_tpu.core.segment import (SegmentationTrainer, dice_weight_for,
+                                         make_segmentation_predict_step,
+                                         make_segmentation_train_step,
+                                         segmentation_loss, soft_dice_loss)
+from deepvision_tpu.data.segmentation import (SyntheticSegmentation,
+                                              segmentation_batches,
+                                              segmentation_scenes,
+                                              segmentation_val_scenes)
+from deepvision_tpu.parallel import mesh as mesh_lib
+
+
+def _tiny_cfg(tmp_path, **kw):
+    cfg = get_config("unet_synthetic").replace(
+        batch_size=8, total_epochs=2,
+        checkpoint_dir=str(tmp_path / "ckpt"), log_every_steps=4)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, image_size=32, train_examples=8 * 6, val_examples=16))
+    return cfg.replace(**kw)
+
+
+def _batches(cfg, steps, seed):
+    return SyntheticSegmentation(cfg.batch_size, cfg.data.image_size,
+                                 cfg.data.channels, cfg.data.num_classes,
+                                 steps, seed=seed)
+
+
+# -- metrics (satellite: streaming confusion / mIoU helpers) -------------------
+
+class TestSegmentationMetrics:
+    def test_confusion_matrix_counts(self):
+        preds = jnp.asarray([[0, 1], [2, 1]])
+        labels = jnp.asarray([[0, 1], [1, 1]])
+        cm = np.asarray(metrics_lib.confusion_matrix(preds, labels, 3))
+        want = np.zeros((3, 3))
+        want[0, 0] = 1   # true 0 pred 0
+        want[1, 1] = 2   # true 1 pred 1 (twice)
+        want[1, 2] = 1   # true 1 pred 2
+        np.testing.assert_array_equal(cm, want)
+
+    def test_confusion_matrix_is_jit_safe_and_weighted(self):
+        f = jax.jit(lambda p, l, w: metrics_lib.confusion_matrix(
+            p, l, 4, weights=w))
+        rs = np.random.RandomState(0)
+        p = rs.randint(0, 4, (2, 8, 8))
+        l = rs.randint(0, 4, (2, 8, 8))
+        w = np.ones((2, 8, 8), np.float32)
+        w[0] = 0.0   # first example's pixels dropped from the counts
+        cm = np.asarray(f(jnp.asarray(p), jnp.asarray(l), jnp.asarray(w)))
+        assert cm.sum() == 64  # only the second example counted
+        cm_ref = np.asarray(metrics_lib.confusion_matrix(
+            jnp.asarray(p[1:]), jnp.asarray(l[1:]), 4))
+        np.testing.assert_array_equal(cm, cm_ref)
+
+    def test_scores_known_case(self):
+        # 2 classes: class 0 -> 3 TP, 1 FN->1; class 1 -> 2 TP, 1 FP from 0
+        cm = np.array([[3.0, 1.0], [0.0, 2.0]])
+        s = metrics_lib.segmentation_scores(cm)
+        assert s["pixel_acc"] == pytest.approx(5 / 6)
+        iou0 = 3 / (4 + 3 - 3)   # tp / (gt + pred - tp)
+        iou1 = 2 / (2 + 3 - 2)
+        assert s["per_class_iou"][0] == pytest.approx(iou0)
+        assert s["per_class_iou"][1] == pytest.approx(iou1)
+        assert s["miou"] == pytest.approx((iou0 + iou1) / 2)
+
+    def test_miou_ignores_absent_classes(self):
+        cm = np.zeros((4, 4))
+        cm[1, 1] = 10.0
+        cm[2, 2] = 5.0
+        cm[2, 1] = 5.0
+        s = metrics_lib.segmentation_scores(cm)
+        # classes 0 and 3 never appear in the ground truth: mIoU averages
+        # over the present {1, 2} only, and their IoUs are nan in per-class
+        assert np.isnan(s["per_class_iou"][0]) and np.isnan(
+            s["per_class_iou"][3])
+        assert s["miou"] == pytest.approx((10 / 15 + 5 / 10) / 2)
+
+    def test_streaming_accumulator(self):
+        stream = metrics_lib.StreamingConfusion(3)
+        rs = np.random.RandomState(1)
+        total = np.zeros((3, 3))
+        for _ in range(3):
+            p = rs.randint(0, 3, (4, 4))
+            l = rs.randint(0, 3, (4, 4))
+            cm = np.asarray(metrics_lib.confusion_matrix(
+                jnp.asarray(p), jnp.asarray(l), 3))
+            stream.update(cm)
+            total += cm
+        np.testing.assert_array_equal(stream.cm, total)
+        assert stream.result()["pixel_acc"] == pytest.approx(
+            np.diag(total).sum() / total.sum())
+        with pytest.raises(ValueError, match="shape"):
+            stream.update(np.zeros((2, 2)))
+
+
+# -- losses --------------------------------------------------------------------
+
+class TestSegmentationLoss:
+    def test_ce_matches_manual(self):
+        rs = np.random.RandomState(0)
+        logits = jnp.asarray(rs.randn(2, 4, 4, 3).astype(np.float32))
+        masks = jnp.asarray(rs.randint(0, 3, (2, 4, 4)))
+        comp = segmentation_loss(logits, masks)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        want = -np.take_along_axis(np.asarray(logp),
+                                   np.asarray(masks)[..., None],
+                                   axis=-1).mean()
+        assert float(comp["ce"]) == pytest.approx(float(want), rel=1e-6)
+        assert float(comp["total"]) == float(comp["ce"])
+
+    def test_dice_bounds_and_blend(self):
+        rs = np.random.RandomState(0)
+        masks = jnp.asarray(rs.randint(0, 3, (2, 8, 8)))
+        # perfect prediction -> dice loss ~ 0
+        perfect = 50.0 * jax.nn.one_hot(masks, 3)
+        assert float(soft_dice_loss(perfect, masks)) < 1e-3
+        logits = jnp.asarray(rs.randn(2, 8, 8, 3).astype(np.float32))
+        d = float(soft_dice_loss(logits, masks))
+        assert 0.0 < d < 1.0
+        comp = segmentation_loss(logits, masks, dice_weight=0.5)
+        assert float(comp["total"]) == pytest.approx(
+            float(comp["ce"]) + 0.5 * float(comp["dice"]), rel=1e-6)
+
+    def test_dice_weight_from_config_loss_field(self):
+        cfg = get_config("unet_synthetic")
+        assert dice_weight_for(cfg) == 0.0
+        assert dice_weight_for(get_config("unet_digits")) > 0.0
+        with pytest.raises(ValueError, match="unknown loss"):
+            dice_weight_for(cfg.replace(loss="hinge"))
+
+
+# -- data ----------------------------------------------------------------------
+
+class TestSegmentationData:
+    def test_synthetic_contract_and_determinism(self):
+        ds = SyntheticSegmentation(4, 32, 3, 6, 2, seed=7)
+        a = list(ds)
+        b = list(SyntheticSegmentation(4, 32, 3, 6, 2, seed=7))
+        assert len(a) == 2
+        img, mask = a[0]
+        assert img.shape == (4, 32, 32, 3) and img.dtype == np.float32
+        assert mask.shape == (4, 32, 32) and mask.dtype == np.int32
+        assert img.min() >= -1.0 and img.max() <= 1.0
+        assert 0 <= mask.min() and mask.max() < 6 and mask.max() > 0
+        np.testing.assert_array_equal(a[1][1], b[1][1])
+
+    def test_synthetic_uint8_mode(self):
+        img, mask = next(iter(SyntheticSegmentation(
+            4, 36, 3, 6, 1, seed=0, emit_uint8=True)))
+        assert img.dtype == np.uint8 and mask.dtype == np.uint8
+        assert img.shape == (4, 36, 36, 3) and mask.shape == (4, 36, 36)
+
+    def test_digit_scenes_mask_semantics(self):
+        from deepvision_tpu.data.digits import scan_splits
+        (tr_x, tr_y), _ = scan_splits()
+        scenes, masks = segmentation_scenes(tr_x, tr_y, n_scenes=8,
+                                            canvas=64, seed=0)
+        assert scenes.shape == (8, 64, 64, 3) and masks.shape == (8, 64, 64)
+        assert masks.max() <= 10 and masks.max() >= 1
+        # foreground mask pixels sit exactly where the scene has bright
+        # strokes: every labeled pixel is non-background in the image
+        fg = masks > 0
+        assert (scenes[..., 0][fg] > -1.0 + 2 * 0.25 - 1e-6).all()
+        # the pinned val set is deterministic
+        va1 = segmentation_val_scenes(canvas=64, n_scenes=4)
+        va2 = segmentation_val_scenes(canvas=64, n_scenes=4)
+        np.testing.assert_array_equal(va1[1], va2[1])
+        batches = list(segmentation_batches(va1, batch_size=2))
+        assert len(batches) == 2 and batches[0][0].shape == (2, 64, 64, 3)
+
+
+# -- model ---------------------------------------------------------------------
+
+class TestUNetModel:
+    def test_output_contract(self):
+        from deepvision_tpu.models import MODELS
+        model = MODELS.get("unet_small")(num_classes=5, dtype=jnp.float32)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 32, 32, 5)
+        assert out.dtype == jnp.float32  # the f32 head contract
+
+    def test_misaligned_size_named_error(self):
+        from deepvision_tpu.models import MODELS
+        model = MODELS.get("unet_small")(num_classes=5)
+        with pytest.raises(ValueError, match="divisible by 8"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 36, 36, 3)),
+                       train=True)
+
+
+# -- training ------------------------------------------------------------------
+
+class TestSegmentationTraining:
+    def test_miou_improves_over_epoch0(self, tmp_path):
+        """The acceptance gate: two epochs on the learnable synthetic set
+        must lift mIoU over the untrained eval, through the full trainer
+        (fit/eval/checkpoint/metrics)."""
+        cfg = _tiny_cfg(tmp_path)
+        tr = SegmentationTrainer(cfg, workdir=str(tmp_path / "wd"))
+        try:
+            tr.init_state((32, 32, 3))
+            before = tr.evaluate(_batches(cfg, 2, 10 ** 6))
+            result = tr.fit(lambda e: _batches(cfg, 6, e),
+                            lambda e: _batches(cfg, 2, 10 ** 6),
+                            sample_shape=(32, 32, 3))
+        finally:
+            tr.close()
+        assert np.isfinite(result["loss"])
+        assert result["miou"] > before["miou"]
+        assert result["pixel_acc"] > before["pixel_acc"]
+        # miou is the watched metric (best-model selection)
+        assert result["best_metric"] == pytest.approx(result["miou"])
+
+    def test_trainer_rejects_mixup(self, tmp_path):
+        with pytest.raises(ValueError, match="classification-only"):
+            SegmentationTrainer(_tiny_cfg(tmp_path, mixup_alpha=0.2),
+                                workdir=str(tmp_path / "wd"))
+
+    def test_xent_dice_trains(self, tmp_path):
+        cfg = _tiny_cfg(tmp_path, loss="xent_dice", total_epochs=1)
+        tr = SegmentationTrainer(cfg, workdir=str(tmp_path / "wd"))
+        try:
+            tr.init_state((32, 32, 3))
+            batch = mesh_lib.shard_batch_pytree(
+                tr.mesh, next(iter(_batches(cfg, 1, 0))))
+            st, m = tr.train_step(tr.state, *batch, jax.random.PRNGKey(0))
+            got = {k: float(v) for k, v in jax.device_get(m).items()}
+        finally:
+            tr.close()
+        assert np.isfinite(got["loss"])
+        assert got["loss"] == pytest.approx(
+            got["ce_loss"] + 0.5 * got["dice_loss"], rel=1e-5)
+
+
+@pytest.mark.slow
+def test_spatial_loss_trajectory_matches_unsharded(tmp_path):
+    """THE H-sharded acceptance pin: the same seeded 6-step trajectory on a
+    (data=4, spatial=2) virtual mesh matches the unsharded (1-device-mesh)
+    run step for step. f32 end to end; the only layout-dependent numerics
+    are sync-BN/reduction reassociation, measured well inside 1e-3
+    relative (verify_mesh's loss agreement bound)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+
+    def run(spatial, tag):
+        cfg = get_config("unet_synthetic").replace(
+            batch_size=8, total_epochs=1, spatial_parallel=spatial,
+            checkpoint_dir=str(tmp_path / f"ckpt{tag}"))
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, image_size=64, train_examples=8 * 6))
+        mesh = (mesh_lib.make_mesh(np.array(jax.devices())[:1])
+                if spatial == 1 else
+                mesh_lib.make_mesh(spatial_parallel=2))
+        tr = SegmentationTrainer(cfg, mesh=mesh,
+                                 workdir=str(tmp_path / f"wd{tag}"))
+        losses = []  # device arrays; fetched once after the loop (SYNC001)
+        try:
+            tr.init_state((64, 64, 3))
+            for batch in SyntheticSegmentation(8, 64, 3, 6, 6, seed=0):
+                sharded = mesh_lib.shard_batch_pytree(tr.mesh, batch)
+                tr.state, m = tr.train_step(tr.state, *sharded,
+                                            jax.random.PRNGKey(0))
+                losses.append(m["loss"])
+            losses = [float(v) for v in jax.device_get(losses)]
+            ev = tr.evaluate(iter(SyntheticSegmentation(8, 64, 3, 6, 2,
+                                                        seed=10 ** 6)))
+        finally:
+            tr.close()
+        return np.asarray(losses), ev
+
+    losses_1, ev_1 = run(1, "a")
+    losses_sp, ev_sp = run(2, "b")
+    np.testing.assert_allclose(losses_sp, losses_1, rtol=1e-3, atol=1e-5)
+    assert ev_sp["miou"] == pytest.approx(ev_1["miou"], abs=5e-3)
+
+
+class TestShardMapFactory:
+    """The owned-collectives step (parallel/spatial_shard.py): cheap guards
+    run on every env; the full trace/run needs the stable `jax.shard_map`
+    alias this env's jax 0.4.37 lacks (same triage as the other shard_map
+    families — jaxvet's COLL probes cover the collective layer)."""
+
+    def test_cheap_guards(self):
+        from deepvision_tpu.parallel.spatial_shard import (
+            make_shardmap_segmentation_train_step)
+        mesh = mesh_lib.make_mesh(spatial_parallel=2) \
+            if len(jax.devices()) >= 2 else None
+        if mesh is None:
+            pytest.skip("needs >= 2 devices")
+        with pytest.raises(ValueError, match="divisible by spatial"):
+            make_shardmap_segmentation_train_step(
+                num_classes=4, image_size=63, mesh=mesh)
+        with pytest.raises(NotImplementedError, match="dice"):
+            make_shardmap_segmentation_train_step(
+                num_classes=4, image_size=64, mesh=mesh, dice_weight=0.5)
+
+    @pytest.mark.slow
+    @pytest.mark.xfail(
+        strict=False,
+        reason="env skew (261db1b class): this env's jax 0.4.37 has no "
+               "stable jax.shard_map alias and its flax _normalize "
+               "signature predates the interceptor's — the spatial "
+               "backend targets the newer API; jaxvet's COLL probes cover "
+               "the collective layer meanwhile")
+    def test_shardmap_parity_vs_oracle(self, tmp_path):
+        """On runtimes with jax.shard_map: the owned-collectives step
+        matches the single-device oracle per-leaf (the CenterNet parity
+        recipe transplanted)."""
+        import optax
+
+        from deepvision_tpu.core.train_state import TrainState, init_model
+        from deepvision_tpu.models import MODELS
+        from deepvision_tpu.parallel.spatial_shard import (
+            make_shardmap_segmentation_train_step)
+
+        model = MODELS.get("unet_small")(num_classes=4, dtype=jnp.float32)
+        rs = np.random.RandomState(0)
+        images = rs.rand(8, 32, 32, 3).astype(np.float32) * 2 - 1
+        masks = rs.randint(0, 4, (8, 32, 32)).astype(np.int32)
+        params, bstats = init_model(model, jax.random.PRNGKey(0),
+                                    jnp.zeros((2, 32, 32, 3)))
+        tx = optax.sgd(0.1, momentum=0.9)
+
+        oracle = make_segmentation_train_step(
+            num_classes=4, compute_dtype=jnp.float32, donate=False)
+        ost, om = oracle(TrainState.create(model.apply, params, tx, bstats),
+                         jnp.asarray(images), jnp.asarray(masks),
+                         jax.random.PRNGKey(2))
+
+        mesh = mesh_lib.make_mesh(np.array(jax.devices())[:4],
+                                  spatial_parallel=2, model_parallel=2)
+        st = TrainState.create(model.apply, params, tx, bstats)
+        repl = mesh_lib.replicated(mesh)
+        rules = mesh_lib.param_sharding_rules(mesh, st.params,
+                                              min_size_to_shard=2 ** 10)
+        st = st.replace(params=jax.device_put(st.params, rules),
+                        batch_stats=jax.device_put(st.batch_stats, repl),
+                        opt_state=jax.device_put(st.opt_state, repl),
+                        step=jax.device_put(st.step, repl))
+        sm_step = make_shardmap_segmentation_train_step(
+            num_classes=4, image_size=32, mesh=mesh,
+            compute_dtype=jnp.float32, donate=False)
+        batch = mesh_lib.shard_batch_pytree(mesh, (images, masks))
+        sst, sm = sm_step(st, *batch, jax.random.PRNGKey(2))
+        assert float(sm["loss"]) == pytest.approx(float(om["loss"]),
+                                                  rel=1e-5)
+        for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(
+                    jax.device_get(ost.params))[0],
+                jax.tree_util.tree_leaves(jax.device_get(sst.params))):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-3,
+                err_msg=jax.tree_util.keystr(path))
+
+
+# -- jaxvet coverage -----------------------------------------------------------
+
+def test_jaxvet_clean_over_segmentation_configs():
+    """The grown registry audits clean: DTYPE/DONATE/SERVE/COST over the
+    new family's traced steps, against the committed CHECK_COST rows."""
+    from deepvision_tpu.check.cli import audit
+    findings, report = audit(["unet_synthetic", "unet_digits"])
+    assert not findings, [f.format() for f in findings]
+    assert {"unet_synthetic/train", "unet_synthetic/eval",
+            "unet_synthetic/predict", "unet_synthetic/serve"} <= set(
+                report["units"])
+
+
+def test_predict_step_returns_class_ids(tmp_path):
+    cfg = _tiny_cfg(tmp_path, total_epochs=1)
+    tr = SegmentationTrainer(cfg, workdir=str(tmp_path / "wd"))
+    try:
+        tr.init_state((32, 32, 3))
+        predict = make_segmentation_predict_step(compute_dtype=jnp.float32)
+        images = next(iter(_batches(cfg, 1, 0)))[0]
+        out = predict(tr.eval_state(), jnp.asarray(images))
+    finally:
+        tr.close()
+    assert out.shape == (8, 32, 32) and out.dtype == jnp.int32
+    assert int(out.max()) < cfg.data.num_classes
+
+
+# -- serving -------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_fleet_answers_with_mask(tmp_path):
+    """Acceptance: POST /predict/unet_synthetic answers with an int32
+    class-id mask through the fleet routing, equal to the un-bucketed
+    reference (padding rows provably inert for dense outputs too)."""
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.server import InferenceServer
+
+    engine = PredictEngine.from_config("unet_synthetic", buckets=(1, 2),
+                                       verbose=False)
+    rs = np.random.RandomState(0)
+    x = rs.rand(1, 64, 64, 3).astype(np.float32) * 2 - 1
+    direct = engine.reference(x)
+    assert direct.dtype == np.int32 and direct.shape == (1, 64, 64)
+
+    fleet = ModelFleet()
+    fleet.add(engine, max_delay_ms=5.0)
+    server = InferenceServer(fleet=fleet, flush_every_s=30.0)
+    import threading
+    t = threading.Thread(target=server.serve, kwargs={"port": 0},
+                         daemon=True)
+    t.start()
+    try:
+        assert server.ready.wait(60)
+        body = json.dumps({"instances": x.tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/predict/unet_synthetic",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            payload = json.loads(resp.read())
+        mask = np.asarray(payload["predictions"])
+        assert mask.shape == (1, 64, 64)
+        assert mask.dtype.kind == "i" or np.allclose(mask, mask.astype(int))
+        np.testing.assert_array_equal(mask.astype(np.int32), direct)
+    finally:
+        server.stop()
+        t.join(timeout=60)
+        server.close()
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def test_cli_synthetic_smoke(tmp_path, monkeypatch):
+    """`UNet/jax/train.py -m unet_synthetic` end to end through the shared
+    CLI driver (config overrides, trainer, synthetic data, fit, mIoU)."""
+    monkeypatch.chdir(tmp_path)
+    from deepvision_tpu.cli import run_segmentation
+    result = run_segmentation(
+        "UNet", ["unet_synthetic"],
+        ["-m", "unet_synthetic", "--synthetic", "--epochs", "1",
+         "--batch-size", "8", "--steps-per-epoch", "2",
+         "--workdir", str(tmp_path / "wd")])
+    assert np.isfinite(result["best_metric"])
+    assert "miou" in result
+
+
+def test_cli_rejects_wrong_dataset(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from deepvision_tpu.cli import run_segmentation
+    with pytest.raises(SystemExit, match="float"):
+        run_segmentation(
+            "UNet", ["unet_digits"],
+            ["-m", "unet_digits", "--epochs", "1", "--device-augment",
+             "--workdir", str(tmp_path / "wd")])

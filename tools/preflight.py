@@ -25,6 +25,11 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               reused (zero recompiles) and provenance advanced — the
               zero-downtime deploy path has to work BEFORE traffic
               depends on it
+  segment     dense-prediction family (docs/SEGMENTATION.md): a 2-epoch
+              synthetic CPU train must improve mIoU, one H-sharded
+              spatial train step on a 2-virtual-device mesh must match
+              the pure-DP oracle per-leaf, and the bucketed AOT engine
+              must answer with int32 class-id masks
   devices     backend reachable, device count/platform, mesh construction
   input       host tf.data throughput (real TFRecords when --data-dir is
               given, synthetic JPEG shards otherwise) vs --input-floor
@@ -252,6 +257,86 @@ def check_fleet(args):
         shutil.rmtree(tmpdir, ignore_errors=True)
     return (f"2-model fleet served; epoch 1->2 hot-swapped "
             f"(verified, zero recompiles)")
+
+
+@check("segment")
+def check_segment(args):
+    # the dense-prediction family end to end (docs/SEGMENTATION.md): a
+    # 2-epoch synthetic CPU-feasible train whose mIoU must IMPROVE over the
+    # untrained eval, one H-sharded spatial train step on a 2-virtual-device
+    # mesh proving update parity vs the pure-DP oracle (subprocess, same
+    # isolation rationale as check_mesh_parity), and a serve smoke proving
+    # the bucketed AOT engine answers with int32 class-id masks.
+    import dataclasses
+    import shutil
+    import subprocess
+
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.segment import SegmentationTrainer
+    from deepvision_tpu.data.segmentation import SyntheticSegmentation
+
+    cfg = get_config("unet_synthetic").replace(batch_size=8, total_epochs=2)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, image_size=32, train_examples=64, val_examples=16))
+    tmpdir = tempfile.mkdtemp(prefix="preflight_segment_")
+    trainer = None
+    try:
+        trainer = SegmentationTrainer(cfg, workdir=tmpdir)
+        trainer.init_state((32, 32, 3))
+
+        def batches(steps, seed):
+            return SyntheticSegmentation(cfg.batch_size, 32, 3,
+                                         cfg.data.num_classes, steps,
+                                         seed=seed)
+
+        miou0 = trainer.evaluate(batches(2, 10 ** 6))["miou"]
+        result = trainer.fit(lambda epoch: batches(8, epoch),
+                             lambda epoch: batches(2, 10 ** 6),
+                             sample_shape=(32, 32, 3))
+        miou2 = result.get("miou", 0.0)
+        if not np.isfinite(miou2) or miou2 <= miou0:
+            raise RuntimeError(f"2-epoch synthetic train did not improve "
+                               f"mIoU: {miou0:.3f} -> {miou2:.3f}")
+    finally:
+        if trainer is not None:
+            trainer.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    # one H-sharded spatial step vs the DP oracle, on 2 virtual CPU devices
+    argv = [sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "verify_mesh.py"),
+            "-m", "unet_synthetic", "--spatial-parallel", "2",
+            "--batch-size", "8", "--image-size", "64"]
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env["XLA_FLAGS"] = (
+        child_env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(argv, capture_output=True, text=True, env=child_env,
+                          timeout=900)
+    if proc.returncode != 0:
+        lines = ((proc.stderr.strip() + "\n" + proc.stdout.strip())
+                 .strip().splitlines())
+        raise RuntimeError("spatial step: "
+                           + ("; ".join(lines[-3:]) if lines else
+                              f"verify_mesh exited {proc.returncode}"))
+
+    # serve smoke: the bucketed engine must answer with class-id masks
+    from deepvision_tpu.serve.engine import PredictEngine
+    engine = PredictEngine.from_config("unet_synthetic", buckets=(1, 2),
+                                       verbose=False)
+    x = np.random.RandomState(0).rand(
+        1, *engine.example_shape).astype(np.float32) * 2 - 1
+    mask = engine.predict(x)
+    if (mask.shape != (1, 64, 64) or mask.dtype != np.int32
+            or mask.max() >= cfg.data.num_classes):
+        raise RuntimeError(f"serve mask contract broken: shape={mask.shape} "
+                           f"dtype={mask.dtype} max={mask.max()}")
+    return (f"2-epoch mIoU {miou0:.2f}->{miou2:.2f}; H-sharded step matches "
+            f"DP oracle; serve returns int32 masks")
 
 
 @check("devices")
@@ -580,6 +665,7 @@ def main(argv=None):
     check_check(args)
     check_serve(args)
     check_fleet(args)
+    check_segment(args)
     check_devices(args)
     check_input(args)
     check_augment(args)
