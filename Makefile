@@ -10,6 +10,7 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
+        bench-serve-promote \
         bench-input dryrun smoke seg-smoke serve-smoke serve-fleet-smoke \
         preflight preflight-record lint lint-changed fsck check \
         check-update-cost reshard-parity
@@ -117,6 +118,14 @@ bench-serve-load: ## open-loop fleet load bench: sustained-QPS arrival
 	## schedule over a 2-model fleet — sustained QPS, p99-under-load,
 	## shed rate (one JSON line; docs/SERVING.md "Load bench")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py --load
+
+bench-serve-promote: ## accuracy-gated promotion under open-loop load: a
+	## new epoch lands mid-bench and runs shadow->gate->canary->promote
+	## while arrivals keep firing — promotion_secs, shed rate, p99 delta
+	## through the swap, zero-mixed-generation audit (one JSON line;
+	## docs/SERVING.md "Promotion")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
+	    --load --promote-at 1.5 --secs 5
 
 dryrun:      ## 8-virtual-device multichip compile/exec check
 	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

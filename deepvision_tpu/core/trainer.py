@@ -45,8 +45,16 @@ class TrainingDivergedError(RuntimeError):
     """Raised when an epoch's mean train loss is non-finite (NaN/inf): the
     optimizer state is poisoned, so training on would only burn pod-hours.
     The reference's only gesture at this was skipping NaN val batches with a
-    TODO (`Hourglass/tensorflow/train.py:126-130`); here divergence halts
-    loudly with the last committed checkpoint to resume from."""
+    TODO (`Hourglass/tensorflow/train.py:126-130`). Here a divergent epoch
+    first takes the auto-recovery path when it is enabled
+    (`--recover-on-divergence N` / `TrainConfig.recover_on_divergence`):
+    fit() rolls back to the last committed checkpoint, scales the LR down by
+    `recovery_lr_factor`, and retries the epoch up to N times, logging each
+    rollback to the `resilience_` stream (docs/FAILURES.md). Only when
+    recovery is off — or its budget is exhausted — does this error halt the
+    run loudly with the last committed checkpoint to resume from; and on the
+    serving side the promotion gate (serve/promote.py) keeps any epoch such
+    a run still managed to commit away from traffic."""
 
 
 def divergence_halt(config, ckpt, epoch: int, what: str,

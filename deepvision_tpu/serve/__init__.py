@@ -14,6 +14,10 @@ checkpoint into something that takes traffic (docs/SERVING.md):
   metrics, routed by registry name (`POST /predict/<model>`)
 - reload.WeightReloader: hot weight reload — new integrity-verified
   epochs swap into live engines atomically, zero downtime, zero recompiles
+- promote.PromotionController: accuracy-gated promotion — shadow eval of
+  each candidate against the live generation on a pinned shard, a
+  metric-delta gate, canary traffic routing, and p99/error auto-rollback,
+  every decision on the resilience_ stream and /healthz
 - server.InferenceServer: stdlib HTTP front-end + graceful SIGTERM drain
   (core/resilience.GracefulShutdown contract, exit 0)
 - cli: `python -m deepvision_tpu.serve` (HTTP or --smoke; multi-model via
@@ -24,5 +28,6 @@ from .batcher import Draining, DynamicBatcher, Overloaded, RequestRejected  # no
 from .engine import PredictEngine, load_checkpoint_weights, pick_bucket  # noqa: F401
 from .fleet import ModelFleet, ServedModel, UnknownModel  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .promote import PromotionController, pinned_eval_shard  # noqa: F401
 from .reload import WeightReloader  # noqa: F401
 from .server import InferenceServer  # noqa: F401

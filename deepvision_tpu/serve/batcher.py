@@ -44,13 +44,18 @@ class Draining(RequestRejected):
 
 
 class _Request:
-    __slots__ = ("images", "n", "future", "t_submit")
+    __slots__ = ("images", "n", "future", "t_submit", "generation")
 
-    def __init__(self, images: np.ndarray):
+    def __init__(self, images: np.ndarray,
+                 generation: Optional[str] = None):
         self.images = images
         self.n = images.shape[0]
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        # weight generation this request is pinned to (None = live). The
+        # dispatcher never coalesces requests of different generations into
+        # one batch — the promotion canary's zero-mixed-weights contract.
+        self.generation = generation
 
 
 def _settle(fut: Future, result=None, exc: Optional[BaseException] = None):
@@ -85,6 +90,13 @@ class DynamicBatcher:
         self.max_delay = max_delay_ms / 1000.0
         self.max_queue_examples = int(max_queue_examples)
         self.metrics = metrics
+        # optional per-batch tap `observer(generation, latencies_s,
+        # dispatch_s, error)` — the promotion controller's
+        # canary-vs-baseline comparison feed (generation is 'live' or
+        # 'candidate'; dispatch_s is the device-dispatch wall time, the
+        # part of latency wholly owned by ONE generation; error is the
+        # dispatch exception or None). Called from the dispatcher thread.
+        self.observer = None
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._pending = 0          # examples accepted, results not yet set
@@ -109,7 +121,7 @@ class DynamicBatcher:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, images) -> Future:
+    def submit(self, images, *, generation: Optional[str] = None) -> Future:
         x = self.engine._coerce(images)
         n = x.shape[0]
         if n > self.max_batch:
@@ -131,7 +143,7 @@ class DynamicBatcher:
                     f"{self.max_queue_examples}) — shed load or raise "
                     f"max_queue_examples")
             self._pending += n
-        req = _Request(x)
+        req = _Request(x, generation=generation)
         self._q.put(req)
         return req.future
 
@@ -168,6 +180,9 @@ class DynamicBatcher:
                 if total + nxt.n > self.max_batch:
                     self._carry = nxt       # first request of the NEXT batch
                     break                   # max_batch flush
+                if nxt.generation != first.generation:
+                    self._carry = nxt       # generation boundary: a batch
+                    break                   # runs ONE weight generation
                 batch.append(nxt)
                 total += nxt.n
             self._dispatch(batch, total)
@@ -175,14 +190,18 @@ class DynamicBatcher:
     def _dispatch(self, batch: List[_Request], total: int) -> None:
         images = (batch[0].images if len(batch) == 1
                   else np.concatenate([r.images for r in batch]))
-        t0 = time.monotonic()
+        generation = batch[0].generation   # whole batch shares it (collect
+        t0 = time.monotonic()              # loop breaks on a boundary)
         try:
-            out = self.engine.predict(images)
+            out = self.engine.predict(images, generation=generation)
         except BaseException as e:  # noqa: BLE001 — must reach the futures,
             with self._lock:        # not kill the dispatcher thread
                 self._pending -= total
+            now = time.monotonic()
             for r in batch:
                 _settle(r.future, exc=e)
+            self._observe(generation, [now - r.t_submit for r in batch],
+                          now - t0, e)
             return
         now = time.monotonic()
         with self._lock:
@@ -191,12 +210,23 @@ class DynamicBatcher:
         for r in batch:
             _settle(r.future, tree_slice(out, lo, lo + r.n))
             lo += r.n
+        latencies = [now - r.t_submit for r in batch]
         if self.metrics is not None:
             self.metrics.observe_batch(
                 n_real=total,
                 bucket=pick_bucket(total, self.engine.buckets),
                 dispatch_s=now - t0,
-                request_latencies_s=[now - r.t_submit for r in batch])
+                request_latencies_s=latencies)
+        self._observe(generation, latencies, now - t0, None)
+
+    def _observe(self, generation, latencies, dispatch_s, error) -> None:
+        observer = self.observer
+        if observer is None:
+            return
+        try:
+            observer(generation or "live", latencies, dispatch_s, error)
+        except Exception:  # noqa: BLE001 — a broken tap must not take the
+            pass           # dispatcher thread (and every future) with it
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -67,6 +67,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "verifies (corrupt candidates are refused and "
                         "logged, old weights keep serving). 0 disables "
                         "(default)")
+    p.add_argument("--promote-gate", type=float, default=None,
+                   metavar="DELTA",
+                   help="accuracy-gated promotion (docs/SERVING.md "
+                        "'Promotion'): instead of swapping a verified "
+                        "candidate straight in, shadow-eval it against the "
+                        "live weights on a pinned shard and promote only if "
+                        "the watched metric delta (top-1 / mIoU) is >= "
+                        "DELTA (e.g. -0.02 = at most 2 points worse), then "
+                        "canary a traffic fraction and auto-roll-back on "
+                        "p99/error regression. Decisions land on /healthz "
+                        "and the resilience_ stream. Needs --reload-every; "
+                        "unset = direct integrity-verified swap (default)")
+    p.add_argument("--canary-frac", type=float, default=0.05,
+                   metavar="FRAC",
+                   help="fraction of live traffic routed to the candidate "
+                        "generation during the canary window (default "
+                        "0.05; per-generation batches, never mixed)")
+    p.add_argument("--canary-window", type=float, default=5.0,
+                   metavar="SECS",
+                   help="canary decision window: how long candidate and "
+                        "baseline traffic are compared (p99, error rate) "
+                        "before promote/rollback (default 5)")
     p.add_argument("--image-size", type=int, default=None,
                    help="serving resolution (default: each config's)")
     p.add_argument("--no-verify", action="store_true",
@@ -162,7 +184,7 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
             sm.engine.input_dtype)
         while not stop.is_set():
             try:
-                sm.batcher.submit(x).result(timeout=120)
+                sm.submit(x).result(timeout=120)  # promoter-routed, like HTTP
             except RequestRejected:
                 return  # drain/overload reached this client — done
             except Exception as e:  # noqa: BLE001 — smoke must report
@@ -228,6 +250,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if len(names) > 1 and args.checkpoint:
         parser.error("-c/--checkpoint is single-model; a fleet serves each "
                      "model's latest verified checkpoint")
+    if not 0.0 < args.canary_frac <= 1.0:
+        parser.error(f"--canary-frac must be in (0, 1], got "
+                     f"{args.canary_frac}")
+    if args.canary_window < 0:
+        parser.error(f"--canary-window must be >= 0, got "
+                     f"{args.canary_window}")
+    if args.promote_gate is not None and not args.reload_every:
+        parser.error("--promote-gate needs --reload-every: promotion "
+                     "evaluates the candidates the hot-reload poller finds")
 
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
@@ -267,7 +298,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     server = InferenceServer(
         fleet=fleet, flush_every_s=args.flush_every,
         reload_every_s=args.reload_every,
-        log_dir=args.workdir or args.runs_root)
+        log_dir=args.workdir or args.runs_root,
+        promote_gate=args.promote_gate,
+        canary_frac=args.canary_frac,
+        canary_window_s=args.canary_window)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

@@ -23,6 +23,15 @@ process per chip behind a load balancer (each process owns its params on
 `jax.devices()[0]`), not a mesh — the mesh is training's tool for batches
 too big for one chip, which serving buckets never are. The batch-of-1
 utilization problem is the dynamic micro-batcher's job (serve/batcher.py).
+
+The engine can host TWO weight generations at once: the live one every
+ordinary dispatch uses, and a staged candidate (`stage_candidate`) the
+accuracy-gated promotion pipeline (serve/promote.py) shadow-evaluates and
+canary-routes before flipping it live (`promote_candidate`) or retreating
+(`drop_candidate`). Both generations run through the same AOT bucket
+executables — equal weight signatures mean zero recompiles — and every
+dispatch resolves exactly one generation's variables on entry, so no batch
+ever mixes weights.
 """
 
 from __future__ import annotations
@@ -187,6 +196,16 @@ class PredictEngine:
         # the buffers instead of re-staging them per request
         self._device = jax.devices()[0]
         self._variables = jax.device_put(variables, self._device)
+        # second weight generation (the promotion pipeline's CANDIDATE,
+        # serve/promote.py): staged on the same device, served only to
+        # dispatches that ask for generation="candidate" — shadow eval and
+        # canary traffic — through the SAME compiled bucket programs (the
+        # executables take variables as an argument, so hosting two
+        # signature-equal generations costs zero recompiles). None = only
+        # the live generation exists.
+        self._candidate = None
+        self.candidate_provenance: Optional[dict] = None
+        self._candidate_delay_s = 0.0   # fault injection: canary latency spike
 
         def predict(variables, images):
             x = _normalize_input(images, input_norm, compute_dtype)
@@ -342,6 +361,76 @@ class PredictEngine:
         if provenance is not None:
             self.provenance = dict(provenance)
 
+    # -- candidate generation (staged promotion, serve/promote.py) ---------
+
+    @property
+    def has_candidate(self) -> bool:
+        return self._candidate is not None
+
+    def stage_candidate(self, variables, provenance: Optional[dict] = None,
+                        *, inject_delay_s: float = 0.0) -> None:
+        """Host a second weight generation beside the live one. Same
+        signature contract as `swap_variables` (equal tree/shapes/dtypes,
+        else ValueError — the compiled programs must run both generations
+        as-is); staging is device_put + block, off the request path.
+        Dispatches keep defaulting to the live generation: only callers
+        that ask for `generation="candidate"` (the promotion controller's
+        shadow eval and canary-routed batches) see these weights.
+        `inject_delay_s` is the deterministic canary latency-spike fault
+        (DEEPVISION_FAULT_PROMOTE_REGRESS=<epoch>:latency) — every
+        candidate-generation dispatch sleeps that long."""
+        new_sig = weight_signature(variables)
+        old_sig = weight_signature(self._variables)
+        if new_sig != old_sig:
+            raise ValueError(
+                f"refusing to stage candidate for {self.name!r}: weights do "
+                f"not match the compiled signature (tree structure or leaf "
+                f"shapes/dtypes differ) — the AOT bucket programs would "
+                f"need a recompile; build a fresh engine instead")
+        staged = jax.device_put(variables, self._device)
+        jax.block_until_ready(staged)
+        self._candidate = staged
+        self.candidate_provenance = dict(provenance) if provenance else None
+        self._candidate_delay_s = float(inject_delay_s)
+
+    def promote_candidate(self) -> dict:
+        """Flip the candidate generation live — one reference assignment,
+        exactly like `swap_variables`: in-flight batches (which resolved
+        their generation's variables at dispatch) finish on the weights
+        they started with; every later dispatch serves the new epoch.
+        Returns the now-live provenance."""
+        if self._candidate is None:
+            raise RuntimeError(f"{self.name!r} has no staged candidate to "
+                               f"promote")
+        self._variables = self._candidate
+        if self.candidate_provenance is not None:
+            self.provenance = dict(self.candidate_provenance)
+        self.drop_candidate()
+        return self.provenance
+
+    def drop_candidate(self) -> None:
+        """Retreat to the incumbent: unstage the candidate. Later
+        `generation="candidate"` dispatches resolve to the live weights (a
+        rolled-back canary request still gets a single-generation answer —
+        the incumbent's)."""
+        self._candidate = None
+        self.candidate_provenance = None
+        self._candidate_delay_s = 0.0
+
+    def _resolve_generation(self, generation: Optional[str]):
+        """One-shot read of a generation's (variables, injected_delay_s):
+        the caller holds the returned reference for the whole dispatch, so
+        a concurrent promote/drop never mixes weights inside a batch."""
+        if generation in (None, "live"):
+            return self._variables, 0.0
+        if generation != "candidate":
+            raise ValueError(f"unknown weight generation {generation!r} "
+                             f"(expected 'live' or 'candidate')")
+        cand = self._candidate   # racing drop_candidate: read once
+        if cand is None:
+            return self._variables, 0.0
+        return cand, self._candidate_delay_s
+
     # -- prediction --------------------------------------------------------
 
     def _coerce(self, images) -> np.ndarray:
@@ -355,31 +444,38 @@ class PredictEngine:
                 f"(or one bare example), got {x.shape}")
         return x
 
-    def predict(self, images):
+    def predict(self, images, generation: Optional[str] = None):
         """Host-in host-out bucketed prediction (pads, dispatches, strips).
-        Oversize batches run as max_batch chunks plus one tail bucket."""
+        Oversize batches run as max_batch chunks plus one tail bucket.
+        `generation` selects the weight set ('live'/None, or 'candidate'
+        while a promotion has one staged) — each dispatch runs against
+        exactly one generation's variables."""
         x = self._coerce(images)
         n = x.shape[0]
         if n <= self.max_batch:
-            return self._dispatch(x)
-        return tree_concat([self._dispatch(x[i:i + self.max_batch])
+            return self._dispatch(x, generation)
+        return tree_concat([self._dispatch(x[i:i + self.max_batch],
+                                           generation)
                             for i in range(0, n, self.max_batch)])
 
-    def _dispatch(self, x: np.ndarray):
+    def _dispatch(self, x: np.ndarray, generation: Optional[str] = None):
+        variables, delay_s = self._resolve_generation(generation)
+        if delay_s > 0:
+            time.sleep(delay_s)   # injected canary latency spike (faults)
         n = x.shape[0]
         b = pick_bucket(n, self.buckets)
         if b != n:
             x = np.pad(x, [(0, b - n)] + [(0, 0)] * (x.ndim - 1))
-        out = self._compiled[b](self._variables, x)
+        out = self._compiled[b](variables, x)
         return tree_slice(jax.device_get(out), 0, n)
 
-    def reference(self, images):
+    def reference(self, images, generation: Optional[str] = None):
         """Eager, un-bucketed predict at the exact batch size — the direct
         `model.apply` oracle the padding-equivalence tests (and preflight's
         serve check) compare the bucketed path against."""
         x = self._coerce(images)
-        return jax.device_get(self._predict_fn(self._variables,
-                                               jnp.asarray(x)))
+        variables, _ = self._resolve_generation(generation)
+        return jax.device_get(self._predict_fn(variables, jnp.asarray(x)))
 
     # -- measurement -------------------------------------------------------
 

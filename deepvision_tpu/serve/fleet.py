@@ -63,17 +63,32 @@ class ServedModel:
         self.batcher = batcher
         self.metrics = metrics
         self.workdir = workdir
+        # accuracy-gated promotion controller (serve/promote.py) when the
+        # deployment runs candidates through shadow/canary before they go
+        # live; None = the plain integrity-verified direct-swap path
+        self.promoter = None
         self.reload_lock = threading.Lock()
         self.reload_stats: Dict[str, float] = {
-            "reloads": 0, "refused_corrupt": 0, "refused_incompatible": 0}
+            "reloads": 0, "refused_corrupt": 0, "refused_incompatible": 0,
+            "refused_gate": 0, "rolled_back": 0}
 
     @property
     def name(self) -> str:
         return self.engine.name
 
+    def submit(self, images):
+        """Route one request into this model's batcher, tagged with the
+        generation the promotion controller picks (the canary fraction
+        runs on the staged candidate while one is in flight; everything
+        else — and everything when no promotion is active — runs live).
+        The HTTP front door and the load bench both submit through here so
+        canary routing cannot be bypassed by one of them."""
+        generation = self.promoter.route() if self.promoter else None
+        return self.batcher.submit(images, generation=generation)
+
     def describe(self) -> dict:
         """The /healthz per-model record: serving shape + weight
-        provenance + reload outcomes."""
+        provenance + reload outcomes + promotion state."""
         with self.reload_lock:
             reload_stats = dict(self.reload_stats)
         return {
@@ -83,14 +98,19 @@ class ServedModel:
             "weights": self.engine.provenance,
             "hot_reload": bool(self.workdir),
             "reload": reload_stats,
+            "promotion": (self.promoter.describe()
+                          if self.promoter else None),
         }
 
     def snapshot(self) -> dict:
         """The /stats per-model record."""
-        return {
+        snap = {
             **self.metrics.snapshot(queue_depth=self.batcher.queue_depth),
             "weights": self.engine.provenance,
         }
+        if self.promoter is not None:
+            snap["promotion"] = self.promoter.describe()
+        return snap
 
 
 class ModelFleet:

@@ -31,6 +31,13 @@ newer than the weights currently live, and for each candidate:
 A candidate whose shapes changed (someone retrained a different
 architecture into the same run dir) is refused as incompatible — that
 deployment needs a new engine process, not a swap.
+
+When a served model carries a PromotionController (serve/promote.py —
+the `--promote-gate` deployment), step 3 is delegated: instead of flipping
+directly, the verified candidate runs the shadow-eval gate and canary
+window, and the reloader records the verdict — a refused or rolled-back
+epoch joins the same permanent refusal cache as a corrupt one, so a bad
+epoch is hashed, restored, and scored exactly once.
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ from typing import Dict, Iterable, Optional, Set
 from ..core import integrity
 from ..core.checkpoint import CheckpointCorruptionError
 from ..core.resilience import log_resilience_event
+from . import promote
 from .engine import load_checkpoint_weights
 from .fleet import ServedModel
 
@@ -157,11 +165,36 @@ class WeightReloader:
                          f"candidate epoch {epoch} failed strict restore "
                          f"({e}) — NOT swapped; old weights keep serving")
             return False
-        try:
-            sm.engine.swap_variables(variables, provenance=provenance)
-        except ValueError as e:
-            self._refuse(sm, epoch, "refused_incompatible", str(e))
-            return False
+        promoter = sm.promoter
+        if promoter is not None:
+            # accuracy-gated promotion (serve/promote.py): the controller
+            # runs shadow eval, the metric-delta gate, and the canary
+            # window, and flips or retreats itself — the reloader's job
+            # reduces to caching the verdict so a refused/rolled-back
+            # epoch is never re-evaluated, and counting it on /healthz.
+            decision = promoter.propose(epoch, variables, provenance)
+            if decision == promote.ROLLED_BACK_ABORT:
+                return False   # shutting down: not the epoch's fault —
+                               # don't cache, a restart may re-evaluate
+            if decision != promote.PROMOTED:
+                counter = {promote.REFUSED_GATE: "refused_gate",
+                           promote.ROLLED_BACK_CANARY: "rolled_back"}.get(
+                    decision, "refused_incompatible")
+                record = (promoter.history[-1] if promoter.history else {})
+                detail = record.get("detail",
+                                    "see /healthz promotion history")
+                incumbent = current if current >= 0 else "random-init"
+                self._refuse(sm, epoch, counter,
+                             f"candidate epoch {epoch} {decision} "
+                             f"({detail}) — incumbent epoch {incumbent} "
+                             f"keeps serving")
+                return False
+        else:
+            try:
+                sm.engine.swap_variables(variables, provenance=provenance)
+            except ValueError as e:
+                self._refuse(sm, epoch, "refused_incompatible", str(e))
+                return False
         with sm.reload_lock:
             sm.reload_stats["reloads"] += 1
             sm.reload_stats["last_reload_epoch"] = float(epoch)
@@ -173,6 +206,8 @@ class WeightReloader:
                       f"verified={provenance.get('verified')}"
                       + (", resharded from the saved mesh to this host"
                          if provenance.get("resharded") else "")
+                      + (", promoted through the shadow/canary gate"
+                         if promoter is not None else "")
                       + "; AOT bucket cache reused, zero recompiles)")
         return True
 

@@ -70,7 +70,10 @@ class InferenceServer:
                  workdir: Optional[str] = None,
                  flush_every_s: float = 10.0,
                  reload_every_s: float = 0.0,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 promote_gate: Optional[float] = None,
+                 canary_frac: float = 0.05,
+                 canary_window_s: float = 5.0):
         if (engine is None) == (fleet is None):
             raise ValueError("pass exactly one of engine= or fleet=")
         if fleet is None:
@@ -86,6 +89,16 @@ class InferenceServer:
         # same stream as the trainer: JSONL + TB when a workdir is given,
         # console echo always (MetricsLogger is the one logging mechanism)
         self.logger = MetricsLogger(log_dir or workdir, name="serve")
+        if promote_gate is not None:
+            # accuracy-gated promotion (serve/promote.py): candidates run
+            # shadow eval + canary before going live; hot reload delegates
+            # its swap decision to the attached controllers
+            from .promote import attach_promoters
+            attach_promoters(fleet, gate_min_delta=promote_gate,
+                             canary_frac=canary_frac,
+                             canary_window_s=canary_window_s,
+                             logger=self.logger,
+                             warn=lambda msg: print(msg, flush=True))
         self.reloader = WeightReloader(
             fleet, poll_every_s=reload_every_s, logger=self.logger)
         self.flush_every_s = flush_every_s
@@ -122,7 +135,13 @@ class InferenceServer:
 
     def drain(self) -> dict:
         """Stop reloading, reject new work, finish everything accepted,
-        flush metrics."""
+        flush metrics. An in-flight promotion canary is aborted FIRST —
+        the candidate rolls back to the incumbent and the poller thread
+        (blocked in its canary window) unblocks, so the reloader join
+        below doesn't wait out the window."""
+        for sm in self.fleet:
+            if sm.promoter is not None:
+                sm.promoter.abort()
         self.reloader.stop()
         print(f"[serve:{self.engine.name}] graceful drain: rejecting new "
               f"work, finishing {self.fleet.queue_depth} queued examples "
@@ -247,7 +266,10 @@ def _make_handler(server: InferenceServer):
                     "error": f"body must be JSON {{'instances': "
                              f"[...]}}: {e}"})
             try:
-                fut = sm.batcher.submit(x)
+                # routes through the promotion controller when one is
+                # attached: the canary fraction runs on the candidate
+                # generation, everything else on the live weights
+                fut = sm.submit(x)
             except Overloaded as e:
                 return self._json(429, {"error": str(e)})
             except Draining as e:

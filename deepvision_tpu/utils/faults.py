@@ -47,6 +47,21 @@ and in-process tests configure it the same way:
                                              self-digest — the metadata an
                                              ELASTIC restore reshards against;
                                              verification must refuse it)
+    DEEPVISION_FAULT_PROMOTE_REGRESS=k:kind  make candidate epoch k a
+                                             REGRESSION when the promotion
+                                             controller (serve/promote.py)
+                                             evaluates it. kind: `accuracy`
+                                             (the candidate's shadow-eval
+                                             score is deterministically
+                                             reduced — the gate must refuse),
+                                             `latency` (the candidate
+                                             generation's canary dispatches
+                                             pay an injected delay — the
+                                             canary p99 comparison must roll
+                                             back). Fires for EVERY evaluation
+                                             of epoch k (the refusal cache,
+                                             not the injector, is what stops
+                                             re-evaluation)
 
 An unset environment yields an inert injector (`active` False) whose hooks
 are cheap no-ops — production runs pay two integer compares per batch.
@@ -61,6 +76,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 CORRUPT_MODES = ("truncate", "bitflip", "delete_manifest", "tamper_sharding")
+PROMOTE_REGRESS_KINDS = ("accuracy", "latency")
 
 
 def _parse_step_count(raw: Optional[str]) -> Tuple[Optional[int], int]:
@@ -81,6 +97,18 @@ def _parse_epoch_mode(raw: Optional[str]) -> Tuple[Optional[int], Optional[str]]
     return int(epoch), mode
 
 
+def _parse_promote_regress(raw: Optional[str]
+                           ) -> Tuple[Optional[int], Optional[str]]:
+    if not raw:
+        return None, None
+    epoch, _, kind = raw.partition(":")
+    kind = kind or "accuracy"
+    if kind not in PROMOTE_REGRESS_KINDS:
+        raise ValueError(f"DEEPVISION_FAULT_PROMOTE_REGRESS kind must be one "
+                         f"of {PROMOTE_REGRESS_KINDS}, got {kind!r}")
+    return int(epoch), kind
+
+
 class FaultInjector:
     """Process-local fault state: counters advance as the hooks are called,
     so a fault fires at a deterministic batch/save index and then clears —
@@ -92,7 +120,9 @@ class FaultInjector:
                  ckpt_save_fails: int = 0,
                  ckpt_async_fails: int = 0,
                  ckpt_corrupt_epoch: Optional[int] = None,
-                 ckpt_corrupt_mode: Optional[str] = None):
+                 ckpt_corrupt_mode: Optional[str] = None,
+                 promote_regress_epoch: Optional[int] = None,
+                 promote_regress_kind: Optional[str] = None):
         self.data_io_step = data_io_step
         self.data_io_remaining = data_io_count if data_io_step is not None else 0
         self.nan_step = nan_step
@@ -100,6 +130,8 @@ class FaultInjector:
         self.ckpt_async_fails = ckpt_async_fails
         self.ckpt_corrupt_epoch = ckpt_corrupt_epoch
         self.ckpt_corrupt_mode = ckpt_corrupt_mode
+        self.promote_regress_epoch = promote_regress_epoch
+        self.promote_regress_kind = promote_regress_kind
         self._batch_index = 0   # advances once per batch PULLED (post-fault)
         self._save_index = 0
         self._async_index = 0
@@ -112,6 +144,8 @@ class FaultInjector:
         nan_step, _ = _parse_step_count(env.get("DEEPVISION_FAULT_NAN_STEP"))
         corrupt_epoch, corrupt_mode = _parse_epoch_mode(
             env.get("DEEPVISION_FAULT_CKPT_CORRUPT"))
+        regress_epoch, regress_kind = _parse_promote_regress(
+            env.get("DEEPVISION_FAULT_PROMOTE_REGRESS"))
         return cls(data_io_step=io_step, data_io_count=io_count,
                    nan_step=nan_step,
                    ckpt_save_fails=int(
@@ -119,13 +153,16 @@ class FaultInjector:
                    ckpt_async_fails=int(
                        env.get("DEEPVISION_FAULT_CKPT_ASYNC_FAILS", "0")),
                    ckpt_corrupt_epoch=corrupt_epoch,
-                   ckpt_corrupt_mode=corrupt_mode)
+                   ckpt_corrupt_mode=corrupt_mode,
+                   promote_regress_epoch=regress_epoch,
+                   promote_regress_kind=regress_kind)
 
     @property
     def active(self) -> bool:
         return (self.data_io_step is not None or self.nan_step is not None
                 or self.ckpt_save_fails > 0 or self.ckpt_async_fails > 0
-                or self.ckpt_corrupt_epoch is not None)
+                or self.ckpt_corrupt_epoch is not None
+                or self.promote_regress_epoch is not None)
 
     # -- hooks -------------------------------------------------------------
     def before_batch(self) -> None:
@@ -177,6 +214,18 @@ class FaultInjector:
             raise OSError(
                 f"injected async checkpoint-write failure "
                 f"({i + 1}/{self.ckpt_async_fails})")
+
+    def promote_regression(self, epoch: Optional[int]) -> Optional[str]:
+        """Called by the promotion controller (serve/promote.py) when a
+        candidate epoch enters evaluation: returns the injected regression
+        kind (`accuracy` / `latency`) when `epoch` matches the armed one,
+        else None. Deliberately NOT one-shot: the same bad epoch regresses
+        on every evaluation — the controller's refusal cache, not the
+        injector, is what must prevent re-evaluation (and a test can prove
+        that by counting evaluations)."""
+        if epoch is None or epoch != self.promote_regress_epoch:
+            return None
+        return self.promote_regress_kind
 
     def corrupt_checkpoint(self, epoch: int, step_dir: str,
                            manifest_name: str = "integrity_manifest.json"

@@ -1,0 +1,487 @@
+"""Accuracy-gated promotion (serve/promote.py) on the CPU backend.
+
+The contracts pinned here are the ones the closed train→serve loop depends
+on (docs/SERVING.md "Promotion", docs/FAILURES.md "Promotion decisions"):
+
+- the engine hosts two weight generations through ONE compiled bucket
+  cache (stage/promote/drop, zero recompiles) and the batcher never mixes
+  generations inside a batch;
+- a candidate with an injected accuracy regression
+  (DEEPVISION_FAULT_PROMOTE_REGRESS) is refused by the shadow gate, logged
+  to the `resilience_` stream, and CACHED — the same bad epoch is scored
+  exactly once — while a later clean epoch promotes past it;
+- a candidate with an injected latency regression rolls back from canary
+  under concurrent HTTP traffic with zero failed and zero mixed-generation
+  responses (the PR 7 generation-ownership assertion, extended to three
+  generations of truth: incumbent, canary, post-promote);
+- /healthz carries the promotion state and decision history;
+- a SIGTERM mid-canary aborts the canary, retreats to the incumbent, and
+  the serve CLI drains cleanly with exit 0.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config, trainer_class_for_config
+from deepvision_tpu.core.metrics import MetricsLogger
+from deepvision_tpu.serve.batcher import DynamicBatcher
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet
+from deepvision_tpu.serve.promote import (PromotionController,
+                                          pinned_eval_shard)
+from deepvision_tpu.serve.reload import WeightReloader
+from deepvision_tpu.serve.server import InferenceServer
+from deepvision_tpu.utils.faults import FaultInjector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLE = (32, 32, 1)
+
+
+def _save_epoch(workdir, epoch, state=None, scale=None):
+    """Commit one manifested checkpoint epoch the way training does."""
+    trainer = trainer_class_for_config("lenet5")(get_config("lenet5"),
+                                                 workdir=workdir)
+    try:
+        trainer.init_state(SAMPLE)
+        st = state if state is not None else trainer.state
+        if scale is not None:
+            st = st.replace(params=jax.tree_util.tree_map(
+                lambda a: a * scale, st.params))
+        trainer.ckpt.save(epoch, st, {"best_metric": 0.0})
+        trainer.ckpt.flush()
+        return trainer.state
+    finally:
+        trainer.close()
+
+
+def _gated_model(workdir, **controller_kwargs):
+    """Engine restored from epoch 1 + fleet + promotion controller +
+    a zero-cadence reloader (tests drive sweeps synchronously)."""
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    fleet = ModelFleet()
+    sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    controller_kwargs.setdefault("canary_frac", 0.3)
+    controller_kwargs.setdefault("canary_window_s", 0.2)
+    promoter = PromotionController(sm, **controller_kwargs)
+    reloader = WeightReloader(fleet, poll_every_s=0,
+                              logger=controller_kwargs.get("logger"))
+    return fleet, sm, promoter, reloader
+
+
+@pytest.fixture()
+def run_with_epoch1(tmp_path):
+    workdir = str(tmp_path / "lenet5")
+    state1 = _save_epoch(workdir, 1)
+    return workdir, state1
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, *SAMPLE).astype(np.float32)
+
+
+# -- engine: two weight generations, one compiled cache -----------------------
+
+def test_engine_hosts_two_generations(run_with_epoch1):
+    workdir, _ = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    n_programs = len(engine.compile_log)
+    x = _imgs(2, seed=1)
+    live = jax.device_get(engine._variables)
+    cand = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.1, live)
+    assert not engine.has_candidate
+    engine.stage_candidate(cand, {"checkpoint_epoch": 2, "verified": True})
+
+    out_live = engine.predict(x)
+    out_cand = engine.predict(x, generation="candidate")
+    assert not np.allclose(out_live, out_cand)     # distinct weights
+    # generation names are closed: typos must not silently serve live
+    with pytest.raises(ValueError, match="unknown weight generation"):
+        engine.predict(x, generation="blue")
+
+    engine.promote_candidate()
+    assert not engine.has_candidate
+    assert engine.provenance["checkpoint_epoch"] == 2
+    np.testing.assert_allclose(engine.predict(x), out_cand,
+                               rtol=1e-5, atol=1e-6)
+    # a dropped candidate resolves to live — single-generation answers even
+    # for canary-tagged requests racing a rollback
+    engine.stage_candidate(jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 2.0, live))
+    engine.drop_candidate()
+    np.testing.assert_allclose(engine.predict(x, generation="candidate"),
+                               out_cand, rtol=1e-5, atol=1e-6)
+    assert len(engine.compile_log) == n_programs   # zero recompiles, ever
+    # incompatible candidates are refused at staging
+    bad = dict(live, extra={"w": np.zeros((1,), np.float32)})
+    with pytest.raises(ValueError, match="recompile"):
+        engine.stage_candidate(bad)
+
+
+def test_batcher_never_mixes_generations():
+    """Interleaved live/candidate submissions: every response equals its
+    generation's reference, and the observer sees per-generation batches
+    (the generation-boundary flush)."""
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 8),
+                                       verbose=False)
+    live = jax.device_get(engine._variables)
+    engine.stage_candidate(jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 1.2, live))
+    batches = []
+    batcher = DynamicBatcher(engine, max_delay_ms=20.0)
+    batcher.observer = (lambda gen, lats, disp, err:
+                        batches.append((gen, len(lats), err)))
+    try:
+        x = _imgs(1, seed=3)
+        ref = {"live": engine.reference(x),
+               "candidate": engine.reference(x, generation="candidate")}
+        futs = [(gen, batcher.submit(x, generation=None if gen == "live"
+                                     else gen))
+                for gen in ["live", "candidate"] * 8]
+        for gen, fut in futs:
+            np.testing.assert_allclose(fut.result(timeout=120), ref[gen],
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        batcher.drain(timeout=30)
+    assert sum(n for _, n, _ in batches) == 16
+    assert {g for g, _, _ in batches} == {"live", "candidate"}
+    assert all(err is None for _, _, err in batches)
+
+
+def test_pinned_eval_shard_contract():
+    """The default shadow shard is deterministic, engine-shaped, and
+    supported families only."""
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    cfg = get_config("lenet5")
+    a_img, a_lab = pinned_eval_shard(cfg, engine, examples=16)
+    b_img, b_lab = pinned_eval_shard(cfg, engine, examples=16)
+    np.testing.assert_array_equal(a_img, b_img)    # pinned means pinned
+    np.testing.assert_array_equal(a_lab, b_lab)
+    assert a_img.shape == (16, *engine.example_shape)
+    assert a_img.dtype == engine.input_dtype
+    with pytest.raises(ValueError, match="promotion supports"):
+        pinned_eval_shard(get_config("yolov3_digits"), engine)
+    fleet = ModelFleet()
+    sm = fleet.add(PredictEngine.from_config("yolov3_digits", buckets=(1,),
+                                             verbose=False))
+    try:
+        with pytest.raises(ValueError, match="not promotion-gatable"):
+            PromotionController(sm)
+        assert sm.promoter is None      # a refused attach leaves no hook
+    finally:
+        fleet.drain(timeout=30)
+
+
+# -- gate refusal: logged, cached, recoverable --------------------------------
+
+def test_gate_refusal_logged_cached_then_good_epoch_promotes(
+        run_with_epoch1, tmp_path):
+    """An accuracy-regressing candidate (DEEPVISION_FAULT_PROMOTE_REGRESS)
+    is refused by the shadow gate: decision on the resilience stream and
+    /healthz-visible history, refusal CACHED (the epoch is scored exactly
+    once), incumbent serves byte-identical outputs — and a later clean
+    epoch promotes past the quarantined one."""
+    workdir, state1 = run_with_epoch1
+    logger = MetricsLogger(str(tmp_path / "logs"), name="serve")
+    fleet, sm, promoter, reloader = _gated_model(
+        workdir, logger=logger,
+        faults=FaultInjector(promote_regress_epoch=2,
+                             promote_regress_kind="accuracy"))
+    engine = sm.engine
+    x = _imgs(2, seed=3)
+    ref_old = engine.predict(x)
+    try:
+        _save_epoch(workdir, 2, state1, scale=1.05)
+        assert reloader.check_once() == 0
+        verdict = promoter.history[-1]
+        assert verdict["decision"] == "refused_gate"
+        assert verdict["epoch"] == 2
+        assert verdict["metric_delta"] < promoter.gate_min_delta
+        assert engine.provenance["checkpoint_epoch"] == 1
+        assert not engine.has_candidate                  # dropped, not live
+        np.testing.assert_array_equal(engine.predict(x), ref_old)
+        assert sm.reload_stats["refused_gate"] == 1
+        # the decision reached the resilience forensics stream
+        assert logger.history["resilience_promote_refused_gate"][
+            "value"] == [1.0]
+        assert logger.history["resilience_promote_epoch"]["value"] == [2.0]
+        # cached: the next sweep neither restores nor re-scores epoch 2
+        evals = promoter.shadow_evals
+        assert reloader.check_once() == 0
+        assert promoter.shadow_evals == evals
+        assert sm.reload_stats["refused_gate"] == 1
+        # a clean epoch 3 promotes past the quarantined 2
+        _save_epoch(workdir, 3, state1, scale=1.1)
+        assert reloader.check_once() == 1
+        assert promoter.history[-1]["decision"] == "promoted"
+        assert engine.provenance["checkpoint_epoch"] == 3
+        assert engine.provenance["verified"] is True
+        assert sm.reload_stats["reloads"] == 1
+    finally:
+        fleet.drain(timeout=30)
+        logger.close()
+
+
+# -- canary rollback under concurrent HTTP traffic ----------------------------
+
+def test_canary_rollback_under_http_traffic(run_with_epoch1, tmp_path):
+    """A latency-regressing candidate reaches canary under live HTTP
+    traffic and auto-rolls-back: zero failed requests, every response
+    matches exactly one weight generation (incumbent or canary candidate —
+    never a mixture), the incumbent keeps serving, and /healthz shows the
+    rollback decision."""
+    workdir, state1 = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    fleet = ModelFleet()
+    fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0,
+                          reload_every_s=0.05,
+                          promote_gate=-0.02, canary_frac=0.4,
+                          canary_window_s=1.0)
+    sm = fleet.default
+    sm.promoter.faults = FaultInjector(promote_regress_epoch=2,
+                                       promote_regress_kind="latency")
+    x = _imgs(1, seed=7)
+    ref_old = engine.reference(x)
+    # the exact epoch-2 weights the canary cohort will see
+    cand_vars = dict(jax.device_get(engine._variables))
+    cand_vars["params"] = jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 1.05, cand_vars["params"])
+    engine.stage_candidate(cand_vars)
+    ref_cand = engine.reference(x, generation="candidate")
+    engine.drop_candidate()
+
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    stop = threading.Event()
+    results, failures = [], []
+
+    def client():
+        req_body = json.dumps({"instances": x.tolist()}).encode()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(base + "/predict/lenet5",
+                                             data=req_body)
+                out = json.load(urllib.request.urlopen(req, timeout=60))
+                results.append(np.asarray(out["predictions"], np.float32))
+            except Exception as e:  # noqa: BLE001 — every failure counts
+                failures.append(e)
+                return
+
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for c in clients:
+            c.start()
+        time.sleep(0.3)                    # traffic against the incumbent
+        _save_epoch(workdir, 2, state1, scale=1.05)
+        deadline = time.monotonic() + 120
+        decisions = []
+        while time.monotonic() < deadline:
+            health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                      timeout=30))
+            promo = health["models"]["lenet5"]["promotion"]
+            decisions = promo["decisions"]
+            if decisions:
+                break
+            time.sleep(0.05)
+        assert decisions, "no promotion decision ever appeared on /healthz"
+        assert decisions[-1]["decision"] == "rolled_back_canary"
+        assert decisions[-1]["canary_requests"] > 0   # canary really served
+        assert health["models"]["lenet5"]["weights"][
+            "checkpoint_epoch"] == 1                  # incumbent retained
+        assert health["models"]["lenet5"]["reload"]["rolled_back"] == 1
+        time.sleep(0.2)                    # traffic after the rollback
+    finally:
+        stop.set()
+        for c in clients:
+            c.join(timeout=60)
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+
+    assert not failures, f"requests failed across the canary: {failures[:3]}"
+    assert not engine.has_candidate
+    n_old = n_cand = 0
+    for out in results:
+        if np.allclose(out, ref_old, rtol=1e-4, atol=1e-5):
+            n_old += 1
+        elif np.allclose(out, ref_cand, rtol=1e-4, atol=1e-5):
+            n_cand += 1
+        else:
+            pytest.fail("a response matches NEITHER weight generation — "
+                        "mixed/torn weights reached a request")
+    assert n_old > 0 and n_cand > 0, (n_old, n_cand)  # both cohorts observed
+
+
+def test_promotion_under_http_traffic_zero_mixed(run_with_epoch1):
+    """The happy path end to end over HTTP: a clean candidate shadows,
+    canaries, and PROMOTES under live traffic — zero failed requests,
+    every response on exactly one generation, provenance advances, zero
+    recompiles (the PR 7 hot-reload assertion riding the new pipeline)."""
+    workdir, state1 = run_with_epoch1
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    n_programs = len(engine.compile_log)
+    fleet = ModelFleet()
+    fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0,
+                          reload_every_s=0.05,
+                          promote_gate=-0.02, canary_frac=0.3,
+                          canary_window_s=0.5)
+    x = _imgs(1, seed=9)
+    ref_old = engine.reference(x)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    stop = threading.Event()
+    results, failures = [], []
+
+    def client():
+        req_body = json.dumps({"instances": x.tolist()}).encode()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(base + "/predict/lenet5",
+                                             data=req_body)
+                out = json.load(urllib.request.urlopen(req, timeout=60))
+                results.append(np.asarray(out["predictions"], np.float32))
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+                return
+
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for c in clients:
+            c.start()
+        time.sleep(0.3)
+        _save_epoch(workdir, 2, state1, scale=1.05)
+        deadline = time.monotonic() + 120
+        epoch = None
+        while time.monotonic() < deadline:
+            health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                      timeout=30))
+            epoch = (health["models"]["lenet5"]["weights"]
+                     ["checkpoint_epoch"])
+            if epoch == 2:
+                break
+            time.sleep(0.05)
+        assert epoch == 2, f"/healthz never advanced past {epoch}"
+        promo = health["models"]["lenet5"]["promotion"]
+        assert promo["decisions"][-1]["decision"] == "promoted"
+        assert health["models"]["lenet5"]["reload"]["reloads"] == 1
+        time.sleep(0.2)                    # traffic against the new epoch
+    finally:
+        stop.set()
+        for c in clients:
+            c.join(timeout=60)
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+
+    assert not failures, f"requests failed across the swap: {failures[:3]}"
+    assert len(engine.compile_log) == n_programs
+    assert engine._jitted._cache_size() == 0      # no silent jit fallback
+    ref_new = engine.reference(x)
+    assert not np.allclose(ref_old, ref_new)
+    n_old = n_new = 0
+    for out in results:
+        if np.allclose(out, ref_old, rtol=1e-4, atol=1e-5):
+            n_old += 1
+        elif np.allclose(out, ref_new, rtol=1e-4, atol=1e-5):
+            n_new += 1
+        else:
+            pytest.fail("a response matches NEITHER weight generation")
+    assert n_old > 0 and n_new > 0, (n_old, n_new)
+
+
+# -- CLI surface --------------------------------------------------------------
+
+def test_promote_cli_flag_contract():
+    from deepvision_tpu.serve.cli import main
+
+    with pytest.raises(SystemExit):   # the gate needs the reload poller
+        main(["-m", "lenet5", "--promote-gate", "-0.02"])
+    with pytest.raises(SystemExit):
+        main(["-m", "lenet5", "--reload-every", "1",
+              "--promote-gate", "-0.02", "--canary-frac", "0"])
+    with pytest.raises(SystemExit):
+        main(["-m", "lenet5", "--reload-every", "1",
+              "--promote-gate", "-0.02", "--canary-window", "-1"])
+
+
+# -- SIGTERM mid-canary -------------------------------------------------------
+
+def test_sigterm_mid_canary_rolls_back_and_drains_exit0(tmp_path):
+    """SIGTERM while a canary is in flight: the promotion aborts, the
+    candidate rolls back to the incumbent, and the serve CLI drains
+    cleanly with exit 0 — the preemption contract holds even mid-cycle."""
+    workdir = str(tmp_path / "lenet5")
+    state1 = _save_epoch(workdir, 1)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepvision_tpu.serve", "-m", "lenet5",
+         "--workdir", workdir, "--reload-every", "0.1",
+         "--promote-gate", "-0.02", "--canary-frac", "0.3",
+         "--canary-window", "120", "--port", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.time() + 420
+        lines = []
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if "listening on" in line:
+                port = int(line.split("http://127.0.0.1:")[1].split()[0])
+                break
+        assert port, "serve CLI never started listening:\n" + "".join(lines)
+        # commit the candidate; the 120s canary window guarantees the
+        # SIGTERM lands mid-canary once /healthz says the canary started
+        _save_epoch(workdir, 2, state1, scale=1.05)
+        state = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                health = json.load(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10))
+                state = health["models"]["lenet5"]["promotion"]["state"]
+                if state == "canary":
+                    break
+            except Exception:  # noqa: BLE001 — server still warming up
+                pass
+            time.sleep(0.05)
+        assert state == "canary", f"promotion never reached canary: {state}"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=60)
+    full = "".join(lines) + out
+    assert proc.returncode == 0, full[-2000:]
+    assert "graceful drain" in full
+    assert "drained cleanly" in full
+    assert "rolled_back_abort" in full     # the mid-canary retreat is loud

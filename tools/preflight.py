@@ -25,6 +25,14 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               reused (zero recompiles) and provenance advanced — the
               zero-downtime deploy path has to work BEFORE traffic
               depends on it
+  promote     accuracy-gated promotion (docs/SERVING.md "Promotion"): a
+              candidate epoch armed with the deterministic
+              accuracy-regression fault must be REFUSED by the shadow
+              gate (and cached, never re-evaluated), then a good
+              candidate must promote through shadow->canary with zero
+              recompiles — the gate that keeps a silently-regressed
+              checkpoint away from traffic has to actually fire BEFORE
+              a deployment trusts it
   segment     dense-prediction family (docs/SEGMENTATION.md): a 2-epoch
               synthetic CPU train must improve mIoU, one H-sharded
               spatial train step on a 2-virtual-device mesh must match
@@ -257,6 +265,96 @@ def check_fleet(args):
         shutil.rmtree(tmpdir, ignore_errors=True)
     return (f"2-model fleet served; epoch 1->2 hot-swapped "
             f"(verified, zero recompiles)")
+
+
+@check("promote")
+def check_promote(args):
+    # the accuracy-gated promotion loop end to end (docs/SERVING.md
+    # "Promotion"), both verdicts: a candidate armed with the
+    # deterministic accuracy-regression fault must be refused by the
+    # shadow gate (incumbent keeps serving, refusal cached — the epoch is
+    # scored exactly once), then a clean candidate must promote through
+    # shadow -> canary with the AOT bucket cache reused (zero recompiles).
+    import shutil
+
+    import jax
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.promote import PromotionController
+    from deepvision_tpu.serve.reload import WeightReloader
+    from deepvision_tpu.utils.faults import FaultInjector
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_promote_")
+    fleet = None
+
+    def commit(epoch, state, scale=1.0):
+        trainer = trainer_class_for_config("lenet5")(
+            get_config("lenet5"), workdir=workdir)
+        try:
+            trainer.init_state((32, 32, 1))
+            st = state if state is not None else trainer.state
+            if scale != 1.0:
+                st = st.replace(params=jax.tree_util.tree_map(
+                    lambda a: a * scale, st.params))
+            trainer.ckpt.save(epoch, st, {"best_metric": 0.0})
+            trainer.ckpt.flush()
+            return trainer.state
+        finally:
+            trainer.close()
+
+    try:
+        workdir = os.path.join(tmpdir, "lenet5")
+        state1 = commit(1, None)
+        fleet = ModelFleet()
+        engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                           buckets=(1, 4), verbose=False)
+        sm = fleet.add(engine, workdir=workdir, max_delay_ms=5.0)
+        promoter = PromotionController(
+            sm, canary_frac=0.25, canary_window_s=0.2,
+            faults=FaultInjector(promote_regress_epoch=2,
+                                 promote_regress_kind="accuracy"))
+        reloader = WeightReloader(fleet, poll_every_s=0)
+        n_programs = len(engine.compile_log)
+        x = np.random.RandomState(0).randn(
+            1, *engine.example_shape).astype(engine.input_dtype)
+        ref_old = engine.predict(x)
+
+        # the regressing candidate: gate must refuse, incumbent keeps serving
+        commit(2, state1, scale=1.05)
+        if reloader.check_once() != 0:
+            raise RuntimeError("regressing candidate was NOT refused")
+        verdict = promoter.history[-1]
+        if verdict["decision"] != "refused_gate":
+            raise RuntimeError(f"expected refused_gate, got {verdict}")
+        if engine.provenance["checkpoint_epoch"] != 1:
+            raise RuntimeError("refused candidate reached the live engine")
+        np.testing.assert_array_equal(engine.predict(x), ref_old)
+        # the refusal is cached: the same bad epoch is never scored again
+        evals = promoter.shadow_evals
+        if reloader.check_once() != 0 or promoter.shadow_evals != evals:
+            raise RuntimeError("refused epoch was re-evaluated")
+
+        # a clean candidate promotes through shadow -> canary
+        commit(3, state1, scale=1.1)
+        if reloader.check_once() != 1:
+            raise RuntimeError("clean candidate did not promote")
+        if promoter.history[-1]["decision"] != "promoted" \
+                or engine.provenance["checkpoint_epoch"] != 3:
+            raise RuntimeError(f"promotion did not land: "
+                               f"{promoter.history[-1]}, "
+                               f"{engine.provenance}")
+        if len(engine.compile_log) != n_programs:
+            raise RuntimeError("promotion recompiled the bucket cache")
+        delta = promoter.history[-1]["metric_delta"]
+    finally:
+        if fleet is not None:
+            fleet.drain(timeout=60)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return (f"regressing epoch 2 refused at the gate (cached), clean "
+            f"epoch 3 promoted (delta {delta:+.3f}, zero recompiles)")
 
 
 @check("segment")
@@ -665,6 +763,7 @@ def main(argv=None):
     check_check(args)
     check_serve(args)
     check_fleet(args)
+    check_promote(args)
     check_segment(args)
     check_devices(args)
     check_input(args)
