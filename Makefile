@@ -10,7 +10,7 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
-        bench-serve-promote \
+        bench-serve-promote bench-serve-spike \
         bench-input dryrun smoke seg-smoke serve-smoke serve-fleet-smoke \
         preflight preflight-record lint lint-changed fsck check \
         check-update-cost reshard-parity
@@ -118,6 +118,14 @@ bench-serve-load: ## open-loop fleet load bench: sustained-QPS arrival
 	## schedule over a 2-model fleet — sustained QPS, p99-under-load,
 	## shed rate (one JSON line; docs/SERVING.md "Load bench")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py --load
+
+bench-serve-spike: ## overload transient: offered QPS steps 1x->3x->1x while
+	## the shed-driven autoscaler scales the dispatcher pools —
+	## time-to-absorb, shed during the transient, per-phase p99, and the
+	## zero-recompile worker-spawn proof (one JSON line; docs/SERVING.md
+	## "Overload control")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
+	    --load --spike
 
 bench-serve-promote: ## accuracy-gated promotion under open-loop load: a
 	## new epoch lands mid-bench and runs shadow->gate->canary->promote

@@ -12,6 +12,11 @@ checkpoint into something that takes traffic (docs/SERVING.md):
   flushed on the trainer's MetricsLogger stream
 - fleet.ModelFleet: many models behind one process — per-model batcher +
   metrics, routed by registry name (`POST /predict/<model>`)
+- autoscale.AutoscaleController / CircuitBreaker: overload control —
+  shed-driven scaling of each model's dispatcher pool over the shared AOT
+  bucket cache (zero recompiles), deadline admission control at the door,
+  and per-model fail-fast circuit breaking (docs/SERVING.md "Overload
+  control")
 - reload.WeightReloader: hot weight reload — new integrity-verified
   epochs swap into live engines atomically, zero downtime, zero recompiles
 - promote.PromotionController: accuracy-gated promotion — shadow eval of
@@ -24,7 +29,10 @@ checkpoint into something that takes traffic (docs/SERVING.md):
   `-m name1,name2 --runs-root runs/`)
 """
 
-from .batcher import Draining, DynamicBatcher, Overloaded, RequestRejected  # noqa: F401
+from .autoscale import AutoscaleController, CircuitBreaker  # noqa: F401
+from .batcher import (CircuitOpen, DeadlineExpired,  # noqa: F401
+                      DeadlineUnmeetable, Draining, DynamicBatcher,
+                      Overloaded, RequestRejected, result_within)
 from .engine import PredictEngine, load_checkpoint_weights, pick_bucket  # noqa: F401
 from .fleet import ModelFleet, ServedModel, UnknownModel  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401

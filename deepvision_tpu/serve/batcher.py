@@ -2,32 +2,54 @@
 
 The engine (serve/engine.py) kills retrace and per-shape compile; this
 module kills batch-of-1 utilization. Concurrent `submit()` calls land in a
-thread-safe queue; a single dispatcher thread coalesces them up to
-`max_batch` examples or until the OLDEST request's `max_delay_ms` deadline
-expires — whichever comes first — pads to the nearest bucket, runs one
-device dispatch, and scatters the per-request output slices back through
-`concurrent.futures.Future`s. One device program in flight at a time, by
-construction: the device is the serialization point anyway, and a single
-dispatcher keeps the queue discipline (and the latency accounting) exact.
+thread-safe queue; a POOL of dispatcher workers (1 by default) coalesces
+them up to `max_batch` examples or until the OLDEST request's
+`max_delay_ms` deadline expires — whichever comes first — pads to the
+nearest bucket, runs one device dispatch, and scatters the per-request
+output slices back through `concurrent.futures.Future`s. Every request
+lives in exactly ONE batch, so row ownership is worker-count-independent;
+workers share the engine's AOT bucket cache, so `set_workers()` is a
+thread + a reference — ZERO recompiles (the autoscaler's whole premise,
+serve/autoscale.py). With one worker the device idles while the worker
+waits out the coalescing deadline; extra workers overlap collect with
+dispatch and, on multi-core hosts, overlap the host-side batch work too.
 
-Backpressure is example-counted: once `max_queue_examples` are pending
-(queued + in the in-flight dispatch), `submit` raises `Overloaded` — load
-sheds at the door (HTTP 429) instead of building an unbounded latency queue.
-`drain()` is the graceful-shutdown half (used by serve/server.py under the
-resilience SIGTERM contract): new work is rejected with `Draining` (503),
-everything already accepted finishes, the dispatcher thread exits.
+Overload control at the door (`submit` refuses BEFORE accepting — nothing
+partial ever happens):
+
+- `Overloaded` (HTTP 429): example-counted backpressure — once
+  `max_queue_examples` are pending, shed instead of building an unbounded
+  latency queue.
+- `DeadlineUnmeetable` (HTTP 503 + Retry-After): requests carry a deadline
+  (client-supplied or the configured default); when the dispatch-time EMA
+  x queued batches says the answer cannot arrive in time, refuse NOW — a
+  fast 503 the client can retry elsewhere beats a slow 504 here.
+- `CircuitOpen` (HTTP 503 naming the model): the per-model circuit breaker
+  (serve/autoscale.CircuitBreaker) is open after K consecutive dispatch
+  errors — fail fast until the half-open probe proves the path again.
+- `Draining` (HTTP 503): shutting down; in-flight batches finish.
+
+`result_within()` is the deadline-bounded wait every caller of a submit
+future uses (the HTTP handler, `--smoke`, the benches): a wedged dispatch
+answers `DeadlineExpired` (HTTP 504) in bounded time instead of blocking a
+blind 120 s.
 """
 
 from __future__ import annotations
 
+import math
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import List, Optional
 
 import numpy as np
 
+from ..core.resilience import log_resilience_event
+from ..utils.faults import FaultInjector
 from .engine import PredictEngine, pick_bucket, tree_slice
 
 
@@ -41,6 +63,53 @@ class Overloaded(RequestRejected):
 
 class Draining(RequestRejected):
     """Shutting down: in-flight batches finish, new work is rejected (503)."""
+
+
+class DeadlineUnmeetable(RequestRejected):
+    """Admission control refused at the door: the dispatch-time EMA x
+    queued batches says the result cannot arrive inside the request's
+    deadline (HTTP 503 + Retry-After `retry_after_s`) — shed NOW so the
+    client retries another replica instead of waiting for a certain 504."""
+
+    def __init__(self, msg: str, *, eta_s: float, deadline_s: float,
+                 retry_after_s: float):
+        super().__init__(msg)
+        self.eta_s = eta_s
+        self.deadline_s = deadline_s
+        self.retry_after_s = retry_after_s
+
+
+class CircuitOpen(RequestRejected):
+    """The model's circuit breaker is open (K consecutive dispatch errors):
+    fail fast with the model's name (HTTP 503) until the half-open probe
+    closes it — see serve/autoscale.CircuitBreaker."""
+
+    def __init__(self, msg: str, *, model: str, retry_after_s: float):
+        super().__init__(msg)
+        self.model = model
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExpired(TimeoutError):
+    """An ACCEPTED request's result did not arrive by its deadline (HTTP
+    504). Distinct from RequestRejected: the work may still complete on
+    the device — only the waiter gave up."""
+
+
+def result_within(future: Future, deadline_s: Optional[float], *,
+                  what: str = "request"):
+    """Deadline-bounded `future.result()`: raises `DeadlineExpired` after
+    `deadline_s` (None = wait forever — explicit opt-in, never a default).
+    The single wait primitive for the HTTP handler, `--smoke`, and the
+    benches, so no caller can reintroduce a blind unbounded block."""
+    try:
+        return future.result(timeout=deadline_s)
+    except _FutureTimeout:
+        raise DeadlineExpired(
+            f"{what} deadline of {deadline_s:g}s expired before a result "
+            f"arrived — the model is wedged or the queue estimate was "
+            f"optimistic; retry with a longer deadline or another replica"
+        ) from None
 
 
 class _Request:
@@ -58,6 +127,14 @@ class _Request:
         self.generation = generation
 
 
+# queue control tokens: None stops ALL workers (drain, re-put by each
+# exiting worker so siblings see it too); _RETIRE stops exactly one
+# SUPERNUMERARY worker (scale-down — a worker that pops it while the pool
+# is already at target drops it, so a stale token can never shrink below
+# the current target)
+_RETIRE = object()
+
+
 def _settle(fut: Future, result=None, exc: Optional[BaseException] = None):
     """Deliver ignoring client-side cancellation races."""
     try:
@@ -70,46 +147,76 @@ def _settle(fut: Future, result=None, exc: Optional[BaseException] = None):
 
 
 class DynamicBatcher:
-    """Thread-safe request queue + single dispatcher thread over an engine.
+    """Thread-safe request queue + a pool of dispatcher workers over an
+    engine.
 
     `submit(images) -> Future` accepts `(n, *example_shape)` with
     `1 <= n <= max_batch` (or one bare example); the future resolves to the
-    output pytree sliced to exactly those n rows, in order.
+    output pytree sliced to exactly those n rows, in order. `workers` sizes
+    the initial pool; `set_workers()` grows/shrinks it live (the
+    autoscaler's lever — zero recompiles, the workers share the engine's
+    AOT bucket cache). `default_deadline_s` arms admission control for
+    submits that don't carry their own deadline (None = no default, every
+    request admitted regardless of the queue).
     """
 
     def __init__(self, engine: PredictEngine, *,
                  max_batch: Optional[int] = None,
                  max_delay_ms: float = 5.0,
                  max_queue_examples: int = 1024,
-                 metrics=None):
+                 metrics=None,
+                 workers: int = 1,
+                 default_deadline_s: Optional[float] = None,
+                 faults: Optional[FaultInjector] = None):
         if max_delay_ms < 0:
             raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
         self.engine = engine
         self.max_batch = min(int(max_batch or engine.max_batch),
                              engine.max_batch)
         self.max_delay = max_delay_ms / 1000.0
         self.max_queue_examples = int(max_queue_examples)
         self.metrics = metrics
+        self.default_deadline_s = default_deadline_s
+        # per-model circuit breaker (serve/autoscale.CircuitBreaker),
+        # attached by fleet.add: submit fail-fasts while it is open, and
+        # every dispatch outcome is recorded on it. None = no breaker
+        # (bare library use).
+        self.breaker = None
+        # resilience_ event stream for the observer-tap error log (set by
+        # the server; None = stderr only)
+        self.logger = None
+        self.faults = faults if faults is not None else FaultInjector.from_env()
         # optional per-batch tap `observer(generation, latencies_s,
         # dispatch_s, error)` — the promotion controller's
         # canary-vs-baseline comparison feed (generation is 'live' or
         # 'candidate'; dispatch_s is the device-dispatch wall time, the
         # part of latency wholly owned by ONE generation; error is the
-        # dispatch exception or None). Called from the dispatcher thread.
+        # dispatch exception or None). Called from a dispatcher worker; an
+        # observer exception is counted on the metrics and logged once per
+        # distinct error (never silently swallowed).
         self.observer = None
+        self._observer_errors_seen: set = set()
+        self._observer_error_seq = 0
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._pending = 0          # examples accepted, results not yet set
         self._draining = False
-        self._carry: Optional[_Request] = None  # overflow of the last batch
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="dynamic-batcher")
-        self._thread.start()
+        # EMA of per-batch device dispatch wall time — the admission
+        # controller's service-time estimate (0 until the first dispatch:
+        # no evidence, every deadline admitted)
+        self._dispatch_ema_s = 0.0
+        self._threads: List[threading.Thread] = []
+        self._target_workers = int(workers)
+        self._worker_seq = 0
+        for _ in range(self._target_workers):
+            self._spawn_locked()
 
     @property
     def queue_depth(self) -> int:
         """Examples accepted whose results are not yet delivered (queued +
-        in the in-flight dispatch) — the serving analog of the prefetcher's
+        in in-flight dispatches) — the serving analog of the prefetcher's
         queue_depth stall diagnostic."""
         with self._lock:
             return self._pending
@@ -119,15 +226,68 @@ class DynamicBatcher:
         with self._lock:
             return self._draining
 
+    @property
+    def dispatch_ema_s(self) -> float:
+        with self._lock:
+            return self._dispatch_ema_s
+
+    # -- worker pool -------------------------------------------------------
+
+    @property
+    def workers(self) -> int:
+        with self._lock:
+            return len(self._threads)
+
+    def _spawn_locked(self) -> None:
+        self._worker_seq += 1
+        t = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"dispatch-worker-{getattr(self.engine, 'name', 'model')}"
+                 f"-{self._worker_seq}")
+        self._threads.append(t)
+        t.start()
+
+    def set_workers(self, n: int) -> int:
+        """Resize the dispatcher pool to n workers (>= 1). Growing spawns
+        threads immediately; shrinking enqueues retire tokens that each
+        stop one worker at a batch boundary — no in-flight batch is ever
+        abandoned. Returns the new target. A draining batcher refuses to
+        resize (its workers are already exiting)."""
+        n = max(1, int(n))
+        retire = 0
+        with self._lock:
+            if self._draining:
+                return len(self._threads)
+            self._target_workers = n
+            while len(self._threads) < n:
+                self._spawn_locked()
+            retire = len(self._threads) - n
+        for _ in range(retire):
+            self._q.put(_RETIRE)
+        return n
+
     # -- client side -------------------------------------------------------
 
-    def submit(self, images, *, generation: Optional[str] = None) -> Future:
+    def submit(self, images, *, generation: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Future:
         x = self.engine._coerce(images)
         n = x.shape[0]
         if n > self.max_batch:
             raise ValueError(
                 f"request of {n} examples exceeds max_batch="
                 f"{self.max_batch}; split client batches")
+        breaker = self.breaker
+        if breaker is not None:
+            wait_s = breaker.reject_for()
+            if wait_s is not None:
+                if self.metrics is not None:
+                    self.metrics.observe_breaker_reject()
+                raise CircuitOpen(
+                    f"circuit open for model {breaker.name!r} after "
+                    f"{breaker.k} consecutive dispatch errors — failing "
+                    f"fast; half-open probe in {wait_s:.2f}s",
+                    model=breaker.name, retry_after_s=wait_s)
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
         with self._lock:
             if self._draining:
                 raise Draining(
@@ -142,22 +302,60 @@ class DynamicBatcher:
                     f"queue full ({self._pending} examples pending, cap "
                     f"{self.max_queue_examples}) — shed load or raise "
                     f"max_queue_examples")
+            if dl is not None:
+                eta = self._eta_locked(n)
+                if eta > dl:
+                    # Retry-After ~= time for the current backlog to clear
+                    retry = max(0.001, eta - self.max_delay
+                                - self._dispatch_ema_s)
+                    if self.metrics is not None:
+                        self.metrics.observe_admission_reject()
+                    raise DeadlineUnmeetable(
+                        f"deadline {dl * 1000:g}ms unmeetable: estimated "
+                        f"completion in {eta * 1000:.1f}ms "
+                        f"({self._pending} examples queued, dispatch EMA "
+                        f"{self._dispatch_ema_s * 1000:.1f}ms x "
+                        f"{len(self._threads)} worker(s)) — refused at the "
+                        f"door so you can retry elsewhere",
+                        eta_s=eta, deadline_s=dl, retry_after_s=retry)
             self._pending += n
         req = _Request(x, generation=generation)
         self._q.put(req)
         return req.future
 
-    # -- dispatcher --------------------------------------------------------
+    def _eta_locked(self, n: int) -> float:
+        """Expected submit->result time for an n-example request arriving
+        NOW: the coalescing wait plus (batches ahead of and including it)
+        x dispatch EMA, divided across the worker pool. Deliberately a
+        first-order model — admission control only needs to be right about
+        order of magnitude to turn a certain 504 into a fast 503 — and
+        deliberately optimistic when there is no dispatch evidence yet
+        (EMA 0 admits everything: never refuse on zero data)."""
+        ema = self._dispatch_ema_s
+        if ema <= 0.0:
+            return 0.0
+        batches_ahead = math.ceil((self._pending + n) / self.max_batch)
+        workers = max(1, len(self._threads))
+        return self.max_delay + ema * (batches_ahead / workers)
+
+    # -- dispatcher workers ------------------------------------------------
 
     def _loop(self) -> None:
-        while True:
-            first = self._carry
-            self._carry = None
+        carry: Optional[_Request] = None   # overflow of this worker's last
+        while True:                        # batch (per-worker, not shared)
+            first = carry
+            carry = None
             if first is None:
                 first = self._q.get()       # idle: block until work or stop
-            if first is None:               # stop sentinel (queue is FIFO:
-                break                       # everything accepted before it
-                                            # has already been dispatched)
+            if first is None:               # stop: everything accepted
+                self._q.put(None)           # before the sentinel has been
+                break                       # dispatched; re-put for siblings
+            if first is _RETIRE:            # scale-down token: stop exactly
+                with self._lock:            # one supernumerary worker
+                    if len(self._threads) > self._target_workers:
+                        self._threads.remove(threading.current_thread())
+                        return
+                continue                    # stale token (target re-raised)
             batch: List[_Request] = [first]
             total = first.n
             deadline = first.t_submit + self.max_delay
@@ -174,18 +372,22 @@ class DynamicBatcher:
                            else self._q.get_nowait())
                 except queue.Empty:
                     break                   # deadline flush
-                if nxt is None:             # stop observed mid-collect:
-                    self._q.put(None)       # finish this batch, then exit
-                    break
+                if nxt is None or nxt is _RETIRE:
+                    self._q.put(nxt)        # control token mid-collect:
+                    break                   # hand it back, flush this batch
                 if total + nxt.n > self.max_batch:
-                    self._carry = nxt       # first request of the NEXT batch
+                    carry = nxt             # first request of the NEXT batch
                     break                   # max_batch flush
                 if nxt.generation != first.generation:
-                    self._carry = nxt       # generation boundary: a batch
+                    carry = nxt             # generation boundary: a batch
                     break                   # runs ONE weight generation
                 batch.append(nxt)
                 total += nxt.n
             self._dispatch(batch, total)
+
+    def _record_dispatch_locked(self, dt: float) -> None:
+        self._dispatch_ema_s = (dt if self._dispatch_ema_s <= 0.0
+                                else 0.2 * dt + 0.8 * self._dispatch_ema_s)
 
     def _dispatch(self, batch: List[_Request], total: int) -> None:
         images = (batch[0].images if len(batch) == 1
@@ -193,11 +395,17 @@ class DynamicBatcher:
         generation = batch[0].generation   # whole batch shares it (collect
         t0 = time.monotonic()              # loop breaks on a boundary)
         try:
+            self.faults.before_serve_dispatch()
             out = self.engine.predict(images, generation=generation)
         except BaseException as e:  # noqa: BLE001 — must reach the futures,
-            with self._lock:        # not kill the dispatcher thread
+            now = time.monotonic()  # not kill the dispatcher worker
+            with self._lock:
                 self._pending -= total
-            now = time.monotonic()
+                self._record_dispatch_locked(now - t0)
+            if self.metrics is not None:
+                self.metrics.observe_dispatch_error()
+            if self.breaker is not None:
+                self.breaker.record(ok=False)
             for r in batch:
                 _settle(r.future, exc=e)
             self._observe(generation, [now - r.t_submit for r in batch],
@@ -206,6 +414,9 @@ class DynamicBatcher:
         now = time.monotonic()
         with self._lock:
             self._pending -= total
+            self._record_dispatch_locked(now - t0)
+        if self.breaker is not None:
+            self.breaker.record(ok=True)
         lo = 0
         for r in batch:
             _settle(r.future, tree_slice(out, lo, lo + r.n))
@@ -225,18 +436,44 @@ class DynamicBatcher:
             return
         try:
             observer(generation or "live", latencies, dispatch_s, error)
-        except Exception:  # noqa: BLE001 — a broken tap must not take the
-            pass           # dispatcher thread (and every future) with it
+        except Exception as e:  # noqa: BLE001 — a broken tap must not take
+            # the dispatcher worker (and every future) with it, but it must
+            # also never be SILENT: count it, and log one resilience event
+            # per distinct error so a broken canary feed is an incident
+            # line, not a mystery
+            if self.metrics is not None:
+                self.metrics.observe_observer_error()
+            key = (type(e).__name__, str(e))
+            with self._lock:
+                fresh = key not in self._observer_errors_seen
+                if fresh:
+                    self._observer_errors_seen.add(key)
+                    self._observer_error_seq += 1
+                    seq = self._observer_error_seq
+            if fresh:
+                log_resilience_event(self.logger, seq,
+                                     {"serve_observer_error": 1.0})
+                print(f"[serve:{getattr(self.engine, 'name', 'model')}] "
+                      f"batch observer raised {type(e).__name__}: {e} "
+                      f"(suppressed; counted on metrics, further repeats "
+                      f"silent)",
+                      file=sys.stderr, flush=True)
 
     # -- lifecycle ---------------------------------------------------------
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Reject new work, finish everything already accepted, stop the
-        dispatcher thread. Idempotent. True once the thread has exited."""
+        """Reject new work, finish everything already accepted, stop every
+        dispatcher worker. Idempotent. True once all workers have exited."""
         with self._lock:
             self._draining = True
+            threads = list(self._threads)
         self._q.put(None)
-        self._thread.join(timeout)
-        return not self._thread.is_alive()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ok = True
+        for t in threads:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+            ok = ok and not t.is_alive()
+        return ok
 
     close = drain

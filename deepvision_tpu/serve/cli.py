@@ -111,6 +111,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-queue", type=int, default=1024,
                    help="backpressure: per-model pending-example cap before "
                         "submits are rejected with 429 (default 1024)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="dispatcher workers per model feeding the shared "
+                        "AOT bucket cache (default 1; the autoscale floor)")
+    p.add_argument("--max-workers", type=int, default=4,
+                   help="autoscale ceiling per model (default 4); spawning "
+                        "a worker is a thread + a reference — zero "
+                        "recompiles (docs/SERVING.md 'Overload control')")
+    p.add_argument("--autoscale-every", type=float, default=0.0,
+                   metavar="SECS",
+                   help="shed-driven autoscaling: sample per-model "
+                        "shed/p99/queue signals every SECS seconds and "
+                        "scale the dispatcher pool between --workers and "
+                        "--max-workers, with hysteresis; every decision on "
+                        "/healthz + the resilience_ stream. 0 disables "
+                        "(default)")
+    p.add_argument("--deadline-ms", type=float, default=10000.0,
+                   help="default request deadline (client 'deadline_ms' "
+                        "overrides per request): admission control refuses "
+                        "at the door (503 + Retry-After) when the queue "
+                        "says it is unmeetable, and the result wait "
+                        "answers 504 on expiry instead of blocking "
+                        "(default 10000 = 10s)")
+    p.add_argument("--breaker-k", type=int, default=5,
+                   help="circuit breaker: consecutive dispatch errors that "
+                        "open a model's circuit (fail-fast 503 naming the "
+                        "model; default 5)")
+    p.add_argument("--breaker-cooldown", type=float, default=5.0,
+                   metavar="SECS",
+                   help="circuit breaker: seconds an open circuit waits "
+                        "before admitting one half-open probe (default 5)")
     p.add_argument("--port", type=int, default=8700)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--flush-every", type=float, default=10.0,
@@ -170,7 +200,7 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
     answered requests."""
     import numpy as np
 
-    from .batcher import RequestRejected
+    from .batcher import RequestRejected, result_within
 
     models = list(server.fleet)
     stop = threading.Event()
@@ -182,19 +212,25 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
         n = 1 + i % min(4, sm.engine.max_batch)  # mixed sizes: buckets
         x = rs.randn(n, *sm.engine.example_shape).astype(
             sm.engine.input_dtype)
+        # deadline-bounded wait, same as the HTTP front door: a wedged
+        # model fails the smoke with DeadlineExpired in seconds, not a
+        # blind 120 s block per client
+        deadline_s = sm.batcher.default_deadline_s or 30.0
         while not stop.is_set():
             try:
-                sm.submit(x).result(timeout=120)  # promoter-routed, like HTTP
+                result_within(sm.submit(x), deadline_s,
+                              what=f"smoke[{sm.name}]")  # promoter-routed
             except RequestRejected:
                 return  # drain/overload reached this client — done
             except Exception as e:  # noqa: BLE001 — smoke must report
-                errors.append(e)
-                return
+                errors.append(e)   # (incl. DeadlineExpired: a wedged model
+                return             # is a FAILED smoke, loudly and fast)
 
     with GracefulShutdown(on_signal=stop.set,
                           what="finishing in-flight batches, rejecting new "
                                "work, then exiting 0") as gs:
         server.reloader.start()
+        server.autoscaler.start()
         threads = [threading.Thread(target=client, args=(i,), daemon=True)
                    for i in range(max(n_threads, len(models)))]
         print(f"[serve:{server.engine.name}] ready: synthetic load "
@@ -259,6 +295,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.promote_gate is not None and not args.reload_every:
         parser.error("--promote-gate needs --reload-every: promotion "
                      "evaluates the candidates the hot-reload poller finds")
+    if args.workers < 1:
+        parser.error(f"--workers must be >= 1, got {args.workers}")
+    if args.max_workers < args.workers:
+        parser.error(f"--max-workers ({args.max_workers}) must be >= "
+                     f"--workers ({args.workers})")
+    if args.deadline_ms <= 0:
+        parser.error(f"--deadline-ms must be > 0, got {args.deadline_ms}")
+    if args.breaker_k < 1:
+        parser.error(f"--breaker-k must be >= 1, got {args.breaker_k}")
+    if args.breaker_cooldown <= 0:
+        parser.error(f"--breaker-cooldown must be > 0, got "
+                     f"{args.breaker_cooldown}")
 
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
@@ -294,14 +342,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         engine.warmup()
         fleet.add(engine, workdir=workdir, max_batch=args.max_batch,
                   max_delay_ms=args.max_delay_ms,
-                  max_queue_examples=args.max_queue)
+                  max_queue_examples=args.max_queue,
+                  workers=args.workers,
+                  default_deadline_s=args.deadline_ms / 1000.0,
+                  breaker_k=args.breaker_k,
+                  breaker_cooldown_s=args.breaker_cooldown)
     server = InferenceServer(
         fleet=fleet, flush_every_s=args.flush_every,
         reload_every_s=args.reload_every,
         log_dir=args.workdir or args.runs_root,
         promote_gate=args.promote_gate,
         canary_frac=args.canary_frac,
-        canary_window_s=args.canary_window)
+        canary_window_s=args.canary_window,
+        max_workers=args.max_workers,
+        autoscale_every_s=args.autoscale_every,
+        default_deadline_s=args.deadline_ms / 1000.0)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

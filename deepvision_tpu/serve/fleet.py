@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterator, List, Optional
 
+from .autoscale import CircuitBreaker
 from .batcher import DynamicBatcher
 from .engine import PredictEngine
 from .metrics import ServingMetrics
@@ -71,33 +72,56 @@ class ServedModel:
         self.reload_stats: Dict[str, float] = {
             "reloads": 0, "refused_corrupt": 0, "refused_incompatible": 0,
             "refused_gate": 0, "rolled_back": 0}
+        # autoscale decision record, mutated by the AutoscaleController
+        # under reload_lock (the control-plane lock) and read by /healthz
+        self.autoscale_stats: Dict[str, float] = {
+            "scale_ups": 0, "scale_downs": 0,
+            "workers": self.batcher.workers}
+        # the model's documented p99 contract (max_delay + one max-bucket
+        # compute time, ms) — measured lazily by the autoscaler's first
+        # sample; None until then
+        self.p99_bound_ms: Optional[float] = None
 
     @property
     def name(self) -> str:
         return self.engine.name
 
-    def submit(self, images):
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        return self.batcher.breaker
+
+    def submit(self, images, *, deadline_s: Optional[float] = None):
         """Route one request into this model's batcher, tagged with the
         generation the promotion controller picks (the canary fraction
         runs on the staged candidate while one is in flight; everything
         else — and everything when no promotion is active — runs live).
         The HTTP front door and the load bench both submit through here so
-        canary routing cannot be bypassed by one of them."""
+        canary routing cannot be bypassed by one of them. `deadline_s`
+        feeds admission control (None = the batcher's configured default);
+        the breaker's fail-fast and the deadline refusal both raise from
+        here, BEFORE anything is queued."""
         generation = self.promoter.route() if self.promoter else None
-        return self.batcher.submit(images, generation=generation)
+        return self.batcher.submit(images, generation=generation,
+                                   deadline_s=deadline_s)
 
     def describe(self) -> dict:
         """The /healthz per-model record: serving shape + weight
-        provenance + reload outcomes + promotion state."""
+        provenance + reload outcomes + promotion/overload-control state."""
         with self.reload_lock:
             reload_stats = dict(self.reload_stats)
+            autoscale_stats = dict(self.autoscale_stats)
+        autoscale_stats["workers"] = self.batcher.workers
         return {
             "buckets": list(self.engine.buckets),
             "max_batch": self.batcher.max_batch,
             "queue_depth": self.batcher.queue_depth,
+            "workers": self.batcher.workers,
+            "default_deadline_s": self.batcher.default_deadline_s,
             "weights": self.engine.provenance,
             "hot_reload": bool(self.workdir),
             "reload": reload_stats,
+            "autoscale": autoscale_stats,
+            "breaker": (self.breaker.describe() if self.breaker else None),
             "promotion": (self.promoter.describe()
                           if self.promoter else None),
         }
@@ -106,8 +130,11 @@ class ServedModel:
         """The /stats per-model record."""
         snap = {
             **self.metrics.snapshot(queue_depth=self.batcher.queue_depth),
+            "workers": float(self.batcher.workers),
             "weights": self.engine.provenance,
         }
+        if self.breaker is not None:
+            snap["breaker_state"] = self.breaker.describe()["state"]
         if self.promoter is not None:
             snap["promotion"] = self.promoter.describe()
         return snap
@@ -126,18 +153,30 @@ class ModelFleet:
             workdir: Optional[str] = None,
             max_batch: Optional[int] = None,
             max_delay_ms: float = 5.0,
-            max_queue_examples: int = 1024) -> ServedModel:
+            max_queue_examples: int = 1024,
+            workers: int = 1,
+            default_deadline_s: Optional[float] = None,
+            breaker_k: int = 5,
+            breaker_cooldown_s: float = 5.0) -> ServedModel:
         """Register an engine under its own name with a fresh batcher and
         metrics accumulator. Per-model backpressure: one model being
         hammered sheds ITS requests (429) without starving the others'
-        queues."""
+        queues. Per-model circuit breaker likewise: one model's broken
+        dispatch path fail-fasts ITS requests (503 naming the model)
+        without poisoning the rest of the fleet. `workers` sizes the
+        initial dispatcher pool (the autoscaler resizes it live);
+        `default_deadline_s` arms admission control for requests that
+        carry no deadline of their own."""
         if engine.name in self._models:
             raise ValueError(f"model {engine.name!r} already served — one "
                              f"entry per registry name")
         metrics = ServingMetrics()
         batcher = DynamicBatcher(
             engine, max_batch=max_batch, max_delay_ms=max_delay_ms,
-            max_queue_examples=max_queue_examples, metrics=metrics)
+            max_queue_examples=max_queue_examples, metrics=metrics,
+            workers=workers, default_deadline_s=default_deadline_s)
+        batcher.breaker = CircuitBreaker(engine.name, k=breaker_k,
+                                         cooldown_s=breaker_cooldown_s)
         sm = ServedModel(engine, batcher, metrics, workdir=workdir)
         self._models[engine.name] = sm
         return sm

@@ -31,11 +31,22 @@ import numpy as np
 
 class ServingMetrics:
     """Cumulative counters since construction (or the last reset) plus a
-    bounded latency window. All methods are thread-safe."""
+    bounded latency window. All methods are thread-safe.
+
+    Two horizons: the interval counters (zeroed by `snapshot(reset=True)`,
+    the server's periodic flush) and the LIFETIME totals (`totals()`, never
+    reset) — the autoscale control loop samples deltas of the totals, so
+    its shed/overload evidence cannot be erased out from under it by a
+    concurrent metrics flush."""
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
         self._window = window
+        # lifetime totals — survive every reset (autoscaler's sample source)
+        self._totals = {"requests": 0, "examples": 0, "shed": 0,
+                        "admission_rejected": 0, "deadline_expired": 0,
+                        "breaker_rejected": 0, "dispatch_errors": 0,
+                        "observer_errors": 0}
         self._reset_locked(time.monotonic())
 
     def _reset_locked(self, now: float) -> None:
@@ -47,6 +58,13 @@ class ServingMetrics:
         self._rows = 0          # device rows dispatched, padding included
         self._dispatch_s = 0.0
         self._shed = 0          # requests rejected at the door (Overloaded)
+        # overload-control interval counters (docs/SERVING.md "Overload
+        # control"): refusals at the door by kind, plus failure evidence
+        self._admission_rejected = 0   # DeadlineUnmeetable (fast 503)
+        self._deadline_expired = 0     # accepted, answered 504 past deadline
+        self._breaker_rejected = 0     # CircuitOpen fail-fast 503
+        self._dispatch_errors = 0      # engine dispatches that raised
+        self._observer_errors = 0      # per-batch observer tap exceptions
 
     def observe_batch(self, *, n_real: int, bucket: int, dispatch_s: float,
                       request_latencies_s: Sequence[float]) -> None:
@@ -57,6 +75,8 @@ class ServingMetrics:
             self._rows += bucket
             self._dispatch_s += dispatch_s
             self._lat.extend(request_latencies_s)
+            self._totals["requests"] += len(request_latencies_s)
+            self._totals["examples"] += n_real
 
     def observe_shed(self, n_requests: int = 1) -> None:
         """Count a request rejected by backpressure (`Overloaded`, HTTP
@@ -66,6 +86,45 @@ class ServingMetrics:
         its offered traffic is not meeting anything."""
         with self._lock:
             self._shed += n_requests
+            self._totals["shed"] += n_requests
+
+    def _bump(self, interval_attr: str, total_key: str) -> None:
+        with self._lock:
+            setattr(self, interval_attr, getattr(self, interval_attr) + 1)
+            self._totals[total_key] += 1
+
+    def observe_admission_reject(self) -> None:
+        """A request refused at the door because the dispatch-time EMA x
+        queue depth said its deadline was unmeetable (fast 503 +
+        Retry-After) — overload evidence for the autoscaler, same as shed."""
+        self._bump("_admission_rejected", "admission_rejected")
+
+    def observe_deadline_expired(self) -> None:
+        """An ACCEPTED request whose result did not arrive by its deadline
+        (HTTP 504): the admission estimate was too optimistic, or a
+        dispatch stalled."""
+        self._bump("_deadline_expired", "deadline_expired")
+
+    def observe_breaker_reject(self) -> None:
+        """A request failed fast because the model's circuit is open."""
+        self._bump("_breaker_rejected", "breaker_rejected")
+
+    def observe_dispatch_error(self) -> None:
+        """A device dispatch raised (the whole batch's futures got the
+        exception) — the circuit breaker's failure evidence."""
+        self._bump("_dispatch_errors", "dispatch_errors")
+
+    def observe_observer_error(self) -> None:
+        """The per-batch observer tap raised — counted, never silent
+        (each distinct error also gets one resilience event)."""
+        self._bump("_observer_errors", "observer_errors")
+
+    def totals(self) -> dict:
+        """Lifetime counters, NEVER reset — the autoscale control loop
+        samples deltas of these so a concurrent `snapshot(reset=True)`
+        (the server's periodic flush) cannot zero its evidence window."""
+        with self._lock:
+            return dict(self._totals)
 
     def snapshot(self, queue_depth: Optional[int] = None,
                  reset: bool = False) -> dict:
@@ -87,6 +146,11 @@ class ServingMetrics:
                 "mean_dispatch_ms": (1000.0 * self._dispatch_s / self._batches
                                      if self._batches else 0.0),
                 "shed_requests": float(self._shed),
+                "admission_rejected": float(self._admission_rejected),
+                "deadline_expired": float(self._deadline_expired),
+                "breaker_rejected": float(self._breaker_rejected),
+                "dispatch_errors": float(self._dispatch_errors),
+                "observer_errors": float(self._observer_errors),
             }
             if self._lat:
                 lat_ms = np.asarray(self._lat, np.float64) * 1000.0

@@ -5,19 +5,36 @@ dependencies — each connection gets a thread, and concurrent handler
 threads are exactly the concurrency the micro-batchers coalesce) over a
 `ModelFleet` (serve/fleet.py) — one model or many behind one process:
 
-    POST /predict           {"instances": [[...HWC floats...], ...]}
+    POST /predict           {"instances": [[...HWC floats...], ...],
+                             "deadline_ms": 250}   (deadline optional)
                             -> 200 {"predictions": [...]} from the DEFAULT
                                model (f32 outputs; the PR 3 surface)
     POST /predict/<model>   -> same, routed by registry name; an unknown
                                name gets 404 with "served_models" in the
                                body (never an opaque error)
                             -> 400 bad shape/body, 429 overloaded
-                               (per-model backpressure), 503 draining
+                               (per-model backpressure)
+                            -> 503 + Retry-After: admission control
+                               (deadline unmeetable given the dispatch EMA
+                               and queue), circuit open (K consecutive
+                               dispatch errors — body names the model), or
+                               draining
+                            -> 504 deadline expired AFTER acceptance — the
+                               wait is deadline-bounded (client
+                               "deadline_ms" or the --deadline-ms default),
+                               never the old blind 120 s
     GET  /healthz           -> 200 aggregate status + per-model weight
-                               provenance (epoch, manifest hash, verified)
-                               and reload outcomes — diff across replicas
-                               to audit a fleet for weight skew
+                               provenance (epoch, manifest hash, verified),
+                               reload outcomes, worker count, autoscale
+                               decisions, and breaker state — diff across
+                               replicas to audit a fleet for weight skew
     GET  /stats[/<model>]   -> 200 per-model ServingMetrics snapshot(s)
+
+Overload control (docs/SERVING.md "Overload control"): when
+`autoscale_every_s > 0` a control loop samples per-model shed/p99/queue
+signals and resizes each model's dispatcher pool between `workers` and
+`max_workers` — scaling up is a thread + a reference to the shared AOT
+bucket cache, zero recompiles.
 
 Hot weight reload (serve/reload.py): models constructed with a workdir are
 watched for new integrity-verified epochs, which swap in atomically with
@@ -36,6 +53,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -44,13 +62,20 @@ import numpy as np
 
 from ..core.metrics import MetricsLogger
 from ..core.resilience import GracefulShutdown
-from .batcher import Draining, Overloaded
+from .autoscale import AutoscaleController
+from .batcher import (CircuitOpen, DeadlineExpired, DeadlineUnmeetable,
+                      Draining, Overloaded, result_within)
 from .engine import PredictEngine
 from .fleet import ModelFleet, UnknownModel
 from .reload import WeightReloader
 
 DRAIN_WHAT = ("finishing in-flight batches, rejecting new work, "
               "then exiting 0")
+
+# HTTP-wait bound for requests that carry no deadline and hit a model with
+# no configured default: generous enough for a cold first dispatch on a
+# slow host, but BOUNDED — the old blind 120 s wait is gone everywhere
+FALLBACK_DEADLINE_S = 30.0
 
 
 class InferenceServer:
@@ -73,15 +98,26 @@ class InferenceServer:
                  log_dir: Optional[str] = None,
                  promote_gate: Optional[float] = None,
                  canary_frac: float = 0.05,
-                 canary_window_s: float = 5.0):
+                 canary_window_s: float = 5.0,
+                 workers: int = 1,
+                 max_workers: int = 4,
+                 autoscale_every_s: float = 0.0,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_k: int = 5,
+                 breaker_cooldown_s: float = 5.0):
         if (engine is None) == (fleet is None):
             raise ValueError("pass exactly one of engine= or fleet=")
         if fleet is None:
             fleet = ModelFleet()
             fleet.add(engine, workdir=workdir, max_batch=max_batch,
                       max_delay_ms=max_delay_ms,
-                      max_queue_examples=max_queue_examples)
+                      max_queue_examples=max_queue_examples,
+                      workers=workers,
+                      default_deadline_s=default_deadline_s,
+                      breaker_k=breaker_k,
+                      breaker_cooldown_s=breaker_cooldown_s)
         self.fleet = fleet
+        self.default_deadline_s = default_deadline_s
         default = fleet.default
         self.engine = default.engine
         self.batcher = default.batcher
@@ -101,6 +137,22 @@ class InferenceServer:
                              warn=lambda msg: print(msg, flush=True))
         self.reloader = WeightReloader(
             fleet, poll_every_s=reload_every_s, logger=self.logger)
+        # overload-control wiring: every batcher/breaker logs onto the
+        # server's resilience_ stream (observer-tap errors, breaker
+        # transitions are incident lines, not stderr-only)
+        for sm in fleet:
+            sm.batcher.logger = self.logger
+            if sm.breaker is not None:
+                sm.breaker.logger = self.logger
+        # shed-driven autoscaling (serve/autoscale.py): armed by
+        # autoscale_every_s > 0, scales each model's dispatcher pool
+        # between its startup worker count and max_workers
+        self.autoscaler = AutoscaleController(
+            list(fleet), interval_s=autoscale_every_s,
+            min_workers=min(sm.batcher.workers for sm in fleet),
+            max_workers=max(max_workers,
+                            max(sm.batcher.workers for sm in fleet)),
+            logger=self.logger)
         self.flush_every_s = flush_every_s
         self._flush_step = 0
         self._wake = threading.Event()
@@ -142,6 +194,7 @@ class InferenceServer:
         for sm in self.fleet:
             if sm.promoter is not None:
                 sm.promoter.abort()
+        self.autoscaler.stop()
         self.reloader.stop()
         print(f"[serve:{self.engine.name}] graceful drain: rejecting new "
               f"work, finishing {self.fleet.queue_depth} queued examples "
@@ -150,6 +203,7 @@ class InferenceServer:
         return self.flush_metrics(reset=False)
 
     def close(self) -> None:
+        self.autoscaler.stop()
         self.reloader.stop()
         self.fleet.drain()
         self.logger.close()
@@ -162,6 +216,7 @@ class InferenceServer:
         with GracefulShutdown(on_signal=self._wake.set,
                               what=DRAIN_WHAT) as gs:
             self.reloader.start()
+            self.autoscaler.start()
             http_thread.start()
             self.ready.set()
             print(f"[serve:{self.engine.name}] listening on "
@@ -193,11 +248,13 @@ def _make_handler(server: InferenceServer):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _json(self, code: int, obj) -> None:
+        def _json(self, code: int, obj, headers=None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -257,27 +314,73 @@ def _make_handler(server: InferenceServer):
                   self._unknown_path())
             if sm is None:
                 return
+            t_in = time.monotonic()
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(length) or b"{}")
                 x = np.asarray(payload["instances"], np.float32)
+                # request deadline: body "deadline_ms", else the
+                # X-Deadline-Ms header, else the model's configured
+                # default, else the server fallback — ALWAYS bounded
+                deadline_ms = payload.get(
+                    "deadline_ms", self.headers.get("X-Deadline-Ms"))
+                if deadline_ms is not None:
+                    deadline_s = float(deadline_ms) / 1000.0
+                    if deadline_s <= 0:
+                        raise ValueError(
+                            f"deadline_ms must be > 0, got {deadline_ms}")
+                else:
+                    deadline_s = (sm.batcher.default_deadline_s
+                                  or server.default_deadline_s
+                                  or FALLBACK_DEADLINE_S)
             except (KeyError, TypeError, ValueError) as e:
                 return self._json(400, {
-                    "error": f"body must be JSON {{'instances': "
-                             f"[...]}}: {e}"})
+                    "error": f"body must be JSON {{'instances': [...]"
+                             f"[, 'deadline_ms': N]}}: {e}"})
             try:
                 # routes through the promotion controller when one is
                 # attached: the canary fraction runs on the candidate
-                # generation, everything else on the live weights
-                fut = sm.submit(x)
+                # generation, everything else on the live weights.
+                # Admission control, backpressure, and the circuit
+                # breaker all refuse HERE, before anything is queued.
+                fut = sm.submit(x, deadline_s=deadline_s)
             except Overloaded as e:
                 return self._json(429, {"error": str(e)})
+            except DeadlineUnmeetable as e:
+                # fast 503: the queue says this deadline cannot be met —
+                # Retry-After tells the client when the backlog should
+                # have cleared
+                return self._json(
+                    503, {"error": str(e), "model": sm.name,
+                          "reason": "deadline_unmeetable",
+                          "eta_ms": round(e.eta_s * 1000.0, 1)},
+                    headers={"Retry-After":
+                             f"{max(e.retry_after_s, 0.001):.3f}"})
+            except CircuitOpen as e:
+                # fail-fast 503 NAMING the model whose dispatch path is
+                # broken — the fleet's other models keep serving
+                return self._json(
+                    503, {"error": str(e), "model": e.model,
+                          "reason": "circuit_open"},
+                    headers={"Retry-After":
+                             f"{max(e.retry_after_s, 0.001):.3f}"})
             except Draining as e:
-                return self._json(503, {"error": str(e)})
+                return self._json(503, {"error": str(e),
+                                        "reason": "draining"})
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
             try:
-                out = fut.result(timeout=120)
+                # deadline-bounded wait: 504 on expiry, never a blind
+                # multi-minute block — a wedged model answers in seconds
+                out = result_within(
+                    fut, max(0.001, t_in + deadline_s - time.monotonic()),
+                    what=f"predict[{sm.name}]")
+            except DeadlineExpired as e:
+                sm.metrics.observe_deadline_expired()
+                return self._json(504, {"error": str(e), "model": sm.name,
+                                        "reason": "deadline_expired",
+                                        "deadline_ms":
+                                            round(deadline_s * 1000.0, 1)})
             except Exception as e:  # noqa: BLE001 — a failed dispatch must
                 return self._json(500, {"error": repr(e)})  # not hang the client
             self._json(200, {"predictions": jax.tree_util.tree_map(

@@ -47,6 +47,22 @@ and in-process tests configure it the same way:
                                              self-digest — the metadata an
                                              ELASTIC restore reshards against;
                                              verification must refuse it)
+    DEEPVISION_FAULT_SERVE_DISPATCH_FAIL=k[:n]
+                                             fail n consecutive serving
+                                             dispatches starting at dispatch
+                                             k (0-based, counted per
+                                             DynamicBatcher across all its
+                                             workers; n defaults to 1): the
+                                             engine call raises before it
+                                             runs, the whole batch's futures
+                                             get the error, and the per-model
+                                             circuit breaker sees exactly n
+                                             consecutive failures — the
+                                             deterministic drive for the
+                                             breaker's open -> half-open ->
+                                             close cycle (tests and the
+                                             preflight `autoscale` check),
+                                             no flaky dispatch path needed
     DEEPVISION_FAULT_PROMOTE_REGRESS=k:kind  make candidate epoch k a
                                              REGRESSION when the promotion
                                              controller (serve/promote.py)
@@ -71,6 +87,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -122,7 +139,9 @@ class FaultInjector:
                  ckpt_corrupt_epoch: Optional[int] = None,
                  ckpt_corrupt_mode: Optional[str] = None,
                  promote_regress_epoch: Optional[int] = None,
-                 promote_regress_kind: Optional[str] = None):
+                 promote_regress_kind: Optional[str] = None,
+                 serve_dispatch_fail_at: Optional[int] = None,
+                 serve_dispatch_fail_count: int = 1):
         self.data_io_step = data_io_step
         self.data_io_remaining = data_io_count if data_io_step is not None else 0
         self.nan_step = nan_step
@@ -132,9 +151,18 @@ class FaultInjector:
         self.ckpt_corrupt_mode = ckpt_corrupt_mode
         self.promote_regress_epoch = promote_regress_epoch
         self.promote_regress_kind = promote_regress_kind
+        self.serve_dispatch_fail_at = serve_dispatch_fail_at
+        self.serve_dispatch_fail_count = (serve_dispatch_fail_count
+                                          if serve_dispatch_fail_at is not None
+                                          else 0)
         self._batch_index = 0   # advances once per batch PULLED (post-fault)
         self._save_index = 0
         self._async_index = 0
+        self._serve_dispatch_index = 0
+        # serving dispatches run on N concurrent pool workers; the counter
+        # must still be exact or the "n CONSECUTIVE failures" contract
+        # flakes — the only multi-threaded hook, so the only locked one
+        self._serve_lock = threading.Lock()
 
     @classmethod
     def from_env(cls, env=None) -> "FaultInjector":
@@ -146,6 +174,8 @@ class FaultInjector:
             env.get("DEEPVISION_FAULT_CKPT_CORRUPT"))
         regress_epoch, regress_kind = _parse_promote_regress(
             env.get("DEEPVISION_FAULT_PROMOTE_REGRESS"))
+        dispatch_at, dispatch_count = _parse_step_count(
+            env.get("DEEPVISION_FAULT_SERVE_DISPATCH_FAIL"))
         return cls(data_io_step=io_step, data_io_count=io_count,
                    nan_step=nan_step,
                    ckpt_save_fails=int(
@@ -155,14 +185,17 @@ class FaultInjector:
                    ckpt_corrupt_epoch=corrupt_epoch,
                    ckpt_corrupt_mode=corrupt_mode,
                    promote_regress_epoch=regress_epoch,
-                   promote_regress_kind=regress_kind)
+                   promote_regress_kind=regress_kind,
+                   serve_dispatch_fail_at=dispatch_at,
+                   serve_dispatch_fail_count=dispatch_count)
 
     @property
     def active(self) -> bool:
         return (self.data_io_step is not None or self.nan_step is not None
                 or self.ckpt_save_fails > 0 or self.ckpt_async_fails > 0
                 or self.ckpt_corrupt_epoch is not None
-                or self.promote_regress_epoch is not None)
+                or self.promote_regress_epoch is not None
+                or self.serve_dispatch_fail_at is not None)
 
     # -- hooks -------------------------------------------------------------
     def before_batch(self) -> None:
@@ -214,6 +247,24 @@ class FaultInjector:
             raise OSError(
                 f"injected async checkpoint-write failure "
                 f"({i + 1}/{self.ckpt_async_fails})")
+
+    def before_serve_dispatch(self) -> None:
+        """Called by DynamicBatcher._dispatch right before the engine call;
+        dispatches [k, k+n) raise, so the batch's futures carry the error
+        and the circuit breaker sees exactly n consecutive failures. The
+        index counts every dispatch of the owning batcher (all pool
+        workers), under a lock — concurrency must not smear the window."""
+        if self.serve_dispatch_fail_at is None:
+            return
+        with self._serve_lock:
+            i = self._serve_dispatch_index
+            self._serve_dispatch_index += 1
+        lo = self.serve_dispatch_fail_at
+        if lo <= i < lo + self.serve_dispatch_fail_count:
+            raise RuntimeError(
+                f"injected serving dispatch failure "
+                f"{i - lo + 1}/{self.serve_dispatch_fail_count} "
+                f"(dispatch {i})")
 
     def promote_regression(self, epoch: Optional[int]) -> Optional[str]:
         """Called by the promotion controller (serve/promote.py) when a

@@ -1,0 +1,505 @@
+"""Overload-resilient serving (serve/autoscale.py + the batcher pool).
+
+The contracts pinned here are the ones a traffic spike depends on
+(docs/SERVING.md "Overload control", docs/FAILURES.md "Overload
+decisions"):
+
+- N dispatcher workers share ONE engine's AOT bucket cache: every response
+  matches its own request under concurrent HTTP traffic (row ownership is
+  worker-count-independent), and `set_workers` adds zero compile-log
+  entries and leaves the jit cache empty (a worker is a thread + a
+  reference);
+- promotion stays correct across the pool: with workers > 1, three weight
+  generations of truth (incumbent, first promote, second promote) and zero
+  mixed-generation responses;
+- the circuit breaker opens after K consecutive injected dispatch errors
+  (DEEPVISION_FAULT_SERVE_DISPATCH_FAIL), fail-fasts in bounded time,
+  half-opens after the cooldown, and closes on a successful probe;
+- the autoscale control loop scales up under sustained shed and down when
+  idle, with hysteresis, recording every decision;
+- overload answers are DISTINCT and bounded: 503 + Retry-After for an
+  unmeetable deadline at the door, 504 for a deadline that expired after
+  acceptance — never the old blind 120 s wait;
+- the per-batch observer tap never swallows exceptions silently (counted
+  on ServingMetrics, one resilience event per distinct error).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.serve.autoscale import (AutoscaleController,
+                                            CircuitBreaker)
+from deepvision_tpu.serve.batcher import (CircuitOpen, DeadlineExpired,
+                                          DynamicBatcher, RequestRejected,
+                                          result_within)
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet
+from deepvision_tpu.serve.server import InferenceServer
+from deepvision_tpu.utils.faults import FaultInjector
+
+SAMPLE = (32, 32, 1)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # one engine for the whole module: 2 bucket compiles happen once
+    return PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                     verbose=False)
+
+
+def _imgs(n, seed=0):
+    return np.random.RandomState(seed).randn(n, *SAMPLE).astype(np.float32)
+
+
+class _Paced:
+    """Engine proxy with a fixed per-dispatch pause. Two uses: the sleep
+    releases the GIL, so extra pool workers add REAL capacity even on one
+    core (the autoscale tests' lever), and it makes dispatch time a known
+    constant (the admission-control tests' lever)."""
+
+    def __init__(self, inner, delay_s):
+        self._inner, self._delay = inner, delay_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def predict(self, images, generation=None):
+        time.sleep(self._delay)
+        return self._inner.predict(images, generation=generation)
+
+
+# -- worker pool: row ownership + zero recompiles -----------------------------
+
+def test_pool_row_ownership_under_http_traffic(engine):
+    """8 HTTP clients x 4 rounds of DISTINCT inputs against a 3-worker
+    pool: every response equals exactly its own request's reference — row
+    ownership survives concurrent collection and dispatch across
+    workers."""
+    fleet = ModelFleet()
+    fleet.add(engine, max_delay_ms=3.0, workers=3)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    refs = {i: engine.reference(_imgs(1 + i % 3, seed=200 + i))
+            for i in range(8)}
+    errors = []
+
+    def client(i):
+        x = _imgs(1 + i % 3, seed=200 + i)
+        body = json.dumps({"instances": x.tolist()}).encode()
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        try:
+            for _ in range(4):
+                req = urllib.request.Request(base + "/predict", data=body)
+                out = json.load(urllib.request.urlopen(req, timeout=60))
+                np.testing.assert_allclose(
+                    np.asarray(out["predictions"], np.float32), refs[i],
+                    rtol=1e-4, atol=1e-5)
+        except Exception as e:  # noqa: BLE001 — surface in the main thread
+            errors.append((i, e))
+
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                  timeout=30))
+        assert health["models"]["lenet5"]["workers"] == 3
+        assert health["models"]["lenet5"]["breaker"]["state"] == "closed"
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for c in threads:
+            c.start()
+        for c in threads:
+            c.join(timeout=120)
+    finally:
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+    assert not errors, errors[:2]
+
+
+def test_scale_up_zero_recompiles(engine):
+    """set_workers(1 -> 4 -> 1) under traffic: outputs stay correct, the
+    compile log gains ZERO entries, and the jit cache stays empty (no
+    silent fallback) — spawning a worker is a thread + a reference to the
+    shared AOT bucket cache."""
+    n_programs = len(engine.compile_log)
+    b = DynamicBatcher(engine, max_delay_ms=2.0, workers=1)
+    refs = {i: engine.reference(_imgs(1 + i % 3, seed=50 + i))
+            for i in range(6)}
+    errors = []
+
+    def client(i):
+        x = _imgs(1 + i % 3, seed=50 + i)
+        try:
+            for _ in range(5):
+                out = result_within(b.submit(x), 60.0)
+                np.testing.assert_allclose(out, refs[i], rtol=1e-4,
+                                           atol=1e-5)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    try:
+        assert b.set_workers(4) == 4
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for c in threads:
+            c.start()
+        for c in threads:
+            c.join(timeout=120)
+        assert not errors, errors[:2]
+        assert b.workers == 4
+        b.set_workers(1)
+        # retiring workers still answer: one more round on the shrunk pool
+        x = _imgs(2, seed=99)
+        out = result_within(b.submit(x), 60.0)
+        np.testing.assert_allclose(out, engine.reference(x), rtol=1e-4,
+                                   atol=1e-5)
+    finally:
+        assert b.drain(timeout=30)
+    assert len(engine.compile_log) == n_programs
+    assert engine._jitted._cache_size() == 0
+
+
+# -- promotion under the pool -------------------------------------------------
+
+def test_promotion_under_pool_zero_mixed_three_generations(tmp_path):
+    """Two promotions under concurrent traffic with workers=2: every
+    response matches exactly ONE of the three weight generations (epoch 1
+    incumbent, epoch 2, epoch 3), zero failed — canary batches stay
+    generation-pure across the whole pool and `swap_variables`'
+    one-reference flip is visible to every worker."""
+    from tests.test_promote import _save_epoch
+
+    from deepvision_tpu.serve.promote import PromotionController
+    from deepvision_tpu.serve.reload import WeightReloader
+
+    workdir = str(tmp_path / "lenet5")
+    state1 = _save_epoch(workdir, 1)
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    n_programs = len(engine.compile_log)
+    fleet = ModelFleet()
+    sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0, workers=2)
+    PromotionController(sm, canary_frac=0.3, canary_window_s=0.2)
+    reloader = WeightReloader(fleet, poll_every_s=0)
+    x = _imgs(1, seed=9)
+    refs = [engine.reference(x)]          # generation 1 (incumbent)
+    results, failures = [], []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                results.append(np.asarray(
+                    result_within(sm.submit(x), 60.0)))
+            except RequestRejected:
+                time.sleep(0.002)
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(4)]
+    try:
+        for c in threads:
+            c.start()
+        time.sleep(0.2)
+        for epoch, scale in ((2, 1.05), (3, 1.1)):
+            _save_epoch(workdir, epoch, state1, scale=scale)
+            assert reloader.check_once() == 1
+            assert engine.provenance["checkpoint_epoch"] == epoch
+            refs.append(engine.reference(x))
+            time.sleep(0.2)               # traffic against the new epoch
+    finally:
+        stop.set()
+        for c in threads:
+            c.join(timeout=60)
+        fleet.drain(timeout=30)
+    assert not failures, failures[:3]
+    counts = [0, 0, 0]
+    for out in results:
+        matches = [g for g, ref in enumerate(refs)
+                   if np.allclose(out, ref, rtol=1e-4, atol=1e-5)]
+        assert matches, "a response matches NO weight generation"
+        counts[matches[0]] += 1
+    assert all(c > 0 for c in counts), counts   # all three observed
+    assert len(engine.compile_log) == n_programs
+    assert engine._jitted._cache_size() == 0
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+def test_fault_env_parse():
+    inj = FaultInjector.from_env(
+        {"DEEPVISION_FAULT_SERVE_DISPATCH_FAIL": "2:3"})
+    assert inj.active
+    assert inj.serve_dispatch_fail_at == 2
+    assert inj.serve_dispatch_fail_count == 3
+    # dispatches 0,1 pass; 2,3,4 fail; 5 passes
+    fired = []
+    for i in range(6):
+        try:
+            inj.before_serve_dispatch()
+        except RuntimeError:
+            fired.append(i)
+    assert fired == [2, 3, 4]
+
+
+def test_breaker_open_half_open_close_cycle(engine):
+    """The full cycle under injected dispatch faults: K=3 consecutive
+    errors open the circuit; an open circuit fail-fasts (CircuitOpen, in
+    milliseconds, with a retry hint); after the cooldown ONE half-open
+    probe is admitted; its success closes the circuit and traffic flows
+    again. A failed probe re-opens (second arm, k=1)."""
+    b = DynamicBatcher(
+        engine, max_delay_ms=1.0,
+        faults=FaultInjector(serve_dispatch_fail_at=0,
+                             serve_dispatch_fail_count=3))
+    b.breaker = CircuitBreaker("lenet5", k=3, cooldown_s=0.2)
+    x = _imgs(1)
+    try:
+        for _ in range(3):                 # the injected failures
+            with pytest.raises(RuntimeError, match="injected"):
+                result_within(b.submit(x), 60.0)
+        assert b.breaker.describe()["state"] == "open"
+        t0 = time.perf_counter()
+        with pytest.raises(CircuitOpen, match="lenet5"):
+            b.submit(x)
+        assert time.perf_counter() - t0 < 1.0   # fail-FAST, no queueing
+        time.sleep(0.25)                   # cooldown -> half-open
+        out = result_within(b.submit(x), 60.0)  # the probe
+        np.testing.assert_allclose(out, engine.reference(x), rtol=1e-4,
+                                   atol=1e-5)
+        d = b.breaker.describe()
+        assert d["state"] == "closed" and d["opened"] == 1 \
+            and d["closed_after_open"] == 1
+        totals = b.metrics.totals() if b.metrics else None
+    finally:
+        assert b.drain(timeout=30)
+    del totals
+
+    # second arm: a FAILED probe re-opens the circuit for another cooldown
+    b = DynamicBatcher(
+        engine, max_delay_ms=1.0,
+        faults=FaultInjector(serve_dispatch_fail_at=0,
+                             serve_dispatch_fail_count=2))
+    b.breaker = CircuitBreaker("lenet5", k=1, cooldown_s=0.15)
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            result_within(b.submit(x), 60.0)       # opens (k=1)
+        assert b.breaker.describe()["state"] == "open"
+        time.sleep(0.2)
+        with pytest.raises(RuntimeError, match="injected"):
+            result_within(b.submit(x), 60.0)       # failed probe
+        d = b.breaker.describe()
+        assert d["state"] == "open" and d["reopened"] == 1
+        time.sleep(0.2)
+        result_within(b.submit(x), 60.0)           # good probe closes
+        assert b.breaker.describe()["state"] == "closed"
+    finally:
+        assert b.drain(timeout=30)
+
+
+# -- autoscale control loop ---------------------------------------------------
+
+class _FakeEngine:
+    """Pure-host stub: paced dispatch (sleep releases the GIL, so workers
+    genuinely parallelize) with no compiles — the control-loop tests need
+    timing control, not XLA."""
+
+    name = "fake"
+    example_shape = (8, 8, 1)
+    input_dtype = np.dtype(np.float32)
+    buckets = (1, 4)
+    max_batch = 4
+    compile_log: list = []
+    provenance: dict = {"weights": "stub", "checkpoint_epoch": None,
+                        "verified": False}
+
+    def __init__(self, delay_s=0.02):
+        self._delay = delay_s
+
+    def _coerce(self, images):
+        x = np.asarray(images, np.float32)
+        return x[None] if x.shape == self.example_shape else x
+
+    def predict(self, images, generation=None):
+        time.sleep(self._delay)
+        return np.zeros((images.shape[0], 10), np.float32)
+
+
+def test_autoscaler_scales_up_on_shed_then_down_when_idle():
+    """Sustained shed scales the pool up (with hysteresis: one overloaded
+    sample is not enough at up_after=2); the scaled pool absorbs the same
+    offered rate; a sustained idle period scales back down to min_workers.
+    Decisions land on the ServedModel's autoscale stats."""
+    fleet = ModelFleet()
+    sm = fleet.add(_FakeEngine(), max_delay_ms=1.0, max_queue_examples=16)
+    ctl = AutoscaleController([sm], interval_s=0, min_workers=1,
+                              max_workers=3, up_after=2, down_after=3,
+                              cooldown_s=0.0)
+    x = np.zeros((1, 8, 8, 1), np.float32)
+    futs = []
+    stop = threading.Event()
+
+    def offer():
+        # ~330 req/s vs ~180/s one-worker capacity (20ms paced batches <=4)
+        while not stop.is_set():
+            try:
+                futs.append(sm.submit(x))
+            except RequestRejected:
+                pass
+            time.sleep(0.003)
+
+    t = threading.Thread(target=offer, daemon=True)
+    try:
+        t.start()
+        time.sleep(0.3)                       # build overload evidence
+        assert sm.metrics.totals()["shed"] > 0
+        assert ctl.check_once() == 0          # hysteresis: streak 1 of 2
+        assert sm.batcher.workers == 1
+        time.sleep(0.25)
+        assert ctl.check_once() == 1          # streak 2: scale up
+        assert sm.batcher.workers == 2
+        assert sm.autoscale_stats["scale_ups"] == 1
+        # the scaled pool (~360/s) absorbs the same offered rate: after the
+        # backlog drains, a fresh window must shed nothing
+        time.sleep(0.5)
+        before = sm.metrics.totals()["shed"]
+        time.sleep(0.4)
+        assert sm.metrics.totals()["shed"] == before
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    for f in futs:
+        try:
+            result_within(f, 60.0)
+        except RequestRejected:
+            pass
+    # idle: no shed, empty queue -> scale down after down_after samples
+    for _ in range(4):
+        ctl.check_once()
+    assert sm.autoscale_stats["scale_downs"] >= 1
+    assert sm.describe()["autoscale"]["scale_ups"] == 1
+    fleet.drain(timeout=30)
+
+
+# -- distinct, bounded overload answers (503 vs 504) --------------------------
+
+def test_admission_503_and_deadline_504_over_http(engine):
+    """The acceptance pin: no request ever waits the old blind 120 s.
+    A request whose deadline expires after acceptance answers 504 in
+    ~deadline time; once the dispatch EMA knows the service time, an
+    unmeetable deadline is refused at the door with 503 + Retry-After.
+    Both in bounded seconds, with machine-readable reasons."""
+    fleet = ModelFleet()
+    fleet.add(_Paced(engine, 0.25), max_delay_ms=1.0, workers=1)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0)
+    t = threading.Thread(target=srv.serve, kwargs={"port": 0}, daemon=True)
+    t.start()
+    x = _imgs(1, seed=3)
+    try:
+        assert srv.ready.wait(60)
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        # 504: first request (EMA empty -> admitted), 80ms deadline vs a
+        # 250ms dispatch — must expire, and answer fast
+        body = json.dumps({"instances": x.tolist(),
+                           "deadline_ms": 80}).encode()
+        t0 = time.perf_counter()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/predict", data=body),
+                timeout=30)
+        elapsed = time.perf_counter() - t0
+        assert e.value.code == 504
+        assert json.load(e.value)["reason"] == "deadline_expired"
+        assert elapsed < 5.0, f"504 took {elapsed:.1f}s — not bounded"
+        time.sleep(0.5)   # let the dispatch finish: EMA now ~250ms
+        # 503 at the door: the EMA says 100ms can never be met
+        body = json.dumps({"instances": x.tolist(),
+                           "deadline_ms": 100}).encode()
+        t0 = time.perf_counter()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/predict", data=body),
+                timeout=30)
+        elapsed = time.perf_counter() - t0
+        assert e.value.code == 503
+        assert float(e.value.headers["Retry-After"]) > 0
+        assert json.load(e.value)["reason"] == "deadline_unmeetable"
+        assert elapsed < 2.0, f"503 took {elapsed:.1f}s — not at the door"
+        # an achievable deadline still answers 200 through the same path
+        body = json.dumps({"instances": x.tolist(),
+                           "deadline_ms": 5000}).encode()
+        out = json.load(urllib.request.urlopen(
+            urllib.request.Request(base + "/predict", data=body),
+            timeout=30))
+        np.testing.assert_allclose(np.asarray(out["predictions"],
+                                              np.float32),
+                                   engine.reference(x), rtol=1e-4,
+                                   atol=1e-5)
+        snap = json.load(urllib.request.urlopen(base + "/stats",
+                                                timeout=30))
+        assert snap["deadline_expired"] >= 1.0
+        assert snap["admission_rejected"] >= 1.0
+    finally:
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+
+
+def test_result_within_bounds_the_wait():
+    fut = Future()                 # never resolved — a wedged model
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExpired, match="wedged"):
+        result_within(fut, 0.05, what="unit")
+    assert time.perf_counter() - t0 < 2.0
+
+
+# -- observer tap: never silent ----------------------------------------------
+
+def test_observer_exceptions_counted_not_swallowed(engine):
+    """A broken per-batch observer is counted on ServingMetrics every time
+    it raises, and only the FIRST occurrence of each distinct error is
+    logged (dispatches and futures are unaffected either way)."""
+    from deepvision_tpu.serve.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    b = DynamicBatcher(engine, max_delay_ms=1.0, metrics=m)
+
+    def broken_observer(generation, latencies, dispatch_s, error):
+        raise ValueError("tap exploded")
+
+    b.observer = broken_observer
+    x = _imgs(1)
+    try:
+        for _ in range(3):
+            out = result_within(b.submit(x), 60.0)   # results unaffected
+            np.testing.assert_allclose(out, engine.reference(x),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        assert b.drain(timeout=30)
+    assert m.totals()["observer_errors"] == 3
+    assert len(b._observer_errors_seen) == 1   # one distinct error logged
+
+
+# -- CLI surface --------------------------------------------------------------
+
+def test_cli_overload_flag_contract():
+    from deepvision_tpu.serve.cli import main
+
+    for argv in (["-m", "lenet5", "--workers", "0"],
+                 ["-m", "lenet5", "--workers", "3", "--max-workers", "2"],
+                 ["-m", "lenet5", "--deadline-ms", "0"],
+                 ["-m", "lenet5", "--breaker-k", "0"],
+                 ["-m", "lenet5", "--breaker-cooldown", "0"]):
+        with pytest.raises(SystemExit):
+            main(argv)

@@ -33,6 +33,16 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               recompiles — the gate that keeps a silently-regressed
               checkpoint away from traffic has to actually fire BEFORE
               a deployment trusts it
+  autoscale   overload control (docs/SERVING.md "Overload control"):
+              injected overload against a paced one-worker model must
+              shed, the shed-driven control loop must scale the
+              dispatcher pool up (a resilience-logged decision, zero
+              recompiles), and the same offered rate must then be
+              absorbed shed-free; the per-model circuit breaker, driven
+              by the deterministic dispatch-failure fault, must open
+              after K consecutive errors, fail fast, and close through
+              a half-open probe — the two loops that keep a traffic
+              spike (or a broken dispatch path) from becoming an outage
   segment     dense-prediction family (docs/SEGMENTATION.md): a 2-epoch
               synthetic CPU train must improve mIoU, one H-sharded
               spatial train step on a 2-virtual-device mesh must match
@@ -355,6 +365,139 @@ def check_promote(args):
         shutil.rmtree(tmpdir, ignore_errors=True)
     return (f"regressing epoch 2 refused at the gate (cached), clean "
             f"epoch 3 promoted (delta {delta:+.3f}, zero recompiles)")
+
+
+@check("autoscale")
+def check_autoscale(args):
+    # both overload-control loops end to end (docs/SERVING.md "Overload
+    # control"), deterministically. (1) Autoscaling: a PACED engine proxy
+    # (fixed sleep per dispatch, so extra workers genuinely add capacity on
+    # any host — the sleep overlaps) is offered ~2x its one-worker
+    # capacity; it must shed, the control loop must scale the pool up with
+    # zero recompiles, and the SAME offered rate must then be absorbed
+    # shed-free. (2) Circuit breaker: the deterministic dispatch-failure
+    # fault (DEEPVISION_FAULT_SERVE_DISPATCH_FAIL semantics, armed
+    # in-process) must open the circuit after K consecutive errors,
+    # fail-fast the next submit, and close through a half-open probe.
+    import numpy as np
+
+    from deepvision_tpu.serve.autoscale import AutoscaleController
+    from deepvision_tpu.serve.batcher import (CircuitOpen, DynamicBatcher,
+                                              RequestRejected,
+                                              result_within)
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.utils.faults import FaultInjector
+
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    n_programs = len(engine.compile_log)
+    x = np.random.RandomState(0).randn(
+        1, *engine.example_shape).astype(engine.input_dtype)
+
+    class Paced:
+        """Engine proxy with a fixed per-dispatch pause: worker overlap
+        (the sleep releases the GIL) adds real capacity even on 1 core."""
+
+        def __init__(self, inner, delay_s):
+            self._inner, self._delay = inner, delay_s
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def predict(self, images, generation=None):
+            time.sleep(self._delay)
+            return self._inner.predict(images, generation=generation)
+
+    fleet = ModelFleet()
+    sm = fleet.add(Paced(engine, 0.02), max_delay_ms=1.0,
+                   max_queue_examples=16, workers=1)
+    ctl = AutoscaleController([sm], interval_s=0, min_workers=1,
+                              max_workers=3, up_after=1, down_after=10 ** 6,
+                              cooldown_s=0.0)
+    try:
+        def offer(secs):
+            """Open-loop single-image arrivals at ~330/s (one-worker
+            capacity with 20ms paced batches of <=4 is ~200/s); returns
+            (futures, shed)."""
+            futs, shed = [], 0
+            end = time.monotonic() + secs
+            while time.monotonic() < end:
+                try:
+                    futs.append(sm.submit(x))
+                except RequestRejected:
+                    shed += 1
+                time.sleep(0.003)
+            return futs, shed
+
+        futs, shed = offer(0.4)
+        if shed == 0:
+            raise RuntimeError("injected overload did not shed — the "
+                               "backpressure door is not closing")
+        for _ in range(2):          # two overloaded samples -> 3 workers
+            ctl.check_once()
+            f2, _ = offer(0.2)
+            futs += f2
+        if sm.autoscale_stats["scale_ups"] < 1 or sm.batcher.workers < 2:
+            raise RuntimeError(
+                f"sustained shed did not scale the pool up: "
+                f"{sm.autoscale_stats}, workers={sm.batcher.workers}")
+        for f in futs:              # drain the overload backlog
+            try:
+                result_within(f, 60.0, what="preflight request")
+            except RequestRejected:
+                pass
+        # the same offered rate must now be absorbed shed-free
+        futs, shed = offer(0.4)
+        for f in futs:
+            result_within(f, 60.0, what="preflight request")
+        if shed != 0:
+            raise RuntimeError(f"scaled-up pool still shed {shed} "
+                               f"requests at the recovered operating point")
+        if len(engine.compile_log) != n_programs:
+            raise RuntimeError("worker scale-up recompiled the bucket cache")
+        workers = sm.batcher.workers
+    finally:
+        fleet.drain(timeout=60)
+
+    # circuit breaker: K=3 consecutive injected dispatch failures open it,
+    # the next submit fails fast, the half-open probe closes it
+    from deepvision_tpu.serve.autoscale import CircuitBreaker
+    batcher = DynamicBatcher(
+        engine, max_delay_ms=1.0,
+        faults=FaultInjector(serve_dispatch_fail_at=0,
+                             serve_dispatch_fail_count=3))
+    batcher.breaker = CircuitBreaker("lenet5", k=3, cooldown_s=0.2)
+    try:
+        for i in range(3):
+            try:
+                result_within(batcher.submit(x), 60.0)
+                raise RuntimeError(f"injected dispatch {i} did not fail")
+            except RuntimeError as e:
+                if "injected" not in str(e):
+                    raise
+        if batcher.breaker.describe()["state"] != "open":
+            raise RuntimeError(f"3 consecutive dispatch errors did not "
+                               f"open the circuit: "
+                               f"{batcher.breaker.describe()}")
+        t0 = time.perf_counter()
+        try:
+            batcher.submit(x)
+            raise RuntimeError("open circuit accepted a request")
+        except CircuitOpen:
+            pass
+        if time.perf_counter() - t0 > 1.0:
+            raise RuntimeError("open-circuit rejection was not fast")
+        time.sleep(0.25)            # cooldown -> half-open probe
+        result_within(batcher.submit(x), 60.0, what="breaker probe")
+        state = batcher.breaker.describe()["state"]
+        if state != "closed":
+            raise RuntimeError(f"successful probe did not close the "
+                               f"circuit: {batcher.breaker.describe()}")
+    finally:
+        batcher.drain(timeout=60)
+    return (f"shed -> scale-up to {workers} workers (zero recompiles) -> "
+            f"absorbed; breaker opened after 3 faults, probe closed it")
 
 
 @check("segment")
@@ -764,6 +907,7 @@ def main(argv=None):
     check_serve(args)
     check_fleet(args)
     check_promote(args)
+    check_autoscale(args)
     check_segment(args)
     check_devices(args)
     check_input(args)
