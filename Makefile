@@ -10,7 +10,7 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
-        bench-serve-promote bench-serve-spike \
+        bench-serve-promote bench-serve-spike bench-serve-trace \
         bench-input dryrun smoke seg-smoke serve-smoke serve-fleet-smoke \
         preflight preflight-record lint lint-changed fsck check \
         check-update-cost reshard-parity
@@ -126,6 +126,13 @@ bench-serve-spike: ## overload transient: offered QPS steps 1x->3x->1x while
 	## "Overload control")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
 	    --load --spike
+
+bench-serve-trace: ## Perfetto trace of the load bench: runs the open-loop
+	## arrival schedule untraced then traced at default sampling, dumps
+	## trace.json, and FAILS if tracing cost >3% of sustained QPS
+	## (docs/OBSERVABILITY.md)
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
+	    --load --trace-out trace.json
 
 bench-serve-promote: ## accuracy-gated promotion under open-loop load: a
 	## new epoch lands mid-bench and runs shadow->gate->canary->promote

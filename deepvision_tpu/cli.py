@@ -82,6 +82,12 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--workdir", default=None)
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="override steps per epoch (synthetic/smoke)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome/Perfetto trace-event JSON of the "
+                        "run on exit: per log-window spans splitting host "
+                        "data wait vs device dispatch vs checkpoint commit, "
+                        "tagged with the prefetch transfer ledger "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the first epoch here")
     p.add_argument("--seed", type=int, default=None,
@@ -435,6 +441,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
     workdir = args.workdir or os.path.join("runs", cfg.name)
 
     trainer = trainer_factory(cfg, workdir)
+    if args.trace_out:
+        trainer.arm_tracing(args.trace_out)
     train_fn, val_fn = make_data(cfg, args)
 
     # mnist pipeline pads 28→32, matching the configured image_size

@@ -38,7 +38,7 @@ from .config import TrainConfig
 from .metrics import MetricsLogger
 from .optim import build_optimizer, set_lr_scale
 from .resilience import (GracefulShutdown, PreemptionExit, RetryPolicy,
-                         resilient_batches)
+                         log_resilience_event, resilient_batches)
 from .steps import annotate_step
 from .train_state import TrainState, init_model
 
@@ -127,9 +127,10 @@ class AdversarialTrainer:
               f"(attempt {attempt}/{self.retry_policy.max_retries}): {exc} — "
               f"retrying in {delay:.2f}s", file=sys.stderr, flush=True)
         if jax.process_index() == 0:
-            self.logger.log(self._batch_count,
-                            {f"{what}_retries": float(attempt)},
-                            prefix="resilience_", echo=False)
+            # through the single resilience choke point (the correlation
+            # fields land there), not a hand-rolled prefixed write
+            log_resilience_event(self.logger, self._batch_count,
+                                 {f"{what}_retries": float(attempt)})
 
     def _payload(self):
         return {"gen": CheckpointManager._payload(self.gen_state),
@@ -197,11 +198,11 @@ class AdversarialTrainer:
                   f"{self._recoveries}: epoch {epoch} diverged — rolled back "
                   f"to epoch {got}, LR scale now {self._recovery_scale:g}",
                   flush=True)
-            self.logger.log(
-                self._batch_count,
+            log_resilience_event(
+                self.logger, self._batch_count,
                 {"divergence_recoveries": float(self._recoveries),
                  "lr_scale": self._recovery_scale},
-                epoch=epoch, prefix="resilience_", echo=False)
+                epoch=epoch)
         return got
 
     def fit(self, train_data_fn: Callable[[int], Iterable],

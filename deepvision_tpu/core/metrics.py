@@ -79,7 +79,11 @@ class MetricsLogger:
         self._jsonl.flush()
 
     def log(self, step: int, metrics: Dict[str, float], epoch: Optional[int] = None,
-            prefix: str = "", echo: bool = True):
+            prefix: str = "", echo: bool = True,
+            extra: Optional[Dict[str, str]] = None):
+        """`extra` carries non-numeric correlation fields (request_id,
+        trace_ref — core/resilience.log_resilience_event) onto the JSONL
+        line only: history and TensorBoard are scalar stores."""
         metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
         with self._lock:
             for k, v in metrics.items():
@@ -87,6 +91,7 @@ class MetricsLogger:
                 h["epochs"].append(epoch if epoch is not None else step)
                 h["value"].append(v)
             rec = {"step": step, "epoch": epoch, "t": round(time.time() - self._t0, 3),
+                   **(extra or {}),
                    **{prefix + k: round(v, 6) for k, v in metrics.items()}}
             if self._jsonl:
                 # json.dumps would emit bare NaN/Infinity tokens for non-finite

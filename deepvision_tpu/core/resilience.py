@@ -117,18 +117,34 @@ def resilient_batches(batches: Iterable, policy: RetryPolicy,
 
 
 def log_resilience_event(logger, step: int, metrics: dict,
-                         epoch: Optional[int] = None) -> None:
+                         epoch: Optional[int] = None, *,
+                         request_id: Optional[str] = None,
+                         trace_ref: Optional[str] = None) -> None:
     """Write one event onto the `resilience_` metrics stream — the single
     forensics channel every recovery path shares (divergence rollbacks and
     checkpoint fallbacks in the trainers, refused hot reloads in
-    serve/reload.py): prefixed keys, float values, no console echo, same
-    JSONL/TB stream as the run's ordinary metrics so incidents line up
-    with the training/serving timeline. A None logger is a no-op, so
-    callers without a metrics stream (library embedding) need no guard."""
+    serve/reload.py, sheds/breaker transitions in the serving stack):
+    prefixed keys, float values, no console echo, same JSONL/TB stream as
+    the run's ordinary metrics so incidents line up with the
+    training/serving timeline. A None logger is a no-op, so callers
+    without a metrics stream (library embedding) need no guard.
+
+    `request_id` / `trace_ref` are the correlation fields
+    (docs/OBSERVABILITY.md): the HTTP request id that triggered this event
+    and/or the ``span:<id>`` of the span that produced it, written as
+    string fields on the JSONL line — a shed, breaker-open, or rollback
+    event joins the exact spans (GET /trace) and client log line behind
+    it on these keys."""
     if logger is None:
         return
+    extra = {}
+    if request_id is not None:
+        extra["request_id"] = str(request_id)
+    if trace_ref is not None:
+        extra["trace_ref"] = str(trace_ref)
     logger.log(step, {k: float(v) for k, v in metrics.items()},
-               epoch=epoch, prefix="resilience_", echo=False)
+               epoch=epoch, prefix="resilience_", echo=False,
+               extra=extra or None)
 
 
 class PreemptionExit(Exception):

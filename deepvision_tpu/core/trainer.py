@@ -41,6 +41,21 @@ def _is_main_process() -> bool:
     return jax.process_index() == 0
 
 
+def _timed_pulls(batches, tacc):
+    """Iterate `batches` accumulating the host-blocking pull time into
+    `tacc[0]` (ns) — the data-wait share of the per-window tracing spans
+    (Trainer.arm_tracing). Only installed when tracing is armed."""
+    it = iter(batches)
+    while True:
+        t = time.monotonic_ns()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        tacc[0] += time.monotonic_ns() - t
+        yield batch
+
+
 class TrainingDivergedError(RuntimeError):
     """Raised when an epoch's mean train loss is non-finite (NaN/inf): the
     optimizer state is poisoned, so training on would only burn pod-hours.
@@ -305,6 +320,12 @@ class Trainer:
         self._prefetcher = None      # live DevicePrefetcher during an epoch
         self._watchdog: Optional[StepWatchdog] = None
         self._shutdown: Optional[GracefulShutdown] = None
+        # span tracing (obs/trace.py; armed by arm_tracing / --trace-out):
+        # per log-window spans splitting host data wait vs device dispatch,
+        # plus per-epoch checkpoint-commit spans. None = off — the step
+        # loop pays one branch.
+        self.tracer = None
+        self._trace_out: Optional[str] = None
 
         self.rng = jax.random.PRNGKey(config.seed)
         self.state: Optional[TrainState] = None
@@ -672,6 +693,11 @@ class Trainer:
         consumed = 0        # host-side count of steps dispatched this epoch
         k = self.config.steps_per_dispatch
         group: list = []    # staged batches awaiting a k-step dispatch
+        # tracing accumulators (arm_tracing / --trace-out): [host data-wait
+        # ns, dispatch ns, window start ns, steps at window start] — None
+        # keeps the untraced step loop at exactly one branch per step
+        tacc = ([0, 0, time.monotonic_ns(), 0]
+                if self.tracer is not None else None)
 
         def record(metrics, n_steps, n_examples):
             nonlocal consumed, n_img
@@ -684,6 +710,8 @@ class Trainer:
             device_metrics.append(metrics)
             weights.append(n_steps)
             log_every = self.config.log_every_steps
+            if tacc is not None and consumed // log_every > prev // log_every:
+                self._emit_window_spans(tacc, epoch, consumed)
             if (consumed // log_every > prev // log_every
                     and _is_main_process()):
                 # JSONL/TB writes are process-0-only, like checkpoints
@@ -703,8 +731,14 @@ class Trainer:
                         epoch=epoch, prefix="train_", echo=True)
 
         def run_single(batch):
-            self.state, metrics = self.train_step(self.state, *batch,
-                                                  step_rng)
+            if tacc is None:
+                self.state, metrics = self.train_step(self.state, *batch,
+                                                      step_rng)
+            else:
+                t_d = time.monotonic_ns()
+                self.state, metrics = self.train_step(self.state, *batch,
+                                                      step_rng)
+                tacc[1] += time.monotonic_ns() - t_d
             if self.ema_update is not None:
                 self._micro_count += 1
                 if self._micro_count % self.config.optimizer.accum_steps == 0:
@@ -730,12 +764,13 @@ class Trainer:
         self._prefetcher = staged
         if self._watchdog is not None:
             self._watchdog.beat()
+        batches_iter = staged if tacc is None else _timed_pulls(staged, tacc)
 
         def _preempted() -> bool:
             return self._shutdown is not None and self._shutdown.requested
 
         try:
-            for batch in staged:
+            for batch in batches_iter:
                 if _preempted():
                     # finish-the-in-flight-step contract: the last dispatched
                     # step completes on device; we just stop feeding new ones
@@ -756,8 +791,12 @@ class Trainer:
                         flat = [a for b in group for a in b]
                         group = []
                         try:
+                            t_d = (time.monotonic_ns() if tacc is not None
+                                   else 0)
                             self.state, metrics = self._multi_step(
                                 self.state, *flat, step_rng)
+                            if tacc is not None:
+                                tacc[1] += time.monotonic_ns() - t_d
                         finally:
                             # a failing dispatch must not pin k staged
                             # batches in the retained traceback frame
@@ -777,6 +816,10 @@ class Trainer:
             self._prefetcher = None
             staged.close()
         jax.block_until_ready(self.state.params)
+        if tacc is not None and consumed > tacc[3]:
+            # epoch tail below the log_every boundary: flush the partial
+            # window so short runs (and every epoch's tail) still trace
+            self._emit_window_spans(tacc, epoch, consumed)
         for s, m, pf_stats in pending:  # main process only
             self.logger.log(s, {**jax.device_get(m), **pf_stats},
                             epoch=epoch, prefix="train_", echo=True)
@@ -988,7 +1031,15 @@ class Trainer:
             host["plateau"] = {"best": self.plateau.best,
                                "num_bad_epochs": self.plateau.num_bad_epochs,
                                "scale": self.plateau.scale}
+        t_ck = time.monotonic_ns() if self.tracer is not None else 0
         self.ckpt.save(epoch, self.state, host_state=host, metric=metric)
+        if self.tracer is not None:
+            # the host-blocking share of the commit (async saves: snapshot
+            # + enqueue; sync saves: the full write) — the third split of
+            # the training trace next to data wait and dispatch
+            self.tracer.add("ckpt_commit", "train", t_ck,
+                            time.monotonic_ns() - t_ck,
+                            args={"epoch": epoch})
         self._last_saved_epoch = epoch
 
     def _commit_preemption(self, epoch: int) -> None:
@@ -1034,6 +1085,42 @@ class Trainer:
                 epoch=epoch, prefix="resilience_", echo=False)
         return got
 
+    def arm_tracing(self, trace_out: Optional[str] = None, tracer=None):
+        """Arm span tracing (`--trace-out`, docs/OBSERVABILITY.md): each
+        log_every window emits a `train_window` span split into aggregate
+        `host_data_wait` (time blocked on the input pipeline) and
+        `train_dispatch` (host time dispatching steps) child spans, tagged
+        with the prefetcher's transfer ledger (queue depth, bytes staged,
+        stage latency); each checkpoint save emits a `ckpt_commit` span.
+        The Chrome trace JSON lands at `trace_out` when the trainer closes
+        — load it in Perfetto. Returns the tracer (tests read it live)."""
+        from ..obs.trace import Tracer
+        # no sampling for training: windows are log_every-rate, not
+        # request-rate — every one matters in a trace
+        self.tracer = tracer if tracer is not None else Tracer(sample=1.0)
+        self._trace_out = trace_out
+        return self.tracer
+
+    def _emit_window_spans(self, tacc, epoch: int, consumed: int) -> None:
+        """One window's spans at the log_every boundary: wall window +
+        aggregate data-wait/dispatch splits (tacc accumulators, reset
+        here). The split is host-observed — data wait is time blocked on
+        the prefetcher, dispatch is host time in the (async) step calls —
+        so window_wall - (wait + dispatch) is host-side everything-else."""
+        now_ns = time.monotonic_ns()
+        w0 = tacc[2]
+        wid = self.tracer.add(
+            "train_window", "train", w0, now_ns - w0,
+            args={"epoch": epoch, "steps": consumed - tacc[3],
+                  **self._prefetch_stats()})
+        self.tracer.add("host_data_wait", "train", w0, tacc[0],
+                        args={"window": wid, "aggregate": True})
+        self.tracer.add("train_dispatch", "train", w0, tacc[1],
+                        args={"window": wid, "aggregate": True})
+        tacc[0] = tacc[1] = 0
+        tacc[2] = now_ns
+        tacc[3] = consumed
+
     def _prefetch_stats(self) -> dict:
         """Host-side snapshot of the live prefetcher's transfer ledger (no
         device sync): queue depth plus the staged-bytes total and the last
@@ -1056,6 +1143,12 @@ class Trainer:
         }
 
     def close(self):
+        if self.tracer is not None and self._trace_out:
+            from ..obs.export import write_chrome_trace
+            path, self._trace_out = self._trace_out, None  # idempotent
+            n = write_chrome_trace(self.tracer, path)
+            print(f"[{self.config.name}] wrote {n} trace span(s) to "
+                  f"{path} (open in https://ui.perfetto.dev)", flush=True)
         self.logger.close()
         self.ckpt.close()
 

@@ -1,0 +1,19 @@
+"""Observability: end-to-end tracing + metrics exposition (zero deps).
+
+The serving stack can shed, autoscale, canary, and roll back — but until
+now every one of those decisions was explained by scattered surfaces
+(`/stats` JSON, `resilience_*` events, stderr lines). This package is the
+instrument that turns them into one joined picture:
+
+- `obs.trace` — a thread-safe, ring-buffered, sampled span recorder with
+  request-id context propagation. One branch when disabled; a few dict
+  builds per sampled request when enabled.
+- `obs.export` — Chrome trace-event JSON (loadable in Perfetto /
+  `chrome://tracing`) and Prometheus text exposition (`GET /metrics`),
+  plus the minimal format validator the tests and preflight share.
+
+See docs/OBSERVABILITY.md for the span taxonomy, the scrape quickstart,
+and the correlation contract joining spans to `resilience_*` events.
+"""
+
+from .trace import Tracer, TraceContext, new_request_id  # noqa: F401

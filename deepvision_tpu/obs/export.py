@@ -1,0 +1,397 @@
+"""Trace + metrics exposition: Chrome trace-event JSON and Prometheus text.
+
+Two consumers, zero dependencies:
+
+- `chrome_trace(tracer)` / `write_chrome_trace(tracer, path)` render a
+  `Tracer`'s ring as Chrome trace-event JSON — loadable in Perfetto
+  (https://ui.perfetto.dev) or `chrome://tracing`. Complete ("X") events
+  carry every span's args (request_id, bucket, generation, worker, ...);
+  flow events ("s"/"f") draw the request→batch arrows so one request's
+  queue wait visually lands in the device batch that served it. Serving
+  exposes this as `GET /trace?secs=N`; trainers via `--trace-out`.
+
+- `render_prometheus(fleet)` renders a serving fleet's state as Prometheus
+  text exposition (format 0.0.4) for `GET /metrics`: lifetime counters
+  (requests/sheds/errors — `ServingMetrics.totals()`, never reset, so
+  scrapes are monotone), gauges (queue depth, autoscale worker count,
+  breaker state), fixed-bucket latency/queue-wait/dispatch histograms,
+  and reload/autoscale/promotion decision counters — all labeled by
+  `model`.
+
+`validate_prometheus_text` / `parse_prometheus_text` are the minimal
+format validator and sample parser the tests and preflight's `obs` check
+share, so the exposition contract is pinned by the same code in both.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .trace import Tracer
+
+PREFIX = "deepvision_serve_"
+
+# ServingMetrics.totals() key -> (metric name, help) — every one a lifetime
+# counter that survives snapshot(reset=True), so consecutive scrapes are
+# monotone by construction
+_TOTAL_COUNTERS = (
+    ("requests", "requests_total", "Requests answered (batched dispatches)"),
+    ("examples", "examples_total", "Examples dispatched to the device"),
+    ("shed", "shed_total", "Requests shed by queue backpressure (HTTP 429)"),
+    ("admission_rejected", "admission_rejected_total",
+     "Requests refused at the door: deadline unmeetable (fast HTTP 503)"),
+    ("deadline_expired", "deadline_expired_total",
+     "Accepted requests whose deadline expired before a result (HTTP 504)"),
+    ("breaker_rejected", "breaker_rejected_total",
+     "Requests failed fast while the model's circuit was open (HTTP 503)"),
+    ("dispatch_errors", "dispatch_errors_total",
+     "Device dispatches that raised (the circuit breaker's evidence)"),
+    ("observer_errors", "observer_errors_total",
+     "Per-batch observer tap exceptions (counted, never silent)"),
+)
+
+_BREAKER_STATES = ("closed", "open", "half_open")
+
+
+# -- Chrome trace-event export -------------------------------------------------
+
+def chrome_trace(tracer: Tracer, since_s: Optional[float] = None) -> dict:
+    """Render the tracer's ring as a Chrome trace-event JSON object."""
+    spans = tracer.spans(since_s)
+    pid = os.getpid()
+    tids: Dict[str, int] = {}
+    events: List[dict] = [
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": f"deepvision_tpu[{pid}]"}},
+    ]
+
+    def tid_of(name: str) -> int:
+        if name not in tids:
+            tids[name] = len(tids) + 1
+            events.append({"ph": "M", "pid": pid, "tid": tids[name],
+                           "name": "thread_name", "args": {"name": name}})
+        return tids[name]
+
+    by_id = {s["id"]: s for s in spans}
+    for s in spans:
+        ts_us = (s["ts"] - tracer.t0_ns) / 1000.0
+        events.append({
+            "name": s["name"], "cat": s["cat"], "ph": "X",
+            "ts": ts_us, "dur": s["dur"] / 1000.0,
+            "pid": pid, "tid": tid_of(s["tid"]),
+            "args": {**s["args"], "span_id": s["id"]},
+        })
+        # request -> batch flow arrow: from the end of a request's
+        # queue_wait span to the start of the batch span that served it
+        batch = s["args"].get("batch")
+        if s["name"] == "queue_wait" and batch in by_id:
+            b = by_id[batch]
+            events.append({"ph": "s", "id": s["id"], "cat": "flow",
+                           "name": "request->batch", "pid": pid,
+                           "tid": tid_of(s["tid"]),
+                           "ts": ts_us + s["dur"] / 1000.0})
+            events.append({"ph": "f", "bp": "e", "id": s["id"],
+                           "cat": "flow", "name": "request->batch",
+                           "pid": pid, "tid": tid_of(b["tid"]),
+                           "ts": (b["ts"] - tracer.t0_ns) / 1000.0})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            # wall-clock anchor of monotonic ts=0, for lining the trace up
+            # with serve.jsonl / train.jsonl timestamps
+            "t0_unix": tracer.t0_unix,
+            "spans_recorded": tracer.recorded,
+            "spans_exported": len(spans),
+        },
+    }
+
+
+def write_chrome_trace(tracer: Tracer, path: str,
+                       since_s: Optional[float] = None) -> int:
+    """Write the Chrome trace JSON to `path`; returns the span count."""
+    trace = chrome_trace(tracer, since_s)
+    with open(path, "w") as fp:
+        json.dump(trace, fp)
+    return trace["otherData"]["spans_exported"]
+
+
+# -- Prometheus text exposition ------------------------------------------------
+
+def _escape(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n",
+                                                                   r"\n")
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels(d: Dict[str, str]) -> str:
+    if not d:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in d.items()) + "}"
+
+
+def _emit(lines: List[str], name: str, mtype: str, help_text: str,
+          samples) -> None:
+    """One metric family: HELP + TYPE, then every sample grouped under it
+    (the exposition format requires a family's samples to be contiguous).
+    `samples` yields (suffix, labels_dict, value)."""
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} {mtype}")
+    for suffix, labels, value in samples:
+        lines.append(f"{name}{suffix}{_labels(labels)} {_fmt(value)}")
+
+
+def render_prometheus(fleet) -> str:
+    """Prometheus text exposition (0.0.4) of a ModelFleet's serving state,
+    one `model` label per served model. Counters come from never-reset
+    lifetime stores (`ServingMetrics.totals()`, reload/autoscale decision
+    stats, promotion history), so consecutive scrapes are monotone."""
+    models = list(fleet)
+    lines: List[str] = []
+    totals = {sm.name: sm.metrics.totals() for sm in models}
+    for key, name, help_text in _TOTAL_COUNTERS:
+        _emit(lines, PREFIX + name, "counter", help_text,
+              [("", {"model": sm.name}, totals[sm.name].get(key, 0))
+               for sm in models])
+
+    _emit(lines, PREFIX + "queue_depth", "gauge",
+          "Examples accepted whose results are not yet delivered",
+          [("", {"model": sm.name}, sm.batcher.queue_depth)
+           for sm in models])
+    _emit(lines, PREFIX + "workers", "gauge",
+          "Dispatcher workers in the model's pool (the autoscaler's lever)",
+          [("", {"model": sm.name}, sm.batcher.workers) for sm in models])
+
+    breaker_samples = []
+    for sm in models:
+        state = (sm.breaker.describe()["state"] if sm.breaker is not None
+                 else None)
+        for s in _BREAKER_STATES:
+            breaker_samples.append(
+                ("", {"model": sm.name, "state": s},
+                 1 if state == s else 0))
+    _emit(lines, PREFIX + "breaker_state", "gauge",
+          "Circuit breaker state, one-hot over {closed, open, half_open}",
+          breaker_samples)
+
+    reload_samples = []
+    autoscale_samples = []
+    for sm in models:
+        with sm.reload_lock:
+            reload_stats = dict(sm.reload_stats)
+            autoscale_stats = dict(sm.autoscale_stats)
+        reload_samples += [("", {"model": sm.name, "outcome": k}, v)
+                           for k, v in sorted(reload_stats.items())]
+        autoscale_samples += [
+            ("", {"model": sm.name, "decision": d},
+             autoscale_stats.get(f"{d}s", 0))
+            for d in ("scale_up", "scale_down")]
+    _emit(lines, PREFIX + "reload_outcomes_total", "counter",
+          "Hot weight reload outcomes (swaps, refusals, rollbacks)",
+          reload_samples)
+    _emit(lines, PREFIX + "autoscale_decisions_total", "counter",
+          "Autoscale decisions taken by the shed-driven control loop",
+          autoscale_samples)
+
+    promo_samples = []
+    for sm in models:
+        if sm.promoter is None:
+            continue
+        counts: Dict[str, int] = {}
+        for rec in list(sm.promoter.history):
+            d = str(rec.get("decision", "unknown"))
+            counts[d] = counts.get(d, 0) + 1
+        promo_samples += [("", {"model": sm.name, "decision": d}, n)
+                          for d, n in sorted(counts.items())]
+    if promo_samples:
+        _emit(lines, PREFIX + "promotion_decisions_total", "counter",
+              "Accuracy-gated promotion decisions (shadow/canary verdicts)",
+              promo_samples)
+
+    for hist_name, help_text in (
+            ("request_latency_seconds",
+             "Request latency, submit to result (fixed buckets, lifetime)"),
+            ("queue_wait_seconds",
+             "Time from submit acceptance to dispatch start"),
+            ("dispatch_seconds",
+             "Device dispatch wall time per batch")):
+        samples = []
+        for sm in models:
+            h = sm.metrics.histograms().get(hist_name)
+            if h is None:
+                continue
+            samples += [("_bucket", {"model": sm.name, "le": _fmt(le)}, n)
+                        for le, n in h["buckets"]]
+            samples.append(("_sum", {"model": sm.name}, h["sum"]))
+            samples.append(("_count", {"model": sm.name}, h["count"]))
+        _emit(lines, PREFIX + hist_name, "histogram", help_text, samples)
+    return "\n".join(lines) + "\n"
+
+
+# -- minimal format validation (shared by tests + preflight) -------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_RE = re.compile(
+    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_labels(raw: Optional[str], errors: List[str],
+                  where: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    if not raw:
+        return labels
+    for part in raw.split(","):
+        m = _LABEL_RE.match(part.strip())
+        if m is None:
+            errors.append(f"{where}: bad label pair {part!r}")
+            continue
+        labels[m.group("k")] = (m.group("v")
+                                .replace(r"\"", '"')
+                                .replace(r"\n", "\n")
+                                .replace("\\\\", "\\"))
+    return labels
+
+
+def _family(name: str, types: Dict[str, str]) -> str:
+    """Sample name -> declared family: histogram samples land under their
+    base name's TYPE declaration."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = name[:-len(suffix)] if name.endswith(suffix) else None
+        if base and types.get(base) in ("histogram", "summary"):
+            return base
+    return name
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    return float(raw)  # raises for garbage; "NaN" parses
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, tuple], float]:
+    """{(sample_name, sorted labels tuple): value} over every sample line —
+    what the monotone-across-scrapes checks diff."""
+    out: Dict[Tuple[str, tuple], float] = {}
+    errors: List[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = _parse_labels(m.group("labels"), errors, line)
+        try:
+            out[(m.group("name"), tuple(sorted(labels.items())))] = \
+                _parse_value(m.group("value"))
+        except ValueError:
+            continue
+    return out
+
+
+def validate_prometheus_text(text: str) -> List[str]:
+    """Minimal Prometheus text-format (0.0.4) validation; returns a list of
+    problems (empty = valid). Checks: metric-name/label charset, every
+    sample preceded by its family's TYPE (with a HELP), declared types
+    legal, histogram buckets cumulative with an le="+Inf" bucket equal to
+    `_count`."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    # (family, non-le labels) -> [(le_value, count)], plus _count samples
+    hist_buckets: Dict[tuple, List[Tuple[float, float]]] = {}
+    hist_counts: Dict[tuple, float] = {}
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            if not parts or not _NAME_RE.match(parts[0]):
+                errors.append(f"line {i}: bad HELP metric name")
+            else:
+                helps[parts[0]] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2 or not _NAME_RE.match(parts[0]):
+                errors.append(f"line {i}: malformed TYPE line {line!r}")
+                continue
+            name, mtype = parts
+            if mtype not in _TYPES:
+                errors.append(f"line {i}: unknown type {mtype!r}")
+            if name in types:
+                errors.append(f"line {i}: duplicate TYPE for {name}")
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line.strip())
+        if m is None:
+            errors.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        if not _NAME_RE.match(name):
+            errors.append(f"line {i}: bad metric name {name!r}")
+            continue
+        labels = _parse_labels(m.group("labels"), errors, f"line {i}")
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError:
+            errors.append(f"line {i}: bad sample value {m.group('value')!r}")
+            continue
+        fam = _family(name, types)
+        if fam not in types:
+            errors.append(f"line {i}: sample {name} has no preceding TYPE")
+        elif fam not in helps:
+            errors.append(f"line {i}: family {fam} has no HELP line")
+        if name.endswith("_bucket") and types.get(fam) == "histogram":
+            if "le" not in labels:
+                errors.append(f"line {i}: histogram bucket without le label")
+                continue
+            key = (fam, tuple(sorted((k, v) for k, v in labels.items()
+                                     if k != "le")))
+            try:
+                hist_buckets.setdefault(key, []).append(
+                    (_parse_value(labels["le"]), value))
+            except ValueError:
+                errors.append(f"line {i}: bad le value {labels['le']!r}")
+        elif name.endswith("_count") and types.get(fam) == "histogram":
+            hist_counts[(fam, tuple(sorted(labels.items())))] = value
+
+    for (fam, labels), buckets in hist_buckets.items():
+        les = [le for le, _ in buckets]
+        counts = [n for _, n in buckets]
+        if les != sorted(les):
+            errors.append(f"{fam}{dict(labels)}: bucket le values not "
+                          f"ascending")
+        if any(b > a for b, a in zip(counts, counts[1:])):
+            errors.append(f"{fam}{dict(labels)}: bucket counts not "
+                          f"cumulative")
+        if not les or not math.isinf(les[-1]):
+            errors.append(f"{fam}{dict(labels)}: missing le=\"+Inf\" bucket")
+        else:
+            total = hist_counts.get((fam, labels))
+            if total is not None and counts[-1] != total:
+                errors.append(f"{fam}{dict(labels)}: +Inf bucket "
+                              f"{counts[-1]} != _count {total}")
+    return errors

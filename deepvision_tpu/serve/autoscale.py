@@ -107,8 +107,11 @@ class CircuitBreaker:
             self._probe_started = now
             return None
 
-    def record(self, ok: bool) -> None:
-        """Dispatch outcome feed (called by DynamicBatcher._dispatch)."""
+    def record(self, ok: bool, trace_ref: Optional[str] = None) -> None:
+        """Dispatch outcome feed (called by DynamicBatcher._dispatch).
+        `trace_ref` names the span of the dispatch that produced this
+        outcome (``span:<id>``), so a breaker transition's resilience
+        event joins back to the exact batch that tripped it."""
         transition = None
         with self._lock:
             if ok:
@@ -140,7 +143,8 @@ class CircuitBreaker:
             log_resilience_event(self.logger, self._events,
                                  {f"breaker_{transition}": 1.0,
                                   "breaker_consecutive_errors":
-                                      float(consecutive)})
+                                      float(consecutive)},
+                                 trace_ref=trace_ref)
             print(f"[serve-breaker:{self.name}] circuit {transition}"
                   + (f" after {consecutive} consecutive dispatch errors "
                      f"(fail-fast 503 for {self.cooldown_s:g}s, then a "

@@ -113,10 +113,11 @@ def result_within(future: Future, deadline_s: Optional[float], *,
 
 
 class _Request:
-    __slots__ = ("images", "n", "future", "t_submit", "generation")
+    __slots__ = ("images", "n", "future", "t_submit", "generation", "trace")
 
     def __init__(self, images: np.ndarray,
-                 generation: Optional[str] = None):
+                 generation: Optional[str] = None,
+                 trace=None):
         self.images = images
         self.n = images.shape[0]
         self.future: Future = Future()
@@ -125,6 +126,10 @@ class _Request:
         # dispatcher never coalesces requests of different generations into
         # one batch — the promotion canary's zero-mixed-weights contract.
         self.generation = generation
+        # obs.trace.TraceContext of a SAMPLED request (None for unsampled /
+        # tracing off): the dispatcher records this request's queue_wait
+        # span and links it to the batch span that served it
+        self.trace = trace
 
 
 # queue control tokens: None stops ALL workers (drain, re-put by each
@@ -187,6 +192,11 @@ class DynamicBatcher:
         # resilience_ event stream for the observer-tap error log (set by
         # the server; None = stderr only)
         self.logger = None
+        # obs.trace.Tracer (set by the server / the benches; None = tracing
+        # off — the dispatch path pays exactly one attribute check). Batch
+        # spans (bucket/generation/worker) are recorded whenever enabled;
+        # per-request queue_wait spans only for sampled requests.
+        self.tracer = None
         self.faults = faults if faults is not None else FaultInjector.from_env()
         # optional per-batch tap `observer(generation, latencies_s,
         # dispatch_s, error)` — the promotion controller's
@@ -269,7 +279,7 @@ class DynamicBatcher:
     # -- client side -------------------------------------------------------
 
     def submit(self, images, *, generation: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None, trace=None) -> Future:
         x = self.engine._coerce(images)
         n = x.shape[0]
         if n > self.max_batch:
@@ -319,7 +329,7 @@ class DynamicBatcher:
                         f"door so you can retry elsewhere",
                         eta_s=eta, deadline_s=dl, retry_after_s=retry)
             self._pending += n
-        req = _Request(x, generation=generation)
+        req = _Request(x, generation=generation, trace=trace)
         self._q.put(req)
         return req.future
 
@@ -356,7 +366,8 @@ class DynamicBatcher:
                         self._threads.remove(threading.current_thread())
                         return
                 continue                    # stale token (target re-raised)
-            batch: List[_Request] = [first]
+            t_collect = time.monotonic()   # batch-formation start (the
+            batch: List[_Request] = [first]  # batch span's left edge)
             total = first.n
             deadline = first.t_submit + self.max_delay
             while total < self.max_batch:
@@ -383,13 +394,14 @@ class DynamicBatcher:
                     break                   # runs ONE weight generation
                 batch.append(nxt)
                 total += nxt.n
-            self._dispatch(batch, total)
+            self._dispatch(batch, total, t_collect)
 
     def _record_dispatch_locked(self, dt: float) -> None:
         self._dispatch_ema_s = (dt if self._dispatch_ema_s <= 0.0
                                 else 0.2 * dt + 0.8 * self._dispatch_ema_s)
 
-    def _dispatch(self, batch: List[_Request], total: int) -> None:
+    def _dispatch(self, batch: List[_Request], total: int,
+                  t_collect: Optional[float] = None) -> None:
         images = (batch[0].images if len(batch) == 1
                   else np.concatenate([r.images for r in batch]))
         generation = batch[0].generation   # whole batch shares it (collect
@@ -404,12 +416,16 @@ class DynamicBatcher:
                 self._record_dispatch_locked(now - t0)
             if self.metrics is not None:
                 self.metrics.observe_dispatch_error()
+            trace_ref = self._trace_batch(batch, total, t_collect, t0, now,
+                                          generation, error=repr(e))
             if self.breaker is not None:
-                self.breaker.record(ok=False)
+                # the failing batch's span is the breaker's evidence: a
+                # later breaker_opened event joins back to these spans
+                self.breaker.record(ok=False, trace_ref=trace_ref)
             for r in batch:
                 _settle(r.future, exc=e)
             self._observe(generation, [now - r.t_submit for r in batch],
-                          now - t0, e)
+                          now - t0, e, trace_ref=trace_ref)
             return
         now = time.monotonic()
         with self._lock:
@@ -427,10 +443,54 @@ class DynamicBatcher:
                 n_real=total,
                 bucket=pick_bucket(total, self.engine.buckets),
                 dispatch_s=now - t0,
-                request_latencies_s=latencies)
-        self._observe(generation, latencies, now - t0, None)
+                request_latencies_s=latencies,
+                # queueing vs device split: submit accept -> dispatch start
+                queue_waits_s=[t0 - r.t_submit for r in batch])
+        trace_ref = self._trace_batch(batch, total, t_collect, t0, now,
+                                      generation)
+        self._observe(generation, latencies, now - t0, None,
+                      trace_ref=trace_ref)
 
-    def _observe(self, generation, latencies, dispatch_s, error) -> None:
+    def _trace_batch(self, batch: List[_Request], total: int,
+                     t_collect: Optional[float], t0: float, now: float,
+                     generation: Optional[str],
+                     error: Optional[str] = None) -> Optional[str]:
+        """Record the batch-level spans (one `batch` span linked to its N
+        request spans, plus the `device_dispatch` child) and each sampled
+        member's `queue_wait` span. Returns a ``span:<id>`` trace ref for
+        the resilience events this dispatch may trigger, or None when
+        tracing is off — the whole method is behind ONE branch."""
+        tr = self.tracer
+        if tr is None or not tr.enabled:
+            return None
+        name = getattr(self.engine, "name", "model")
+        worker = threading.current_thread().name
+        bid = tr.new_id()
+        traced = [r for r in batch if r.trace is not None]
+        for r in traced:
+            tr.add("queue_wait", "serve", int(r.t_submit * 1e9),
+                   int((t0 - r.t_submit) * 1e9),
+                   args={"request_id": r.trace.request_id, "batch": bid,
+                         "model": name}, tid=worker)
+        args = {"model": name,
+                "bucket": pick_bucket(total, self.engine.buckets),
+                "generation": generation or "live", "worker": worker,
+                "n_real": total, "n_requests": len(batch),
+                "requests": [r.trace.request_id for r in traced]}
+        if error is not None:
+            args["error"] = error
+        t_batch = t_collect if t_collect is not None else batch[0].t_submit
+        tr.add("batch", "serve", int(t_batch * 1e9),
+               int((now - t_batch) * 1e9), args=args, span_id=bid,
+               tid=worker)
+        tr.add("device_dispatch", "serve", int(t0 * 1e9),
+               int((now - t0) * 1e9),
+               args={"model": name, "batch": bid,
+                     "generation": generation or "live"}, tid=worker)
+        return f"span:{bid}"
+
+    def _observe(self, generation, latencies, dispatch_s, error,
+                 trace_ref: Optional[str] = None) -> None:
         observer = self.observer
         if observer is None:
             return
@@ -452,7 +512,8 @@ class DynamicBatcher:
                     seq = self._observer_error_seq
             if fresh:
                 log_resilience_event(self.logger, seq,
-                                     {"serve_observer_error": 1.0})
+                                     {"serve_observer_error": 1.0},
+                                     trace_ref=trace_ref)
                 print(f"[serve:{getattr(self.engine, 'name', 'model')}] "
                       f"batch observer raised {type(e).__name__}: {e} "
                       f"(suppressed; counted on metrics, further repeats "

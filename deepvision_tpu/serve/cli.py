@@ -141,6 +141,17 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECS",
                    help="circuit breaker: seconds an open circuit waits "
                         "before admitting one half-open probe (default 5)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   metavar="FRAC",
+                   help="per-request span sampling rate behind GET /trace "
+                        "(default: DEEPVISION_TRACE_SAMPLE env or 0.1). "
+                        "Requests carrying an explicit X-Request-Id header "
+                        "are ALWAYS sampled — the request you are chasing "
+                        "leaves its spans (docs/OBSERVABILITY.md)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable span tracing entirely: GET /trace serves "
+                        "an empty ring and the request path pays a single "
+                        "branch")
     p.add_argument("--port", type=int, default=8700)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--flush-every", type=float, default=10.0,
@@ -307,6 +318,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.breaker_cooldown <= 0:
         parser.error(f"--breaker-cooldown must be > 0, got "
                      f"{args.breaker_cooldown}")
+    if args.trace_sample is not None and not 0.0 <= args.trace_sample <= 1.0:
+        parser.error(f"--trace-sample must be in [0, 1], got "
+                     f"{args.trace_sample}")
 
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
@@ -356,7 +370,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         canary_window_s=args.canary_window,
         max_workers=args.max_workers,
         autoscale_every_s=args.autoscale_every,
-        default_deadline_s=args.deadline_ms / 1000.0)
+        default_deadline_s=args.deadline_ms / 1000.0,
+        trace=not args.no_trace,
+        trace_sample=args.trace_sample)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

@@ -90,7 +90,8 @@ class ServedModel:
     def breaker(self) -> Optional[CircuitBreaker]:
         return self.batcher.breaker
 
-    def submit(self, images, *, deadline_s: Optional[float] = None):
+    def submit(self, images, *, deadline_s: Optional[float] = None,
+               trace=None):
         """Route one request into this model's batcher, tagged with the
         generation the promotion controller picks (the canary fraction
         runs on the staged candidate while one is in flight; everything
@@ -99,10 +100,12 @@ class ServedModel:
         canary routing cannot be bypassed by one of them. `deadline_s`
         feeds admission control (None = the batcher's configured default);
         the breaker's fail-fast and the deadline refusal both raise from
-        here, BEFORE anything is queued."""
+        here, BEFORE anything is queued. `trace` is a sampled request's
+        TraceContext (obs/trace.py) — the dispatcher records its queue
+        wait and links it to the batch that serves it."""
         generation = self.promoter.route() if self.promoter else None
         return self.batcher.submit(images, generation=generation,
-                                   deadline_s=deadline_s)
+                                   deadline_s=deadline_s, trace=trace)
 
     def describe(self) -> dict:
         """The /healthz per-model record: serving shape + weight

@@ -11,6 +11,14 @@ The numbers that matter (docs/SERVING.md):
   The healthy contract is p99 <= max_delay_ms + one max-bucket compute time;
   p99 far above it means overload (queueing), far below p50 ~= max_delay
   means the deadline is doing nothing (traffic always fills batches).
+- `p50_queue_ms` / `p99_queue_ms` / `mean_queue_wait_ms` vs
+  `mean_dispatch_ms`: latency SPLIT into its two components — time spent
+  waiting for a batch slot (coalescing + backlog) vs time inside the
+  device dispatch. The p99 bound above conflates them; when it is blown,
+  this split says whether the cure is workers/shedding (queue-dominated)
+  or a smaller bucket/model (dispatch-dominated). Both components also
+  feed fixed-bucket lifetime histograms (`histograms()`) rendered on
+  `GET /metrics` as Prometheus histograms (docs/OBSERVABILITY.md).
 - `padding_waste`: fraction of dispatched device rows that were padding —
   the price of shape bucketing. High waste at low traffic is fine (the
   rows are free when the chip is idle); high waste at HIGH traffic means
@@ -23,10 +31,47 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Optional, Sequence
 
 import numpy as np
+
+# fixed histogram buckets (seconds): spans 1ms (the coalescing floor) to
+# 10s (the serve CLI's default deadline); values past the last edge land in
+# the implicit +Inf bucket. Fixed — not adaptive — so scrapes from every
+# replica aggregate, the whole point of exposition histograms.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Histogram:
+    """Lifetime fixed-bucket histogram (Prometheus semantics): per-bucket
+    counts plus sum/count, NEVER reset — rendered cumulatively with a +Inf
+    bucket by `render()`. Callers hold the owning ServingMetrics lock."""
+
+    __slots__ = ("edges", "counts", "sum", "count")
+
+    def __init__(self, edges: Sequence[float] = LATENCY_BUCKETS_S):
+        self.edges = tuple(edges)
+        self.counts = [0] * (len(self.edges) + 1)   # last = > max edge
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(self.edges, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def render(self) -> dict:
+        """{"buckets": [(le, cumulative_count), ..., (inf, count)],
+        "sum": float, "count": int} — the exposition shape."""
+        cum, buckets = 0, []
+        for le, n in zip(self.edges, self.counts):
+            cum += n
+            buckets.append((le, cum))
+        buckets.append((float("inf"), self.count))
+        return {"buckets": buckets, "sum": self.sum, "count": self.count}
 
 
 class ServingMetrics:
@@ -47,11 +92,18 @@ class ServingMetrics:
                         "admission_rejected": 0, "deadline_expired": 0,
                         "breaker_rejected": 0, "dispatch_errors": 0,
                         "observer_errors": 0}
+        # lifetime fixed-bucket histograms (never reset — /metrics renders
+        # them as Prometheus histograms, which must be monotone per scrape)
+        self._hist = {"request_latency_seconds": _Histogram(),
+                      "queue_wait_seconds": _Histogram(),
+                      "dispatch_seconds": _Histogram()}
         self._reset_locked(time.monotonic())
 
     def _reset_locked(self, now: float) -> None:
         self._t0 = now
         self._lat: deque = deque(maxlen=self._window)
+        self._qwait: deque = deque(maxlen=self._window)
+        self._queue_wait_s = 0.0
         self._requests = 0
         self._examples = 0
         self._batches = 0
@@ -67,7 +119,14 @@ class ServingMetrics:
         self._observer_errors = 0      # per-batch observer tap exceptions
 
     def observe_batch(self, *, n_real: int, bucket: int, dispatch_s: float,
-                      request_latencies_s: Sequence[float]) -> None:
+                      request_latencies_s: Sequence[float],
+                      queue_waits_s: Optional[Sequence[float]] = None
+                      ) -> None:
+        """One dispatched batch. `queue_waits_s` (per request, submit
+        acceptance -> dispatch start) separates the queueing component of
+        latency from `dispatch_s` (the device's share) — the two used to be
+        conflated inside the submit->result latencies, leaving the p99
+        bound unable to say WHERE a blown deadline went."""
         with self._lock:
             self._requests += len(request_latencies_s)
             self._examples += n_real
@@ -77,6 +136,14 @@ class ServingMetrics:
             self._lat.extend(request_latencies_s)
             self._totals["requests"] += len(request_latencies_s)
             self._totals["examples"] += n_real
+            self._hist["dispatch_seconds"].observe(dispatch_s)
+            for lat in request_latencies_s:
+                self._hist["request_latency_seconds"].observe(lat)
+            if queue_waits_s is not None:
+                self._qwait.extend(queue_waits_s)
+                for qw in queue_waits_s:
+                    self._queue_wait_s += qw
+                    self._hist["queue_wait_seconds"].observe(qw)
 
     def observe_shed(self, n_requests: int = 1) -> None:
         """Count a request rejected by backpressure (`Overloaded`, HTTP
@@ -126,6 +193,13 @@ class ServingMetrics:
         with self._lock:
             return dict(self._totals)
 
+    def histograms(self) -> dict:
+        """Lifetime latency/queue-wait/dispatch histograms in exposition
+        shape ({name: {"buckets": [(le, cum)], "sum", "count"}}) — rendered
+        on `GET /metrics`; never reset, so scrapes are monotone."""
+        with self._lock:
+            return {name: h.render() for name, h in self._hist.items()}
+
     def snapshot(self, queue_depth: Optional[int] = None,
                  reset: bool = False) -> dict:
         """Metric dict (floats only — MetricsLogger-ready). `reset=True`
@@ -145,6 +219,11 @@ class ServingMetrics:
                                   if self._rows else 0.0),
                 "mean_dispatch_ms": (1000.0 * self._dispatch_s / self._batches
                                      if self._batches else 0.0),
+                # queueing share of latency (submit accept -> dispatch
+                # start), distinct from the device's mean_dispatch_ms
+                "mean_queue_wait_ms": (1000.0 * self._queue_wait_s
+                                       / self._requests
+                                       if self._requests else 0.0),
                 "shed_requests": float(self._shed),
                 "admission_rejected": float(self._admission_rejected),
                 "deadline_expired": float(self._deadline_expired),
@@ -156,6 +235,10 @@ class ServingMetrics:
                 lat_ms = np.asarray(self._lat, np.float64) * 1000.0
                 out["p50_ms"] = float(np.percentile(lat_ms, 50))
                 out["p99_ms"] = float(np.percentile(lat_ms, 99))
+            if self._qwait:
+                qw_ms = np.asarray(self._qwait, np.float64) * 1000.0
+                out["p50_queue_ms"] = float(np.percentile(qw_ms, 50))
+                out["p99_queue_ms"] = float(np.percentile(qw_ms, 99))
             if queue_depth is not None:
                 out["queue_depth"] = float(queue_depth)
             if reset:

@@ -29,6 +29,24 @@ threads are exactly the concurrency the micro-batchers coalesce) over a
                                decisions, and breaker state — diff across
                                replicas to audit a fleet for weight skew
     GET  /stats[/<model>]   -> 200 per-model ServingMetrics snapshot(s)
+    GET  /metrics           -> 200 Prometheus text exposition (0.0.4):
+                               lifetime counters, queue-depth/worker/breaker
+                               gauges, fixed-bucket latency histograms —
+                               `model`-labeled, monotone across scrapes
+                               (docs/OBSERVABILITY.md)
+    GET  /trace[?secs=N]    -> 200 Chrome trace-event JSON of the recent
+                               span ring (last N seconds; default all) —
+                               load in Perfetto to follow one request
+                               admission -> queue -> batch -> dispatch ->
+                               response
+
+Request ids: every request gets one — the client's `X-Request-Id` header
+when present, a generated id otherwise — echoed in EVERY response
+(200/400/429/503/504), stamped into the request's spans, and carried on
+any `resilience_*` event the request triggers, so a shed or expiry can be
+joined to the exact spans (and client log line) behind it. Client-supplied
+ids force trace sampling: the request an operator is chasing always
+leaves its spans.
 
 Overload control (docs/SERVING.md "Overload control"): when
 `autoscale_every_s > 0` a control loop samples per-model shed/p99/queue
@@ -54,6 +72,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -61,7 +80,9 @@ import jax
 import numpy as np
 
 from ..core.metrics import MetricsLogger
-from ..core.resilience import GracefulShutdown
+from ..core.resilience import GracefulShutdown, log_resilience_event
+from ..obs.export import chrome_trace, render_prometheus
+from ..obs.trace import Tracer, new_request_id
 from .autoscale import AutoscaleController
 from .batcher import (CircuitOpen, DeadlineExpired, DeadlineUnmeetable,
                       Draining, Overloaded, result_within)
@@ -104,7 +125,10 @@ class InferenceServer:
                  autoscale_every_s: float = 0.0,
                  default_deadline_s: Optional[float] = None,
                  breaker_k: int = 5,
-                 breaker_cooldown_s: float = 5.0):
+                 breaker_cooldown_s: float = 5.0,
+                 trace: bool = True,
+                 trace_sample: Optional[float] = None,
+                 trace_capacity: int = 16384):
         if (engine is None) == (fleet is None):
             raise ValueError("pass exactly one of engine= or fleet=")
         if fleet is None:
@@ -137,11 +161,21 @@ class InferenceServer:
                              warn=lambda msg: print(msg, flush=True))
         self.reloader = WeightReloader(
             fleet, poll_every_s=reload_every_s, logger=self.logger)
+        # end-to-end tracing (obs/trace.py): one tracer behind /trace,
+        # shared by the HTTP handlers (request/admission/response spans)
+        # and every model's dispatcher (queue_wait/batch/dispatch spans).
+        # `trace=False` disables it outright — every producer is behind a
+        # single branch, so the hot path pays ~zero.
+        self.tracer = Tracer(capacity=trace_capacity, sample=trace_sample,
+                             enabled=trace)
+        self._event_lock = threading.Lock()
+        self._event_seq = 0
         # overload-control wiring: every batcher/breaker logs onto the
         # server's resilience_ stream (observer-tap errors, breaker
         # transitions are incident lines, not stderr-only)
         for sm in fleet:
             sm.batcher.logger = self.logger
+            sm.batcher.tracer = self.tracer
             if sm.breaker is not None:
                 sm.breaker.logger = self.logger
         # shed-driven autoscaling (serve/autoscale.py): armed by
@@ -161,6 +195,13 @@ class InferenceServer:
         self.bound_port: Optional[int] = None
 
     # -- metrics -----------------------------------------------------------
+
+    def next_event_step(self) -> int:
+        """Monotone step counter for per-request resilience events (sheds,
+        expiries) logged from concurrent handler threads."""
+        with self._event_lock:
+            self._event_seq += 1
+            return self._event_seq
 
     def flush_metrics(self, echo: bool = True, reset: bool = True) -> dict:
         """Flush one per-interval snapshot per model to the metrics stream;
@@ -248,15 +289,30 @@ def _make_handler(server: InferenceServer):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _json(self, code: int, obj, headers=None) -> None:
-            body = json.dumps(obj).encode()
+        # the request id assigned by the current do_GET/do_POST — echoed
+        # on EVERY response this handler writes, refusals included
+        request_id: Optional[str] = None
+
+        def _assign_request_id(self) -> str:
+            self.request_id = (self.headers.get("X-Request-Id")
+                               or new_request_id())
+            return self.request_id
+
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers=None) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if self.request_id is not None:
+                self.send_header("X-Request-Id", self.request_id)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _json(self, code: int, obj, headers=None) -> None:
+            self._send(code, json.dumps(obj).encode(), "application/json",
+                       headers)
 
         def _resolve(self, root: str):
             """Map `/<root>` or `/<root>/<model>` to a ServedModel; answers
@@ -279,6 +335,22 @@ def _make_handler(server: InferenceServer):
                              "served_models": server.fleet.names()})
 
         def do_GET(self):
+            self._assign_request_id()
+            if self.path == "/metrics":
+                # Prometheus text exposition: counters come from lifetime
+                # stores, so consecutive scrapes are monotone
+                return self._send(
+                    200, render_prometheus(server.fleet).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            if self.path == "/trace" or self.path.startswith("/trace?"):
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    secs = float(q["secs"][0]) if "secs" in q else None
+                except ValueError:
+                    return self._json(400, {"error": "secs must be a "
+                                                     "number"})
+                return self._json(200, chrome_trace(server.tracer, secs))
             if self.path == "/healthz":
                 d = server.fleet.default
                 self._json(200, {
@@ -309,12 +381,44 @@ def _make_handler(server: InferenceServer):
                 self._unknown_path()
 
         def do_POST(self):
+            rid = self._assign_request_id()
             sm = (self._resolve("/predict")
                   if self.path.startswith("/predict") else
                   self._unknown_path())
             if sm is None:
                 return
             t_in = time.monotonic()
+            tracer = server.tracer
+            # sampling decision for this request's spans: a client-supplied
+            # X-Request-Id forces it (the one-request-debugging contract);
+            # ctx is None for unsampled requests — zero spans recorded
+            ctx = tracer.request_context(
+                rid, forced="X-Request-Id" in self.headers)
+
+            def refused(outcome: str, admission: bool = True) -> None:
+                """A request turned away (429/503/504): when sampled, close
+                its span chain and log ONE correlated resilience event, so
+                the shed joins to the exact spans that led to it.
+                `admission=False` for post-acceptance failures (504), whose
+                admission span was already recorded as accepted."""
+                if ctx is None:
+                    return
+                now = time.monotonic()
+                if admission:
+                    tracer.add("admission", "serve", int(t_adm * 1e9),
+                               int((now - t_adm) * 1e9),
+                               args={"request_id": rid, "model": sm.name,
+                                     "outcome": outcome})
+                tracer.add("http_request", "serve", int(t_in * 1e9),
+                           int((now - t_in) * 1e9),
+                           args={"request_id": rid, "model": sm.name,
+                                 "outcome": outcome},
+                           span_id=ctx.root_id)
+                log_resilience_event(
+                    server.logger, server.next_event_step(),
+                    {f"serve_refused_{outcome}": 1.0},
+                    request_id=rid, trace_ref=ctx.trace_ref)
+
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(length) or b"{}")
@@ -337,19 +441,27 @@ def _make_handler(server: InferenceServer):
                 return self._json(400, {
                     "error": f"body must be JSON {{'instances': [...]"
                              f"[, 'deadline_ms': N]}}: {e}"})
+            t_adm = time.monotonic()
             try:
                 # routes through the promotion controller when one is
                 # attached: the canary fraction runs on the candidate
                 # generation, everything else on the live weights.
                 # Admission control, backpressure, and the circuit
                 # breaker all refuse HERE, before anything is queued.
-                fut = sm.submit(x, deadline_s=deadline_s)
+                fut = sm.submit(x, deadline_s=deadline_s, trace=ctx)
+                if ctx is not None:
+                    tracer.add("admission", "serve", int(t_adm * 1e9),
+                               int((time.monotonic() - t_adm) * 1e9),
+                               args={"request_id": rid, "model": sm.name,
+                                     "outcome": "accepted"})
             except Overloaded as e:
+                refused("overloaded")
                 return self._json(429, {"error": str(e)})
             except DeadlineUnmeetable as e:
                 # fast 503: the queue says this deadline cannot be met —
                 # Retry-After tells the client when the backlog should
                 # have cleared
+                refused("deadline_unmeetable")
                 return self._json(
                     503, {"error": str(e), "model": sm.name,
                           "reason": "deadline_unmeetable",
@@ -359,12 +471,14 @@ def _make_handler(server: InferenceServer):
             except CircuitOpen as e:
                 # fail-fast 503 NAMING the model whose dispatch path is
                 # broken — the fleet's other models keep serving
+                refused("circuit_open")
                 return self._json(
                     503, {"error": str(e), "model": e.model,
                           "reason": "circuit_open"},
                     headers={"Retry-After":
                              f"{max(e.retry_after_s, 0.001):.3f}"})
             except Draining as e:
+                refused("draining")
                 return self._json(503, {"error": str(e),
                                         "reason": "draining"})
             except ValueError as e:
@@ -377,13 +491,32 @@ def _make_handler(server: InferenceServer):
                     what=f"predict[{sm.name}]")
             except DeadlineExpired as e:
                 sm.metrics.observe_deadline_expired()
+                refused("deadline_expired", admission=False)
                 return self._json(504, {"error": str(e), "model": sm.name,
                                         "reason": "deadline_expired",
                                         "deadline_ms":
                                             round(deadline_s * 1000.0, 1)})
             except Exception as e:  # noqa: BLE001 — a failed dispatch must
-                return self._json(500, {"error": repr(e)})  # not hang the client
+                refused("dispatch_error", admission=False)  # not hang the
+                return self._json(500, {"error": repr(e)})  # client
+            if ctx is None:
+                return self._json(200, {"predictions":
+                                        jax.tree_util.tree_map(
+                                            lambda a: np.asarray(a).tolist(),
+                                            out)})
+            t_w = time.monotonic()
             self._json(200, {"predictions": jax.tree_util.tree_map(
                 lambda a: np.asarray(a).tolist(), out)})
+            now = time.monotonic()
+            tracer.add("response_write", "serve", int(t_w * 1e9),
+                       int((now - t_w) * 1e9),
+                       args={"request_id": rid, "model": sm.name})
+            # root span last: its chain (admission -> queue_wait -> batch ->
+            # device_dispatch -> response_write) all carries request_id
+            tracer.add("http_request", "serve", int(t_in * 1e9),
+                       int((now - t_in) * 1e9),
+                       args={"request_id": rid, "model": sm.name,
+                             "status": 200},
+                       span_id=ctx.root_id)
 
     return Handler

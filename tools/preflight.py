@@ -43,6 +43,15 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               after K consecutive errors, fail fast, and close through
               a half-open probe — the two loops that keep a traffic
               spike (or a broken dispatch path) from becoming an outage
+  obs         observability (docs/OBSERVABILITY.md): serve a model over
+              HTTP, POST a request with an explicit X-Request-Id and
+              assert the id is echoed, scrape GET /metrics twice (the
+              exposition must validate as Prometheus text format and the
+              counters must advance monotonically), and fetch GET /trace
+              asserting the request's complete span chain (http_request →
+              admission → queue_wait → batch with bucket/generation/worker
+              tags) — the joined picture an operator debugs a 504 with
+              has to exist BEFORE the incident
   segment     dense-prediction family (docs/SEGMENTATION.md): a 2-epoch
               synthetic CPU train must improve mIoU, one H-sharded
               spatial train step on a 2-virtual-device mesh must match
@@ -500,6 +509,97 @@ def check_autoscale(args):
             f"absorbed; breaker opened after 3 faults, probe closed it")
 
 
+@check("obs")
+def check_obs(args):
+    # end-to-end observability (docs/OBSERVABILITY.md): the whole joined
+    # picture — request id echo, Prometheus exposition, span chain — over
+    # the REAL HTTP surface, because that is what an operator will scrape.
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from deepvision_tpu.obs.export import (parse_prometheus_text,
+                                           validate_prometheus_text)
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.server import InferenceServer
+
+    fleet = ModelFleet()
+    fleet.add(PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                        verbose=False), max_delay_ms=5.0)
+    server = InferenceServer(fleet=fleet, flush_every_s=60.0)
+    # serve() off the main thread: the signal handlers degrade to an inert
+    # flag (documented GracefulShutdown behavior); stop() ends it
+    th = threading.Thread(target=server.serve, kwargs={"port": 0},
+                          daemon=True)
+    th.start()
+    try:
+        if not server.ready.wait(120):
+            raise RuntimeError("server did not become ready in 120s")
+        base = f"http://127.0.0.1:{server.bound_port}"
+        x = np.random.RandomState(0).randn(
+            1, *fleet.default.engine.example_shape).astype(np.float32)
+        body = _json.dumps({"instances": x.tolist()}).encode()
+
+        def post():
+            req = urllib.request.Request(
+                base + "/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "preflight-obs"})
+            return urllib.request.urlopen(req, timeout=60)
+
+        resp = post()
+        if resp.headers.get("X-Request-Id") != "preflight-obs":
+            raise RuntimeError(
+                f"X-Request-Id not echoed: {resp.headers.get('X-Request-Id')!r}")
+        m1 = urllib.request.urlopen(base + "/metrics",
+                                    timeout=60).read().decode()
+        errors = validate_prometheus_text(m1)
+        if errors:
+            raise RuntimeError(f"/metrics failed Prometheus validation: "
+                               f"{errors[:3]}")
+        post()
+        m2 = urllib.request.urlopen(base + "/metrics",
+                                    timeout=60).read().decode()
+        p1, p2 = parse_prometheus_text(m1), parse_prometheus_text(m2)
+        key = ("deepvision_serve_requests_total", (("model", "lenet5"),))
+        if not p2.get(key, 0) > p1.get(key, 0):
+            raise RuntimeError(f"requests_total did not advance between "
+                               f"scrapes: {p1.get(key)} -> {p2.get(key)}")
+        regressed = [k for k, v in p1.items()
+                     if k[0].endswith("_total") and p2.get(k, v) < v]
+        if regressed:
+            raise RuntimeError(f"counters regressed across scrapes: "
+                               f"{regressed[:3]}")
+        trace = _json.load(urllib.request.urlopen(base + "/trace",
+                                                  timeout=60))
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        mine = [e for e in spans
+                if e["args"].get("request_id") == "preflight-obs"]
+        chain = {e["name"] for e in mine}
+        need = {"http_request", "admission", "queue_wait", "response_write"}
+        if not need <= chain:
+            raise RuntimeError(f"incomplete request span chain: have "
+                               f"{sorted(chain)}, need {sorted(need)}")
+        qw = next(e for e in mine if e["name"] == "queue_wait")
+        batch = next((e for e in spans if e["name"] == "batch"
+                      and e["args"].get("span_id") == qw["args"]["batch"]),
+                     None)
+        if batch is None or not {"bucket", "generation",
+                                 "worker"} <= set(batch["args"]):
+            raise RuntimeError(f"queue_wait not linked to a tagged batch "
+                               f"span: {batch}")
+    finally:
+        server.stop()
+        th.join(timeout=60)
+        server.close()
+    return (f"X-Request-Id echoed; /metrics valid + counters advanced "
+            f"({int(p1.get(key, 0))}->{int(p2.get(key, 0))}); span chain "
+            f"complete, batch tagged bucket={batch['args']['bucket']}")
+
+
 @check("segment")
 def check_segment(args):
     # the dense-prediction family end to end (docs/SEGMENTATION.md): a
@@ -908,6 +1008,7 @@ def main(argv=None):
     check_fleet(args)
     check_promote(args)
     check_autoscale(args)
+    check_obs(args)
     check_segment(args)
     check_devices(args)
     check_input(args)
