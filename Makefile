@@ -11,9 +11,9 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
 
 .PHONY: test test-all verify bench bench-serve bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
-        bench-input dryrun smoke seg-smoke serve-smoke serve-fleet-smoke \
-        preflight preflight-record lint lint-changed fsck check \
-        check-update-cost reshard-parity
+        bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
+        serve-fleet-smoke preflight preflight-record lint lint-changed \
+        fsck check check-update-cost reshard-parity
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
 	## dtype-policy / sharding hazards (docs/LINTING.md) over the whole
@@ -102,6 +102,14 @@ bench-input: ## input pipeline end-to-end: uint8 + device-augment vs the
 	## host-f32 transform path — images/sec and bytes-to-device per
 	## batch (one JSON line; docs/INPUT_PIPELINE.md)
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_input.py
+
+bench-epoch: ## dispatch amortization: per-step vs steps_per_dispatch=k vs
+	## whole-epoch on-device scan — steps/sec and dispatches/epoch at
+	## all three dispatch counts, loss-trajectory parity gated at the
+	## 2e-5 fusion bound, zero recompiles across epochs, and the
+	## double-buffered staging overlap proof (one JSON line, exit 1 on
+	## any gate; docs/INPUT_PIPELINE.md "On-device epochs")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_epoch.py
 
 serve-smoke: ## serving-stack smoke: bucketed AOT cache, micro-batcher,
 	## metrics, graceful drain — synthetic load, exit 0 on pass

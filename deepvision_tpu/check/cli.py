@@ -44,13 +44,16 @@ def audit(names: Optional[Sequence[str]] = None,
     # re-emit its verdicts under the alias's unit names. The sweep still
     # reports one unit set PER REGISTERED NAME (the registry-hygiene
     # non-vacuity contract); it just doesn't pay for the same jaxpr twice.
-    # "spatial" is a pseudo-target: just the collective probes (they are
-    # part of every full sweep; naming them audits the spatial layer alone)
+    # "spatial" / "epoch" are pseudo-targets: the collective probes and the
+    # epoch-scan units (both part of every full sweep; naming one audits
+    # that layer alone)
+    full_sweep = not names
     spatial_only = bool(names) and "spatial" in names
-    if spatial_only:
-        names = [n for n in names if n != "spatial"]
+    epoch_only = bool(names) and "epoch" in names
+    if spatial_only or epoch_only:
+        names = [n for n in names if n not in ("spatial", "epoch")]
     requested = (list(names) if names
-                 else ([] if spatial_only else CONFIGS.names()))
+                 else ([] if spatial_only or epoch_only else CONFIGS.names()))
     canonical: dict = {}     # config-identity -> first name seen
     alias_of: dict = {}      # alias name -> canonical name
     for n in requested:
@@ -72,7 +75,8 @@ def audit(names: Optional[Sequence[str]] = None,
     by_config: dict = {}     # canonical config -> [(unit suffix, findings,
     #                           cost)] for alias re-emission
     for unit in build_units(sweep_names, progress=progress,
-                            spatial=spatial_only or not names):
+                            spatial=full_sweep or spatial_only,
+                            epoch=full_sweep or epoch_only):
         audited.append(unit.name)
         if unit.skipped:
             skipped[unit.name] = unit.skipped
@@ -212,10 +216,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return EXIT_USAGE
 
     from ..configs import CONFIGS
-    bad = [n for n in args.configs if n not in CONFIGS and n != "spatial"]
+    bad = [n for n in args.configs
+           if n not in CONFIGS and n not in ("spatial", "epoch")]
     if bad:
         print(f"usage error: unknown config(s): {', '.join(bad)}; known: "
-              f"spatial, {', '.join(CONFIGS.names())}", file=sys.stderr)
+              f"spatial, epoch, {', '.join(CONFIGS.names())}",
+              file=sys.stderr)
         return EXIT_USAGE
     if args.update_cost and args.configs:
         print("usage error: --update-cost rewrites the whole-registry "

@@ -144,7 +144,7 @@ def _classification_units(name, cfg) -> List[TracedUnit]:
         compute_dtype=dt, mesh=None, remat=cfg.remat,
         mixup_alpha=cfg.mixup_alpha, cutmix_alpha=cfg.cutmix_alpha,
         input_norm=input_norm, log_grad_norm=cfg.log_grad_norm,
-        donate=cfg.steps_per_dispatch == 1)
+        donate=cfg.donate_step())
     closed, donated, outs = _trace(step, state, images, labels, rng)
     units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
                             outs, dict(getattr(step, "_jaxvet", {})),
@@ -180,7 +180,7 @@ def _detection_units(name, cfg) -> List[TracedUnit]:
     step = det.make_yolo_train_step(
         num_classes=cfg.data.num_classes, grid_sizes=grids, compute_dtype=dt,
         mesh=None, remat=cfg.remat, input_norm=input_norm,
-        log_grad_norm=cfg.log_grad_norm, donate=cfg.steps_per_dispatch == 1)
+        log_grad_norm=cfg.log_grad_norm, donate=cfg.donate_step())
     closed, donated, outs = _trace(step, state, images, boxes, classes,
                                    valid, rng)
     units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
@@ -222,7 +222,7 @@ def _pose_units(name, cfg) -> List[TracedUnit]:
     step = pose_lib.make_pose_train_step(
         heatmap_size=hm, compute_dtype=dt, mesh=None, remat=cfg.remat,
         input_norm=input_norm, log_grad_norm=cfg.log_grad_norm,
-        donate=cfg.steps_per_dispatch == 1)
+        donate=cfg.donate_step())
     closed, donated, outs = _trace(step, state, images, kp, kp, kp, rng)
     units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
                             outs, dict(getattr(step, "_jaxvet", {})),
@@ -257,7 +257,7 @@ def _centernet_units(name, cfg) -> List[TracedUnit]:
     step = cn.make_centernet_train_step(
         num_classes=cfg.data.num_classes, grid=grid, compute_dtype=dt,
         mesh=None, remat=cfg.remat, input_norm=input_norm,
-        log_grad_norm=cfg.log_grad_norm, donate=cfg.steps_per_dispatch == 1)
+        log_grad_norm=cfg.log_grad_norm, donate=cfg.donate_step())
     closed, donated, outs = _trace(step, state, images, boxes, classes,
                                    valid, rng)
     units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
@@ -299,7 +299,7 @@ def _segmentation_units(name, cfg) -> List[TracedUnit]:
     step = seg_lib.make_segmentation_train_step(
         num_classes=cfg.data.num_classes, compute_dtype=dt, mesh=None,
         remat=cfg.remat, input_norm=input_norm, dice_weight=dice,
-        log_grad_norm=cfg.log_grad_norm, donate=cfg.steps_per_dispatch == 1)
+        log_grad_norm=cfg.log_grad_norm, donate=cfg.donate_step())
     closed, donated, outs = _trace(step, state, images, masks, rng)
     units.append(TracedUnit(f"{name}/train", name, "train", closed, donated,
                             outs, dict(getattr(step, "_jaxvet", {})),
@@ -447,6 +447,77 @@ def _serve_unit(name, cfg) -> TracedUnit:
         meta={"donate": False, "compute_dtype": dt, "kind": "predict"})
 
 
+# -- whole-epoch scan units ---------------------------------------------------
+
+# The epoch-scan wrapper (core/steps.make_epoch_train_step) audited over one
+# classification and one segmentation inner step — the two families the
+# on-device epoch path ships for first (the paired-augment RNG contract
+# rides inside the scanned step). Fixed scan length: the COST rows scale
+# linearly with it (scan bodies are trip-weighted), so the baseline stays a
+# pure function of the package source.
+EPOCH_UNIT_CONFIGS = ("lenet5", "unet_synthetic")
+EPOCH_SCAN_LEN = 4
+
+
+def epoch_unit_names() -> List[str]:
+    """The audit units the epoch-scan probes contribute — pinned by the
+    cost-baseline coverage test next to the per-config unit names."""
+    return [f"epoch/{name}" for name in EPOCH_UNIT_CONFIGS]
+
+
+def _epoch_scan_units() -> List[TracedUnit]:
+    """Trace the scanned epoch step abstractly: the outer jit must donate
+    the state (and ONLY the state — the resident epoch arrays are reused
+    every epoch), carry no explicit collectives, honor the inner step's
+    dtype policy through the scan body, and its cost row (scan-length-
+    weighted) lands in CHECK_COST.json like any other step's."""
+    from ..configs import get_config
+    from ..core import segment as seg_lib
+    from ..core import steps as steps_lib
+
+    units: List[TracedUnit] = []
+    for cname in EPOCH_UNIT_CONFIGS:
+        name = f"epoch/{cname}"
+        try:
+            cfg = get_config(cname)
+            model, cfg, images, input_norm = _family_setup(cfg)
+            dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+            tx = _optimizer_for(cfg)
+            state = _abstract_state(model, tx, images,
+                                    ema=bool(cfg.ema_decay))
+            b, sz = AUDIT_BATCH, cfg.data.image_size
+            ep_images = S((EPOCH_SCAN_LEN, *images.shape), images.dtype)
+            if cfg.family == "segmentation":
+                inner = seg_lib.make_segmentation_train_step(
+                    num_classes=cfg.data.num_classes, compute_dtype=dt,
+                    mesh=None, input_norm=input_norm,
+                    dice_weight=seg_lib.dice_weight_for(cfg),
+                    log_grad_norm=cfg.log_grad_norm, donate=False)
+                batch_args = (ep_images,
+                              S((EPOCH_SCAN_LEN, b, sz, sz), jnp.int32))
+            else:
+                inner = steps_lib.make_classification_train_step(
+                    label_smoothing=cfg.label_smoothing,
+                    aux_weight=cfg.aux_loss_weight, compute_dtype=dt,
+                    mesh=None, input_norm=input_norm,
+                    log_grad_norm=cfg.log_grad_norm, donate=False)
+                batch_args = (ep_images,
+                              S((EPOCH_SCAN_LEN, b), jnp.int32))
+            step = steps_lib.make_epoch_train_step(
+                inner, len(batch_args), mesh=None,
+                ema_decay=cfg.ema_decay, shuffle=True)
+            closed, donated, outs = _trace(step, state, *batch_args,
+                                           S((2,), jnp.uint32))
+            units.append(TracedUnit(
+                name, "", "train", closed, donated, outs,
+                dict(getattr(step, "_jaxvet", {})),
+                head_dims=_head_dims(cfg)))
+        except Exception as e:
+            units.append(TracedUnit(name, "", "train",
+                                    error=f"{type(e).__name__}: {e}"))
+    return units
+
+
 # -- spatial collective probes ------------------------------------------------
 
 def _spatial_probe_units() -> List[TracedUnit]:
@@ -579,11 +650,12 @@ def config_unit_names(name: str) -> List[str]:
 
 def build_units(names: Optional[List[str]] = None,
                 progress: Optional[Callable[[str], None]] = None,
-                spatial: bool = True):
+                spatial: bool = True, epoch: bool = True):
     """Yield TracedUnits for the named configs (default: whole registry,
-    plus the spatial collective probes). Each unit's jaxpr is yielded and
-    then released by the caller — keeping the sweep's live set bounded is
-    what holds the whole-registry wall time under the CI budget."""
+    plus the spatial collective probes and the epoch-scan units). Each
+    unit's jaxpr is yielded and then released by the caller — keeping the
+    sweep's live set bounded is what holds the whole-registry wall time
+    under the CI budget."""
     from ..configs import CONFIGS
 
     config_names = CONFIGS.names() if names is None else names
@@ -618,3 +690,7 @@ def build_units(names: Optional[List[str]] = None,
     if spatial:
         for u in _spatial_probe_units():
             yield u
+    if epoch:
+        for u in _epoch_scan_units():
+            yield u
+        gc.collect()

@@ -169,6 +169,17 @@ def build_parser(family: str, models: Sequence[str]) -> argparse.ArgumentParser:
     p.add_argument("--prefetch-batches", type=_positive_int, default=None,
                    help="stage this many training batches ahead on device "
                         "from a producer thread (default 2; 1 disables)")
+    p.add_argument("--epoch-on-device", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="whole-epoch on-device training: stage the full "
+                        "epoch device-resident once and run ONE lax.scan "
+                        "dispatch per epoch — zero host round-trips (the "
+                        "endpoint of the --steps-per-dispatch axis). In-"
+                        "memory datasets only (synthetic/mnist/digits/"
+                        "seg scenes); per-epoch reshuffle happens on device "
+                        "folded from (seed, epoch); an epoch that exceeds "
+                        "the HBM budget falls back to the staged path with "
+                        "a named warning (docs/INPUT_PIPELINE.md)")
     p.add_argument("--eval-only", action="store_true",
                    help="restore (-c/--auto-resume) and run validation once; "
                         "no training")
@@ -409,6 +420,8 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         cfg = cfg.replace(steps_per_dispatch=args.steps_per_dispatch)
     if args.prefetch_batches:
         cfg = cfg.replace(prefetch_batches=args.prefetch_batches)
+    if getattr(args, "epoch_on_device", None) is not None:
+        cfg = cfg.replace(epoch_on_device=args.epoch_on_device)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
     if args.resume:
@@ -438,6 +451,26 @@ def _run(family: str, models: Sequence[str], trainer_factory: Callable,
         if synthetic_image_size:
             synth["image_size"] = synthetic_image_size
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, **synth))
+    if cfg.epoch_on_device:
+        # the cache stages ONE epoch device-resident and replays it — only
+        # the in-memory datasets are epoch-stationary and HBM-plausible;
+        # the streaming pipelines keep the double-buffered staged default
+        cacheable = {"synthetic", "seg_synthetic", "mnist", "digits",
+                     "digits_seg", "digits_detect"}
+        if cfg.data.dataset not in cacheable:
+            raise SystemExit(
+                f"--epoch-on-device caches one epoch device-resident and "
+                f"needs an in-memory, epoch-stationary dataset "
+                f"({', '.join(sorted(cacheable))}); dataset="
+                f"{cfg.data.dataset!r} streams from disk — use the default "
+                f"double-buffered staged path (--prefetch-batches) there")
+        if cfg.data.dataset in ("digits_seg", "digits_detect"):
+            # these pipelines re-COMPOSE scenes each epoch; under the cache
+            # that becomes "epoch 1's scenes, device-reshuffled" — say so
+            print(f"[{cfg.name}] --epoch-on-device: {cfg.data.dataset} "
+                  f"normally re-composes scenes per epoch; the cache "
+                  f"replays epoch 1's scenes with a device-side (seed, "
+                  f"epoch) reshuffle instead", flush=True)
     workdir = args.workdir or os.path.join("runs", cfg.name)
 
     trainer = trainer_factory(cfg, workdir)

@@ -117,13 +117,13 @@ class CenterNetTrainer(LossWatchedTrainer):
                     input_norm=input_norm,
                     log_grad_norm=config.log_grad_norm,
                     remat=config.remat,
-                    donate=config.steps_per_dispatch == 1))
+                    donate=config.donate_step()))
         else:
             self._step_factory = lambda m, corr: make_centernet_train_step(
                 num_classes=config.data.num_classes, grid=grid,
                 compute_dtype=compute_dtype, mesh=m, remat=config.remat,
                 input_norm=input_norm, log_grad_norm=config.log_grad_norm,
-                donate=config.steps_per_dispatch == 1, grad_correction=corr)
+                donate=config.donate_step(), grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_centernet_eval_step(
             num_classes=config.data.num_classes, grid=grid,

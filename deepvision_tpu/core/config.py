@@ -236,6 +236,31 @@ class TrainConfig:
     # would desync the EMA/accumulation alignment). HBM cost: k staged
     # batches per dispatch.
     steps_per_dispatch: int = 1
+    # Whole-epoch on-device training (data/device_cache.py +
+    # steps.make_epoch_train_step): stage the full epoch device-resident
+    # once and run ONE lax.scan dispatch per epoch — zero host round-trips,
+    # the endpoint of the dispatch-amortization axis steps_per_dispatch
+    # starts (r05 showed dispatch, not FLOPs, is the off-chip lever).
+    # Requires epoch-stationary data (the cache replays the first epoch's
+    # stream; per-epoch variety comes from epoch_shuffle + the per-(seed,
+    # step) augment draws); datasets that don't fit the HBM budget fall
+    # back to the staged path with a named EpochCacheOverflowWarning.
+    # Checkpoint/metrics flushes happen at the scan boundary (one host sync
+    # per epoch). Incompatible with steps_per_dispatch > 1 (pick one lever)
+    # and accum_steps > 1. CLI: --epoch-on-device; docs/INPUT_PIPELINE.md.
+    epoch_on_device: bool = False
+    # Per-epoch reshuffle for the on-device epoch: a device-side permutation
+    # of the example axis folded from (seed, epoch) — the deterministic
+    # replacement for the host pipelines' reshuffle, reproducible across
+    # resumes. Off = replay the cached order every epoch (parity testing).
+    epoch_shuffle: bool = True
+
+    def donate_step(self) -> bool:
+        """Whether a family's single train step may donate its state: only
+        when the step IS the dispatch unit. Under steps_per_dispatch > 1 or
+        the whole-epoch scan the wrapper donates at the outer jit instead —
+        inner donation cannot apply inside the scanned trace."""
+        return self.steps_per_dispatch == 1 and not self.epoch_on_device
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -156,13 +156,13 @@ class PoseTrainer(LossWatchedTrainer):
                     input_norm=input_norm,
                     log_grad_norm=config.log_grad_norm,
                     remat=config.remat,
-                    donate=config.steps_per_dispatch == 1))
+                    donate=config.donate_step()))
         else:
             self._step_factory = lambda m, corr: make_pose_train_step(
                 heatmap_size=hm, compute_dtype=compute_dtype, mesh=m,
                 remat=config.remat, input_norm=input_norm,
                 log_grad_norm=config.log_grad_norm,
-                donate=config.steps_per_dispatch == 1, grad_correction=corr)
+                donate=config.donate_step(), grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_pose_eval_step(
             heatmap_size=hm, compute_dtype=compute_dtype, mesh=self.mesh,

@@ -265,7 +265,7 @@ class SegmentationTrainer(Trainer):
                     dice_weight=dice_weight,
                     log_grad_norm=config.log_grad_norm,
                     remat=config.remat,
-                    donate=config.steps_per_dispatch == 1))
+                    donate=config.donate_step()))
         else:
             self._step_factory = (
                 lambda m, corr: make_segmentation_train_step(
@@ -275,7 +275,7 @@ class SegmentationTrainer(Trainer):
                     device_augment=self._train_augment,
                     dice_weight=dice_weight,
                     log_grad_norm=config.log_grad_norm,
-                    donate=config.steps_per_dispatch == 1,
+                    donate=config.donate_step(),
                     grad_correction=corr))
         self.train_step = self._step_factory(self.mesh, None)
         self.eval_step = make_segmentation_eval_step(

@@ -267,6 +267,87 @@ def make_multistep_train_step(step_fn: Callable, k: int, n_batch_args: int,
                          kind="train")
 
 
+# fold_in tag for the per-epoch device-side shuffle permutation. The inner
+# step folds the SAME rng with state.step (and then tags 1/2 for mixup /
+# augment), so any small constant could collide with a real step number —
+# this one is outside any reachable step count.
+EPOCH_SHUFFLE_TAG = 2**31 - 1
+
+
+def make_epoch_train_step(step_fn: Callable, n_batch_args: int,
+                          *, mesh: Optional[Mesh] = None,
+                          ema_decay: Optional[float] = None,
+                          shuffle: bool = False) -> Callable:
+    """Wrap any family's `(state, *batch, rng) -> (state, metrics)` step into
+    `(state, *epoch_arrays, rng)` running a WHOLE EPOCH per host dispatch —
+    `lax.scan` over device-resident data (`data/device_cache.py`), one XLA
+    launch and zero host round-trips per epoch (config.epoch_on_device).
+
+    Each of the `n_batch_args` epoch arrays is `(steps, batch, ...)` —
+    already staged on device, step slices sharded like single batches (the
+    cache's `(None, 'data', ...)` layout). The r05 dispatch grid showed
+    per-dispatch RPC latency collapsing off-chip throughput to 46–66 img/s
+    vs ~2400 on-chip; `steps_per_dispatch` amortizes a handful of steps,
+    this wrapper amortizes all of them.
+
+    `shuffle=True` re-permutes the EXAMPLE axis on device before the scan:
+    `jax.random.permutation` keyed by `fold_in(rng, EPOCH_SHUFFLE_TAG)`.
+    The trainer passes `rng = fold_in(seed_key, epoch)`, so the permutation
+    is a pure function of (seed, epoch) — the device-side replacement for
+    the host pipelines' per-epoch reshuffle, reproducible across resumes.
+    Costs one transient shuffled copy of the epoch in HBM.
+
+    Inner per-step RNG stays correct exactly as in
+    `make_multistep_train_step`: every task step folds `rng` with
+    `state.step`, which advances inside the scan — so augment/mixup draws
+    per (seed, step) are bit-identical to the per-step path (the paired-
+    augment segmentation contract rides along unchanged). Same construction
+    rules too: build `step_fn` with donate=False (its donation cannot apply
+    inside this trace; the wrapper donates the state at the outer jit), and
+    the EMA update runs inside the scan so the averaging cadence matches
+    k=1. The epoch arrays are NOT donated — they are reused every epoch.
+
+    Returns per-step metrics STACKED along a leading `steps` axis (not the
+    mean): the trainer derives the epoch mean from them, and parity tests /
+    bench_epoch.py read the full per-step trajectory."""
+
+    def epoch(state, *args):
+        arrays, rng = args[:-1], args[-1]
+        assert len(arrays) == n_batch_args, (len(arrays), n_batch_args)
+        if shuffle:
+            n_steps, batch = arrays[0].shape[0], arrays[0].shape[1]
+            perm = jax.random.permutation(
+                jax.random.fold_in(rng, EPOCH_SHUFFLE_TAG), n_steps * batch)
+            arrays = tuple(
+                a.reshape(n_steps * batch, *a.shape[2:])[perm]
+                .reshape(a.shape) for a in arrays)
+
+        from flax.core import FrozenDict, freeze
+        frozen_bs = isinstance(state.batch_stats, FrozenDict)
+
+        def body(st, xs):
+            st, metrics = step_fn(st, *xs, rng)
+            if frozen_bs and not isinstance(st.batch_stats, FrozenDict):
+                # same carry-type normalization as the multistep wrapper
+                st = st.replace(batch_stats=freeze(st.batch_stats))
+            if ema_decay is not None:
+                from .train_state import ema_tree_update
+                st = st.replace(ema_params=ema_tree_update(
+                    ema_decay, st.ema_params, st.params))
+            return st, metrics
+
+        state, metrics = jax.lax.scan(body, state, arrays)
+        return state, metrics
+
+    jit_kwargs = {"donate_argnums": (0,)}
+    if mesh is not None:
+        jit_kwargs["out_shardings"] = (None, NamedSharding(mesh, P()))
+    inner = getattr(step_fn, "_jaxvet", {})
+    return annotate_step(jax.jit(epoch, **jit_kwargs), donate=True,
+                         compute_dtype=inner.get("compute_dtype"),
+                         kind="train")
+
+
 def make_classification_eval_step(*, compute_dtype: jnp.dtype = jnp.bfloat16,
                                   mesh: Optional[Mesh] = None,
                                   input_norm: Optional[tuple] = None,

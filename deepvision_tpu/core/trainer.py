@@ -219,6 +219,24 @@ class Trainer:
                 "steps_per_dispatch > 1 is incompatible with accum_steps > 1 "
                 "(the device-side scan would desync the EMA/accumulation "
                 "cadence) — pick one lever")
+        if config.epoch_on_device:
+            if config.steps_per_dispatch > 1:
+                raise ValueError(
+                    "epoch_on_device and steps_per_dispatch > 1 are both "
+                    "dispatch-amortization levers over the same scan — the "
+                    "epoch scan already runs every step in one dispatch; "
+                    "pick one")
+            if accum > 1:
+                raise ValueError(
+                    "epoch_on_device is incompatible with accum_steps > 1 "
+                    "(the epoch scan would desync the EMA/accumulation "
+                    "cadence, same as steps_per_dispatch)")
+            if config.spatial_backend == "shard_map":
+                raise ValueError(
+                    "epoch_on_device does not support "
+                    "spatial_backend='shard_map' yet (scanning the manual-"
+                    "collective step is untested on this jax); use the "
+                    "gspmd backend")
         compute_dtype = jnp.dtype(config.dtype) if config.dtype else jnp.bfloat16
         input_norm = ((config.data.mean, config.data.std)
                       if config.data.normalize_on_device else None)
@@ -273,7 +291,7 @@ class Trainer:
                     compute_dtype=compute_dtype, input_norm=input_norm,
                     log_grad_norm=config.log_grad_norm,
                     remat=config.remat,
-                    donate=config.steps_per_dispatch == 1))
+                    donate=config.donate_step()))
         else:
             self._step_factory = lambda m, corr: steps.make_classification_train_step(
                 label_smoothing=config.label_smoothing, aux_weight=config.aux_loss_weight,
@@ -282,11 +300,27 @@ class Trainer:
                 cutmix_alpha=config.cutmix_alpha, input_norm=input_norm,
                 device_augment=self._train_augment,
                 log_grad_norm=config.log_grad_norm,
-                donate=config.steps_per_dispatch == 1, grad_correction=corr)
+                donate=config.donate_step(), grad_correction=corr)
         self.train_step = self._step_factory(self.mesh, None)
         # steps_per_dispatch > 1: built lazily on first epoch (train_epoch),
         # AFTER subclasses have installed their family's train_step
         self._multi_step = None
+        # whole-epoch on-device path (config.epoch_on_device): the staged
+        # cache, the scanned epoch step (built lazily like _multi_step), and
+        # the sticky HBM-overflow fallback flag — once build_epoch_cache
+        # refuses an epoch, the rest of the run stays on the staged path
+        self._epoch_cache = None
+        self._epoch_step = None
+        self._epoch_fallback = False
+        # host-side count of train dispatches (single steps, k-step scans,
+        # and epoch scans each count 1): surfaces as train_dispatches_total
+        # in the log_every flush so dispatch amortization is visible in
+        # logs without a profiler, and bench_epoch.py reads it
+        self._dispatches_total = 0
+        # snapshot of the prefetcher's transfer ledger at the last staged
+        # epoch's end (the live prefetcher is gone by then) — bench_epoch.py
+        # reads the overlapped fraction from here
+        self.last_prefetch_ledger: dict = {}
         self.eval_step = steps.make_classification_eval_step(
             compute_dtype=compute_dtype, mesh=self.mesh, input_norm=input_norm,
             device_augment=self._eval_augment)
@@ -675,6 +709,102 @@ class Trainer:
 
     # -- loops ------------------------------------------------------------
     def train_epoch(self, epoch: int, data: Iterable) -> dict:
+        """One training epoch. Routes to the whole-epoch on-device scan when
+        `config.epoch_on_device` is set (and the epoch fits HBM — the cache
+        build falls back here with a named warning otherwise); every other
+        configuration runs the staged per-batch loop."""
+        if self.config.epoch_on_device and not self._epoch_fallback:
+            return self._train_epoch_on_device(epoch, data)
+        return self._train_epoch_staged(epoch, data)
+
+    def _train_epoch_on_device(self, epoch: int, data: Iterable) -> dict:
+        """The zero-round-trip epoch (ROADMAP item 2): stage the epoch
+        device-resident once (`data/device_cache.py`), then ONE scanned
+        dispatch per epoch (`steps.make_epoch_train_step`). The metrics
+        fetch and the log flush are pinned to the scan boundary — a single
+        host sync per epoch while the device is idle anyway, so the
+        SYNC001 discipline (no sync in the hot loop) holds trivially: there
+        is no hot host loop left."""
+        from ..data import device_cache
+        cfg = self.config
+        if self._epoch_cache is None:
+            # the first trained epoch's stream IS the cache (the mode's
+            # epoch-stationarity contract); retry/fault wrapping matches
+            # the staged path so flaky storage backs off identically
+            src = resilient_batches(
+                data, self.retry_policy,
+                injector=self.faults if self.faults.active else None,
+                on_retry=self._log_retry)
+            cache, fallback = device_cache.build_epoch_cache(
+                self.mesh, src, shuffle=cfg.epoch_shuffle, name=cfg.name)
+            if cache is None:
+                # named EpochCacheOverflowWarning already emitted; sticky —
+                # the rest of the run trains through the staged path
+                self._epoch_fallback = True
+                return self._train_epoch_staged(epoch, fallback,
+                                                wrapped=True)
+            self._epoch_cache = cache
+            if _is_main_process():
+                print(f"[{cfg.name}] epoch cache: {cache.steps} steps x "
+                      f"{cache.examples_per_step} examples device-resident "
+                      f"({cache.nbytes / 1e6:.1f} MB staged once in "
+                      f"{cache.stage_secs:.2f}s) — 1 dispatch/epoch"
+                      + (", device shuffle per (seed, epoch)"
+                         if cfg.epoch_shuffle else ""), flush=True)
+        cache = self._epoch_cache
+        if self._epoch_step is None:
+            # lazily, like _multi_step: subclasses installed their family's
+            # train_step after the base __init__ ran
+            self._epoch_step = steps.make_epoch_train_step(
+                self.train_step, cache.n_batch_args, mesh=self.mesh,
+                ema_decay=cfg.ema_decay, shuffle=cfg.epoch_shuffle)
+        t0 = time.time()
+        step0 = int(self.state.step)  # device idle between epochs: cheap
+        step_rng = jax.random.fold_in(self.rng, epoch)
+        t_d = time.monotonic_ns()
+        self.state, metrics = self._epoch_step(self.state, *cache.arrays,
+                                               step_rng)
+        jax.block_until_ready(self.state.params)
+        dispatch_ns = time.monotonic_ns() - t_d
+        self._dispatches_total += 1
+        self._host_step = step0 + cache.steps
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        # scan-boundary flush: per-step metrics come back stacked (steps,)
+        host = jax.device_get(metrics)
+        out = {k: float(np.mean(v)) for k, v in host.items()}
+        dt = time.time() - t0
+        n_img = cache.steps * cache.examples_per_step
+        out["images_per_sec"] = n_img / dt if dt > 0 else 0.0
+        if self.tracer is not None:
+            wid = self.tracer.add(
+                "train_window", "train", t_d, dispatch_ns,
+                args={"epoch": epoch, "steps": cache.steps,
+                      **self._prefetch_stats()})
+            self.tracer.add("train_dispatch", "train", t_d, dispatch_ns,
+                            args={"window": wid, "aggregate": True})
+        if _is_main_process():
+            self.logger.log(self._host_step,
+                            {**{k: v for k, v in out.items()
+                                if k != "images_per_sec"},
+                             **self._prefetch_stats()},
+                            epoch=epoch, prefix="train_", echo=True)
+        if cfg.halt_on_nonfinite and not np.isfinite(out.get("loss", 0.0)):
+            if _is_main_process():
+                self.logger.log(self._host_step, out, epoch=epoch,
+                                prefix="epoch_train_")
+            divergence_halt(cfg, self.ckpt, epoch,
+                            f"mean train loss is {out['loss']}")
+        return out
+
+    def _train_epoch_staged(self, epoch: int, data: Iterable,
+                            wrapped: bool = False) -> dict:
+        """The staged per-batch loop: host batches -> double-buffered
+        DevicePrefetcher -> per-step (or k-step scanned) dispatches.
+        `wrapped=True` means `data` already passed through
+        resilient_batches (the epoch-cache overflow fallback hands back a
+        wrapped stream — wrapping twice would double-fire injected
+        faults)."""
         t0 = time.time()
         n_img = 0
         step_rng = jax.random.fold_in(self.rng, epoch)
@@ -704,6 +834,7 @@ class Trainer:
             prev = consumed
             consumed += n_steps
             n_img += n_examples
+            self._dispatches_total += 1  # one host dispatch, whatever its k
             self._host_step = step0 + consumed
             if self._watchdog is not None:
                 self._watchdog.beat()
@@ -755,10 +886,11 @@ class Trainer:
         # The host pull is retry-wrapped (transient OSError from flaky
         # storage backs off instead of killing the epoch) and carries the
         # fault injector's deterministic failures when armed.
-        data = resilient_batches(
-            data, self.retry_policy,
-            injector=self.faults if self.faults.active else None,
-            on_retry=self._log_retry)
+        if not wrapped:
+            data = resilient_batches(
+                data, self.retry_policy,
+                injector=self.faults if self.faults.active else None,
+                on_retry=self._log_retry)
         staged = prefetch_to_device(self.mesh, data,
                                     self.config.prefetch_batches)
         self._prefetcher = staged
@@ -814,6 +946,16 @@ class Trainer:
             # exactly when a recovering driver needs the HBM back)
             group = None
             self._prefetcher = None
+            # final ledger snapshot (the live prefetcher is about to close):
+            # the overlap fraction is the double-buffering proof
+            # bench_epoch.py reports (docs/INPUT_PIPELINE.md)
+            self.last_prefetch_ledger = {
+                "bytes_staged_total": staged.bytes_staged_total,
+                "last_stage_secs": staged.last_stage_secs,
+                "wait_secs_total": staged.wait_secs_total,
+                "first_wait_secs": staged.first_wait_secs,
+                "overlapped_fraction": staged.overlapped_fraction,
+            }
             staged.close()
         jax.block_until_ready(self.state.params)
         if tacc is not None and consumed > tacc[3]:
@@ -953,8 +1095,11 @@ class Trainer:
                 if profiling:
                     jax.profiler.start_trace(profile_dir)
                 try:
-                    train_metrics = self.train_epoch(epoch,
-                                                     train_data_fn(epoch))
+                    # a live epoch cache replays on device — don't make the
+                    # host pipeline build an epoch nobody will read
+                    train_metrics = self.train_epoch(
+                        epoch, () if self._epoch_cache is not None
+                        else train_data_fn(epoch))
                 except TrainingDivergedError:
                     # bounded auto-recovery: roll back to the last committed
                     # checkpoint, scale the LR down, retry the epoch — the
@@ -1126,13 +1271,19 @@ class Trainer:
         device sync): queue depth plus the staged-bytes total and the last
         single-batch staging latency — logged at the log_every cadence so a
         starving pipeline AND the uint8-vs-f32 transfer savings both show up
-        in the metrics stream (parallel/prefetch.py)."""
+        in the metrics stream (parallel/prefetch.py). `dispatches_total`
+        (logged as train_dispatches_total) counts host train dispatches —
+        per-step, k-step-scanned, or one-per-epoch — so dispatch
+        amortization is visible in logs and the bench without a profiler."""
         pf = self._prefetcher
+        out = {"dispatches_total": float(self._dispatches_total)}
         if pf is None:
-            return {"prefetch_queue_depth": 0}
-        return {"prefetch_queue_depth": pf.queue_depth,
-                "prefetch_bytes_staged": float(pf.bytes_staged_total),
-                "prefetch_stage_ms": round(pf.last_stage_secs * 1e3, 3)}
+            out["prefetch_queue_depth"] = 0
+            return out
+        out.update(prefetch_queue_depth=pf.queue_depth,
+                   prefetch_bytes_staged=float(pf.bytes_staged_total),
+                   prefetch_stage_ms=round(pf.last_stage_secs * 1e3, 3))
+        return out
 
     def _watchdog_diagnostics(self) -> dict:
         pf = self._prefetcher

@@ -1,0 +1,190 @@
+"""Device-resident epoch cache for whole-epoch scan training.
+
+The r05 dispatch grid (docs/TUNING.md item 8) showed per-dispatch latency —
+not FLOPs — is the remaining training lever off-chip, and
+`steps_per_dispatch` only amortizes a handful of steps. For datasets that
+fit HBM (synthetic, digits, MNIST, the segmentation scenes) this module
+stages the FULL epoch on device ONCE; `core/steps.make_epoch_train_step`
+then scans the jitted step over the resident slices — one XLA launch and
+zero host round-trips per epoch (`TrainConfig.epoch_on_device`).
+
+Contract: the data must be **epoch-stationary** — the cache stages the
+first trained epoch's stream and replays it; per-epoch variety comes from
+the device-side shuffle (a permutation folded from (seed, epoch), see
+`make_epoch_train_step`) and the per-(seed, step) augment draws, NOT from
+the host pipeline re-running. Datasets that re-compose examples each epoch
+(digits_detect scenes) lose that recomposition under this mode — the CLI
+prints a note where it applies.
+
+Overflow is a fallback, never a crash: `build_epoch_cache` sizes the epoch
+against the HBM budget WHILE collecting and, on overflow (or a ragged
+stream the scan cannot stack), emits the named `EpochCacheOverflowWarning`
+and hands back an iterator replaying the already-pulled batches plus the
+rest of the stream — the caller trains that epoch (and the rest of the
+run) through the default double-buffered staged path
+(`parallel/prefetch.py`) with nothing lost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import warnings
+from typing import Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+
+# Share of the per-device HBM limit the cache may claim when the backend
+# reports one (TPU memory_stats); the rest belongs to params/optimizer
+# state/activations. Overridable for tests and odd hosts via
+# DEEPVISION_EPOCH_CACHE_MAX_BYTES (an absolute TOTAL byte cap).
+HBM_BUDGET_FRACTION = 0.5
+
+
+class EpochCacheOverflowWarning(UserWarning):
+    """The epoch does not fit the device cache (HBM budget exceeded, or the
+    batch stream is ragged/empty and cannot be stacked for the scan);
+    training falls back to the staged per-batch path."""
+
+
+def epoch_sharding(mesh, ndim: int, dim2: Optional[int] = None
+                   ) -> NamedSharding:
+    """Sharding for a stacked `(steps, batch, ...)` epoch array: leading
+    steps axis replicated (scan slices it), the rest laid out exactly like
+    a single staged batch (`mesh_lib.batch_sharding` — batch over 'data',
+    H over 'spatial' where it divides). `dim2` is the per-batch H extent
+    when known."""
+    inner = mesh_lib.batch_sharding(mesh, ndim - 1, dim1=dim2)
+    return NamedSharding(mesh, P(*([None] + list(inner.spec))))
+
+
+def hbm_budget_bytes() -> Optional[int]:
+    """Total byte budget for the cache, or None for unlimited.
+
+    DEEPVISION_EPOCH_CACHE_MAX_BYTES wins when set. Otherwise, when the
+    backend reports a per-device `bytes_limit` (TPU), the budget is
+    HBM_BUDGET_FRACTION of the limit summed over local devices — the cache
+    shards its batch axis over 'data', so the total is what competes with
+    HBM. CPU backends report no limit: unlimited (host RAM is the real
+    ceiling there, and the staged fallback saves nothing of it)."""
+    env = os.environ.get("DEEPVISION_EPOCH_CACHE_MAX_BYTES")
+    if env:
+        return int(float(env))
+    try:
+        devices = jax.local_devices()
+        stats = devices[0].memory_stats() or {}
+    except Exception:
+        return None
+    limit = stats.get("bytes_limit")
+    if not limit:
+        return None
+    return int(HBM_BUDGET_FRACTION * float(limit) * len(devices))
+
+
+@dataclasses.dataclass
+class DeviceEpochCache:
+    """One epoch staged device-resident, ready for the epoch scan.
+
+    `arrays` is the batch tuple stacked along a leading steps axis — the
+    positional args of `make_epoch_train_step` — under `epoch_sharding`.
+    The ledger fields mirror DevicePrefetcher's so the one-time staging
+    cost is visible in logs next to the per-batch path's numbers."""
+    arrays: Tuple[jax.Array, ...]
+    steps: int
+    examples_per_step: int
+    nbytes: int          # host bytes staged (dtype-honest, like the ledger)
+    stage_secs: float    # wall time of the one device_put + barrier
+
+    @property
+    def n_batch_args(self) -> int:
+        return len(self.arrays)
+
+
+def _replay_then(collected, rest: Iterator) -> Iterator:
+    """The overflow fallback stream: already-pulled batches, then the rest
+    of the source — the epoch the caller was about to train, intact."""
+    for b in collected:
+        yield b
+    for b in rest:
+        yield b
+
+
+def build_epoch_cache(mesh, batches: Iterable, *, shuffle: bool = False,
+                      max_bytes: Optional[int] = None, name: str = "train"
+                      ) -> Tuple[Optional[DeviceEpochCache],
+                                 Optional[Iterator]]:
+    """Collect one epoch of host batches and stage them device-resident.
+
+    Returns `(cache, None)` on success, or `(None, fallback_iterator)` when
+    the epoch cannot be cached — budget overflow, a ragged stream (batches
+    whose shapes/dtypes differ step to step cannot be stacked for the
+    scan), or an empty stream. Every fallback emits the named
+    EpochCacheOverflowWarning so the mode switch is loud, and the returned
+    iterator loses no data.
+
+    `shuffle=True` doubles the accounted footprint: the device-side
+    permutation gathers a transient shuffled copy of the epoch.
+    """
+    budget = max_bytes if max_bytes is not None else hbm_budget_bytes()
+    factor = 2.0 if shuffle else 1.0
+    it = iter(batches)
+    collected = []
+    nbytes = 0
+    spec = None  # ((shape, dtype), ...) of the first batch
+    for b in it:
+        b = tuple(np.asarray(x) for x in b)
+        bspec = tuple((x.shape, x.dtype) for x in b)
+        if spec is None:
+            spec = bspec
+        elif bspec != spec:
+            warnings.warn(
+                f"[{name}] epoch_on_device: batch {len(collected)} has "
+                f"shape/dtype {bspec} != first batch {spec} — a ragged "
+                f"stream cannot be stacked for the epoch scan; falling "
+                f"back to the staged per-batch path (drop_remainder "
+                f"pipelines stack cleanly)", EpochCacheOverflowWarning,
+                stacklevel=2)
+            return None, _replay_then(collected + [b], it)
+        nbytes += sum(x.nbytes for x in b)
+        collected.append(b)
+        if budget is not None and nbytes * factor > budget:
+            warnings.warn(
+                f"[{name}] epoch_on_device: epoch exceeds the device cache "
+                f"budget ({nbytes * factor / 1e9:.2f} GB accounted "
+                f"{'incl. the shuffle copy ' if shuffle else ''}vs "
+                f"{budget / 1e9:.2f} GB) after {len(collected)} batches — "
+                f"falling back to the double-buffered staged path "
+                f"(parallel/prefetch.py)", EpochCacheOverflowWarning,
+                stacklevel=2)
+            return None, _replay_then(collected, it)
+    if not collected:
+        warnings.warn(f"[{name}] epoch_on_device: empty epoch stream — "
+                      f"nothing to cache", EpochCacheOverflowWarning,
+                      stacklevel=2)
+        return None, iter(())
+    t0 = time.perf_counter()
+    stacked = tuple(np.stack([b[j] for b in collected])
+                    for j in range(len(collected[0])))
+
+    def _put(a):
+        sharding = epoch_sharding(mesh, a.ndim,
+                                  dim2=a.shape[2] if a.ndim == 5 else None)
+        # per-host batch rows, like shard_batch_pytree: plain device_put on
+        # a cross-process sharding would treat the array as a GLOBAL value
+        # and allgather-assert equality across hosts
+        if jax.process_count() > 1 and not sharding.is_fully_addressable:
+            return jax.make_array_from_process_local_data(sharding, a)
+        return jax.device_put(a, sharding)
+
+    arrays = tuple(_put(a) for a in stacked)
+    for a in arrays:
+        jax.block_until_ready(a)
+    stage_secs = time.perf_counter() - t0
+    return DeviceEpochCache(arrays=arrays, steps=len(collected),
+                            examples_per_step=int(collected[0][0].shape[0]),
+                            nbytes=nbytes, stage_secs=stage_secs), None

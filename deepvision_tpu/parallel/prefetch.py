@@ -15,7 +15,10 @@ The prefetcher also keeps the transfer ledger: `bytes_staged_total` /
 path (data/device_augment.py, `--device-augment`) saves over f32 batches —
 the trainer surfaces them in its periodic `log_every` flush next to
 `prefetch_queue_depth`, and bench_input.py reads them for its
-bytes-to-device comparison.
+bytes-to-device comparison. `wait_secs_total` / `overlapped_fraction`
+additionally measure how much of that staging time was HIDDEN under the
+consumer's compute — the double-buffering proof `bench_epoch.py` reports
+(docs/INPUT_PIPELINE.md "On-device epochs").
 """
 
 from __future__ import annotations
@@ -73,6 +76,17 @@ class DevicePrefetcher:
       call (dispatch + transfer of one batch).
     - `bytes_per_sec`: cumulative staged bytes / cumulative staging wall
       time — effective host→device staging bandwidth.
+    - `wait_secs_total` / `first_wait_secs` / `overlapped_fraction`: time
+      the CONSUMER spent blocked waiting for staged batches, the share of
+      it that was the one-time pipeline fill (producer thread spawn + the
+      first batch's stage — nothing exists to overlap it with), and the
+      share of staging wall time hidden under consumer work in steady
+      state: 1 − (wait − first_wait)/stage_total. Double buffering is
+      working exactly when the fraction is high: the producer stages batch
+      k+1 while the consumer computes on batch k, so after the fill the
+      consumer only waits when the host generator — not staging — is the
+      bottleneck. Inline mode (`size <= 1`) stages synchronously, so every
+      stage is a wait and the fraction is 0 by construction.
     """
 
     def __init__(self, mesh, batches: Iterable, size: int = 2):
@@ -84,6 +98,9 @@ class DevicePrefetcher:
         self.bytes_staged_total = 0
         self.batches_staged_total = 0
         self.last_stage_secs = 0.0
+        self.wait_secs_total = 0.0
+        self.first_wait_secs = 0.0
+        self._first_wait_seen = False
         self._stage_secs_total = 0.0
         if size <= 1:
             self._inline = iter(batches)
@@ -102,6 +119,21 @@ class DevicePrefetcher:
         if self._stage_secs_total <= 0.0:
             return 0.0
         return self.bytes_staged_total / self._stage_secs_total
+
+    @property
+    def overlapped_fraction(self) -> float:
+        """Share of staging wall time hidden under consumer compute in
+        steady state: max(0, 1 − (wait − first_wait)/stage_total). The
+        first wait is the pipeline fill (thread spawn + the first batch's
+        stage, nothing to overlap with) — reported via `first_wait_secs`,
+        not charged here. Conservative — the steady-state wait also counts
+        time blocked on a slow host GENERATOR, so a low number means "the
+        consumer waited", not necessarily "transfer was exposed"; a high
+        number proves the double buffer hid the staging."""
+        if self._stage_secs_total <= 0.0:
+            return 0.0
+        steady = self.wait_secs_total - self.first_wait_secs
+        return max(0.0, 1.0 - steady / self._stage_secs_total)
 
     def _stage(self, b):
         """shard_batch_pytree with the transfer ledger updated around it."""
@@ -142,10 +174,19 @@ class DevicePrefetcher:
 
     def __next__(self):
         if self._inline is not None:
-            return self._stage(next(self._inline))
+            # inline staging is synchronous: the whole stage is a wait
+            staged = self._stage(next(self._inline))
+            self.wait_secs_total += self.last_stage_secs
+            return staged
         if self._stop.is_set():
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
+        wait = time.perf_counter() - t0
+        if not self._first_wait_seen:
+            self._first_wait_seen = True
+            self.first_wait_secs = wait  # the one-time pipeline fill
+        self.wait_secs_total += wait
         if item is _SENTINEL:
             self._stop.set()
             raise StopIteration

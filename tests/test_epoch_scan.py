@@ -1,0 +1,326 @@
+"""Whole-epoch on-device training (TrainConfig.epoch_on_device).
+
+The mode must be a pure dispatch-count optimization: the device cache
+(`data/device_cache.py`) + epoch scan (`steps.make_epoch_train_step`)
+reproduce the per-step path's training byte-for-byte up to XLA fusion —
+loss-trajectory/param parity per-step vs steps_per_dispatch=k vs
+whole-epoch (incl. a paired-augment segmentation config), the (seed,
+epoch)-folded device shuffle, resume across epoch boundaries, the
+HBM-overflow fallback with its named warning, the dispatch counter, the
+prefetcher's overlap ledger, and the CLI flag wiring.
+"""
+
+import dataclasses
+import tempfile
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.core.config import (DataConfig, OptimizerConfig,
+                                        ScheduleConfig, TrainConfig)
+from deepvision_tpu.core.trainer import Trainer
+from deepvision_tpu.data.device_cache import (EpochCacheOverflowWarning,
+                                              build_epoch_cache)
+from deepvision_tpu.data.synthetic import SyntheticClassification
+from deepvision_tpu.parallel import mesh as mesh_lib
+
+# the honest same-math-different-fusion bound — see
+# test_steps_per_dispatch_matches_single_step_training's rationale
+RTOL, ATOL = 1e-5, 2e-5
+
+
+def _config(tmp_path, **kw):
+    base = dict(
+        name="epoch_test", model="lenet5",
+        batch_size=32, total_epochs=1,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        schedule=ScheduleConfig(name="constant"),
+        data=DataConfig(dataset="synthetic", image_size=32, num_classes=10,
+                        train_examples=32 * 6),
+        dtype="float32",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_every_steps=2,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(steps=6, seed=123):
+    # a FIXED batch stream (seed independent of epoch): the cache-mode
+    # epoch-stationarity contract, and what makes per-step vs scanned
+    # trajectories comparable
+    return SyntheticClassification(batch_size=32, image_size=32, channels=1,
+                                   num_classes=10, num_batches=steps,
+                                   seed=seed)
+
+
+def _assert_tree_close(a, b, context=""):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=RTOL, atol=ATOL, err_msg=context)
+
+
+def test_epoch_scan_three_way_dispatch_parity(tmp_path):
+    """per-step == steps_per_dispatch=3 == whole-epoch scan: identical
+    final params, EMA (per-step cadence inside every scan), step count and
+    epoch-mean loss — with the dispatch counts 6 / 2 / 1 per epoch."""
+    def run(workdir, **kw):
+        cfg = _config(tmp_path, ema_decay=0.9, **kw)
+        tr = Trainer(cfg, workdir=str(tmp_path / workdir))
+        tr.init_state((32, 32, 1))
+        metrics = tr.train_epoch(1, _data())
+        state, dispatches = tr.state, tr._dispatches_total
+        tr.close()
+        return metrics, state, dispatches
+
+    m1, s1, d1 = run("per_step")
+    mk, sk, dk = run("k3", steps_per_dispatch=3)
+    me, se, de = run("epoch", epoch_on_device=True, epoch_shuffle=False)
+    assert (d1, dk, de) == (6, 2, 1)
+    assert int(s1.step) == int(sk.step) == int(se.step) == 6
+    for name, s in (("k3", sk), ("epoch", se)):
+        _assert_tree_close(s1.params, s.params, f"{name} params")
+        _assert_tree_close(s1.ema_params, s.ema_params, f"{name} ema")
+    np.testing.assert_allclose(m1["loss"], me["loss"], rtol=1e-5)
+    np.testing.assert_allclose(m1["loss"], mk["loss"], rtol=1e-5)
+
+
+def test_epoch_scan_segmentation_paired_augment_parity(tmp_path):
+    """The paired-augment RNG contract rides the scan for free: a
+    segmentation run with --device-augment (image+mask crops from THE one
+    (seed, step) draw inside the scanned step) reproduces the per-step
+    path's params and losses."""
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.config import decode_image_size
+    from deepvision_tpu.core.segment import SegmentationTrainer
+    from deepvision_tpu.data.segmentation import SyntheticSegmentation
+
+    def run(workdir, on_device):
+        cfg = get_config("unet_synthetic").replace(
+            batch_size=8, total_epochs=1, device_augment=True,
+            epoch_on_device=on_device, epoch_shuffle=False,
+            schedule=ScheduleConfig(name="constant"))
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, image_size=32, train_examples=8 * 3))
+        tr = SegmentationTrainer(cfg, workdir=str(tmp_path / workdir))
+        tr.init_state((32, 32, 3))
+        # uint8 image+mask pairs at the padded decode size — the paired
+        # device-augment staging contract, epoch-stationary seed
+        d = decode_image_size(32)
+        metrics = tr.train_epoch(1, SyntheticSegmentation(
+            8, d, 3, cfg.data.num_classes, 3, seed=7, emit_uint8=True))
+        state, dispatches = tr.state, tr._dispatches_total
+        tr.close()
+        return metrics, state, dispatches
+
+    m1, s1, d1 = run("seg_per_step", False)
+    me, se, de = run("seg_epoch", True)
+    assert (d1, de) == (3, 1)
+    _assert_tree_close(s1.params, se.params, "segmentation params")
+    np.testing.assert_allclose(m1["loss"], me["loss"], rtol=1e-5)
+
+
+def test_epoch_shuffle_is_seed_epoch_permutation():
+    """The device-side shuffle applies EXACTLY the (seed, epoch)-folded
+    permutation: scanned per-step metrics over a shuffle=True epoch equal
+    the host-computed permutation of the same data, and the epoch fold
+    makes epochs differ."""
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.core import steps as steps_lib
+    from deepvision_tpu.core.train_state import TrainState
+
+    n_steps, batch = 4, 8
+    images = np.arange(n_steps * batch, dtype=np.float32).reshape(
+        n_steps, batch, 1)
+    labels = np.zeros((n_steps, batch), np.int32)
+
+    def fake_step(state, x, y, rng):
+        # consumes the shuffled slice; metrics expose which rows arrived
+        return state.apply_gradients({"w": jnp.zeros(())}), \
+            {"mean": x.mean()}
+
+    state = TrainState.create(lambda *a, **k: None, {"w": jnp.zeros(())},
+                              optax.sgd(0.1), {})
+    epoch_step = steps_lib.make_epoch_train_step(fake_step, 2, shuffle=True)
+    rng = jax.random.fold_in(jax.random.PRNGKey(0), 1)  # seed 0, epoch 1
+    state, metrics = epoch_step(state, images, labels, rng)  # state donated
+    perm = np.asarray(jax.random.permutation(
+        jax.random.fold_in(rng, steps_lib.EPOCH_SHUFFLE_TAG),
+        n_steps * batch))
+    want = images.reshape(-1, 1)[perm].reshape(n_steps, batch, 1).mean(
+        axis=(1, 2))
+    np.testing.assert_allclose(np.asarray(metrics["mean"]), want, rtol=1e-6)
+    # a different epoch folds a different permutation
+    rng2 = jax.random.fold_in(jax.random.PRNGKey(0), 2)
+    _, metrics2 = epoch_step(state, images, labels, rng2)
+    assert not np.allclose(np.asarray(metrics["mean"]),
+                           np.asarray(metrics2["mean"]))
+
+
+def test_epoch_scan_resume_across_epoch_boundary(tmp_path):
+    """Checkpoints land at scan boundaries, so resume is an epoch-boundary
+    restore by construction: 2 epochs + stop + resume for 2 more (a fresh
+    process's cache re-stages and the (seed, epoch) shuffle re-derives)
+    matches the uninterrupted 4-epoch run."""
+    def losses(tr):
+        return dict(zip(tr.logger.history["epoch_train_loss"]["epochs"],
+                        tr.logger.history["epoch_train_loss"]["value"]))
+
+    kw = dict(total_epochs=4, epoch_on_device=True)
+    base = Trainer(_config(tmp_path, **kw), workdir=str(tmp_path / "base"))
+    base.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    want = losses(base)
+    base.close()
+    assert set(want) == {1, 2, 3, 4}
+
+    part = Trainer(_config(tmp_path, **kw), workdir=str(tmp_path / "part"))
+    part.fit(lambda e: _data(), None, sample_shape=(32, 32, 1),
+             total_epochs=2)
+    part.close()
+    res = Trainer(_config(tmp_path, **kw), workdir=str(tmp_path / "part"))
+    res.init_state((32, 32, 1))
+    assert res.resume() == 2
+    res.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    got = losses(res)
+    res.close()
+    for epoch in (3, 4):
+        np.testing.assert_allclose(got[epoch], want[epoch], rtol=RTOL,
+                                   atol=ATOL)
+
+
+def test_hbm_overflow_falls_back_with_named_warning(tmp_path, monkeypatch):
+    """An epoch that exceeds the cache budget trains through the staged
+    path instead — named EpochCacheOverflowWarning, per-step dispatches,
+    no data lost, and the fallback is sticky for later epochs."""
+    monkeypatch.setenv("DEEPVISION_EPOCH_CACHE_MAX_BYTES", "1024")
+    cfg = _config(tmp_path, total_epochs=2, epoch_on_device=True)
+    tr = Trainer(cfg, workdir=str(tmp_path / "wd"))
+    with pytest.warns(EpochCacheOverflowWarning, match="budget"):
+        tr.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    # 6 steps x 2 epochs dispatched singly; the trajectory is the per-step
+    # path's (the fallback replays every collected batch)
+    assert tr._dispatches_total == 12 and tr._epoch_fallback
+    assert all(np.isfinite(v)
+               for v in tr.logger.history["epoch_train_loss"]["value"])
+    tr.close()
+
+    oracle = Trainer(_config(tmp_path, total_epochs=2),
+                     workdir=str(tmp_path / "oracle"))
+    oracle.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    np.testing.assert_allclose(
+        tr.logger.history["epoch_train_loss"]["value"],
+        oracle.logger.history["epoch_train_loss"]["value"],
+        rtol=RTOL, atol=ATOL)
+    oracle.close()
+
+
+def test_ragged_stream_falls_back_with_named_warning():
+    """A batch stream the scan cannot stack (shape changes mid-epoch) is a
+    loud staged-path fallback, not a crash — and the fallback iterator
+    replays every batch."""
+    mesh = mesh_lib.make_mesh()
+    batches = [(np.zeros((4, 8, 8, 1), np.float32),),
+               (np.zeros((2, 8, 8, 1), np.float32),)]  # ragged tail
+    with pytest.warns(EpochCacheOverflowWarning, match="ragged"):
+        cache, fallback = build_epoch_cache(mesh, iter(batches))
+    assert cache is None
+    replayed = [b[0].shape for b in fallback]
+    assert replayed == [(4, 8, 8, 1), (2, 8, 8, 1)]
+
+
+def test_dispatch_counter_reaches_logs(tmp_path):
+    """train_dispatches_total lands in the log_every flush next to the
+    prefetch ledger on BOTH paths — dispatch amortization visible in logs
+    without a profiler."""
+    tr = Trainer(_config(tmp_path), workdir=str(tmp_path / "staged"))
+    tr.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    hist = tr.logger.history
+    assert hist["train_dispatches_total"]["value"][-1] == 6.0
+    assert "train_prefetch_queue_depth" in hist
+    # the epoch's final prefetcher ledger snapshot survives close
+    assert "overlapped_fraction" in tr.last_prefetch_ledger
+    tr.close()
+
+    tr2 = Trainer(_config(tmp_path, epoch_on_device=True),
+                  workdir=str(tmp_path / "epoch"))
+    tr2.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    assert tr2.logger.history["train_dispatches_total"]["value"] == [1.0]
+    tr2.close()
+
+
+def test_epoch_on_device_rejects_conflicting_levers(tmp_path):
+    with pytest.raises(ValueError, match="pick one"):
+        Trainer(_config(tmp_path, epoch_on_device=True,
+                        steps_per_dispatch=2), workdir=None)
+    with pytest.raises(ValueError, match="accum_steps"):
+        Trainer(_config(tmp_path, epoch_on_device=True,
+                        optimizer=OptimizerConfig(name="adam",
+                                                  learning_rate=1e-3,
+                                                  accum_steps=2)),
+                workdir=None)
+    with pytest.raises(ValueError, match="shard_map"):
+        Trainer(_config(tmp_path, epoch_on_device=True,
+                        spatial_backend="shard_map"), workdir=None)
+
+
+def test_cli_epoch_on_device_flag(tmp_path):
+    """--epoch-on-device trains end to end through the CLI (synthetic) and
+    refuses streaming datasets with a staged-path remedy."""
+    from deepvision_tpu.cli import run_classification
+
+    result = run_classification(
+        "LeNet", ["lenet5"],
+        argv=["-m", "lenet5", "--synthetic", "--epochs", "2", "--batch-size",
+              "16", "--steps-per-epoch", "2", "--epoch-on-device",
+              "--workdir", str(tmp_path)])
+    assert "best_metric" in result
+
+    with pytest.raises(SystemExit, match="epoch-on-device"):
+        run_classification(
+            "ResNet", ["resnet50"],
+            argv=["-m", "resnet50", "--epoch-on-device", "--epochs", "1",
+                  "--data-dir", str(tmp_path / "nope"),
+                  "--workdir", str(tmp_path)])
+
+
+def test_prefetcher_overlap_ledger():
+    """The overlap lane of the transfer ledger: a compute-bound consumer
+    (sleep releases the core, the preflight Paced convention) hides the
+    staging — high fraction; inline staging (size=1) is synchronous — zero
+    by construction."""
+    from deepvision_tpu.parallel.prefetch import DevicePrefetcher
+
+    mesh = mesh_lib.make_mesh()
+    src = [(np.zeros((64, 32, 32, 3), np.uint8),) for _ in range(8)]
+
+    pf = DevicePrefetcher(mesh, iter(src), size=2)
+    for _ in pf:
+        time.sleep(0.02)
+    assert pf._stage_secs_total > 0
+    assert pf.first_wait_secs > 0  # the pipeline fill was accounted
+    overlapped = pf.overlapped_fraction
+    pf.close()
+    assert overlapped > 0.5, (overlapped, pf.wait_secs_total)
+
+    inline = DevicePrefetcher(mesh, iter(src), size=1)
+    for _ in inline:
+        pass
+    assert inline.overlapped_fraction == 0.0
+    inline.close()
+
+
+def test_epoch_step_single_program_across_epochs(tmp_path):
+    """Zero recompiles across epochs: after a multi-epoch run the scanned
+    epoch step's jit cache holds exactly one executable (shuffle ON — the
+    permutation is traced, not a cache key)."""
+    tr = Trainer(_config(tmp_path, total_epochs=3, epoch_on_device=True,
+                         epoch_shuffle=True),
+                 workdir=str(tmp_path / "wd"))
+    tr.fit(lambda e: _data(), None, sample_shape=(32, 32, 1))
+    assert tr._epoch_step._cache_size() == 1
+    tr.close()

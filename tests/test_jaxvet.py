@@ -51,13 +51,15 @@ def test_cost_baseline_covers_whole_registry():
     """CHECK_COST.json (written by the registry-wide sweep) must carry a
     cost row for every traced unit of every registered config — the
     committed artifact IS the proof that sweep count equals registry
-    count, refreshed every time the baseline is."""
-    from deepvision_tpu.check.harness import config_unit_names
+    count, refreshed every time the baseline is — plus the epoch-scan
+    units (the whole-epoch lax.scan wrapper's own rows)."""
+    from deepvision_tpu.check.harness import (config_unit_names,
+                                              epoch_unit_names)
     from deepvision_tpu.configs import CONFIGS
 
     with open(os.path.join(REPO, "CHECK_COST.json")) as fp:
         baseline = json.load(fp)
-    expected = set()
+    expected = set(epoch_unit_names())
     for name in CONFIGS.names():
         # cost rows exist for jaxpr-traced units (train/eval); predict and
         # serve units are eval_shape-only

@@ -362,10 +362,12 @@ def test_corrupt_epoch_during_elastic_resume_falls_back(tmp_path,
 
 # -- SIGKILL on N, resume on 2N (subprocess, the pod-resize shape) ------------
 
-def _run_lenet(workdir, epochs, n_devices, check=True, **popen_kw):
+def _run_lenet(workdir, epochs, n_devices, check=True, extra_env=None,
+               **popen_kw):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS=f"--xla_force_host_platform_device_count={n_devices}",
                PYTHONPATH=REPO)
+    env.update(extra_env or {})
     env.pop("PALLAS_AXON_POOL_IPS", None)
     cmd = [sys.executable, os.path.join(REPO, "LeNet", "jax", "train.py"),
            "-m", "lenet5", "--synthetic", "--epochs", str(epochs),
@@ -395,10 +397,16 @@ def test_elastic_resume_parity_after_sigkill_on_2N(tmp_path):
     """The pod-resize acceptance shape end-to-end through the CLI: a run
     SIGKILLed mid-training on 8 devices auto-resumes on 16 (2N) and its
     post-resume loss trajectory matches an uninterrupted 8-device run.
-    8 epochs + kill at the FIRST committed checkpoint: warm-cache epochs
-    are sub-second, so a short run can race to completion before the
-    signal lands (seen with 3 epochs) — the budget keeps post-resume
-    epochs to compare."""
+
+    Kill timing is DETERMINISTIC: the victim arms the transient-I/O fault
+    at global batch 2 — epoch 2's first pull (2 steps/epoch) — with a slow
+    retry schedule, so after committing epoch 1 it stalls ~30s in backoff
+    (the run would still finish clean if never killed: retries < the
+    budget). The SIGKILL, sent the moment the first checkpoint commits,
+    always lands inside that stall. The previous shape (8 epochs, kill on
+    first-commit detection) raced: warm-cache epochs are sub-second and
+    the victim could finish all 8 epochs before the signal (passed alone,
+    flaky in-suite)."""
     epochs = 8
     base_wd = tmp_path / "base"
     _run_lenet(base_wd, epochs, 8)
@@ -406,15 +414,23 @@ def test_elastic_resume_parity_after_sigkill_on_2N(tmp_path):
     assert set(want) == set(range(1, epochs + 1))
 
     victim_wd = tmp_path / "victim"
-    proc = _run_lenet(victim_wd, epochs, 8, background=True)
+    proc = _run_lenet(victim_wd, epochs, 8, background=True, extra_env={
+        "DEEPVISION_FAULT_DATA_IO_STEP": "2:4",  # epoch 2, first batch
+        "DEEPVISION_IO_RETRIES": "6",            # would recover if not killed
+        "DEEPVISION_IO_RETRY_DELAY": "6",        # 6+8+8+8s of backoff stall
+    })
     try:
         ckpt_root = victim_wd / "ckpt"
 
         def committed():
+            # manifest present == the save's commit point: the fault-armed
+            # kill lands moments after the save starts, so polling for the
+            # bare epoch dir could kill a half-written checkpoint
             if not ckpt_root.is_dir():
                 return []
             return [int(d.name) for d in ckpt_root.iterdir()
-                    if d.is_dir() and d.name.isdigit()]
+                    if d.is_dir() and d.name.isdigit()
+                    and os.path.exists(integrity.manifest_path(str(d)))]
 
         deadline = time.time() + 420
         while time.time() < deadline:

@@ -57,6 +57,13 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               spatial train step on a 2-virtual-device mesh must match
               the pure-DP oracle per-leaf, and the bucketed AOT engine
               must answer with int32 class-id masks
+  epoch       whole-epoch on-device training (docs/INPUT_PIPELINE.md
+              "On-device epochs"): a 2-epoch synthetic run through the
+              device cache + epoch scan must make exactly ONE train
+              dispatch per epoch and reproduce the per-step oracle's
+              loss trajectory within the 2e-5 fusion bound — the
+              zero-round-trip path has to be byte-honest BEFORE a pod
+              run trusts --epoch-on-device
   devices     backend reachable, device count/platform, mesh construction
   input       host tf.data throughput (real TFRecords when --data-dir is
               given, synthetic JPEG shards otherwise) vs --input-floor
@@ -680,6 +687,65 @@ def check_segment(args):
             f"DP oracle; serve returns int32 masks")
 
 
+@check("epoch")
+def check_epoch(args):
+    # whole-epoch on-device training end to end (docs/INPUT_PIPELINE.md
+    # "On-device epochs"): the cached path must be a pure dispatch-count
+    # optimization — same (seed, step) RNG draws, same math. Train the tiny
+    # fixed lenet5 2 epochs per-step (the oracle) and again through the
+    # epoch cache + scan (shuffle off so the trajectories are comparable),
+    # then pin dispatches/epoch == 1 and the loss trajectory at the 2e-5
+    # same-math-different-fusion bound.
+    import dataclasses
+    import shutil
+
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.config import ScheduleConfig
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.synthetic import SyntheticClassification
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_epoch_")
+
+    def run(on_device, workdir):
+        cfg = get_config("lenet5").replace(
+            batch_size=16, total_epochs=2, epoch_on_device=on_device,
+            epoch_shuffle=False, schedule=ScheduleConfig(name="constant"))
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, dataset="synthetic", image_size=32,
+            train_examples=16 * 4))
+        trainer = Trainer(cfg, workdir=os.path.join(tmpdir, workdir))
+
+        def data(epoch):  # epoch-stationary: the cache-mode contract
+            return SyntheticClassification(16, 32, 1, 10, 4, seed=0)
+
+        try:
+            trainer.fit(data, None, sample_shape=(32, 32, 1))
+            return (list(trainer.logger.history["epoch_train_loss"]["value"]),
+                    trainer._dispatches_total)
+        finally:
+            trainer.close()
+
+    try:
+        want, oracle_dispatches = run(False, "oracle")
+        got, epoch_dispatches = run(True, "epoch")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if epoch_dispatches != 2:
+        raise RuntimeError(f"cached path made {epoch_dispatches} dispatches "
+                           f"over 2 epochs, not 1/epoch")
+    if not all(np.isfinite(v) for v in got):
+        raise RuntimeError(f"non-finite epoch-scan losses: {got}")
+    err = max(abs(a - b) for a, b in zip(want, got))
+    if err > 2e-5:
+        raise RuntimeError(
+            f"epoch-scan loss trajectory diverges from the per-step oracle "
+            f"by {err:.2e} (bound 2e-5): {want} vs {got}")
+    return (f"1 dispatch/epoch (oracle: {oracle_dispatches // 2}); "
+            f"trajectory err {err:.1e}")
+
+
 @check("devices")
 def check_devices(args):
     import jax
@@ -1010,6 +1076,7 @@ def main(argv=None):
     check_autoscale(args)
     check_obs(args)
     check_segment(args)
+    check_epoch(args)
     check_devices(args)
     check_input(args)
     check_augment(args)
