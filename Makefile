@@ -9,7 +9,8 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_spatial.py tests/test_spatial_shardmap.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
-.PHONY: test test-all verify bench bench-serve bench-serve-load \
+.PHONY: test test-all verify bench bench-serve bench-serve-int8 \
+        bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
         bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
         serve-fleet-smoke preflight preflight-record lint lint-changed \
@@ -121,6 +122,11 @@ serve-fleet-smoke: ## multi-model fleet smoke: two engines behind one
 	## every served model must answer (docs/SERVING.md "Fleet")
 	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve -m lenet5,lenet5_digits \
 	    --smoke --duration 2
+
+bench-serve-int8: ## int8-vs-bf16 serving: arm the calibrated quantization
+	## gate (accuracy-delta vs the pinned shard), then the same closed-loop
+	## load through each precision ladder — QPS, p99, bytes/batch one line
+	env $(CPU_ENV) $(PY) bench_serve.py --int8
 
 bench-serve-load: ## open-loop fleet load bench: sustained-QPS arrival
 	## schedule over a 2-model fleet — sustained QPS, p99-under-load,

@@ -807,8 +807,155 @@ def promote_under_load(args) -> None:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def int8_bench() -> None:
+    """int8-vs-bf16 serving comparison (docs/SERVING.md "Quantized
+    serving"): one engine, both precision ladders compiled in its AOT
+    cache, the SAME closed-loop load driven through the same micro-batcher
+    at each precision — sustained images/sec, p99 at an overload-free
+    operating point, and bytes/batch (the weight bytes one dispatch reads
+    + the input batch), as one bench.py-schema line.
+
+    The byte cut is the hardware-portable claim (the r05 regime is
+    bandwidth-bound, and int8 weights are ~4x smaller than the f32 tree
+    the bf16 buckets dispatch with). The THROUGHPUT ratio is reported
+    honestly per platform: XLA:CPU has no fast int8 conv path, so on a CPU
+    host vs_bf16 is typically <= 1 — the ratio is the TPU story, the gate
+    and the byte accounting are what this bench proves everywhere. A
+    refused gate (arm DEEPVISION_FAULT_QUANT_REGRESS=1 to rehearse) still
+    emits the line, with the refusal decision and no int8 phase."""
+    model_name = os.environ.get("DEEPVISION_SERVE_BENCH_MODEL", "lenet5")
+    secs = float(os.environ.get("DEEPVISION_SERVE_BENCH_SECS", "2.0"))
+    max_delay_ms = float(os.environ.get("DEEPVISION_SERVE_BENCH_DELAY_MS",
+                                        "5.0"))
+    max_batch = int(os.environ.get("DEEPVISION_SERVE_BENCH_MAX_BATCH", "32"))
+
+    import jax
+
+    from deepvision_tpu.cli import (compilation_cache_stats,
+                                    setup_compilation_cache)
+    setup_compilation_cache()
+
+    from deepvision_tpu.ops.quant import tree_nbytes
+    from deepvision_tpu.serve.batcher import (DynamicBatcher,
+                                              RequestRejected,
+                                              result_within)
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.metrics import ServingMetrics
+    from deepvision_tpu.serve.quantize import arm_int8
+
+    engine = PredictEngine.from_config(
+        model_name, buckets=(1, 8, 32), max_batch=max_batch)
+    engine.warmup()
+    platform = jax.devices()[0].platform
+    decision = arm_int8(engine)         # calibrate + compile + GATE
+    engine.warmup()                     # absorb the int8 first-dispatch too
+    int8_live = decision["decision"] == "int8_enabled"
+
+    metrics = ServingMetrics(window=8192)
+    batcher = DynamicBatcher(engine, max_delay_ms=max_delay_ms,
+                             max_queue_examples=64 * max_batch,
+                             metrics=metrics)
+    x1 = np.random.RandomState(0).randn(
+        1, *engine.example_shape).astype(engine.input_dtype)
+
+    def sustained(precision: str) -> float:
+        """Closed-loop saturation at one precision through the batcher."""
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            xi = np.random.RandomState(i).randn(
+                1, *engine.example_shape).astype(engine.input_dtype)
+            while not stop.is_set():
+                try:
+                    result_within(batcher.submit(xi, precision=precision),
+                                  BENCH_WAIT_S, what="bench request")
+                except RequestRejected:
+                    time.sleep(0.001)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(min(128, 3 * max_batch))]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)             # fill the pipeline before timing
+        metrics.snapshot(reset=True)
+        time.sleep(secs)
+        thr = metrics.snapshot(reset=True)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        return thr["images_per_sec"]
+
+    def p99_at(precision: str, rate: float) -> float:
+        """p99 at ~20% of that precision's capacity (overload-free)."""
+        metrics.snapshot(reset=True)
+        tick, futs, end = 0.002, [], time.perf_counter() + secs
+        per_tick = max(1, int(rate * tick))
+        while time.perf_counter() < end:
+            for _ in range(per_tick):
+                try:
+                    futs.append(batcher.submit(x1, precision=precision))
+                except RequestRejected:
+                    pass
+            time.sleep(tick)
+        for f in futs:
+            result_within(f, BENCH_WAIT_S, what="bench request")
+        return metrics.snapshot().get("p99_ms", float("inf"))
+
+    bf16_ips = sustained("bf16")
+    bf16_p99 = p99_at("bf16", max(50.0, 0.2 * bf16_ips))
+    int8_ips = int8_p99 = None
+    if int8_live:
+        int8_ips = sustained("int8")
+        int8_p99 = p99_at("int8", max(50.0, 0.2 * int8_ips))
+    batcher.drain(timeout=30)
+
+    input_bytes = int(np.zeros(
+        (max_batch, *engine.example_shape), engine.input_dtype).nbytes)
+    wb_bf16 = decision["weight_bytes_bf16"]
+    wb_int8 = decision["weight_bytes_int8"]
+    print(json.dumps({
+        "metric": f"serve_int8_images_per_sec(1img/req,{model_name},"
+                  f"b{max_batch},delay{max_delay_ms:g}ms,{platform})",
+        "value": round(int8_ips, 2) if int8_ips else 0.0,
+        "unit": "images/sec",
+        # int8 vs bf16 sustained throughput, same engine/batcher/load —
+        # <= 1 on CPU (no fast int8 conv path in XLA:CPU), the byte cut
+        # below is the bandwidth-bound (TPU) lever either way
+        "vs_bf16": (round(int8_ips / bf16_ips, 3)
+                    if int8_ips and bf16_ips else 0.0),
+        "bf16_images_per_sec": round(bf16_ips, 2),
+        "p99_ms_bf16": round(bf16_p99, 3),
+        "p99_ms_int8": round(int8_p99, 3) if int8_p99 is not None else None,
+        # bytes one max-batch dispatch reads: the quantized weight tree +
+        # the uint8/f32 input batch, vs the f32 tree the bf16 ladder reads
+        "bytes_per_batch_bf16": wb_bf16 + input_bytes,
+        "bytes_per_batch_int8": (wb_int8 + input_bytes
+                                 if int8_live else None),
+        "weight_bytes_ratio": round(wb_bf16 / wb_int8, 2) if wb_int8 else 0.0,
+        "quant_gate": {k: decision[k] for k in
+                       ("decision", "watch", "metric_bf16", "metric_int8",
+                        "delta", "gate", "quantized_eqns",
+                        "calibration_examples")},
+        "buckets": list(engine.buckets),
+        "cpu_cores": os.cpu_count(),
+        "platform": platform,
+        "compile_cache": compilation_cache_stats(),
+    }))
+    # live int8 must still be an accuracy-gated deployment, and the weight
+    # byte cut is the hard bar (>= 1.8x, the jaxvet QUANT rule's floor)
+    if int8_live and wb_bf16 < 1.8 * wb_int8:
+        raise SystemExit(f"int8 weight bytes {wb_int8} vs bf16 {wb_bf16}: "
+                         f"cut below the 1.8x bar")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--int8", action="store_true",
+                   help="int8-vs-bf16 comparison: arm the calibrated "
+                        "quantization gate on the bench model, then drive "
+                        "the same closed-loop load through each precision "
+                        "ladder — sustained QPS, p99, bytes/batch as one "
+                        "bench line (docs/SERVING.md 'Quantized serving')")
     p.add_argument("--load", action="store_true",
                    help="open-loop fleet load bench (sustained-QPS arrival "
                         "schedule over --models) instead of the closed-loop "
@@ -863,6 +1010,10 @@ def main(argv=None) -> None:
                    help="--promote-at: canary decision window seconds "
                         "(default 1)")
     args = p.parse_args(argv)
+    if args.int8 and (args.load or args.spike or args.promote_at
+                      or args.trace_out):
+        raise SystemExit("--int8 is the standalone precision comparison — "
+                         "run it without the --load family of modes")
     if args.promote_at and not args.load:
         raise SystemExit("--promote-at needs --load (the promotion bench "
                          "runs under the open-loop arrival schedule)")
@@ -880,7 +1031,9 @@ def main(argv=None) -> None:
         env_delay = os.environ.get("DEEPVISION_SERVE_BENCH_DELAY_MS")
         args.delay_ms = (float(env_delay) if env_delay
                          else 10.0 if args.promote_at else 5.0)
-    if args.load and args.promote_at:
+    if args.int8:
+        int8_bench()
+    elif args.load and args.promote_at:
         promote_under_load(args)
     elif args.load and args.spike:
         spike_bench(args)

@@ -44,16 +44,18 @@ def audit(names: Optional[Sequence[str]] = None,
     # re-emit its verdicts under the alias's unit names. The sweep still
     # reports one unit set PER REGISTERED NAME (the registry-hygiene
     # non-vacuity contract); it just doesn't pay for the same jaxpr twice.
-    # "spatial" / "epoch" are pseudo-targets: the collective probes and the
-    # epoch-scan units (both part of every full sweep; naming one audits
-    # that layer alone)
+    # "spatial" / "epoch" / "quant" are pseudo-targets: the collective
+    # probes, the epoch-scan units, and the int8 predict twins (all part of
+    # every full sweep; naming one audits that layer alone)
     full_sweep = not names
     spatial_only = bool(names) and "spatial" in names
     epoch_only = bool(names) and "epoch" in names
-    if spatial_only or epoch_only:
-        names = [n for n in names if n not in ("spatial", "epoch")]
+    quant_only = bool(names) and "quant" in names
+    pseudo_only = spatial_only or epoch_only or quant_only
+    if pseudo_only:
+        names = [n for n in names if n not in ("spatial", "epoch", "quant")]
     requested = (list(names) if names
-                 else ([] if spatial_only or epoch_only else CONFIGS.names()))
+                 else ([] if pseudo_only else CONFIGS.names()))
     canonical: dict = {}     # config-identity -> first name seen
     alias_of: dict = {}      # alias name -> canonical name
     for n in requested:
@@ -74,10 +76,14 @@ def audit(names: Optional[Sequence[str]] = None,
     skipped: dict = {}
     by_config: dict = {}     # canonical config -> [(unit suffix, findings,
     #                           cost)] for alias re-emission
+    quant_facts: dict = {}   # int8 unit -> facts, for the byte-cut bar
     for unit in build_units(sweep_names, progress=progress,
                             spatial=full_sweep or spatial_only,
-                            epoch=full_sweep or epoch_only):
+                            epoch=full_sweep or epoch_only,
+                            quant=full_sweep or quant_only):
         audited.append(unit.name)
+        if unit.quant is not None:
+            quant_facts[unit.name] = dict(unit.quant)
         if unit.skipped:
             skipped[unit.name] = unit.skipped
             continue
@@ -102,6 +108,13 @@ def audit(names: Optional[Sequence[str]] = None,
     if wants_cost:
         for uname, cost in cost_table.items():
             findings.extend(check_cost(uname, cost, baseline))
+    if select is None or "QUANT" in {c.upper() for c in select}:
+        # the int8 byte-cut bar needs BOTH cost rows (the quant unit's and
+        # its bf16 twin's), so it runs after the sweep like COST. A
+        # quant-only audit skips it when the twin wasn't traced this run.
+        from .rules import check_quant_bytes
+        for uname, facts in quant_facts.items():
+            findings.extend(check_quant_bytes(uname, facts, cost_table))
     findings.sort(key=lambda f: (f.unit, f.check, f.message))
     report = {"units": audited, "skipped": skipped, "cost": cost_table,
               "aliases": alias_of, "n_units": len(audited)}
@@ -217,10 +230,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from ..configs import CONFIGS
     bad = [n for n in args.configs
-           if n not in CONFIGS and n not in ("spatial", "epoch")]
+           if n not in CONFIGS and n not in ("spatial", "epoch", "quant")]
     if bad:
         print(f"usage error: unknown config(s): {', '.join(bad)}; known: "
-              f"spatial, epoch, {', '.join(CONFIGS.names())}",
+              f"spatial, epoch, quant, {', '.join(CONFIGS.names())}",
               file=sys.stderr)
         return EXIT_USAGE
     if args.update_cost and args.configs:

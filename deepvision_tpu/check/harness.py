@@ -51,6 +51,8 @@ class TracedUnit:
     traced_collectives: Optional[dict] = None
     # SERVE: bucket coverage facts
     serve: Optional[dict] = None
+    # QUANT: int8 predict-twin facts ({"planned": n, "baseline_unit": name})
+    quant: Optional[dict] = None
     skipped: Optional[str] = None  # env-skew skip, with reason
     error: Optional[str] = None    # build/trace failure (a finding)
 
@@ -108,21 +110,12 @@ def _family_setup(cfg):
 
 def _head_dims(cfg) -> frozenset:
     """Dimensions that identify the DELIBERATE f32 output heads of a
-    declared-bf16 model (`models/*.py`: `nn.Dense(num_classes,
-    dtype=jnp.float32)`, the f32 detection/pose head convs). An f32
-    conv/dot equation is policy-conformant iff one of its operand/result
-    shapes carries one of these dims; everything else is a leak."""
-    nc = cfg.data.num_classes
-    dims = {nc}
-    if cfg.family == "detection":        # YOLO: 3 anchors x (5 + nc) head
-        dims.add(3 * (5 + nc))
-    if cfg.family == "centernet":        # heatmap nc + wh/offset pairs, and
-        dims.update({nc, 2, 64})         # the shared 64-wide f32 head conv
-    if cfg.family == "pose":             # per-stack heatmap heads
-        dims.add(nc)
-    if cfg.family == "segmentation":     # the f32 1x1 class-logit head
-        dims.add(nc)
-    return frozenset(d for d in dims if d)
+    declared-bf16 model. ONE definition shared with the serving-side int8
+    quantization plan (`core/scoring.serving_head_dims`): the equations
+    DTYPE exempts as heads are exactly the equations the quantizer leaves
+    in float — the two layers cannot drift apart."""
+    from ..core.scoring import serving_head_dims
+    return serving_head_dims(cfg)
 
 
 # -- per-family unit builders -------------------------------------------------
@@ -438,13 +431,19 @@ def _serve_unit(name, cfg) -> TracedUnit:
         outs = jax.eval_shape(predict, variables,
                               S((bkt, sz, sz, ch), in_dtype))
         probe_outs[bkt] = list(jax.tree_util.tree_leaves(outs))
+    # the FULL trace at the audit batch: gives the serve unit a cost row
+    # (flops / bytes / param_bytes) — the bf16 twin the int8 quant units
+    # diff their byte cut against, and a drift canary for the predict path
+    # in its own right
+    closed, donated, outs = _trace(jax.jit(predict), variables,
+                                   S((AUDIT_BATCH, sz, sz, ch), in_dtype))
     return TracedUnit(
-        f"{name}/serve", name, "predict",
-        out_avals=probe_outs[buckets[0]],
+        f"{name}/serve", name, "predict", closed, donated, outs,
         serve={"buckets": buckets, "max_batch": max_batch,
                "example_shape": (sz, sz, ch), "input_dtype": str(in_dtype),
                "probe_outs": probe_outs},
-        meta={"donate": False, "compute_dtype": dt, "kind": "predict"})
+        meta={"donate": False, "compute_dtype": dt, "kind": "predict"},
+        head_dims=_head_dims(cfg))
 
 
 # -- whole-epoch scan units ---------------------------------------------------
@@ -516,6 +515,92 @@ def _epoch_scan_units() -> List[TracedUnit]:
             units.append(TracedUnit(name, "", "train",
                                     error=f"{type(e).__name__}: {e}"))
     return units
+
+
+# -- int8 quantized-predict units ---------------------------------------------
+
+# The serving-side int8 twins (ops/quant.py + serve/quantize.py) audited
+# abstractly: the flagship bandwidth-bound config (the r05 motivation) plus
+# the tiny fixed config preflight's `quant` gate runs. The quantization
+# PLAN is structural, so the audit needs no calibration data — unit
+# activation scales stand in (scale VALUES never change the jaxpr shape).
+QUANT_UNIT_CONFIGS = ("lenet5", "resnet50")
+
+
+def quant_unit_names() -> List[str]:
+    """The audit units the int8 predict twins contribute — pinned by the
+    cost-baseline coverage test next to the per-config unit names."""
+    return [f"quant/{name}" for name in QUANT_UNIT_CONFIGS]
+
+
+def _quant_units() -> List[TracedUnit]:
+    """Trace each QUANT_UNIT_CONFIG's int8 predict twin: plan the
+    quantization over the REAL serve predict's jaxpr (the same function
+    `_serve_unit` traces), substitute the int8 equations, and re-trace.
+    The QUANT family then audits the result — int8 convs where claimed,
+    f32 outputs preserved, param-bytes cut vs the bf16 twin's cost row."""
+    units: List[TracedUnit] = []
+    for cname in QUANT_UNIT_CONFIGS:
+        try:
+            units.append(_quant_unit(cname))
+        except Exception as e:
+            units.append(TracedUnit(f"quant/{cname}", "", "predict",
+                                    error=f"{type(e).__name__}: {e}"))
+    return units
+
+
+def _quant_unit(cname: str) -> TracedUnit:
+    """One config's int8 predict twin, traced abstractly (the jit here is
+    the per-config factory site — every config's quantized predict is a
+    distinct function)."""
+    from ..core.config import UNIT_RANGE_NORM
+    from ..core.steps import _normalize_input
+    from ..core.trainer import build_model_from_config
+    from ..configs import get_config
+    from ..ops import quant as quant_lib
+
+    cfg = get_config(cname)
+    model, cfg = build_model_from_config(cfg)
+    sz, ch = cfg.data.image_size, cfg.data.channels
+    dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+    input_norm = (UNIT_RANGE_NORM if cfg.data.normalize_on_device
+                  else None)
+    in_dtype = jnp.uint8 if input_norm is not None else jnp.float32
+    take_first = cfg.family == "classification"
+    head = _head_dims(cfg)
+    variables = jax.eval_shape(
+        lambda r, x: model.init(
+            {"params": r, "dropout": jax.random.fold_in(r, 1)},
+            x, train=True),
+        S((2,), jnp.uint32), S((2, sz, sz, ch), jnp.float32))
+
+    def predict(vars_, images):
+        x = _normalize_input(images, input_norm, dt)
+        out = model.apply(vars_, x, train=False)
+        if take_first and isinstance(out, (tuple, list)):
+            out = out[0]
+        return jax.tree_util.tree_map(
+            lambda y: y.astype(jnp.float32)
+            if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
+
+    images = S((AUDIT_BATCH, sz, sz, ch), in_dtype)
+    closed_f32 = jax.jit(predict).trace(variables, images).jaxpr
+    plan = quant_lib.plan_quantization(closed_f32, head)
+    # unit activation scales: the VALUES are calibration's business
+    # (serve/quantize.py); the audited structure is scale-invariant
+    plan.act_scales = {q.eqn_index: 1.0 for q in plan.eqns}
+    var_specs = [S(tuple(l.shape), l.dtype) for l in
+                 jax.tree_util.tree_leaves(variables)]
+    qvars = quant_lib.quantized_weight_specs(plan, var_specs)
+    qfn = quant_lib.quantized_predict_fn(plan, closed_f32)
+    closed, donated, outs = _trace(jax.jit(qfn), qvars, images)
+    return TracedUnit(
+        f"quant/{cname}", "", "predict", closed, donated, outs,
+        meta={"donate": False, "kind": "predict"},
+        head_dims=head,
+        quant={"planned": len(plan.eqns),
+               "skipped_head": plan.skipped_head,
+               "baseline_unit": f"{cname}/serve"})
 
 
 # -- spatial collective probes ------------------------------------------------
@@ -650,7 +735,8 @@ def config_unit_names(name: str) -> List[str]:
 
 def build_units(names: Optional[List[str]] = None,
                 progress: Optional[Callable[[str], None]] = None,
-                spatial: bool = True, epoch: bool = True):
+                spatial: bool = True, epoch: bool = True,
+                quant: bool = True):
     """Yield TracedUnits for the named configs (default: whole registry,
     plus the spatial collective probes and the epoch-scan units). Each
     unit's jaxpr is yielded and then released by the caller — keeping the
@@ -692,5 +778,9 @@ def build_units(names: Optional[List[str]] = None,
             yield u
     if epoch:
         for u in _epoch_scan_units():
+            yield u
+        gc.collect()
+    if quant:
+        for u in _quant_units():
             yield u
         gc.collect()

@@ -144,3 +144,15 @@ def cost_summary(closed: ClosedJaxpr) -> Dict[str, int]:
         flops += mult * f
     nbytes += sum(_aval_bytes(v.aval) for v in closed.jaxpr.constvars)
     return {"flops": int(flops), "bytes": int(nbytes), "eqns": int(n_eqns)}
+
+
+def param_bytes(closed: ClosedJaxpr, trailing_inputs: int = 1) -> int:
+    """Bytes of a predict program's WEIGHT arguments — every input aval
+    except the trailing image batch. This is the per-dispatch HBM weight
+    traffic the fusion-blind `bytes` proxy cannot isolate (it counts int32
+    accumulators and quantize chains that XLA fuses away), and the number
+    the int8 serve units halve: on the r05 bandwidth-bound regime, weight
+    bytes ARE the serving lever."""
+    invars = closed.jaxpr.invars
+    keep = invars[:max(0, len(invars) - trailing_inputs)]
+    return int(sum(_aval_bytes(v.aval) for v in keep))

@@ -16,7 +16,8 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 
 from .harness import TracedUnit
-from .jaxpr_walk import collect_collectives, cost_summary, heavy_eqns
+from .jaxpr_walk import collect_collectives, cost_summary, heavy_eqns, \
+    param_bytes
 
 ALL_CHECKS: Dict[str, str] = {
     "DTYPE": "no f32 conv/dot reachable inside a declared-bf16 apply "
@@ -34,6 +35,10 @@ ALL_CHECKS: Dict[str, str] = {
             "jaxpr, diffed against the committed CHECK_COST.json baseline",
     "SERVE": "PredictEngine bucket signatures {1, 8, 32, max_batch} cover "
              "each servable config's input spec with f32 outputs",
+    "QUANT": "the int8 predict twins run their planned conv/dot equations "
+             "in int8 (int32 accumulation) with f32 float outputs "
+             "preserved, and their weight-argument bytes (param_bytes "
+             "cost row) undercut the bf16 twin's by >= 1.8x",
     "TRACE": "every registered (config, model, step-factory) combination "
              "builds and traces abstractly at all",
 }
@@ -41,7 +46,14 @@ ALL_CHECKS: Dict[str, str] = {
 # COST drift tolerances (relative). FLOPs from abstract shapes are exact,
 # so any drift is a real model/step change; the bytes proxy may wobble a
 # hair with jax's trace-level canonicalization, eqn counts a bit more.
-COST_TOLERANCE = {"flops": 1e-6, "bytes": 0.01, "eqns": 0.05}
+COST_TOLERANCE = {"flops": 1e-6, "bytes": 0.01, "eqns": 0.05,
+                  "param_bytes": 1e-6}
+
+# the int8 serve units' hard byte bar: weight-argument bytes must undercut
+# the bf16 twin's by at least this factor (f32 -> int8 is ~4x on the
+# kernels; BN/bias/head leaves stay f32, so the tree-level cut lands ~3-4x
+# — 1.8x is the never-regress floor, enforced per sweep)
+QUANT_PARAM_BYTES_FACTOR = 1.8
 
 
 @dataclasses.dataclass
@@ -86,6 +98,18 @@ def check_dtype(unit: TracedUnit) -> List[Finding]:
                     f"outputs must be f32 (engine contract, serve/engine.py)"))
         return findings
     policy = jnp.dtype(policy)
+    if unit.meta.get("kind") == "predict":
+        # traced predict/serve units keep the engine's f32-output contract
+        # on top of the compute-policy audit below
+        for aval in unit.out_avals:
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating) \
+                    and not _is_f32(dt):
+                findings.append(Finding(
+                    unit.name, "DTYPE",
+                    f"float output is {dt}, not float32 — serving/predict "
+                    f"outputs must be f32 (engine contract, "
+                    f"serve/engine.py)"))
     for eqn, _mult, _flops in heavy_eqns(unit.closed):
         out_dt = jnp.dtype(eqn.outvars[0].aval.dtype)
         if policy == jnp.bfloat16 and out_dt == jnp.float32:
@@ -238,6 +262,73 @@ def check_serve(unit: TracedUnit) -> List[Finding]:
     return findings
 
 
+def check_quant(unit: TracedUnit) -> List[Finding]:
+    """The int8 predict twin really runs int8 where the plan claims: every
+    planned heavy equation must take int8 operands and accumulate in int32,
+    every float heavy equation left behind must be head-exempt, and the
+    dequantized results must keep float32 at the output boundary (the
+    engine contract — checked by DTYPE's output rule on the same unit).
+    The mutation test widens a quantized conv back to float and this rule
+    must fire (tests/test_jaxvet.py)."""
+    if unit.quant is None or unit.closed is None:
+        return []
+    findings: List[Finding] = []
+    planned = int(unit.quant.get("planned", 0))
+    n_int8 = 0
+    for eqn, _mult, _flops in heavy_eqns(unit.closed):
+        in_dt = jnp.dtype(eqn.invars[0].aval.dtype)
+        rhs_dt = jnp.dtype(eqn.invars[1].aval.dtype)
+        out_dt = jnp.dtype(eqn.outvars[0].aval.dtype)
+        if in_dt == jnp.int8 and rhs_dt == jnp.int8:
+            if out_dt != jnp.int32:
+                findings.append(Finding(
+                    unit.name, "QUANT",
+                    f"int8 {eqn.primitive.name} accumulates in {out_dt}, "
+                    f"not int32 — partial products past 127^2 x taps "
+                    f"would wrap silently"))
+            n_int8 += 1
+            continue
+        if jnp.issubdtype(in_dt, jnp.floating) \
+                and not unit.head_dims & _eqn_dims(eqn):
+            shape = tuple(eqn.outvars[0].aval.shape)
+            findings.append(Finding(
+                unit.name, "QUANT",
+                f"claimed-int8 predict carries a float "
+                f"{eqn.primitive.name} {shape} ({in_dt}) outside the f32 "
+                f"heads — the quantized path silently widened back to "
+                f"float, the exact regression the int8 byte cut exists "
+                f"to prevent"))
+    if n_int8 < planned:
+        findings.append(Finding(
+            unit.name, "QUANT",
+            f"plan claims {planned} int8 equations but the traced jaxpr "
+            f"carries {n_int8} — quantization quietly skipped "
+            f"{planned - n_int8} of them"))
+    return findings
+
+
+def check_quant_bytes(unit_name: str, quant_facts: dict,
+                      cost_table: dict) -> List[Finding]:
+    """The byte-cut bar, enforced against the committed cost rows: the
+    int8 unit's weight-argument bytes must undercut its bf16 twin's
+    (`<config>/serve`) by QUANT_PARAM_BYTES_FACTOR. Runs in the sweep loop
+    (cli.audit) once both rows exist."""
+    base_name = quant_facts.get("baseline_unit")
+    mine = cost_table.get(unit_name, {}).get("param_bytes")
+    theirs = cost_table.get(base_name, {}).get("param_bytes")
+    if mine is None or theirs is None:
+        return []
+    if mine * QUANT_PARAM_BYTES_FACTOR > theirs:
+        return [Finding(
+            unit_name, "QUANT",
+            f"int8 weight-argument bytes {mine} vs bf16 twin "
+            f"{base_name} {theirs} — cut is only "
+            f"{theirs / max(mine, 1):.2f}x, below the "
+            f"{QUANT_PARAM_BYTES_FACTOR:g}x bar (did quantization skip "
+            f"the heavy kernels?)")]
+    return []
+
+
 def check_trace(unit: TracedUnit) -> List[Finding]:
     if unit.error:
         return [Finding(unit.name, "TRACE",
@@ -248,7 +339,14 @@ def check_trace(unit: TracedUnit) -> List[Finding]:
 def cost_of(unit: TracedUnit) -> Optional[dict]:
     if unit.closed is None or unit.name.startswith("spatial/"):
         return None
-    return cost_summary(unit.closed)
+    cost = cost_summary(unit.closed)
+    if unit.meta.get("kind") == "predict":
+        # predict/serve/quant units: the weight bytes one dispatch reads —
+        # the serving bandwidth lever the int8 twins halve (the fusion-
+        # blind `bytes` proxy cannot see it: int32 accumulators and
+        # quantize chains that fuse away dominate it)
+        cost["param_bytes"] = param_bytes(unit.closed)
+    return cost
 
 
 def check_cost(unit_name: str, cost: dict,
@@ -310,6 +408,8 @@ def run_checks(unit: TracedUnit, select=None) -> List[Finding]:
             out.extend(check_donate(unit))
         if on("SERVE"):
             out.extend(check_serve(unit))
+        if on("QUANT"):
+            out.extend(check_quant(unit))
     if on("COLL"):
         out.extend(check_coll(unit))
     return out

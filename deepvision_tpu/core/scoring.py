@@ -1,0 +1,225 @@
+"""Predict-side watch metrics: score a model family from its SERVING outputs.
+
+Two serving-side gates need the same primitive — "replay a pinned shard
+through the engine and reduce the outputs to one scalar the family watches":
+
+- the accuracy-gated promotion pipeline (serve/promote.py) comparing two
+  WEIGHT generations, and
+- the int8 quantization gate (serve/quantize.py) comparing two PRECISIONS
+  of one generation.
+
+Until this module, only classification (top-1 from logits) and segmentation
+(mIoU from class-id masks) could be scored, so detection/pose/centernet
+took the integrity-only path. This closes the ROADMAP item-3 follow-up with
+predict-side PROXY scores for the remaining families, computed from exactly
+the payloads clients get:
+
+- detection (YOLO serves decoded (boxes, objectness, class_probs) triples
+  per scale): a box-count agreement score — per image, the number of
+  anchors with objectness > 0.5 against the ground-truth box count,
+  reduced as mean(1 / (1 + |pred - true|)). Coarser than mAP, but it is
+  monotone in the right thing (a generation or precision that moves
+  objectness across the decision threshold moves the score) and needs no
+  NMS replay on the host.
+- pose (hourglass serves per-stack heatmaps): PCK@0.2 on the LAST stack —
+  the fraction of visible keypoints whose heatmap argmax lands within 0.2
+  (normalized) of the ground truth.
+- centernet (per-stack {heatmap, wh, offset} head dicts): the same
+  box-count agreement score over sigmoid(heatmap) peaks > 0.3 on the last
+  stack.
+
+All three are deltas-not-absolutes metrics: the gates only ever compare
+score(A) - score(B) on IDENTICAL pinned inputs, so a proxy that tracks
+prediction movement is sufficient — docs/SERVING.md "Promotion" states the
+contract.
+
+`pinned_shard` is the one source of those pinned inputs: deterministic per
+(config, seed) down to the byte (tests/test_quant.py pins equality across
+processes), shaped/dtyped for the engine, built from each family's own
+synthetic generator / calibration-batch recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# families whose watched metric is computable from serving outputs — with
+# the detection/pose/centernet proxies, every servable (non-GAN) family
+GATED_FAMILIES = ("classification", "segmentation", "detection", "pose",
+                  "centernet")
+
+DEFAULT_SHARD_SEED = 12345
+
+# decision thresholds of the count proxies (objectness sigmoid for YOLO,
+# peak sigmoid for CenterNet's -2.19-biased heatmap head) and the PCK
+# radius, in normalized coordinates
+DETECTION_OBJ_THRESH = 0.5
+CENTERNET_PEAK_THRESH = 0.3
+PCK_RADIUS = 0.2
+
+
+def watch_metric_name(cfg) -> str:
+    """The scalar `score_serving_outputs` reduces to, for logs/healthz."""
+    return {"classification": "top1", "segmentation": "miou",
+            "detection": "box_count", "centernet": "box_count",
+            "pose": "pck"}[cfg.family]
+
+
+def serving_head_dims(cfg) -> frozenset:
+    """Dimensions that identify the DELIBERATE f32 output heads of a
+    declared-bf16 model (`models/*.py`: `nn.Dense(num_classes,
+    dtype=jnp.float32)`, the f32 detection/pose head convs). Shared by
+    jaxvet's DTYPE rule (an f32 conv/dot is policy-conformant iff it
+    touches one of these dims) and the int8 quantization plan (the same
+    equations stay in float — the head keeps full precision)."""
+    nc = cfg.data.num_classes
+    dims = {nc}
+    if cfg.family == "detection":        # YOLO: 3 anchors x (5 + nc) head
+        dims.add(3 * (5 + nc))
+    if cfg.family == "centernet":        # heatmap nc + wh/offset pairs, and
+        dims.update({nc, 2, 64})         # the shared 64-wide f32 head conv
+    if cfg.family == "pose":             # per-stack heatmap heads
+        dims.add(nc)
+    if cfg.family == "segmentation":     # the f32 1x1 class-logit head
+        dims.add(nc)
+    return frozenset(d for d in dims if d)
+
+
+def pinned_shard(cfg, *, image_size: int, input_dtype,
+                 examples: int = 64,
+                 seed: int = DEFAULT_SHARD_SEED) -> Tuple[np.ndarray, tuple]:
+    """One deterministic labeled batch for shadow eval / quantization
+    calibration: `(images, targets)` where `targets` is the family's
+    ground-truth tuple. Byte-identical per (config, image_size, dtype,
+    examples, seed) — both gates score live-vs-candidate (or bf16-vs-int8)
+    on IDENTICAL inputs, so the delta is pure weight/precision difference.
+    Production deployments pass a real held-out shard instead; the
+    synthetic default keeps the gates closed-loop testable with no data on
+    disk."""
+    h = int(image_size)
+    ch = cfg.data.channels
+    input_dtype = np.dtype(input_dtype)
+    emit_uint8 = input_dtype == np.dtype(np.uint8)
+    if cfg.family == "classification":
+        from ..data.synthetic import SyntheticClassification
+        gen = SyntheticClassification(
+            examples, image_size=h, channels=ch,
+            num_classes=cfg.data.num_classes, num_batches=1, seed=seed,
+            emit_uint8=emit_uint8)
+        images, labels = next(iter(gen))
+        return images.astype(input_dtype), (np.asarray(labels, np.int64),)
+    if cfg.family == "segmentation":
+        from ..data.segmentation import SyntheticSegmentation
+        gen = SyntheticSegmentation(
+            examples, image_size=h, channels=ch,
+            num_classes=cfg.data.num_classes, num_batches=1, seed=seed,
+            emit_uint8=emit_uint8)
+        images, masks = next(iter(gen))
+        return images.astype(input_dtype), (np.asarray(masks, np.int64),)
+    rs = np.random.RandomState(seed)
+    if cfg.family in ("detection", "centernet"):
+        from ..ops.yolo import MAX_BOXES
+        images = (rs.randint(0, 256, (examples, h, h, ch))
+                  if emit_uint8
+                  else rs.rand(examples, h, h, ch) * 2.0 - 1.0)
+        # varying per-example box counts: the count-agreement proxy needs
+        # per-image diversity, unlike the uniform one-box grad-calibration
+        # batch (core/detection.boxes_calibration_batch)
+        boxes = np.zeros((examples, MAX_BOXES, 4), np.float32)
+        classes = np.zeros((examples, MAX_BOXES), np.int32)
+        valid = np.zeros((examples, MAX_BOXES), np.float32)
+        counts = rs.randint(0, 4, size=(examples,))
+        for i, n in enumerate(counts):
+            for j in range(n):
+                cx, cy = rs.uniform(0.2, 0.8, size=2)
+                w = rs.uniform(0.1, 0.3)
+                boxes[i, j] = [cx, cy, w, w]
+                classes[i, j] = rs.randint(0, cfg.data.num_classes)
+                valid[i, j] = 1.0
+        return images.astype(input_dtype), (boxes, classes, valid)
+    if cfg.family == "pose":
+        k = cfg.data.num_classes           # keypoint count (MPII: 16)
+        images = (rs.randint(0, 256, (examples, h, h, ch))
+                  if emit_uint8 else rs.rand(examples, h, h, ch))
+        kp_x = rs.rand(examples, k).astype(np.float32)
+        kp_y = rs.rand(examples, k).astype(np.float32)
+        visibility = (rs.rand(examples, k) > 0.2).astype(np.float32)
+        return images.astype(input_dtype), (kp_x, kp_y, visibility)
+    raise ValueError(
+        f"config {cfg.name!r} (family {cfg.family!r}) has no predict-side "
+        f"watch metric — gated families: {GATED_FAMILIES}")
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-np.clip(x, -30.0, 30.0)))
+
+
+def _count_agreement(pred_counts: np.ndarray,
+                     true_counts: np.ndarray) -> float:
+    """mean(1 / (1 + |pred - true|)) in (0, 1] — 1.0 iff every image's
+    predicted count matches; smooth in the miss size, so threshold-crossing
+    perturbations (a regressed generation, a bad quantization) move it."""
+    return float(np.mean(1.0 / (1.0 + np.abs(
+        pred_counts.astype(np.float64) - true_counts.astype(np.float64)))))
+
+
+def score_serving_outputs(cfg, outputs, targets) -> float:
+    """Reduce one engine `predict()` output pytree + the pinned targets to
+    the family's watched scalar. `outputs` is exactly what serving clients
+    get (f32 logits / int32 masks / decoded triples / heatmaps)."""
+    import jax
+
+    if cfg.family == "classification":
+        (labels,) = targets
+        logits = np.asarray(jax.tree_util.tree_leaves(outputs)[0])
+        pred = np.argmax(logits, axis=-1).astype(np.int64)
+        return float(np.mean(pred == np.asarray(labels)))
+    if cfg.family == "segmentation":
+        (masks,) = targets
+        from .metrics import StreamingConfusion
+        sc = StreamingConfusion(cfg.data.num_classes)
+        out = np.asarray(jax.tree_util.tree_leaves(outputs)[0])
+        sc.update_preds(out.astype(np.int64), np.asarray(masks))
+        return float(sc.result()["miou"])
+    if cfg.family == "detection":
+        # decoded per-scale triples (boxes, objectness, class_probs); the
+        # objectness leaves are every 2nd-of-3 leaf (models/yolo.py decode)
+        _, _, valid = targets
+        leaves = jax.tree_util.tree_leaves(outputs)
+        assert len(leaves) % 3 == 0, "expected per-scale decoded triples"
+        b = np.asarray(leaves[0]).shape[0]
+        pred = np.zeros((b,), np.int64)
+        for i in range(1, len(leaves), 3):      # the objectness leaves
+            obj = np.asarray(leaves[i]).reshape(b, -1)
+            pred += np.sum(obj > DETECTION_OBJ_THRESH, axis=-1)
+        true = np.sum(np.asarray(valid) > 0, axis=-1)
+        return _count_agreement(pred, true)
+    if cfg.family == "centernet":
+        # per-stack {heatmap, wh, offset} dicts: peaks of the LAST stack's
+        # pre-sigmoid heatmap (bias -2.19 — models/centernet.py)
+        _, _, valid = targets
+        hm = np.asarray(outputs[-1]["heatmap"])
+        b = hm.shape[0]
+        peaks = _sigmoid(hm).reshape(b, -1) > CENTERNET_PEAK_THRESH
+        true = np.sum(np.asarray(valid) > 0, axis=-1)
+        return _count_agreement(np.sum(peaks, axis=-1), true)
+    if cfg.family == "pose":
+        kp_x, kp_y, vis = targets
+        hm = np.asarray(outputs[-1] if isinstance(outputs, (tuple, list))
+                        else outputs)           # last stack (B, h, w, K)
+        b, hh, ww, k = hm.shape
+        flat = hm.reshape(b, hh * ww, k)
+        idx = np.argmax(flat, axis=1)            # (B, K)
+        py = (idx // ww) / max(hh - 1, 1)
+        px = (idx % ww) / max(ww - 1, 1)
+        d = np.sqrt((px - np.asarray(kp_x)) ** 2
+                    + (py - np.asarray(kp_y)) ** 2)
+        v = np.asarray(vis) > 0
+        hits = (d < PCK_RADIUS) & v
+        n_vis = max(int(np.sum(v)), 1)
+        return float(np.sum(hits) / n_vis)
+    raise ValueError(
+        f"config {cfg.name!r} (family {cfg.family!r}) has no predict-side "
+        f"watch metric — gated families: {GATED_FAMILIES}")

@@ -55,6 +55,7 @@ _TOTAL_COUNTERS = (
 )
 
 _BREAKER_STATES = ("closed", "open", "half_open")
+_PRECISIONS = ("bf16", "int8")
 
 
 # -- Chrome trace-event export -------------------------------------------------
@@ -220,6 +221,20 @@ def render_prometheus(fleet) -> str:
               "Accuracy-gated promotion decisions (shadow/canary verdicts)",
               promo_samples)
 
+    # weight-precision provenance, one-hot over the compiled ladder: which
+    # precision this model's dispatches run at (the int8 gate's outcome as
+    # a scrapeable fact, not just a /healthz field)
+    precision_samples = []
+    for sm in models:
+        active = getattr(sm.engine, "precision", "bf16")
+        for p in _PRECISIONS:
+            precision_samples.append(
+                ("", {"model": sm.name, "precision": p},
+                 1 if active == p else 0))
+    _emit(lines, PREFIX + "active_precision", "gauge",
+          "Active serving precision, one-hot over {bf16, int8}",
+          precision_samples)
+
     for hist_name, help_text in (
             ("request_latency_seconds",
              "Request latency, submit to result (fixed buckets, lifetime)"),
@@ -229,13 +244,15 @@ def render_prometheus(fleet) -> str:
              "Device dispatch wall time per batch")):
         samples = []
         for sm in models:
-            h = sm.metrics.histograms().get(hist_name)
-            if h is None:
-                continue
-            samples += [("_bucket", {"model": sm.name, "le": _fmt(le)}, n)
-                        for le, n in h["buckets"]]
-            samples.append(("_sum", {"model": sm.name}, h["sum"]))
-            samples.append(("_count", {"model": sm.name}, h["count"]))
+            by_precision = sm.metrics.histograms_by_precision().get(
+                hist_name, {})
+            for precision in sorted(by_precision):
+                h = by_precision[precision]
+                labels = {"model": sm.name, "precision": precision}
+                samples += [("_bucket", {**labels, "le": _fmt(le)}, n)
+                            for le, n in h["buckets"]]
+                samples.append(("_sum", dict(labels), h["sum"]))
+                samples.append(("_count", dict(labels), h["count"]))
         _emit(lines, PREFIX + hist_name, "histogram", help_text, samples)
     return "\n".join(lines) + "\n"
 
@@ -394,4 +411,54 @@ def validate_prometheus_text(text: str) -> List[str]:
             if total is not None and counts[-1] != total:
                 errors.append(f"{fam}{dict(labels)}: +Inf bucket "
                               f"{counts[-1]} != _count {total}")
+    return errors
+
+
+# the serve-exposition labeling contract layered ON TOP of the format
+# rules: every dispatch/latency histogram series must carry BOTH the model
+# and the precision label (the int8 serving axis — a scrape that loses the
+# precision split would average a precision flip away), and the
+# active-precision one-hot gauge must be present for every served model.
+_PRECISION_LABELED = ("deepvision_serve_request_latency_seconds",
+                      "deepvision_serve_queue_wait_seconds",
+                      "deepvision_serve_dispatch_seconds")
+
+
+def validate_serve_exposition(text: str) -> List[str]:
+    """Format validation (`validate_prometheus_text`) PLUS the serving
+    fleet's own labeling contract: model+precision labels on every
+    dispatch/latency histogram sample, precision values from the compiled
+    ladder, and the `active_precision` gauge family present. The shared
+    validator preflight's `obs`/`quant` checks and tests/test_obs.py run
+    against GET /metrics."""
+    errors = validate_prometheus_text(text)
+    saw_active = False
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        if name.startswith("deepvision_serve_active_precision"):
+            saw_active = True
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                break
+        if base not in _PRECISION_LABELED:
+            continue
+        labels = _parse_labels(m.group("labels"), errors, line)
+        for required in ("model", "precision"):
+            if required not in labels:
+                errors.append(f"{name}: histogram sample missing the "
+                              f"{required!r} label")
+        if labels.get("precision") not in (None, *_PRECISIONS):
+            errors.append(f"{name}: unknown precision label "
+                          f"{labels.get('precision')!r}")
+    if "deepvision_serve_requests_total" in text and not saw_active:
+        errors.append("serve exposition lacks the "
+                      "deepvision_serve_active_precision gauge")
     return errors

@@ -1,0 +1,467 @@
+"""int8 post-training quantization as a jaxpr rewrite.
+
+The serving engine's predict function is an arbitrary composition of the
+zoo's model code — thirteen families, none of which carry quantization
+hooks. Rather than threading an int8 flag through every Flax module (and
+re-auditing every family by hand), this module quantizes at the level
+jaxvet already audits: the **closed jaxpr** of the real predict function.
+
+Three stages, mirroring the TensorRT/AQT-style PTQ recipe:
+
+1. `plan_quantization(closed, head_dims)` — purely STRUCTURAL (no FLOPs,
+   abstract-safe, the same walk jaxvet's cost model does): find every
+   conv_general_dilated / dot_general whose rhs operand is a weight leaf of
+   the `variables` pytree (provenance traced through dtype casts), skip the
+   deliberate f32 heads (the `head_dims` convention shared with jaxvet's
+   DTYPE rule via `core.scoring.serving_head_dims`), and record, per heavy
+   equation, the weight leaf index and the per-output-channel axis the
+   weight scales will broadcast over.
+
+2. `calibrate(plan, closed, variables, images)` — replay the SAME jaxpr
+   concretely on a pinned calibration batch, recording the absolute-max of
+   every planned equation's activation input. Per-tensor activation scales
+   (`absmax / 127`) are pinned from this one deterministic pass; per-channel
+   WEIGHT scales are data-free (absmax over the kernel's non-output dims)
+   and recomputed for every weight generation, which is what lets hot
+   reload / promotion re-quantize a new checkpoint with zero recompiles.
+
+3. `quantized_predict_fn(plan, closed)` — a callable with the engine's
+   exact `(variables, images)` signature that replays the jaxpr with every
+   planned equation swapped for its integer twin:
+
+       q_x   = clip(round(x / s_x), -127, 127) -> int8
+       acc   = conv/dot(q_x, w_int8, preferred_element_type=int32)
+       y     = acc * (s_x * s_w[channel])      -> the original out dtype
+
+   i.e. int8 storage AND int8 MXU compute with int32 accumulation,
+   dequantized at the equation boundary — activations between layers (BN,
+   residual adds, nonlinearities) keep the model's declared policy, and the
+   engine's f32-output contract is untouched. Every other equation replays
+   verbatim, so the quantized program IS the original program modulo the
+   planned substitutions — which is exactly what jaxvet's QUANT family
+   re-audits on the traced quantized jaxpr.
+
+Quantized weights travel as a flat `{"q": {leaf: {"w": int8, "s": f32}},
+"f": {leaf: value}}` pytree built by `quantize_variables`, so the compiled
+bucket programs take weights as ARGUMENTS (not baked constants): swapping
+in a re-quantized generation is the same one-reference flip as bf16 serving
+(serve/engine.py), zero recompiles.
+
+Accumulator-range note: int8xint8 into int32 overflows only past ~1.3e5
+taps (127^2 * K < 2^31); the zoo's largest contraction (VGG's 25088-wide
+fc1) is ~2e4 taps, and `plan_quantization` refuses equations beyond the
+bound rather than wrapping silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.core import Jaxpr, Literal
+
+HEAVY_PRIMS = ("conv_general_dilated", "dot_general")
+
+# provenance survives pure dtype casts only: a reshaped/transposed kernel
+# would scramble the per-channel axis bookkeeping, so it is left unquantized
+# (none of the zoo's modules reshape kernels between init and use)
+_CAST_PRIMS = frozenset({"convert_element_type"})
+
+# int8 x int8 partial products are <= 127^2; int32 accumulation is exact
+# while taps * 127^2 < 2^31 — refuse (leave in float) past this, loudly in
+# the plan rather than silently wrapping at dispatch
+MAX_ACC_TAPS = (2 ** 31 - 1) // (127 * 127)
+
+QMAX = 127.0
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantEqn:
+    """One heavy equation the plan quantizes."""
+    eqn_index: int            # position in jaxpr.eqns
+    prim: str                 # conv_general_dilated | dot_general
+    leaf_index: int           # flat index into the variables pytree
+    # per-channel scale layout: weight dims reduced for the scale, and the
+    # broadcast shape that lands the scale vector on the OUTPUT's channel
+    # dim (per-tensor fallback: w_reduce_axes covers every dim and
+    # out_broadcast is all-1s)
+    w_reduce_axes: Tuple[int, ...]
+    scale_shape: Tuple[int, ...]       # shape of the stored scale array
+    out_broadcast: Tuple[int, ...]     # reshape of scale for the dequant mul
+
+
+@dataclasses.dataclass
+class QuantPlan:
+    """The structural half of PTQ: which equations quantize and how. Built
+    abstractly; `act_scales` stays None until `calibrate` fills it."""
+    eqns: List[QuantEqn]
+    n_var_leaves: int                  # leaves of the variables pytree
+    skipped_head: int = 0              # heavy eqns exempted as f32 heads
+    skipped_other: int = 0             # non-weight rhs / unsupported layout
+    act_scales: Optional[Dict[int, float]] = None   # eqn_index -> s_x
+
+    @property
+    def leaf_indices(self) -> frozenset:
+        return frozenset(q.leaf_index for q in self.eqns)
+
+    def summary(self) -> dict:
+        return {"quantized": len(self.eqns),
+                "skipped_head": self.skipped_head,
+                "skipped_other": self.skipped_other,
+                "calibrated": self.act_scales is not None}
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _conv_channel_layout(eqn) -> Optional[Tuple[Tuple[int, ...],
+                                                Tuple[int, ...],
+                                                Tuple[int, ...]]]:
+    """(w_reduce_axes, scale_shape, out_broadcast) for a conv kernel:
+    per-OUTPUT-channel scales (the rhs_spec's O dim), broadcast onto the
+    output's feature dim. Grouped/depthwise convs keep the same layout —
+    O already enumerates every output channel."""
+    dnums = eqn.params["dimension_numbers"]
+    rhs_shape = tuple(_aval(eqn.invars[1]).shape)
+    out_shape = tuple(_aval(eqn.outvars[0]).shape)
+    o_dim = dnums.rhs_spec[0]
+    reduce_axes = tuple(i for i in range(len(rhs_shape)) if i != o_dim)
+    scale_shape = (rhs_shape[o_dim],)
+    bcast = [1] * len(out_shape)
+    bcast[dnums.out_spec[1]] = rhs_shape[o_dim]
+    return reduce_axes, scale_shape, tuple(bcast)
+
+
+def _dot_channel_layout(eqn) -> Optional[Tuple[Tuple[int, ...],
+                                               Tuple[int, ...],
+                                               Tuple[int, ...]]]:
+    """Per-channel layout for a dot_general rhs (the Dense case: rhs
+    (in, out), one free dim that is the LAST output dim). Anything fancier
+    (batched dots, multi-free-dim rhs) falls back to one per-tensor scale —
+    correct, just coarser."""
+    (_, rhs_c), (_, rhs_b) = eqn.params["dimension_numbers"]
+    rhs_shape = tuple(_aval(eqn.invars[1]).shape)
+    out_shape = tuple(_aval(eqn.outvars[0]).shape)
+    free = [i for i in range(len(rhs_shape))
+            if i not in rhs_c and i not in rhs_b]
+    if len(free) == 1 and not rhs_b \
+            and out_shape and out_shape[-1] == rhs_shape[free[0]]:
+        reduce_axes = tuple(i for i in range(len(rhs_shape))
+                            if i != free[0])
+        bcast = [1] * len(out_shape)
+        bcast[-1] = rhs_shape[free[0]]
+        return reduce_axes, (rhs_shape[free[0]],), tuple(bcast)
+    # per-tensor fallback
+    return (tuple(range(len(rhs_shape))), (), tuple([1] * len(out_shape)))
+
+
+def _contraction_taps(eqn) -> int:
+    """Accumulation depth of one output element — the int32-overflow bound."""
+    if eqn.primitive.name == "conv_general_dilated":
+        dnums = eqn.params["dimension_numbers"]
+        rhs = tuple(_aval(eqn.invars[1]).shape)
+        spatial = [rhs[d] for d in dnums.rhs_spec[2:]]
+        return int(math.prod(spatial)) * int(rhs[dnums.rhs_spec[1]])
+    (lhs_c, _), _ = eqn.params["dimension_numbers"]
+    lhs = tuple(_aval(eqn.invars[0]).shape)
+    return int(math.prod(lhs[d] for d in lhs_c)) if lhs_c else 1
+
+
+def _eqn_dims(eqn) -> set:
+    dims = set()
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = _aval(v)
+        if aval is not None and hasattr(aval, "shape"):
+            dims.update(int(d) for d in aval.shape)
+    return dims
+
+
+def plan_quantization(closed, head_dims=frozenset()) -> QuantPlan:
+    """Structural quantization plan over a predict jaxpr traced as
+    `predict(variables, images)`. Abstract-safe: only shapes/dtypes and the
+    equation graph are consulted (jaxvet builds plans on ShapeDtypeStruct
+    traces). `head_dims` marks the deliberate f32 heads (class/box/keypoint
+    widths) that stay in float — the same convention jaxvet's DTYPE rule
+    applies."""
+    jaxpr: Jaxpr = closed.jaxpr
+    n_leaves = len(jaxpr.invars) - 1   # last invar is the images batch
+    # provenance: var -> variables leaf index, through dtype casts only
+    prov: Dict[Any, int] = {v: i for i, v in enumerate(jaxpr.invars[:-1])}
+    plan_eqns: List[QuantEqn] = []
+    skipped_head = skipped_other = 0
+    for idx, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        if name in _CAST_PRIMS and not isinstance(eqn.invars[0], Literal):
+            src = eqn.invars[0]
+            if src in prov and jnp.issubdtype(
+                    _aval(eqn.outvars[0]).dtype, jnp.floating):
+                prov[eqn.outvars[0]] = prov[src]
+            continue
+        if name not in HEAVY_PRIMS:
+            continue
+        lhs, rhs = eqn.invars[0], eqn.invars[1]
+        lhs_aval, rhs_aval = _aval(lhs), _aval(rhs)
+        if (isinstance(rhs, Literal) or rhs not in prov
+                or not jnp.issubdtype(lhs_aval.dtype, jnp.floating)
+                or not jnp.issubdtype(rhs_aval.dtype, jnp.floating)):
+            skipped_other += 1
+            continue
+        if head_dims & _eqn_dims(eqn):
+            skipped_head += 1          # deliberate f32 head: stays float
+            continue
+        if _contraction_taps(eqn) > MAX_ACC_TAPS:
+            skipped_other += 1         # int32 accumulator could overflow
+            continue
+        if name == "conv_general_dilated":
+            layout = _conv_channel_layout(eqn)
+        else:
+            layout = _dot_channel_layout(eqn)
+        reduce_axes, scale_shape, out_bcast = layout
+        plan_eqns.append(QuantEqn(
+            eqn_index=idx, prim=name, leaf_index=prov[rhs],
+            w_reduce_axes=reduce_axes, scale_shape=scale_shape,
+            out_broadcast=out_bcast))
+    return QuantPlan(eqns=plan_eqns, n_var_leaves=n_leaves,
+                     skipped_head=skipped_head, skipped_other=skipped_other)
+
+
+# -- jaxpr replay -------------------------------------------------------------
+
+# call-style primitives whose bind() signature is not (invals, **params):
+# inline-evaluate their inner jaxpr with default semantics instead. Heavy
+# ops nested inside them are NOT quantized (the plan walks the top level
+# only) — the serving predicts trace flat, so nothing hides there; a relu's
+# custom_jvp body is elementwise anyway.
+_CALL_PRIMS = frozenset({"custom_jvp_call", "custom_vjp_call", "pjit",
+                         "closed_call", "core_call", "remat", "checkpoint"})
+
+
+def _default_bind(eqn, invals):
+    """Replay one equation with its original semantics."""
+    if eqn.primitive.name in _CALL_PRIMS:
+        inner = (eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr")
+                 or eqn.params.get("fun_jaxpr"))
+        if inner is not None:
+            closed = inner if hasattr(inner, "jaxpr") else None
+            if closed is not None:
+                return jax.core.eval_jaxpr(closed.jaxpr, closed.consts,
+                                           *invals)
+            return jax.core.eval_jaxpr(inner, [], *invals)
+    out = eqn.primitive.bind(*invals, **eqn.params)
+    return out if eqn.primitive.multiple_results else [out]
+
+
+def _replay(jaxpr: Jaxpr, consts, args, handler):
+    """Minimal closed-jaxpr interpreter: every equation binds verbatim
+    except where `handler(idx, eqn, invals)` returns a substitute result
+    list (NotImplemented = default semantics)."""
+    env: Dict[Any, Any] = {}
+
+    def read(v):
+        return v.val if isinstance(v, Literal) else env[v]
+
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = c
+    for v, a in zip(jaxpr.invars, args):
+        env[v] = a
+    for idx, eqn in enumerate(jaxpr.eqns):
+        invals = [read(v) for v in eqn.invars]
+        out = handler(idx, eqn, invals)
+        if out is NotImplemented:
+            out = _default_bind(eqn, invals)
+        for v, val in zip(eqn.outvars, out):
+            env[v] = val
+    return [read(v) for v in jaxpr.outvars]
+
+
+def calibrate(plan: QuantPlan, closed, variables, images) -> QuantPlan:
+    """Fill the plan's per-tensor activation scales by replaying the f32
+    jaxpr on ONE pinned calibration batch and recording each planned
+    equation's activation abs-max. Deterministic per (jaxpr, batch); the
+    resulting scales are python floats — closure constants of the compiled
+    int8 programs, identical for every weight generation."""
+    flat_vars = jax.tree_util.tree_leaves(variables)
+    if len(flat_vars) != plan.n_var_leaves:
+        raise ValueError(
+            f"calibration variables have {len(flat_vars)} leaves; the plan "
+            f"was built over {plan.n_var_leaves}")
+    args = [jnp.asarray(v) for v in flat_vars] + [jnp.asarray(images)]
+    wanted = {q.eqn_index for q in plan.eqns}
+    absmax: Dict[int, float] = {}
+
+    def handler(idx, eqn, invals):
+        if idx in wanted:
+            absmax[idx] = float(jnp.max(jnp.abs(
+                invals[0].astype(jnp.float32))))
+        return NotImplemented
+
+    _replay(closed.jaxpr, closed.consts, args, handler)
+    plan.act_scales = {
+        # a degenerate all-zero calibration activation still needs a
+        # nonzero scale (divide-by-zero guard); 1/127 maps 0 -> 0 exactly
+        idx: (m / QMAX if m > 0.0 else 1.0 / QMAX)
+        for idx, m in absmax.items()}
+    return plan
+
+
+# -- weights ------------------------------------------------------------------
+
+def quantize_variables(plan: QuantPlan, variables):
+    """Per-channel symmetric int8 quantization of the plan's weight leaves.
+    Returns the flat quantized pytree the int8 bucket programs take as
+    their `variables` argument:
+
+        {"q": {"<leaf>": {"w": int8 kernel, "s": f32 scales}},
+         "f": {"<leaf>": untouched leaf}}
+
+    Data-free (absmax over the kernel itself), so a NEW weight generation
+    re-quantizes under the pinned activation scales without touching the
+    compiled programs — shapes/dtypes (the engine's compatibility
+    signature) depend only on the plan."""
+    flat, _ = jax.tree_util.tree_flatten(variables)
+    if len(flat) != plan.n_var_leaves:
+        raise ValueError(
+            f"variables have {len(flat)} leaves; the plan was built over "
+            f"{plan.n_var_leaves}")
+    by_leaf = {q.leaf_index: q for q in plan.eqns}
+    q: Dict[str, dict] = {}
+    f: Dict[str, Any] = {}
+    for i, leaf in enumerate(flat):
+        spec = by_leaf.get(i)
+        if spec is None:
+            f[str(i)] = leaf
+            continue
+        w = jnp.asarray(leaf, jnp.float32)
+        absmax = jnp.max(jnp.abs(w), axis=spec.w_reduce_axes)
+        scale = jnp.where(absmax > 0, absmax / QMAX, 1.0 / QMAX)
+        scale_b = jnp.expand_dims(scale, spec.w_reduce_axes) \
+            if spec.scale_shape else scale
+        wq = jnp.clip(jnp.round(w / scale_b), -QMAX, QMAX).astype(jnp.int8)
+        q[str(i)] = {"w": wq, "s": scale.astype(jnp.float32)}
+    return {"q": q, "f": f}
+
+
+def quantized_weight_specs(plan: QuantPlan, var_specs: List[Any]):
+    """The abstract twin of `quantize_variables`: ShapeDtypeStructs of the
+    quantized pytree from the f32 leaf specs — what jaxvet traces the int8
+    unit with, and what `weight_signature` compatibility is checked
+    against."""
+    S = jax.ShapeDtypeStruct
+    by_leaf = {q.leaf_index: q for q in plan.eqns}
+    q: Dict[str, dict] = {}
+    f: Dict[str, Any] = {}
+    for i, spec in enumerate(var_specs):
+        qe = by_leaf.get(i)
+        if qe is None:
+            f[str(i)] = S(tuple(spec.shape), spec.dtype)
+        else:
+            q[str(i)] = {"w": S(tuple(spec.shape), jnp.int8),
+                         "s": S(qe.scale_shape, jnp.float32)}
+    return {"q": q, "f": f}
+
+
+def tree_nbytes(tree) -> int:
+    """Total payload bytes of a pytree of arrays (the bytes/batch weight
+    term the int8 bench reports)."""
+    return int(sum(np.prod(np.shape(leaf))
+                   * jnp.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+                   for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+# -- the quantized predict ----------------------------------------------------
+
+def quantized_predict_fn(plan: QuantPlan, closed, out_tree=None):
+    """Build `qpredict(qvariables, images)` — the int8 twin of the predict
+    the jaxpr was traced from. Replays every equation verbatim except:
+
+    - planned heavy equations run int8 x int8 -> int32 and dequantize at
+      the boundary back to the equation's ORIGINAL output dtype;
+    - the dtype-cast feeding a quantized weight is dropped (the int8 kernel
+      is consumed directly).
+
+    Traceable (jit/AOT-lower) like any jax function; activation scales are
+    baked closure floats, weights arrive as arguments."""
+    if plan.act_scales is None:
+        raise ValueError("plan is not calibrated — run calibrate() (or "
+                         "inject unit scales for an abstract trace) first")
+    jaxpr: Jaxpr = closed.jaxpr
+    consts = closed.consts
+    by_eqn = {q.eqn_index: q for q in plan.eqns}
+    # vars whose value IS a quantized weight (the leaf invar and its cast
+    # descendants): replay substitutes the QTensor pair for them
+    qleaves = plan.leaf_indices
+
+    expand_axes = {q.leaf_index: q.w_reduce_axes for q in plan.eqns}
+
+    def qpredict(qvariables, images):
+        qmap, fmap = qvariables["q"], qvariables["f"]
+        args: List[Any] = []
+        for i in range(plan.n_var_leaves):
+            if i in qleaves:
+                args.append(_QW(qmap[str(i)]["w"], qmap[str(i)]["s"],
+                                expand_axes[i]))
+            else:
+                args.append(fmap[str(i)])
+        args.append(images)
+
+        def handler(idx, eqn, invals):
+            spec = by_eqn.get(idx)
+            if spec is not None:
+                x, w = invals[0], invals[1]
+                if not isinstance(w, _QW):   # plan/weights drifted apart
+                    raise ValueError(
+                        f"eqn {idx} ({eqn.primitive.name}) expected a "
+                        f"quantized weight — qvariables do not match the "
+                        f"plan")
+                s_x = plan.act_scales[idx]
+                out_dtype = _aval(eqn.outvars[0]).dtype
+                qx = jnp.clip(jnp.round(x.astype(jnp.float32) * (1.0 / s_x)),
+                              -QMAX, QMAX).astype(jnp.int8)
+                params = dict(eqn.params,
+                              preferred_element_type=jnp.dtype(jnp.int32))
+                acc = eqn.primitive.bind(qx, w.w, *invals[2:], **params)
+                scale = (w.s.reshape(spec.out_broadcast)
+                         if spec.scale_shape else w.s)
+                return [(acc.astype(jnp.float32) * (scale * s_x))
+                        .astype(out_dtype)]
+            # a float cast of a quantized weight: absorbed (the int8 kernel
+            # feeds its conv directly; any OTHER use dequantizes here)
+            if any(isinstance(v, _QW) for v in invals):
+                if eqn.primitive.name in _CAST_PRIMS \
+                        and isinstance(invals[0], _QW):
+                    return [invals[0]]
+                return _default_bind(eqn, [v.dequant() if isinstance(v, _QW)
+                                           else v for v in invals])
+            return NotImplemented
+
+        out = _replay(jaxpr, consts, args, handler)
+        if out_tree is not None:
+            return jax.tree_util.tree_unflatten(out_tree, out)
+        # no out_tree recorded: single-output predicts unwrap, multi-output
+        # predicts come back as the flat tuple (leaf order preserved)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    return qpredict
+
+
+class _QW:
+    """Replay-time sentinel carrying an int8 kernel + its per-channel
+    scales (reduced over `axes`) through the cast chain to its conv/dot."""
+
+    __slots__ = ("w", "s", "axes")
+
+    def __init__(self, w, s, axes):
+        self.w = w
+        self.s = s
+        self.axes = axes
+
+    def dequant(self):
+        scale = self.s
+        if np.ndim(scale):              # re-expand the reduced axes
+            scale = jnp.expand_dims(scale, self.axes)
+        return self.w.astype(jnp.float32) * scale

@@ -19,6 +19,11 @@ checkpoint into something that takes traffic (docs/SERVING.md):
   control")
 - reload.WeightReloader: hot weight reload — new integrity-verified
   epochs swap into live engines atomically, zero downtime, zero recompiles
+- quantize.Quantizer / arm_int8: calibrated int8 serving behind a hard
+  accuracy gate — int8 bucket twins compiled beside the bf16 cache
+  (`--serve-precision int8`), refusal falls back to bf16 loudly, hot
+  reload/promotion re-quantize with zero recompiles (docs/SERVING.md
+  "Quantized serving")
 - promote.PromotionController: accuracy-gated promotion — shadow eval of
   each candidate against the live generation on a pinned shard, a
   metric-delta gate, canary traffic routing, and p99/error auto-rollback,
@@ -37,5 +42,6 @@ from .engine import PredictEngine, load_checkpoint_weights, pick_bucket  # noqa:
 from .fleet import ModelFleet, ServedModel, UnknownModel  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .promote import PromotionController, pinned_eval_shard  # noqa: F401
+from .quantize import Quantizer, arm_int8  # noqa: F401
 from .reload import WeightReloader  # noqa: F401
 from .server import InferenceServer  # noqa: F401

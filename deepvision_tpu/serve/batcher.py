@@ -113,10 +113,12 @@ def result_within(future: Future, deadline_s: Optional[float], *,
 
 
 class _Request:
-    __slots__ = ("images", "n", "future", "t_submit", "generation", "trace")
+    __slots__ = ("images", "n", "future", "t_submit", "generation",
+                 "precision", "trace")
 
     def __init__(self, images: np.ndarray,
                  generation: Optional[str] = None,
+                 precision: Optional[str] = None,
                  trace=None):
         self.images = images
         self.n = images.shape[0]
@@ -126,6 +128,10 @@ class _Request:
         # dispatcher never coalesces requests of different generations into
         # one batch — the promotion canary's zero-mixed-weights contract.
         self.generation = generation
+        # compiled precision this request is pinned to (None = the model's
+        # active precision). Same coalescing rule as generations: a batch
+        # runs ONE precision's executables — int8 and bf16 rows never mix.
+        self.precision = precision
         # obs.trace.TraceContext of a SAMPLED request (None for unsampled /
         # tracing off): the dispatcher records this request's queue_wait
         # span and links it to the batch span that served it
@@ -279,6 +285,7 @@ class DynamicBatcher:
     # -- client side -------------------------------------------------------
 
     def submit(self, images, *, generation: Optional[str] = None,
+               precision: Optional[str] = None,
                deadline_s: Optional[float] = None, trace=None) -> Future:
         x = self.engine._coerce(images)
         n = x.shape[0]
@@ -286,6 +293,10 @@ class DynamicBatcher:
             raise ValueError(
                 f"request of {n} examples exceeds max_batch="
                 f"{self.max_batch}; split client batches")
+        if precision is not None:
+            # refuse an unarmed precision AT THE DOOR (400, not a batch of
+            # doomed futures); None resolves at dispatch time instead
+            self.engine._resolve_precision(precision)
         breaker = self.breaker
         if breaker is not None:
             wait_s = breaker.reject_for()
@@ -329,7 +340,8 @@ class DynamicBatcher:
                         f"door so you can retry elsewhere",
                         eta_s=eta, deadline_s=dl, retry_after_s=retry)
             self._pending += n
-        req = _Request(x, generation=generation, trace=trace)
+        req = _Request(x, generation=generation, precision=precision,
+                       trace=trace)
         self._q.put(req)
         return req.future
 
@@ -389,9 +401,10 @@ class DynamicBatcher:
                 if total + nxt.n > self.max_batch:
                     carry = nxt             # first request of the NEXT batch
                     break                   # max_batch flush
-                if nxt.generation != first.generation:
-                    carry = nxt             # generation boundary: a batch
-                    break                   # runs ONE weight generation
+                if nxt.generation != first.generation \
+                        or nxt.precision != first.precision:
+                    carry = nxt             # generation/precision boundary:
+                    break                   # a batch runs ONE weight set
                 batch.append(nxt)
                 total += nxt.n
             self._dispatch(batch, total, t_collect)
@@ -404,11 +417,17 @@ class DynamicBatcher:
                   t_collect: Optional[float] = None) -> None:
         images = (batch[0].images if len(batch) == 1
                   else np.concatenate([r.images for r in batch]))
-        generation = batch[0].generation   # whole batch shares it (collect
-        t0 = time.monotonic()              # loop breaks on a boundary)
+        generation = batch[0].generation   # whole batch shares both (the
+        precision = batch[0].precision     # collect loop breaks on either
+        t0 = time.monotonic()              # boundary)
+        # the precision label metrics/spans carry: an explicit request
+        # precision, else the model's active one at dispatch time
+        precision_label = precision or getattr(self.engine, "precision",
+                                               "bf16")
         try:
             self.faults.before_serve_dispatch()
-            out = self.engine.predict(images, generation=generation)
+            out = self.engine.predict(images, generation=generation,
+                                      precision=precision)
         except BaseException as e:  # noqa: BLE001 — must reach the futures,
             now = time.monotonic()  # not kill the dispatcher worker
             with self._lock:
@@ -417,7 +436,8 @@ class DynamicBatcher:
             if self.metrics is not None:
                 self.metrics.observe_dispatch_error()
             trace_ref = self._trace_batch(batch, total, t_collect, t0, now,
-                                          generation, error=repr(e))
+                                          generation, precision_label,
+                                          error=repr(e))
             if self.breaker is not None:
                 # the failing batch's span is the breaker's evidence: a
                 # later breaker_opened event joins back to these spans
@@ -445,15 +465,16 @@ class DynamicBatcher:
                 dispatch_s=now - t0,
                 request_latencies_s=latencies,
                 # queueing vs device split: submit accept -> dispatch start
-                queue_waits_s=[t0 - r.t_submit for r in batch])
+                queue_waits_s=[t0 - r.t_submit for r in batch],
+                precision=precision_label)
         trace_ref = self._trace_batch(batch, total, t_collect, t0, now,
-                                      generation)
+                                      generation, precision_label)
         self._observe(generation, latencies, now - t0, None,
                       trace_ref=trace_ref)
 
     def _trace_batch(self, batch: List[_Request], total: int,
                      t_collect: Optional[float], t0: float, now: float,
-                     generation: Optional[str],
+                     generation: Optional[str], precision: str = "bf16",
                      error: Optional[str] = None) -> Optional[str]:
         """Record the batch-level spans (one `batch` span linked to its N
         request spans, plus the `device_dispatch` child) and each sampled
@@ -475,6 +496,7 @@ class DynamicBatcher:
         args = {"model": name,
                 "bucket": pick_bucket(total, self.engine.buckets),
                 "generation": generation or "live", "worker": worker,
+                "precision": precision,
                 "n_real": total, "n_requests": len(batch),
                 "requests": [r.trace.request_id for r in traced]}
         if error is not None:

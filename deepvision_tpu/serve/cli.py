@@ -89,6 +89,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="canary decision window: how long candidate and "
                         "baseline traffic are compared (p99, error rate) "
                         "before promote/rollback (default 5)")
+    p.add_argument("--serve-precision", choices=("bf16", "int8"),
+                   default="bf16",
+                   help="serving precision (docs/SERVING.md 'Quantized "
+                        "serving'): int8 calibrates each model on its "
+                        "pinned shard, compiles int8 bucket twins beside "
+                        "the bf16 cache, and flips the model to int8 ONLY "
+                        "if the accuracy gate passes — a regression beyond "
+                        "--quant-gate refuses loudly and keeps serving "
+                        "bf16 (decision on /healthz + the resilience_ "
+                        "stream). Per-request override: body "
+                        "{'precision': 'bf16'|'int8'}. Default bf16")
+    p.add_argument("--quant-gate", type=float, default=0.02,
+                   metavar="DELTA",
+                   help="int8 accuracy gate: the watched metric (top-1 / "
+                        "mIoU / box-count / PCK) may be at most DELTA "
+                        "worse at int8 than bf16 on the pinned shard "
+                        "(default 0.02 = 2 points); beyond it the model "
+                        "serves bf16 and the refusal is logged")
     p.add_argument("--image-size", type=int, default=None,
                    help="serving resolution (default: each config's)")
     p.add_argument("--no-verify", action="store_true",
@@ -321,6 +339,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.trace_sample is not None and not 0.0 <= args.trace_sample <= 1.0:
         parser.error(f"--trace-sample must be in [0, 1], got "
                      f"{args.trace_sample}")
+    if args.quant_gate < 0:
+        parser.error(f"--quant-gate must be >= 0, got {args.quant_gate}")
 
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
@@ -373,6 +393,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         default_deadline_s=args.deadline_ms / 1000.0,
         trace=not args.no_trace,
         trace_sample=args.trace_sample)
+    if args.serve_precision == "int8":
+        # arm + gate int8 per model BEFORE traffic: the calibration pass
+        # and the bucket compiles are startup cost, never request cost. A
+        # refusal (or a family with no predict-side watch metric) keeps
+        # that model on bf16 — loudly, never silently; decisions land on
+        # the server's resilience_ stream and /healthz.
+        from .quantize import arm_int8
+        for sm_ in fleet:
+            try:
+                arm_int8(sm_.engine, gate=args.quant_gate,
+                         logger=server.logger)
+            except ValueError as e:
+                print(f"[serve:{sm_.name}] int8 skipped: {e}", flush=True)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

@@ -24,6 +24,15 @@ process per chip behind a load balancer (each process owns its params on
 too big for one chip, which serving buckets never are. The batch-of-1
 utilization problem is the dynamic micro-batcher's job (serve/batcher.py).
 
+The engine carries a **precision axis** beside the bucket axis: bf16 (the
+train-matched policy above) always, plus optional int8 bucket twins armed
+by the calibrated quantization gate (serve/quantize.arm_int8 — per-channel
+weight scales, pinned per-tensor activation scales, f32 heads preserved).
+`precision` is the model's active default; every compiled precision stays
+per-request addressable (`predict(..., precision=)`), and both weight
+generations exist at both precisions so promotion/hot reload never compare
+across precisions.
+
 The engine can host TWO weight generations at once: the live one every
 ordinary dispatch uses, and a staged candidate (`stage_candidate`) the
 accuracy-gated promotion pipeline (serve/promote.py) shadow-evaluates and
@@ -46,6 +55,12 @@ import numpy as np
 # the ONE definition of on-device input normalization, shared with the
 # train/eval steps so serving can never drift from the training dtype policy
 from ..core.steps import _normalize_input
+
+# the engine's precision axis: "bf16" is the train-matched compute policy
+# (f32 for configs that pin f32), "int8" the calibrated post-training
+# quantization twin compiled beside it (serve/quantize.py). Selected
+# per-model by the quant gate (`--serve-precision int8`) or per-request.
+PRECISIONS = ("bf16", "int8")
 
 
 def load_checkpoint_weights(name: str, workdir: str, *,
@@ -206,6 +221,19 @@ class PredictEngine:
         self._candidate = None
         self.candidate_provenance: Optional[dict] = None
         self._candidate_delay_s = 0.0   # fault injection: canary latency spike
+        # -- int8 precision axis (serve/quantize.py) -----------------------
+        # armed by enable_int8: a Quantizer (pinned activation scales +
+        # per-generation weight quantization), the quantized weight trees
+        # for both generations, and the int8 bucket executables compiled
+        # BESIDE the bf16 ones. `precision` is the model's ACTIVE default
+        # — flipped to "int8" only after the accuracy gate passes; either
+        # precision stays per-request addressable while both are compiled.
+        self.precision: str = "bf16"
+        self.quant_decision: Optional[dict] = None   # last gate verdict
+        self._quantizer = None
+        self._qvariables = None
+        self._qcandidate = None
+        self._compiled_int8: dict = {}
 
         def predict(variables, images):
             x = _normalize_input(images, input_norm, compute_dtype)
@@ -320,7 +348,8 @@ class PredictEngine:
             else:
                 cache = "off"
             self.compile_log.append(
-                {"bucket": b, "compile_s": round(dt, 3), "cache": cache})
+                {"bucket": b, "compile_s": round(dt, 3), "cache": cache,
+                 "precision": "bf16"})
             if verbose:
                 print(f"[serve:{self.name}] bucket {b}: compiled in "
                       f"{dt:.2f}s (persistent-cache {cache})", flush=True)
@@ -331,6 +360,81 @@ class PredictEngine:
         x = np.zeros((self.max_batch, *self.example_shape), self.input_dtype)
         for b in self.buckets:
             jax.block_until_ready(self._compiled[b](self._variables, x[:b]))
+            if b in self._compiled_int8:
+                jax.block_until_ready(
+                    self._compiled_int8[b](self._qvariables, x[:b]))
+
+    # -- int8 precision axis (serve/quantize.py) ---------------------------
+
+    @property
+    def int8_enabled(self) -> bool:
+        return bool(self._compiled_int8)
+
+    def enable_int8(self, quantizer, verbose: bool = True) -> None:
+        """Compile the int8 bucket twins beside the bf16 cache — the
+        ONE-TIME arm cost (serve/quantize.arm_int8 drives this and gates
+        the result before flipping `precision`). Per bucket the quantizer
+        re-traces the predict at that batch size and bakes its pinned
+        activation scales; the quantized weight tree is staged once. The
+        active precision is NOT changed here — that is the gate's call."""
+        from ..cli import compilation_cache_stats, install_cache_stats_hooks
+        install_cache_stats_hooks()
+        self._quantizer = quantizer
+        qvars = quantizer.quantize(self._variables)
+        self._qvariables = jax.device_put(qvars, self._device)
+        jax.block_until_ready(self._qvariables)
+        for b in self.buckets:
+            before = compilation_cache_stats()
+            t0 = time.perf_counter()
+            self._compile_int8_bucket(quantizer, b)
+            dt = time.perf_counter() - t0
+            after = compilation_cache_stats()
+            if after["hits"] > before["hits"]:
+                cache = "hit"
+            elif after["misses"] > before["misses"]:
+                cache = "miss"
+            else:
+                cache = "off"
+            self.compile_log.append(
+                {"bucket": b, "compile_s": round(dt, 3), "cache": cache,
+                 "precision": "int8"})
+            if verbose:
+                print(f"[serve:{self.name}] int8 bucket {b}: compiled in "
+                      f"{dt:.2f}s (persistent-cache {cache})", flush=True)
+
+    def _compile_int8_bucket(self, quantizer, b: int) -> None:
+        # one AOT compile per (bucket, quantized twin): each bucket's
+        # quantized predict is a DISTINCT function (its jaxpr is baked at
+        # that batch size), so this is the factory site, not a retrace
+        spec = jax.ShapeDtypeStruct((b, *self.example_shape),
+                                    self.input_dtype)
+        qfn = quantizer.quantized_fn(self._variables, spec)
+        self._compiled_int8[b] = jax.jit(qfn).lower(
+            self._qvariables, spec).compile()
+
+    def disable_int8(self) -> None:
+        """Retreat to bf16-only serving (the gate's refusal path): the
+        quantized tree and int8 executables are dropped, the active
+        precision returns to bf16. The gate's decision record
+        (`quant_decision`) is kept — /healthz shows WHY int8 is off."""
+        self.precision = "bf16"
+        self._quantizer = None
+        self._qvariables = None
+        self._qcandidate = None
+        self._compiled_int8 = {}
+
+    def set_precision(self, precision: str) -> None:
+        """Flip the model's ACTIVE precision (dispatches that don't ask for
+        one explicitly). int8 requires armed+compiled int8 buckets."""
+        if precision not in PRECISIONS:
+            raise ValueError(f"unknown precision {precision!r} "
+                             f"(expected one of {PRECISIONS})")
+        if precision == "int8" and not self.int8_enabled:
+            raise ValueError(
+                f"int8 serving is not armed for {self.name!r} — run the "
+                f"calibration gate first (serve/quantize.arm_int8, or "
+                f"--serve-precision int8 on the serve CLI)")
+        self.precision = precision
 
     # -- hot weight reload -------------------------------------------------
 
@@ -356,8 +460,19 @@ class PredictEngine:
                 f"shapes/dtypes differ) — the AOT bucket programs would "
                 f"need a recompile; build a fresh engine instead")
         staged = jax.device_put(variables, self._device)
+        qstaged = None
+        if self._quantizer is not None:
+            # int8 stays a first-class citizen through hot reload: the new
+            # generation re-quantizes under the PINNED activation scales
+            # (weight scales are data-free) — same shapes/dtypes, so the
+            # compiled int8 buckets run it as-is, zero recompiles
+            qstaged = jax.device_put(self._quantizer.quantize(variables),
+                                     self._device)
+            jax.block_until_ready(qstaged)
         jax.block_until_ready(staged)   # fully resident before going live
         self._variables = staged
+        if qstaged is not None:
+            self._qvariables = qstaged
         if provenance is not None:
             self.provenance = dict(provenance)
 
@@ -389,6 +504,15 @@ class PredictEngine:
                 f"need a recompile; build a fresh engine instead")
         staged = jax.device_put(variables, self._device)
         jax.block_until_ready(staged)
+        if self._quantizer is not None:
+            # both generations exist at BOTH precisions while staged: the
+            # canary fraction must run on the candidate at the model's
+            # active precision, or the comparison would measure precision,
+            # not weights
+            qcand = jax.device_put(self._quantizer.quantize(variables),
+                                   self._device)
+            jax.block_until_ready(qcand)
+            self._qcandidate = qcand
         self._candidate = staged
         self.candidate_provenance = dict(provenance) if provenance else None
         self._candidate_delay_s = float(inject_delay_s)
@@ -403,6 +527,8 @@ class PredictEngine:
             raise RuntimeError(f"{self.name!r} has no staged candidate to "
                                f"promote")
         self._variables = self._candidate
+        if self._qcandidate is not None:
+            self._qvariables = self._qcandidate   # int8 flips in lockstep
         if self.candidate_provenance is not None:
             self.provenance = dict(self.candidate_provenance)
         self.drop_candidate()
@@ -414,21 +540,39 @@ class PredictEngine:
         rolled-back canary request still gets a single-generation answer —
         the incumbent's)."""
         self._candidate = None
+        self._qcandidate = None
         self.candidate_provenance = None
         self._candidate_delay_s = 0.0
 
-    def _resolve_generation(self, generation: Optional[str]):
-        """One-shot read of a generation's (variables, injected_delay_s):
-        the caller holds the returned reference for the whole dispatch, so
-        a concurrent promote/drop never mixes weights inside a batch."""
+    def _resolve_precision(self, precision: Optional[str]) -> str:
+        if precision is None:
+            return self.precision
+        if precision not in PRECISIONS:
+            raise ValueError(f"unknown precision {precision!r} "
+                             f"(expected one of {PRECISIONS})")
+        if precision == "int8" and not self.int8_enabled:
+            raise ValueError(
+                f"int8 serving is not armed for {self.name!r} — the "
+                f"calibration gate has not passed (see /healthz quant)")
+        return precision
+
+    def _resolve_generation(self, generation: Optional[str],
+                            precision: str = "bf16"):
+        """One-shot read of a generation's (variables, injected_delay_s)
+        at the requested precision: the caller holds the returned reference
+        for the whole dispatch, so a concurrent promote/drop never mixes
+        weights inside a batch."""
         if generation in (None, "live"):
-            return self._variables, 0.0
+            return (self._qvariables if precision == "int8"
+                    else self._variables), 0.0
         if generation != "candidate":
             raise ValueError(f"unknown weight generation {generation!r} "
                              f"(expected 'live' or 'candidate')")
-        cand = self._candidate   # racing drop_candidate: read once
+        cand = (self._qcandidate if precision == "int8"
+                else self._candidate)   # racing drop_candidate: read once
         if cand is None:
-            return self._variables, 0.0
+            return (self._qvariables if precision == "int8"
+                    else self._variables), 0.0
         return cand, self._candidate_delay_s
 
     # -- prediction --------------------------------------------------------
@@ -444,53 +588,68 @@ class PredictEngine:
                 f"(or one bare example), got {x.shape}")
         return x
 
-    def predict(self, images, generation: Optional[str] = None):
+    def predict(self, images, generation: Optional[str] = None,
+                precision: Optional[str] = None):
         """Host-in host-out bucketed prediction (pads, dispatches, strips).
         Oversize batches run as max_batch chunks plus one tail bucket.
         `generation` selects the weight set ('live'/None, or 'candidate'
-        while a promotion has one staged) — each dispatch runs against
-        exactly one generation's variables."""
+        while a promotion has one staged); `precision` the compiled ladder
+        ('bf16'/'int8'; None = the model's active precision) — each
+        dispatch runs against exactly one generation's variables through
+        exactly one precision's executables."""
         x = self._coerce(images)
         n = x.shape[0]
         if n <= self.max_batch:
-            return self._dispatch(x, generation)
+            return self._dispatch(x, generation, precision)
         return tree_concat([self._dispatch(x[i:i + self.max_batch],
-                                           generation)
+                                           generation, precision)
                             for i in range(0, n, self.max_batch)])
 
-    def _dispatch(self, x: np.ndarray, generation: Optional[str] = None):
-        variables, delay_s = self._resolve_generation(generation)
+    def _dispatch(self, x: np.ndarray, generation: Optional[str] = None,
+                  precision: Optional[str] = None):
+        precision = self._resolve_precision(precision)
+        variables, delay_s = self._resolve_generation(generation, precision)
         if delay_s > 0:
             time.sleep(delay_s)   # injected canary latency spike (faults)
         n = x.shape[0]
         b = pick_bucket(n, self.buckets)
         if b != n:
             x = np.pad(x, [(0, b - n)] + [(0, 0)] * (x.ndim - 1))
-        out = self._compiled[b](variables, x)
+        compiled = (self._compiled_int8 if precision == "int8"
+                    else self._compiled)
+        out = compiled[b](variables, x)
         return tree_slice(jax.device_get(out), 0, n)
 
     def reference(self, images, generation: Optional[str] = None):
         """Eager, un-bucketed predict at the exact batch size — the direct
         `model.apply` oracle the padding-equivalence tests (and preflight's
-        serve check) compare the bucketed path against."""
+        serve check) compare the bucketed path against. Always the bf16
+        (train-matched) path: this IS the accuracy reference the int8 gate
+        scores against."""
         x = self._coerce(images)
-        variables, _ = self._resolve_generation(generation)
+        variables, _ = self._resolve_generation(generation, "bf16")
         return jax.device_get(self._predict_fn(variables, jnp.asarray(x)))
 
     # -- measurement -------------------------------------------------------
 
     def measure_batch_ms(self, bucket: Optional[int] = None,
-                         iters: int = 5) -> float:
+                         iters: int = 5,
+                         precision: Optional[str] = None) -> float:
         """Steady-state wall time of one compiled dispatch of `bucket`
-        (default max_batch), in ms — the "one batch compute time" term of
-        the serving latency contract (docs/SERVING.md)."""
+        (default max_batch) at `precision` (default: the active one), in
+        ms — the "one batch compute time" term of the serving latency
+        contract (docs/SERVING.md)."""
+        precision = self._resolve_precision(precision)
         b = pick_bucket(bucket or self.max_batch, self.buckets)
         x = np.zeros((b, *self.example_shape), self.input_dtype)
-        c = self._compiled[b]
-        jax.block_until_ready(c(self._variables, x))  # warm
+        if precision == "int8":
+            c, variables = self._compiled_int8[b], self._qvariables
+        else:
+            c, variables = self._compiled[b], self._variables
+        jax.block_until_ready(c(variables, x))  # warm
         t0 = time.perf_counter()
         out = None
         for _ in range(iters):
-            out = c(self._variables, x)
+            out = c(variables, x)
         jax.block_until_ready(out)  # same device: prior dispatches serialized
         return (time.perf_counter() - t0) / iters * 1000.0

@@ -91,7 +91,7 @@ class ServedModel:
         return self.batcher.breaker
 
     def submit(self, images, *, deadline_s: Optional[float] = None,
-               trace=None):
+               precision: Optional[str] = None, trace=None):
         """Route one request into this model's batcher, tagged with the
         generation the promotion controller picks (the canary fraction
         runs on the staged candidate while one is in flight; everything
@@ -105,6 +105,7 @@ class ServedModel:
         wait and links it to the batch that serves it."""
         generation = self.promoter.route() if self.promoter else None
         return self.batcher.submit(images, generation=generation,
+                                   precision=precision,
                                    deadline_s=deadline_s, trace=trace)
 
     def describe(self) -> dict:
@@ -116,6 +117,10 @@ class ServedModel:
         autoscale_stats["workers"] = self.batcher.workers
         return {
             "buckets": list(self.engine.buckets),
+            # the int8 axis: the ACTIVE precision dispatches default to,
+            # and the last calibration-gate decision (why int8 is on/off)
+            "precision": getattr(self.engine, "precision", "bf16"),
+            "quant": getattr(self.engine, "quant_decision", None),
             "max_batch": self.batcher.max_batch,
             "queue_depth": self.batcher.queue_depth,
             "workers": self.batcher.workers,
@@ -135,6 +140,7 @@ class ServedModel:
             **self.metrics.snapshot(queue_depth=self.batcher.queue_depth),
             "workers": float(self.batcher.workers),
             "weights": self.engine.provenance,
+            "precision": getattr(self.engine, "precision", "bf16"),
         }
         if self.breaker is not None:
             snap["breaker_state"] = self.breaker.describe()["state"]

@@ -93,10 +93,14 @@ class ServingMetrics:
                         "breaker_rejected": 0, "dispatch_errors": 0,
                         "observer_errors": 0}
         # lifetime fixed-bucket histograms (never reset — /metrics renders
-        # them as Prometheus histograms, which must be monotone per scrape)
-        self._hist = {"request_latency_seconds": _Histogram(),
-                      "queue_wait_seconds": _Histogram(),
-                      "dispatch_seconds": _Histogram()}
+        # them as Prometheus histograms, which must be monotone per scrape),
+        # keyed {name: {precision: _Histogram}} — the precision axis the
+        # int8 serving path added (docs/SERVING.md "Quantized serving"):
+        # int8 and bf16 batches land in separate labeled series, so a
+        # precision flip is visible in the scrape, not averaged away
+        self._hist = {"request_latency_seconds": {},
+                      "queue_wait_seconds": {},
+                      "dispatch_seconds": {}}
         self._reset_locked(time.monotonic())
 
     def _reset_locked(self, now: float) -> None:
@@ -118,15 +122,24 @@ class ServingMetrics:
         self._dispatch_errors = 0      # engine dispatches that raised
         self._observer_errors = 0      # per-batch observer tap exceptions
 
+    def _hist_for(self, name: str, precision: str) -> _Histogram:
+        by_precision = self._hist[name]
+        h = by_precision.get(precision)
+        if h is None:
+            h = by_precision[precision] = _Histogram()
+        return h
+
     def observe_batch(self, *, n_real: int, bucket: int, dispatch_s: float,
                       request_latencies_s: Sequence[float],
-                      queue_waits_s: Optional[Sequence[float]] = None
-                      ) -> None:
+                      queue_waits_s: Optional[Sequence[float]] = None,
+                      precision: str = "bf16") -> None:
         """One dispatched batch. `queue_waits_s` (per request, submit
         acceptance -> dispatch start) separates the queueing component of
         latency from `dispatch_s` (the device's share) — the two used to be
         conflated inside the submit->result latencies, leaving the p99
-        bound unable to say WHERE a blown deadline went."""
+        bound unable to say WHERE a blown deadline went. `precision` labels
+        the histogram series the batch lands in (the engine precision its
+        dispatch ran at — bf16 or int8)."""
         with self._lock:
             self._requests += len(request_latencies_s)
             self._examples += n_real
@@ -136,14 +149,16 @@ class ServingMetrics:
             self._lat.extend(request_latencies_s)
             self._totals["requests"] += len(request_latencies_s)
             self._totals["examples"] += n_real
-            self._hist["dispatch_seconds"].observe(dispatch_s)
+            self._hist_for("dispatch_seconds", precision).observe(dispatch_s)
+            lat_h = self._hist_for("request_latency_seconds", precision)
             for lat in request_latencies_s:
-                self._hist["request_latency_seconds"].observe(lat)
+                lat_h.observe(lat)
             if queue_waits_s is not None:
                 self._qwait.extend(queue_waits_s)
+                qw_h = self._hist_for("queue_wait_seconds", precision)
                 for qw in queue_waits_s:
                     self._queue_wait_s += qw
-                    self._hist["queue_wait_seconds"].observe(qw)
+                    qw_h.observe(qw)
 
     def observe_shed(self, n_requests: int = 1) -> None:
         """Count a request rejected by backpressure (`Overloaded`, HTTP
@@ -194,11 +209,28 @@ class ServingMetrics:
             return dict(self._totals)
 
     def histograms(self) -> dict:
-        """Lifetime latency/queue-wait/dispatch histograms in exposition
-        shape ({name: {"buckets": [(le, cum)], "sum", "count"}}) — rendered
-        on `GET /metrics`; never reset, so scrapes are monotone."""
+        """Lifetime latency/queue-wait/dispatch histograms AGGREGATED over
+        precisions, in exposition shape ({name: {"buckets": [(le, cum)],
+        "sum", "count"}}) — never reset, so scrapes are monotone."""
         with self._lock:
-            return {name: h.render() for name, h in self._hist.items()}
+            out = {}
+            for name, by_precision in self._hist.items():
+                agg = _Histogram()
+                for h in by_precision.values():
+                    for i, n in enumerate(h.counts):
+                        agg.counts[i] += n
+                    agg.sum += h.sum
+                    agg.count += h.count
+                out[name] = agg.render()
+            return out
+
+    def histograms_by_precision(self) -> dict:
+        """The labeled view `GET /metrics` renders: {name: {precision:
+        exposition dict}} — one Prometheus series per (model, precision),
+        so the int8-vs-bf16 dispatch/latency split is scrapeable."""
+        with self._lock:
+            return {name: {p: h.render() for p, h in by_precision.items()}
+                    for name, by_precision in self._hist.items()}
 
     def snapshot(self, queue_depth: Optional[int] = None,
                  reset: bool = False) -> dict:

@@ -50,6 +50,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import scoring
 from ..core.resilience import log_resilience_event
 from ..utils.faults import FaultInjector
 
@@ -61,10 +62,10 @@ ROLLED_BACK_ABORT = "rolled_back_abort"
 PROMOTED = "promoted"
 
 # families whose watched metric is computable from the engine's serving
-# outputs (logits -> top-1; class-id masks -> mIoU). Detection/pose score
-# through loss-shaped metrics that need training targets, so they keep the
-# integrity-only reload path until they grow a predict-side metric.
-GATED_FAMILIES = ("classification", "segmentation")
+# outputs — since core/scoring.py grew the detection/pose/centernet proxy
+# scores (box-count agreement, PCK), that is every servable family; GANs
+# have no single serving engine at all
+GATED_FAMILIES = scoring.GATED_FAMILIES
 
 # injected candidate-dispatch delay for the `latency` regression kind —
 # large against any sane dispatch time so the canary comparison cannot
@@ -78,38 +79,26 @@ FAULT_ACCURACY_DROP = 0.5
 
 
 def pinned_eval_shard(cfg, engine, *, examples: int = 64,
-                      seed: int = 12345) -> Tuple[np.ndarray, np.ndarray]:
-    """The default pinned shadow-eval shard: one deterministic labeled
-    batch from the family's synthetic generator (label-in-the-mean images
-    for classification, palette scenes for segmentation), shaped/dtyped for
-    this engine. Deterministic per (config, seed), so live and candidate
-    generations are always scored on IDENTICAL inputs — the delta is pure
-    weight difference. Production deployments pass a real held-out shard
-    via `eval_batch=`; the synthetic default keeps the gate closed-loop
+                      seed: int = scoring.DEFAULT_SHARD_SEED
+                      ) -> Tuple[np.ndarray, tuple]:
+    """The default pinned shadow-eval shard, `(images, targets)` from
+    core/scoring.pinned_shard shaped/dtyped for this engine. Deterministic
+    per (config, seed) down to the byte, so live and candidate generations
+    are always scored on IDENTICAL inputs — the delta is pure weight
+    difference. Production deployments pass a real held-out shard via
+    `eval_batch=`; the synthetic default keeps the gate closed-loop
     testable (and preflight-able) with no data on disk."""
-    h, w = engine.example_shape[0], engine.example_shape[1]
-    if cfg.family == "classification":
-        from ..data.synthetic import SyntheticClassification
-        gen = SyntheticClassification(
-            examples, image_size=h, channels=cfg.data.channels,
-            num_classes=cfg.data.num_classes, num_batches=1, seed=seed,
-            emit_uint8=engine.input_dtype == np.dtype(np.uint8))
-        images, labels = next(iter(gen))
-        return images.astype(engine.input_dtype), labels
-    if cfg.family == "segmentation":
-        from ..data.segmentation import SyntheticSegmentation
-        gen = SyntheticSegmentation(
-            examples, image_size=h, channels=cfg.data.channels,
-            num_classes=cfg.data.num_classes, num_batches=1, seed=seed,
-            emit_uint8=engine.input_dtype == np.dtype(np.uint8))
-        images, masks = next(iter(gen))
-        return images.astype(engine.input_dtype), np.asarray(masks,
-                                                             np.int64)
-    raise ValueError(
-        f"config {cfg.name!r} (family {cfg.family!r}) has no predict-side "
-        f"watch metric — accuracy-gated promotion supports families "
-        f"{GATED_FAMILIES}; serve this model without --promote-gate "
-        f"(integrity-verified hot reload still applies)")
+    try:
+        return scoring.pinned_shard(
+            cfg, image_size=engine.example_shape[0],
+            input_dtype=engine.input_dtype, examples=examples, seed=seed)
+    except ValueError:
+        raise ValueError(
+            f"config {cfg.name!r} (family {cfg.family!r}) has no "
+            f"predict-side watch metric — accuracy-gated promotion "
+            f"supports families {GATED_FAMILIES}; serve this model "
+            f"without --promote-gate (integrity-verified hot reload "
+            f"still applies)") from None
 
 
 class PromotionController:
@@ -212,7 +201,7 @@ class PromotionController:
 
     # -- shadow eval -------------------------------------------------------
 
-    def _eval_shard(self) -> Tuple[np.ndarray, np.ndarray]:
+    def _eval_shard(self) -> Tuple[np.ndarray, tuple]:
         if self._eval_batch is None:
             self._eval_batch = pinned_eval_shard(
                 self.cfg, self.sm.engine, examples=self._eval_examples)
@@ -220,19 +209,16 @@ class PromotionController:
 
     def _score(self, generation: Optional[str]) -> float:
         """The family's watched metric for one generation over the pinned
-        shard, computed from the engine's SERVING outputs (logits ->
-        top-1 accuracy; int32 class-id masks -> mIoU) — the same quantity
-        the trainer watches, scored on the exact payloads clients get."""
-        images, labels = self._eval_shard()
+        shard, computed from the engine's SERVING outputs (top-1 from
+        logits, mIoU from class-id masks, box-count agreement from decoded
+        detections / CenterNet peaks, PCK from pose heatmaps —
+        core/scoring.score_serving_outputs), scored on the exact payloads
+        clients get. Runs at the model's ACTIVE precision: when the quant
+        gate flipped serving to int8, candidates are shadow-scored at int8
+        too — the gate compares what clients would actually receive."""
+        images, targets = self._eval_shard()
         out = self.sm.engine.predict(images, generation=generation)
-        if self.cfg.family == "classification":
-            pred = np.argmax(np.asarray(out), axis=-1).astype(np.int64)
-            return float(np.mean(pred == np.asarray(labels)))
-        # segmentation: the engine already serves argmax'd class-id masks
-        from ..core.metrics import StreamingConfusion
-        sc = StreamingConfusion(self.cfg.data.num_classes)
-        sc.update_preds(np.asarray(out, np.int64), np.asarray(labels))
-        return float(sc.result()["miou"])
+        return scoring.score_serving_outputs(self.cfg, out, targets)
 
     # -- the pipeline ------------------------------------------------------
 
@@ -268,8 +254,7 @@ class PromotionController:
             extra = {"metric_live": round(metric_live, 4),
                      "metric_candidate": round(metric_cand, 4),
                      "metric_delta": round(delta, 4),
-                     "watch": ("miou" if self.cfg.family == "segmentation"
-                               else "top1")}
+                     "watch": scoring.watch_metric_name(self.cfg)}
             if delta < self.gate_min_delta:
                 engine.drop_candidate()
                 return self._decide(

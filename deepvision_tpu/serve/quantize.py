@@ -1,0 +1,207 @@
+"""Calibrated int8 serving with a hard accuracy gate.
+
+The r05 profile pinned ResNet-50 serving at 97.4% of the HBM roof — on a
+bandwidth-bound model the lever is bytes, and int8 weights are 4x smaller
+than the f32 tree the bf16 buckets dispatch with. This module is the
+serve-side sequel to the bf16 BN/residual cut: post-training quantization
+(ops/quant.py — per-channel weight scales, per-tensor activation scales
+from one calibration pass, int8 conv/dense with f32 heads and
+dequant-at-boundaries), compiled as int8 bucket variants BESIDE the bf16
+buckets in the engine's AOT cache, behind a **hard accuracy-delta gate**:
+
+1. **Calibrate.** Replay the family's pinned deterministic shard
+   (core/scoring.pinned_shard — the same shard recipe promotion's shadow
+   eval uses) through the f32 predict jaxpr, recording per-equation
+   activation ranges. One pass, pinned per (config, seed) down to the byte.
+2. **Compile.** Per bucket, re-trace the predict at that batch size, plan
+   the identical equation set (asserted), and AOT-compile the int8 twin —
+   a one-time cost at arm time; no request ever traces.
+3. **Gate.** Score the bf16 path and the int8 path on the pinned shard
+   with the family's watched metric (top-1 / mIoU / box-count / PCK —
+   core/scoring.score_serving_outputs, the same scoring promotion gates
+   on). int8 goes live ONLY if `score_int8 - score_bf16 >= -gate`; a
+   regression beyond the gate refuses loudly — the engine keeps serving
+   bf16, the decision lands on stderr, the `resilience_` stream
+   (`resilience_quant_refused`) and /healthz.
+
+`DEEPVISION_FAULT_QUANT_REGRESS=1` (utils/faults.py) deterministically
+degrades the int8 score so the refusal path is provable end-to-end —
+preflight's `quant` check arms it and asserts the fallback.
+
+Weight generations stay first-class at int8: the quantizer's activation
+scales are pinned once, weight scales are data-free, so hot reload and
+promotion re-quantize a new checkpoint under the SAME compiled programs
+(`PredictEngine.swap_variables` / `stage_candidate` call back into
+`Quantizer.quantize` — zero recompiles, signature-checked).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import scoring
+from ..core.resilience import log_resilience_event
+from ..ops import quant
+from ..utils.faults import FaultInjector
+
+# default hard gate: int8 may cost at most 2 points of the watched metric
+DEFAULT_GATE = 0.02
+DEFAULT_CALIB_EXAMPLES = 64
+
+# the armed DEEPVISION_FAULT_QUANT_REGRESS injector subtracts this from the
+# int8 score — large against any sane gate, deterministic regardless of how
+# the (possibly random-weight) model actually scores
+FAULT_SCORE_DROP = 0.5
+
+QUANT_ENABLED = "int8_enabled"
+QUANT_REFUSED = "refused_regression"
+
+
+class Quantizer:
+    """One engine's quantization state: the pinned activation scales plus
+    everything needed to (re-)quantize any signature-equal weight
+    generation and to build the int8 twin of any bucket's predict.
+
+    Built once at arm time from the f32 predict and ONE calibration batch;
+    after that, `quantize(variables)` is the only per-generation work
+    (data-free weight scales), which is what keeps hot reload and promotion
+    recompile-free at int8."""
+
+    def __init__(self, predict_fn: Callable, variables, calib_images,
+                 head_dims=frozenset()):
+        self._predict_fn = predict_fn
+        self.head_dims = frozenset(head_dims)
+        closed = jax.make_jaxpr(predict_fn)(variables, calib_images)
+        plan = quant.plan_quantization(closed, self.head_dims)
+        if not plan.eqns:
+            raise ValueError(
+                "nothing to quantize: no conv/dense with a weight operand "
+                "outside the f32 heads — int8 serving would be a no-op")
+        quant.calibrate(plan, closed, variables, calib_images)
+        self._calib_plan = plan
+        # activation scales in PLANNED ORDER: bucket re-traces bake them by
+        # position (equation indices shift with batch-size-dependent
+        # canonicalization; the planned op sequence does not)
+        self._scales: List[float] = [plan.act_scales[q.eqn_index]
+                                     for q in plan.eqns]
+        self._prims = [q.prim for q in plan.eqns]
+        self._leaf_indices = plan.leaf_indices
+
+    def summary(self) -> dict:
+        return self._calib_plan.summary()
+
+    def _plan_for(self, variables, images_spec) -> tuple:
+        """(calibrated plan, closed jaxpr) for one bucket's batch size —
+        the re-trace must plan the same op sequence as calibration, or the
+        positional scale assignment would be wrong (asserted, not hoped)."""
+        closed = jax.make_jaxpr(self._predict_fn)(variables, images_spec)
+        plan = quant.plan_quantization(closed, self.head_dims)
+        if [q.prim for q in plan.eqns] != self._prims \
+                or plan.leaf_indices != self._leaf_indices:
+            raise ValueError(
+                f"bucket re-trace planned a different equation set "
+                f"({len(plan.eqns)} vs {len(self._prims)} at calibration) — "
+                f"the predict is not batch-polymorphic; cannot quantize")
+        plan.act_scales = {q.eqn_index: s
+                           for q, s in zip(plan.eqns, self._scales)}
+        return plan, closed
+
+    def quantized_fn(self, variables, images_spec) -> Callable:
+        """The int8 predict twin for one bucket: `(qvariables, images) ->
+        outputs`, same output pytree as the f32 predict."""
+        plan, closed = self._plan_for(variables, images_spec)
+        out_tree = jax.tree_util.tree_structure(
+            jax.eval_shape(self._predict_fn, variables, images_spec))
+        return quant.quantized_predict_fn(plan, closed, out_tree)
+
+    def quantize(self, variables):
+        """int8-quantize one weight generation under the pinned plan:
+        per-channel weight scales recomputed from these weights (data-free),
+        activation scales unchanged — the compiled programs run the result
+        as-is."""
+        return quant.quantize_variables(self._calib_plan, variables)
+
+
+def arm_int8(engine, cfg=None, *,
+             gate: float = DEFAULT_GATE,
+             examples: int = DEFAULT_CALIB_EXAMPLES,
+             seed: int = scoring.DEFAULT_SHARD_SEED,
+             shard=None,
+             logger=None,
+             faults: Optional[FaultInjector] = None,
+             verbose: bool = True) -> dict:
+    """Calibrate, compile, and GATE int8 serving for one engine.
+
+    On a gate pass the engine's active precision flips to int8 (bf16
+    buckets stay compiled — per-request `precision` overrides keep
+    working); on a regression beyond `gate` the engine is left exactly as
+    it was, serving bf16, with the refusal logged to stderr and the
+    `resilience_` stream. Returns the decision record (also stored as
+    `engine.quant_decision` and reported on /healthz)."""
+    from ..configs import get_config
+    cfg = cfg or get_config(engine.name)
+    if cfg.family not in scoring.GATED_FAMILIES:
+        raise ValueError(
+            f"config {cfg.name!r} (family {cfg.family!r}) has no "
+            f"predict-side watch metric to gate int8 against — gated "
+            f"families: {scoring.GATED_FAMILIES}")
+    faults = faults if faults is not None else FaultInjector.from_env()
+    t0 = time.monotonic()
+    images, targets = shard if shard is not None else scoring.pinned_shard(
+        cfg, image_size=engine.example_shape[0],
+        input_dtype=engine.input_dtype, examples=examples, seed=seed)
+    watch = scoring.watch_metric_name(cfg)
+
+    # calibrate + compile the int8 bucket twins (one-time arm cost)
+    quantizer = Quantizer(engine._predict_fn, engine._variables,
+                          jnp.asarray(images),
+                          head_dims=scoring.serving_head_dims(cfg))
+    engine.enable_int8(quantizer, verbose=verbose)
+
+    # the hard gate: identical pinned inputs, two precisions
+    metric_bf16 = scoring.score_serving_outputs(
+        cfg, engine.predict(images, precision="bf16"), targets)
+    metric_int8 = scoring.score_serving_outputs(
+        cfg, engine.predict(images, precision="int8"), targets)
+    if faults.quant_regression():
+        metric_int8 = max(0.0, metric_int8 - FAULT_SCORE_DROP)
+    delta = metric_int8 - metric_bf16
+    passed = delta >= -abs(gate)
+    decision = {
+        "decision": QUANT_ENABLED if passed else QUANT_REFUSED,
+        "watch": watch,
+        "metric_bf16": round(metric_bf16, 4),
+        "metric_int8": round(metric_int8, 4),
+        "delta": round(delta, 4),
+        "gate": abs(gate),
+        "calibration_examples": int(np.shape(images)[0]),
+        "quantized_eqns": quantizer.summary()["quantized"],
+        "weight_bytes_bf16": quant.tree_nbytes(engine._variables),
+        "weight_bytes_int8": quant.tree_nbytes(engine._qvariables),
+        "secs": round(time.monotonic() - t0, 3),
+        "unix": time.time(),
+    }
+    if passed:
+        engine.set_precision("int8")
+        log_resilience_event(logger, 1, {
+            "quant_enabled": 1.0, "quant_delta": float(delta)})
+    else:
+        engine.disable_int8()
+        log_resilience_event(logger, 1, {
+            "quant_refused": 1.0, "quant_delta": float(delta)})
+    engine.quant_decision = decision
+    print(f"[serve-quant:{engine.name}] {decision['decision']}: "
+          f"{watch} bf16 {metric_bf16:.4f} vs int8 {metric_int8:.4f} "
+          f"(delta {delta:+.4f}, gate -{abs(gate):g}) — "
+          + (f"int8 live, weights "
+             f"{decision['weight_bytes_bf16'] / 1e6:.1f}MB -> "
+             f"{decision['weight_bytes_int8'] / 1e6:.1f}MB"
+             if passed else "REFUSED, serving bf16"),
+          file=sys.stderr, flush=True)
+    return decision

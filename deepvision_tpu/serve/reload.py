@@ -208,6 +208,9 @@ class WeightReloader:
                          if provenance.get("resharded") else "")
                       + (", promoted through the shadow/canary gate"
                          if promoter is not None else "")
+                      + (", re-quantized under the pinned int8 scales"
+                         if getattr(sm.engine, "int8_enabled", False)
+                         else "")
                       + "; AOT bucket cache reused, zero recompiles)")
         return True
 

@@ -362,6 +362,12 @@ def _make_handler(server: InferenceServer):
                     "buckets": list(d.engine.buckets),
                     "max_batch": d.batcher.max_batch,
                     "weights": d.engine.provenance,
+                    # the int8 serving axis: active precision + the last
+                    # quant-gate decision (docs/SERVING.md "Quantized
+                    # serving") — a refused gate is visible HERE, not
+                    # buried in stderr
+                    "precision": getattr(d.engine, "precision", "bf16"),
+                    "quant": getattr(d.engine, "quant_decision", None),
                     # the fleet view: per-model weight provenance
                     # (checkpoint epoch + integrity-manifest hash +
                     # verified flag) and reload outcomes — diff across
@@ -423,6 +429,15 @@ def _make_handler(server: InferenceServer):
                 length = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(length) or b"{}")
                 x = np.asarray(payload["instances"], np.float32)
+                # per-request precision override ('bf16'/'int8'; absent =
+                # the model's active precision). Validated at submit — an
+                # unarmed precision answers 400 naming the gate.
+                precision = payload.get("precision")
+                if precision is not None and precision not in ("bf16",
+                                                               "int8"):
+                    raise ValueError(
+                        f"precision must be 'bf16' or 'int8', got "
+                        f"{precision!r}")
                 # request deadline: body "deadline_ms", else the
                 # X-Deadline-Ms header, else the model's configured
                 # default, else the server fallback — ALWAYS bounded
@@ -448,7 +463,8 @@ def _make_handler(server: InferenceServer):
                 # generation, everything else on the live weights.
                 # Admission control, backpressure, and the circuit
                 # breaker all refuse HERE, before anything is queued.
-                fut = sm.submit(x, deadline_s=deadline_s, trace=ctx)
+                fut = sm.submit(x, deadline_s=deadline_s,
+                                precision=precision, trace=ctx)
                 if ctx is not None:
                     tracer.add("admission", "serve", int(t_adm * 1e9),
                                int((time.monotonic() - t_adm) * 1e9),

@@ -63,6 +63,18 @@ and in-process tests configure it the same way:
                                              close cycle (tests and the
                                              preflight `autoscale` check),
                                              no flaky dispatch path needed
+    DEEPVISION_FAULT_QUANT_REGRESS=1         make the int8 quantization
+                                             gate (serve/quantize.py) see a
+                                             REGRESSED int8 score: the
+                                             shadow comparison's int8 side
+                                             is deterministically reduced,
+                                             so the gate must refuse int8
+                                             and fall back to bf16 serving
+                                             (a resilience_quant_refused
+                                             event + /healthz decision —
+                                             preflight's `quant` check arms
+                                             this). Fires on every gate
+                                             evaluation while set
     DEEPVISION_FAULT_PROMOTE_REGRESS=k:kind  make candidate epoch k a
                                              REGRESSION when the promotion
                                              controller (serve/promote.py)
@@ -140,6 +152,7 @@ class FaultInjector:
                  ckpt_corrupt_mode: Optional[str] = None,
                  promote_regress_epoch: Optional[int] = None,
                  promote_regress_kind: Optional[str] = None,
+                 quant_regress: bool = False,
                  serve_dispatch_fail_at: Optional[int] = None,
                  serve_dispatch_fail_count: int = 1):
         self.data_io_step = data_io_step
@@ -151,6 +164,7 @@ class FaultInjector:
         self.ckpt_corrupt_mode = ckpt_corrupt_mode
         self.promote_regress_epoch = promote_regress_epoch
         self.promote_regress_kind = promote_regress_kind
+        self.quant_regress = bool(quant_regress)
         self.serve_dispatch_fail_at = serve_dispatch_fail_at
         self.serve_dispatch_fail_count = (serve_dispatch_fail_count
                                           if serve_dispatch_fail_at is not None
@@ -174,6 +188,8 @@ class FaultInjector:
             env.get("DEEPVISION_FAULT_CKPT_CORRUPT"))
         regress_epoch, regress_kind = _parse_promote_regress(
             env.get("DEEPVISION_FAULT_PROMOTE_REGRESS"))
+        quant_regress = env.get("DEEPVISION_FAULT_QUANT_REGRESS",
+                                "") not in ("", "0")
         dispatch_at, dispatch_count = _parse_step_count(
             env.get("DEEPVISION_FAULT_SERVE_DISPATCH_FAIL"))
         return cls(data_io_step=io_step, data_io_count=io_count,
@@ -186,6 +202,7 @@ class FaultInjector:
                    ckpt_corrupt_mode=corrupt_mode,
                    promote_regress_epoch=regress_epoch,
                    promote_regress_kind=regress_kind,
+                   quant_regress=quant_regress,
                    serve_dispatch_fail_at=dispatch_at,
                    serve_dispatch_fail_count=dispatch_count)
 
@@ -195,6 +212,7 @@ class FaultInjector:
                 or self.ckpt_save_fails > 0 or self.ckpt_async_fails > 0
                 or self.ckpt_corrupt_epoch is not None
                 or self.promote_regress_epoch is not None
+                or self.quant_regress
                 or self.serve_dispatch_fail_at is not None)
 
     # -- hooks -------------------------------------------------------------
@@ -265,6 +283,15 @@ class FaultInjector:
                 f"injected serving dispatch failure "
                 f"{i - lo + 1}/{self.serve_dispatch_fail_count} "
                 f"(dispatch {i})")
+
+    def quant_regression(self) -> bool:
+        """Called by the int8 quantization gate (serve/quantize.py) when it
+        compares the bf16 and int8 scores on the pinned shard: True while
+        DEEPVISION_FAULT_QUANT_REGRESS is armed — the int8 score is
+        deterministically degraded and the gate MUST refuse. Deliberately
+        not one-shot: every evaluation under the armed env regresses, so a
+        rehearsal can re-run the refusal at will."""
+        return self.quant_regress
 
     def promote_regression(self, epoch: Optional[int]) -> Optional[str]:
         """Called by the promotion controller (serve/promote.py) when a

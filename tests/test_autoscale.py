@@ -70,7 +70,7 @@ class _Paced:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
-    def predict(self, images, generation=None):
+    def predict(self, images, generation=None, precision=None):
         time.sleep(self._delay)
         return self._inner.predict(images, generation=generation)
 
@@ -331,7 +331,7 @@ class _FakeEngine:
         x = np.asarray(images, np.float32)
         return x[None] if x.shape == self.example_shape else x
 
-    def predict(self, images, generation=None):
+    def predict(self, images, generation=None, precision=None):
         time.sleep(self._delay)
         return np.zeros((images.shape[0], 10), np.float32)
 

@@ -331,16 +331,18 @@ def test_roofline_family_steps(capsys):
 # itself every round, so the fast lane re-running it buys nothing
 @pytest.mark.slow
 def test_preflight_tool(tmp_path):
-    """tools/preflight.py: all sixteen checks (incl. the jaxlint gate, the
-    jaxvet IR-audit gate, the serving-stack smoke, the fleet/hot-reload
-    cycle, the accuracy-gated promotion check, the overload-control
-    autoscale/breaker check, the observability check — request-id echo,
-    Prometheus /metrics validation, /trace span-chain — the
-    segmentation-family gate, the on-device-epoch-scan parity check, the
-    device-augment smoke, the checkpoint-integrity fsck, and the elastic
-    save-on-8/restore-on-2 reshard check) pass on the virtual mesh; an
-    unreachable input floor turns into one FAIL line + exit 1 while the
-    remaining checks still run."""
+    """tools/preflight.py: all seventeen checks (incl. the jaxlint gate,
+    the jaxvet IR-audit gate, the serving-stack smoke, the fleet/hot-reload
+    cycle, the accuracy-gated promotion check, the int8 quantization gate
+    — clean arm enables int8, the fault-armed regression is refused and
+    logged — the overload-control autoscale/breaker check, the
+    observability check — request-id echo, Prometheus /metrics validation,
+    /trace span-chain — the segmentation-family gate, the
+    on-device-epoch-scan parity check, the device-augment smoke, the
+    checkpoint-integrity fsck, and the elastic save-on-8/restore-on-2
+    reshard check) pass on the virtual mesh; an unreachable input floor
+    turns into one FAIL line + exit 1 while the remaining checks still
+    run."""
     import json
     import os
     import subprocess
@@ -356,14 +358,14 @@ def test_preflight_tool(tmp_path):
     ok = subprocess.run(base, capture_output=True, text=True, timeout=600,
                         env=env, cwd=str(tmp_path))
     assert ok.returncode == 0, ok.stdout + ok.stderr[-1000:]
-    assert ok.stdout.count("PASS") == 16 and "FAIL" not in ok.stdout
+    assert ok.stdout.count("PASS") == 17 and "FAIL" not in ok.stdout
     assert json.loads(ok.stdout.strip().splitlines()[-1])["preflight"] == "pass"
 
     bad = subprocess.run(base + ["--input-floor", "1e12"],
                          capture_output=True, text=True, timeout=600, env=env,
                          cwd=str(tmp_path))
     assert bad.returncode == 1
-    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 15
+    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 16
     assert json.loads(bad.stdout.strip().splitlines()[-1])["preflight"] == "fail"
 
 

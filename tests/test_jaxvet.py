@@ -54,18 +54,28 @@ def test_cost_baseline_covers_whole_registry():
     count, refreshed every time the baseline is — plus the epoch-scan
     units (the whole-epoch lax.scan wrapper's own rows)."""
     from deepvision_tpu.check.harness import (config_unit_names,
-                                              epoch_unit_names)
+                                              epoch_unit_names,
+                                              quant_unit_names)
     from deepvision_tpu.configs import CONFIGS
 
     with open(os.path.join(REPO, "CHECK_COST.json")) as fp:
         baseline = json.load(fp)
-    expected = set(epoch_unit_names())
+    expected = set(epoch_unit_names()) | set(quant_unit_names())
     for name in CONFIGS.names():
-        # cost rows exist for jaxpr-traced units (train/eval); predict and
-        # serve units are eval_shape-only
+        # cost rows exist for jaxpr-traced units: train/eval steps and —
+        # since the serve units grew a full trace (the int8 twins' bf16
+        # baseline) — the serve predicts; bare predict units stay
+        # eval_shape-only
         expected.update(u for u in config_unit_names(name)
-                        if u.rsplit("/", 1)[1].startswith(("train", "eval")))
+                        if u.rsplit("/", 1)[1].startswith(("train", "eval",
+                                                           "serve")))
     assert set(baseline["units"]) == expected
+    # the int8 rows must carry the weight-bytes cut the QUANT bar enforces
+    for qname in quant_unit_names():
+        cname = qname.split("/", 1)[1]
+        q = baseline["units"][qname]["param_bytes"]
+        b = baseline["units"][f"{cname}/serve"]["param_bytes"]
+        assert b >= 1.8 * q, (qname, b, q)
 
 
 # -- in-process clean halves + spatial probes --------------------------------
@@ -237,6 +247,40 @@ def test_mutation_cost_stem_drift(tmp_path):
 
 # -- CLI contract ------------------------------------------------------------
 
+def test_quant_units_clean_and_mutation_widened_to_float(tmp_path):
+    """QUANT mutation pair. Silent half: the unmutated tree's int8 predict
+    twin audits clean (planned equations really run int8, byte bar met).
+    Mutated half: the quantized-apply branch silently widened back to
+    float — weights still SHIP int8 (plan intact, engine signature
+    unchanged, nothing for any shape check to see) but the compute runs in
+    float, the exact regression that would quietly erase the serving byte
+    cut — and the QUANT rule must fire on the traced jaxpr."""
+    from deepvision_tpu.check import audit
+
+    findings, report = audit(["lenet5", "quant"], select=["QUANT"])
+    assert findings == [], [f.format() for f in findings]
+    assert "quant/lenet5" in report["units"]
+
+    tree = _mutated_tree(tmp_path, lambda t: _edit(
+        t, "deepvision_tpu/ops/quant.py",
+        "            spec = by_eqn.get(idx)\n"
+        "            if spec is not None:\n"
+        "                x, w = invals[0], invals[1]\n",
+        "            spec = by_eqn.get(idx)\n"
+        "            if spec is not None:\n"
+        "                x, w = invals[0], invals[1]\n"
+        "                return _default_bind(eqn, [\n"
+        "                    x, w.dequant().astype(eqn.invars[1].aval.dtype),\n"
+        "                    *invals[2:]])\n"))
+    proc = _run_check(tree, "quant", "--select", "QUANT")
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    found = _findings(proc)
+    assert any(f["check"] == "QUANT" and f["unit"] == "quant/lenet5"
+               and "quietly skipped" in f["message"] for f in found)
+    assert any(f["check"] == "QUANT" and "float" in f["message"]
+               and "outside the f32 heads" in f["message"] for f in found)
+
+
 def test_cli_usage_errors():
     from deepvision_tpu.check.cli import main
 
@@ -254,7 +298,11 @@ def test_cli_clean_json(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["findings"] == []
-    assert set(out["cost"]) == {"lenet5_digits/train", "lenet5_digits/eval"}
+    # the serve unit grew a traced cost row in the int8 PR (the bf16 twin
+    # the quant units diff against), beside train/eval
+    assert set(out["cost"]) == {"lenet5_digits/train", "lenet5_digits/eval",
+                                "lenet5_digits/serve"}
     assert {"flops", "bytes", "eqns"} <= set(
         out["cost"]["lenet5_digits/train"])
+    assert "param_bytes" in out["cost"]["lenet5_digits/serve"]
     assert out["summary"]["units"] == 3

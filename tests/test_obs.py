@@ -172,6 +172,10 @@ def test_render_prometheus_over_fleet_is_valid():
         sm.batcher.submit(x).result(timeout=60)
         text = render_prometheus(fleet)
         assert validate_prometheus_text(text) == []
+        # the serving labeling contract on top of the format rules:
+        # precision-labeled histograms + the active-precision one-hot
+        from deepvision_tpu.obs.export import validate_serve_exposition
+        assert validate_serve_exposition(text) == []
         parsed = parse_prometheus_text(text)
         assert parsed[("deepvision_serve_requests_total",
                        (("model", "lenet5"),))] == 1.0
@@ -179,8 +183,13 @@ def test_render_prometheus_over_fleet_is_valid():
                        (("model", "lenet5"),))] == 1.0
         assert parsed[("deepvision_serve_breaker_state",
                        (("model", "lenet5"), ("state", "closed")))] == 1.0
+        # histogram series carry the precision label (int8 axis)
         assert ("deepvision_serve_request_latency_seconds_count",
-                (("model", "lenet5"),)) in parsed
+                (("model", "lenet5"), ("precision", "bf16"))) in parsed
+        assert parsed[("deepvision_serve_active_precision",
+                       (("model", "lenet5"), ("precision", "bf16")))] == 1.0
+        assert parsed[("deepvision_serve_active_precision",
+                       (("model", "lenet5"), ("precision", "int8")))] == 0.0
     finally:
         fleet.drain(timeout=30)
 
@@ -321,7 +330,7 @@ def test_request_id_on_503_and_504_with_correlated_events(tmp_path):
         def __getattr__(self, name):
             return getattr(self._inner, name)
 
-        def predict(self, images, generation=None):
+        def predict(self, images, generation=None, precision=None):
             time.sleep(self._delay)
             return self._inner.predict(images, generation=generation)
 

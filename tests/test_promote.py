@@ -160,26 +160,33 @@ def test_batcher_never_mixes_generations():
 
 
 def test_pinned_eval_shard_contract():
-    """The default shadow shard is deterministic, engine-shaped, and
-    supported families only."""
+    """The default shadow shard is deterministic and engine-shaped — and,
+    since core/scoring.py grew the box-count/PCK proxy metrics (ROADMAP
+    item-3 follow-up), the detection family is GATABLE: the shard carries
+    its padded-GT target tuple and a PromotionController attaches where it
+    used to refuse."""
     engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
                                        verbose=False)
     cfg = get_config("lenet5")
-    a_img, a_lab = pinned_eval_shard(cfg, engine, examples=16)
-    b_img, b_lab = pinned_eval_shard(cfg, engine, examples=16)
+    a_img, a_tgt = pinned_eval_shard(cfg, engine, examples=16)
+    b_img, b_tgt = pinned_eval_shard(cfg, engine, examples=16)
     np.testing.assert_array_equal(a_img, b_img)    # pinned means pinned
-    np.testing.assert_array_equal(a_lab, b_lab)
+    for a, b in zip(a_tgt, b_tgt):
+        np.testing.assert_array_equal(a, b)
     assert a_img.shape == (16, *engine.example_shape)
     assert a_img.dtype == engine.input_dtype
-    with pytest.raises(ValueError, match="promotion supports"):
-        pinned_eval_shard(get_config("yolov3_digits"), engine)
     fleet = ModelFleet()
     sm = fleet.add(PredictEngine.from_config("yolov3_digits", buckets=(1,),
                                              verbose=False))
     try:
-        with pytest.raises(ValueError, match="not promotion-gatable"):
-            PromotionController(sm)
-        assert sm.promoter is None      # a refused attach leaves no hook
+        det_cfg = get_config("yolov3_digits")
+        d_img, d_tgt = pinned_eval_shard(det_cfg, sm.engine, examples=4)
+        assert d_img.shape == (4, *sm.engine.example_shape)
+        assert len(d_tgt) == 3          # (boxes, classes, valid)
+        ctl = PromotionController(sm, canary_window_s=0.1)
+        assert sm.promoter is ctl       # detection attaches now
+        score = ctl._score(None)        # box-count agreement, finite
+        assert 0.0 <= score <= 1.0
     finally:
         fleet.drain(timeout=30)
 

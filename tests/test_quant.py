@@ -1,0 +1,374 @@
+"""int8 serving: calibrated post-training quantization + the accuracy gate
+(deepvision_tpu/ops/quant.py, serve/quantize.py, docs/SERVING.md
+"Quantized serving"):
+
+- the jaxpr rewrite itself: planned conv/dense run int8 (int32
+  accumulation), f32 heads stay float, outputs equal the f32 path's
+  argmax with bounded numeric error, weight bytes cut past the 1.8x bar
+- the pinned calibration shard is byte-identical across two builds with
+  the same seed — in-process AND across processes (the determinism the
+  quant gate and shadow eval both stand on; previously only the promote
+  path asserted this for its own shard)
+- the hard gate: clean arm flips the engine to int8; the deterministic
+  DEEPVISION_FAULT_QUANT_REGRESS regression is refused, bf16 keeps
+  serving, and resilience_quant_refused lands on the metrics stream
+- hot reload and promotion run unmodified at int8: swap/stage/promote
+  re-quantize under the pinned scales with ZERO recompiles beyond the
+  one-time int8 bucket compile, and no batch ever mixes precisions
+- the HTTP surface: per-request precision override, /healthz
+  precision+quant decision, /metrics precision-labeled histograms passing
+  the serve-exposition validator
+- predict-side watch metrics for every servable family (the ROADMAP
+  item-3 follow-up): detection/pose/centernet score from serving outputs
+- CLI flag contract (--serve-precision / --quant-gate)
+"""
+
+import hashlib
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config
+from deepvision_tpu.core import scoring
+from deepvision_tpu.ops import quant
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet
+from deepvision_tpu.serve.quantize import Quantizer, arm_int8
+from deepvision_tpu.utils.faults import FaultInjector
+
+
+@pytest.fixture(scope="module")
+def lenet_engine():
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    decision = arm_int8(engine, verbose=False, faults=FaultInjector())
+    assert decision["decision"] == "int8_enabled"
+    return engine
+
+
+# -- the rewrite itself --------------------------------------------------------
+
+def test_quantized_predict_matches_f32_argmax(lenet_engine):
+    """int8 outputs keep the f32 path's decisions on in-distribution
+    inputs, with bounded numeric error — the property the gate quantifies
+    on the pinned shard, pinned here directly."""
+    engine = lenet_engine
+    x = np.random.RandomState(0).randn(
+        4, *engine.example_shape).astype(engine.input_dtype)
+    out_b = engine.predict(x, precision="bf16")
+    out_q = engine.predict(x, precision="int8")
+    assert out_q.dtype == np.float32         # dequant-at-boundary contract
+    np.testing.assert_array_equal(np.argmax(out_b, -1), np.argmax(out_q, -1))
+    rel = np.max(np.abs(out_b - out_q)) / (np.max(np.abs(out_b)) + 1e-9)
+    assert rel < 0.15, f"int8 numeric error blew up: {rel:.3f}"
+    assert not np.array_equal(out_b, out_q)  # it DID quantize
+
+
+def test_plan_quantizes_int8_with_f32_heads(lenet_engine):
+    """The traced int8 jaxpr runs planned conv/dense in int8 -> int32 and
+    leaves the head equations in float; the quantized weight tree cuts
+    bytes past the jaxvet QUANT bar."""
+    engine = lenet_engine
+    spec = jax.ShapeDtypeStruct((4, *engine.example_shape),
+                                engine.input_dtype)
+    qfn = engine._quantizer.quantized_fn(engine._variables, spec)
+    closed = jax.make_jaxpr(qfn)(jax.device_get(engine._qvariables),
+                                 np.zeros(spec.shape, spec.dtype))
+    heavy = [(str(e.invars[0].aval.dtype), str(e.outvars[0].aval.dtype))
+             for e in closed.jaxpr.eqns
+             if e.primitive.name in ("conv_general_dilated", "dot_general")]
+    int8 = [h for h in heavy if h[0] == "int8"]
+    assert len(int8) == engine.quant_decision["quantized_eqns"]
+    assert all(out == "int32" for _, out in int8)   # int32 accumulation
+    assert any(h[0] != "int8" for h in heavy)       # the f32 head survived
+    bytes_bf16 = quant.tree_nbytes(engine._variables)
+    bytes_int8 = quant.tree_nbytes(engine._qvariables)
+    assert bytes_bf16 >= 1.8 * bytes_int8
+
+
+def test_per_channel_weight_scales():
+    """Conv kernels carry one scale per OUTPUT channel (HWIO -> (O,)),
+    dense kernels one per output unit — not a single tensor-wide scale."""
+    engine = PredictEngine.from_config("lenet5", buckets=(1,),
+                                       verbose=False)
+    images = np.random.RandomState(0).randn(
+        2, *engine.example_shape).astype(np.float32)
+    q = Quantizer(engine._predict_fn, engine._variables, images,
+                  head_dims=scoring.serving_head_dims(get_config("lenet5")))
+    qv = q.quantize(engine._variables)
+    assert qv["q"], "nothing quantized"
+    for leaf in qv["q"].values():
+        w, s = leaf["w"], leaf["s"]
+        assert np.asarray(w).dtype == np.int8
+        assert s.shape == (np.shape(w)[-1],)      # per-out-channel (O,)
+        assert np.all(np.asarray(s) > 0)
+
+
+def test_accumulator_overflow_guard(monkeypatch):
+    """Contractions past the int32-safe tap bound are refused by the plan
+    (left in float), never wrapped silently."""
+    engine = PredictEngine.from_config("lenet5", buckets=(1,),
+                                       verbose=False)
+    images = np.random.RandomState(0).randn(
+        2, *engine.example_shape).astype(np.float32)
+    closed = jax.make_jaxpr(engine._predict_fn)(engine._variables, images)
+    full = quant.plan_quantization(closed)
+    monkeypatch.setattr(quant, "MAX_ACC_TAPS", 1)
+    clipped = quant.plan_quantization(closed)
+    assert len(clipped.eqns) < len(full.eqns)
+    assert clipped.skipped_other > full.skipped_other
+
+
+# -- pinned-shard determinism (the gate's foundation) -------------------------
+
+def _shard_digest(name: str, examples: int = 16) -> str:
+    cfg = get_config(name)
+    size = 32 if cfg.family == "classification" else cfg.data.image_size
+    images, targets = scoring.pinned_shard(
+        cfg, image_size=size, input_dtype=np.float32, examples=examples)
+    h = hashlib.sha256(np.ascontiguousarray(images).tobytes())
+    for t in targets:
+        h.update(np.ascontiguousarray(t).tobytes())
+    return h.hexdigest()
+
+
+def test_calibration_shard_deterministic_across_processes():
+    """The shard both the quant gate and shadow eval replay must be
+    byte-identical for the same (config, seed) — in-process twice, and
+    across a FRESH interpreter (two builds, same seed): scores computed in
+    different processes diff pure weight/precision difference, never shard
+    noise. (The promote path asserted this only for its own shard.)"""
+    for name in ("lenet5", "yolov3_digits", "unet_synthetic"):
+        assert _shard_digest(name) == _shard_digest(name), name
+    code = (
+        "import sys; sys.path.insert(0, {root!r});"
+        "from tests.test_quant import _shard_digest;"
+        "print(_shard_digest('lenet5'))"
+    ).format(root=str(__import__("pathlib").Path(__file__).parent.parent))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip() == _shard_digest("lenet5")
+
+
+def test_predict_side_watch_metrics_all_families():
+    """Every servable family scores from serving outputs now — the
+    detection/pose/centernet proxies are finite, bounded, and move when
+    predictions move (the only property a delta gate needs)."""
+    assert set(scoring.GATED_FAMILIES) == {
+        "classification", "segmentation", "detection", "pose", "centernet"}
+    # pose PCK directly on synthetic heatmaps: exact argmax recovery -> 1.0
+    cfg = get_config("hourglass104")
+    k = cfg.data.num_classes
+    rs = np.random.RandomState(0)
+    kp_x = rs.rand(2, k).astype(np.float32)
+    kp_y = rs.rand(2, k).astype(np.float32)
+    vis = np.ones((2, k), np.float32)
+    hm = np.zeros((2, 16, 16, k), np.float32)
+    for b in range(2):
+        for j in range(k):
+            hm[b, int(round(kp_y[b, j] * 15)),
+               int(round(kp_x[b, j] * 15)), j] = 1.0
+    assert scoring.score_serving_outputs(
+        cfg, (hm,), (kp_x, kp_y, vis)) == pytest.approx(1.0)
+    # detection box-count agreement: exact count match -> 1.0, misses decay
+    det = get_config("yolov3_digits")
+    boxes = np.zeros((2, 4, 4), np.float32)
+    classes = np.zeros((2, 4), np.int32)
+    valid = np.zeros((2, 4), np.float32)
+    valid[0, :2] = 1.0
+    obj = np.full((2, 3, 3, 3, 1), -10.0, np.float32)
+    obj[0, 0, 0, :2, 0] = 10.0                  # 2 confident anchors, img 0
+    triple = (np.zeros((2, 3, 3, 3, 4), np.float32), 1 / (1 + np.exp(-obj)),
+              np.zeros((2, 3, 3, 3, det.data.num_classes), np.float32))
+    score = scoring.score_serving_outputs(det, (triple,),
+                                          (boxes, classes, valid))
+    assert score == pytest.approx(1.0)
+    obj[1, 0, 0, 0, 0] = 10.0                   # extra false positive
+    triple = (triple[0], 1 / (1 + np.exp(-obj)), triple[2])
+    worse = scoring.score_serving_outputs(det, (triple,),
+                                          (boxes, classes, valid))
+    assert worse < score
+
+
+# -- the hard gate -------------------------------------------------------------
+
+def test_gate_refuses_forced_regression_and_logs(tmp_path):
+    """DEEPVISION_FAULT_QUANT_REGRESS: the gate must refuse int8, keep
+    bf16 serving byte-identically, and log resilience_quant_refused."""
+    from deepvision_tpu.core.metrics import MetricsLogger
+
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    x = np.random.RandomState(0).randn(
+        2, *engine.example_shape).astype(engine.input_dtype)
+    before = engine.predict(x)
+    logger = MetricsLogger(str(tmp_path), name="serve", tensorboard=False)
+    decision = arm_int8(engine, logger=logger, verbose=False,
+                        faults=FaultInjector(quant_regress=True))
+    logger.close()
+    assert decision["decision"] == "refused_regression"
+    assert engine.precision == "bf16" and not engine.int8_enabled
+    assert engine.quant_decision["decision"] == "refused_regression"
+    np.testing.assert_array_equal(engine.predict(x), before)
+    with pytest.raises(ValueError, match="not armed"):
+        engine.predict(x, precision="int8")
+    events = (tmp_path / "serve.jsonl").read_text()
+    assert "resilience_quant_refused" in events
+
+
+def test_fault_env_parsing(monkeypatch):
+    monkeypatch.setenv("DEEPVISION_FAULT_QUANT_REGRESS", "1")
+    f = FaultInjector.from_env()
+    assert f.active and f.quant_regression()
+    monkeypatch.delenv("DEEPVISION_FAULT_QUANT_REGRESS")
+    assert not FaultInjector.from_env().quant_regression()
+
+
+# -- hot reload + promotion at int8 -------------------------------------------
+
+def test_swap_and_promotion_at_int8_zero_recompiles(lenet_engine):
+    """A new weight generation re-quantizes under the pinned scales:
+    swap_variables and stage/promote both serve the new weights at int8
+    with the compile log unchanged and the jit cache empty."""
+    engine = lenet_engine
+    n_programs = len(engine.compile_log)
+    x = np.random.RandomState(1).randn(
+        2, *engine.example_shape).astype(engine.input_dtype)
+    out0 = engine.predict(x)                     # int8, incumbent
+    scaled = jax.tree_util.tree_map(lambda a: a * 1.03,
+                                    jax.device_get(engine._variables))
+    engine.swap_variables(scaled)
+    out1 = engine.predict(x)
+    assert not np.array_equal(out0, out1)        # int8 serves NEW weights
+    np.testing.assert_allclose(
+        out1, engine.predict(x, precision="int8"))
+    engine.stage_candidate(jax.tree_util.tree_map(
+        lambda a: a * 1.07, jax.device_get(engine._variables)))
+    cand = engine.predict(x, generation="candidate")
+    assert not np.array_equal(cand, out1)
+    engine.promote_candidate()
+    np.testing.assert_array_equal(engine.predict(x), cand)
+    assert len(engine.compile_log) == n_programs  # zero recompiles
+    assert jax.jit(lambda: 0)._cache_size() == 0  # nothing jitted ad hoc
+
+
+def test_batches_never_mix_precisions():
+    """Interleaved bf16/int8 submissions: every answer equals its own
+    precision's direct-engine reference — a cross-precision batch would
+    hand at least one request the other ladder's numerics."""
+    from deepvision_tpu.serve.batcher import DynamicBatcher
+
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    arm_int8(engine, verbose=False, faults=FaultInjector())
+    batcher = DynamicBatcher(engine, max_delay_ms=20.0)
+    try:
+        rs = np.random.RandomState(0)
+        xs = [rs.randn(1, *engine.example_shape).astype(engine.input_dtype)
+              for _ in range(8)]
+        futs = [(batcher.submit(x, precision=("int8" if i % 2 else "bf16")),
+                 x, "int8" if i % 2 else "bf16")
+                for i, x in enumerate(xs)]
+        for fut, x, precision in futs:
+            got = np.asarray(fut.result(timeout=60))
+            want = engine.predict(x, precision=precision)
+            np.testing.assert_array_equal(got, want)
+    finally:
+        batcher.drain(timeout=30)
+
+
+# -- HTTP surface --------------------------------------------------------------
+
+def test_http_precision_override_healthz_and_metrics(tmp_path):
+    from deepvision_tpu.obs.export import validate_serve_exposition
+    from deepvision_tpu.serve.server import InferenceServer
+
+    fleet = ModelFleet()
+    fleet.add(PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                        verbose=False), max_delay_ms=5.0)
+    arm_int8(fleet.default.engine, verbose=False, faults=FaultInjector())
+    server = InferenceServer(fleet=fleet, flush_every_s=60.0)
+    th = threading.Thread(target=server.serve, kwargs={"port": 0},
+                          daemon=True)
+    th.start()
+    try:
+        assert server.ready.wait(120)
+        base = f"http://127.0.0.1:{server.bound_port}"
+        x = np.random.RandomState(0).randn(
+            1, *fleet.default.engine.example_shape)
+
+        def post(body):
+            req = urllib.request.Request(
+                base + "/predict", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.load(urllib.request.urlopen(req, timeout=60))
+
+        default = post({"instances": x.tolist()})          # active = int8
+        forced_bf16 = post({"instances": x.tolist(), "precision": "bf16"})
+        forced_int8 = post({"instances": x.tolist(), "precision": "int8"})
+        assert default["predictions"] == forced_int8["predictions"]
+        assert forced_bf16["predictions"] != forced_int8["predictions"]
+        # bad precision -> 400 naming the contract
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"instances": x.tolist(),
+                             "precision": "fp4"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+
+        health = json.load(urllib.request.urlopen(base + "/healthz",
+                                                  timeout=60))
+        assert health["precision"] == "int8"
+        assert health["quant"]["decision"] == "int8_enabled"
+        assert health["models"]["lenet5"]["precision"] == "int8"
+        stats = json.load(urllib.request.urlopen(base + "/stats",
+                                                 timeout=60))
+        assert stats["precision"] == "int8"
+
+        metrics = urllib.request.urlopen(
+            base + "/metrics", timeout=60).read().decode()
+        assert validate_serve_exposition(metrics) == []
+        assert 'precision="int8"' in metrics
+        assert ('deepvision_serve_active_precision'
+                '{model="lenet5",precision="int8"} 1') in metrics
+    finally:
+        server.stop()
+        th.join(timeout=60)
+        server.close()
+
+
+# -- CLI flag contract ---------------------------------------------------------
+
+def test_serve_cli_flag_contract():
+    from deepvision_tpu.serve.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["-m", "lenet5", "--serve-precision", "int8", "--quant-gate",
+         "0.05"])
+    assert args.serve_precision == "int8"
+    assert args.quant_gate == pytest.approx(0.05)
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["-m", "lenet5", "--serve-precision",
+                                   "fp8"])
+    from deepvision_tpu.serve import cli as serve_cli
+    with pytest.raises(SystemExit):
+        serve_cli.main(["-m", "lenet5", "--quant-gate", "-1", "--smoke"])
+
+
+def test_bench_serve_int8_flag_contract():
+    import bench_serve
+
+    with pytest.raises(SystemExit, match="standalone"):
+        bench_serve.main(["--int8", "--load"])

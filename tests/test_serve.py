@@ -209,7 +209,7 @@ def test_backpressure_overloaded():
         input_dtype = np.dtype(np.float32)
         _coerce = PredictEngine._coerce  # reuse the validation path
 
-        def predict(self, x, generation=None):
+        def predict(self, x, generation=None, precision=None):
             time.sleep(0.3)
             return np.asarray(x) * 2.0
 
@@ -239,7 +239,7 @@ def test_dispatch_error_reaches_futures_not_thread():
         _coerce = PredictEngine._coerce
         fail = True
 
-        def predict(self, x, generation=None):
+        def predict(self, x, generation=None, precision=None):
             if self.fail:
                 self.fail = False
                 raise RuntimeError("boom")

@@ -33,6 +33,16 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               recompiles — the gate that keeps a silently-regressed
               checkpoint away from traffic has to actually fire BEFORE
               a deployment trusts it
+  quant       int8 serving gate (docs/SERVING.md "Quantized serving"):
+              the fixed lenet5 engine must calibrate on its pinned shard,
+              compile int8 bucket twins beside the bf16 cache (no later
+              recompiles), PASS the accuracy-delta gate and serve int8
+              outputs matching the bf16 argmax — then the same gate,
+              armed with the deterministic DEEPVISION_FAULT_QUANT_REGRESS
+              regression, must REFUSE int8 and fall back to bf16 with a
+              resilience_quant_refused event on the metrics stream — the
+              gate that keeps a bad quantization away from traffic has to
+              fire BEFORE a deployment trusts --serve-precision int8
   autoscale   overload control (docs/SERVING.md "Overload control"):
               injected overload against a paced one-worker model must
               shed, the shed-driven control loop must scale the
@@ -383,6 +393,80 @@ def check_promote(args):
             f"epoch 3 promoted (delta {delta:+.3f}, zero recompiles)")
 
 
+@check("quant")
+def check_quant(args):
+    # the int8 serving gate end to end (docs/SERVING.md "Quantized
+    # serving"), both verdicts on the tiny fixed lenet5. Pass arm: the
+    # pinned-shard calibration must build int8 bucket twins beside the
+    # bf16 cache, the accuracy gate must PASS, the active precision must
+    # flip to int8, and int8 predictions must match the bf16 argmax on the
+    # shard — with zero compiles after arm time. Refusal arm: the
+    # deterministic DEEPVISION_FAULT_QUANT_REGRESS regression must refuse
+    # int8, leave bf16 serving, and log resilience_quant_refused.
+    import json as _json
+    import shutil
+
+    import numpy as np
+
+    from deepvision_tpu.core.metrics import MetricsLogger
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.quantize import arm_int8
+    from deepvision_tpu.utils.faults import FaultInjector
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_quant_")
+    logger = MetricsLogger(tmpdir, name="serve", tensorboard=False)
+    try:
+        engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                           verbose=False)
+        decision = arm_int8(engine, logger=logger, verbose=False,
+                            faults=FaultInjector())
+        if decision["decision"] != "int8_enabled" \
+                or engine.precision != "int8":
+            raise RuntimeError(f"clean gate did not enable int8: "
+                               f"{decision}")
+        n_programs = len(engine.compile_log)
+        x = np.random.RandomState(0).randn(
+            3, *engine.example_shape).astype(engine.input_dtype)
+        out_b = engine.predict(x, precision="bf16")
+        out_q = engine.predict(x)           # active precision = int8
+        if not np.array_equal(np.argmax(out_b, -1), np.argmax(out_q, -1)):
+            raise RuntimeError("int8 predictions diverge from bf16 argmax "
+                               "on the calibration regime")
+        if len(engine.compile_log) != n_programs:
+            raise RuntimeError("int8 dispatch recompiled after arm time")
+        if decision["weight_bytes_bf16"] < 1.8 * decision["weight_bytes_int8"]:
+            raise RuntimeError(f"weight byte cut below the 1.8x bar: "
+                               f"{decision}")
+
+        # the refusal path, against a FRESH engine: forced regression must
+        # refuse int8 and keep serving bf16, loudly
+        engine2 = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                            verbose=False)
+        refused = arm_int8(engine2, logger=logger, verbose=False,
+                           faults=FaultInjector(quant_regress=True))
+        if refused["decision"] != "refused_regression" \
+                or engine2.precision != "bf16" or engine2.int8_enabled:
+            raise RuntimeError(f"forced regression was NOT refused: "
+                               f"{refused}, precision={engine2.precision}")
+        np.testing.assert_array_equal(engine2.predict(x),
+                                      engine2.predict(x, precision="bf16"))
+        logger.close()
+        events = [_json.loads(ln) for ln in
+                  open(os.path.join(tmpdir, "serve.jsonl"))]
+        if not any("resilience_quant_refused" in e.get("metrics", e)
+                   or "resilience_quant_refused" in _json.dumps(e)
+                   for e in events):
+            raise RuntimeError("refusal not logged to the resilience "
+                               "stream (resilience_quant_refused)")
+    finally:
+        logger.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return (f"gate passed (delta {decision['delta']:+.3f}, weights "
+            f"{decision['weight_bytes_bf16'] // 1024}KB->"
+            f"{decision['weight_bytes_int8'] // 1024}KB, zero post-arm "
+            f"compiles); forced regression refused + logged")
+
+
 @check("autoscale")
 def check_autoscale(args):
     # both overload-control loops end to end (docs/SERVING.md "Overload
@@ -421,7 +505,7 @@ def check_autoscale(args):
         def __getattr__(self, name):
             return getattr(self._inner, name)
 
-        def predict(self, images, generation=None):
+        def predict(self, images, generation=None, precision=None):
             time.sleep(self._delay)
             return self._inner.predict(images, generation=generation)
 
@@ -1073,6 +1157,7 @@ def main(argv=None):
     check_serve(args)
     check_fleet(args)
     check_promote(args)
+    check_quant(args)
     check_autoscale(args)
     check_obs(args)
     check_segment(args)
