@@ -12,8 +12,10 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
 .PHONY: test test-all verify bench bench-serve bench-serve-int8 \
         bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
+        bench-serve-tier \
         bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
-        serve-fleet-smoke preflight preflight-record lint lint-changed \
+        serve-fleet-smoke serve-tier-smoke preflight preflight-record \
+        lint lint-changed \
         fsck check check-update-cost reshard-parity
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
@@ -123,6 +125,13 @@ serve-fleet-smoke: ## multi-model fleet smoke: two engines behind one
 	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve -m lenet5,lenet5_digits \
 	    --smoke --duration 2
 
+serve-tier-smoke: ## replica-tier smoke: router over 2 supervised replica
+	## processes, synthetic load with a mid-run SIGKILL of replica 0 —
+	## zero failed responses, ejection + supervised restart + readmission
+	## (docs/SERVING.md "Replica tier")
+	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve.tier -m lenet5 \
+	    --replicas 2 --smoke --kill-one --duration 4
+
 bench-serve-int8: ## int8-vs-bf16 serving: arm the calibrated quantization
 	## gate (accuracy-delta vs the pinned shard), then the same closed-loop
 	## load through each precision ladder — QPS, p99, bytes/batch one line
@@ -155,6 +164,14 @@ bench-serve-promote: ## accuracy-gated promotion under open-loop load: a
 	## docs/SERVING.md "Promotion")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
 	    --load --promote-at 1.5 --secs 5
+
+bench-serve-tier: ## replica-tier bench: warm-vs-cold replica boot through
+	## the shared persistent compile cache (>=2x, zero warm recompiles),
+	## then SIGKILL one of 3 replicas under an open-loop schedule — zero
+	## failed responses after the ejection window, goodput within 5% of
+	## pre-kill, supervised readmission (one JSON line; docs/SERVING.md
+	## "Replica tier")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py --tier
 
 dryrun:      ## 8-virtual-device multichip compile/exec check
 	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -36,6 +36,14 @@ server can do. Open-loop arrivals are what real traffic does — they keep
 coming — so p99 and shed rate under a FIXED offered rate are the numbers a
 capacity plan can actually use (Schroeder et al., "Open Versus Closed").
 
+`--tier` benches the multi-replica tier (serve/tier.py, docs/SERVING.md
+"Replica tier") instead: warm-vs-cold replica boot-to-first-200 through the
+tier's shared persistent XLA compile cache (bars: warm >=2x faster, zero
+warm-path recompiles), then a kill-one-of-3 spike — SIGKILL lands on a
+supervised replica mid-schedule and the bars are zero failed client
+responses after the ejection window, post-kill goodput within 5% of
+pre-kill, and supervised readmission of the victim.
+
 Two baselines, measured in the same process on the same model/config:
 
 - `vs_baseline` compares against the NAIVE per-request loop the serving
@@ -948,6 +956,253 @@ def int8_bench() -> None:
                          f"cut below the 1.8x bar")
 
 
+def tier_bench(args) -> None:
+    """Replica-tier bench (serve/tier.py), two phases on one shared
+    persistent compile-cache dir:
+
+    A) WARM BOOT — boot one replica process cold (empty cache) and time
+       Popen -> first 200 from /predict, then boot a second replica on the
+       SAME cache and time it again. The warm boot must be >=2x faster and
+       its /healthz compile stats must show zero cache misses — the "a
+       respawned replica is serving-warm in seconds" contract the tier's
+       supervised restart depends on. Uses a compile-heavy small model
+       (yolov3_digits) so the cache covers compile time, not import time,
+       and pins DEEPVISION_CACHE_MIN_COMPILE_SECS=0 so sub-second bucket
+       compiles persist too.
+
+    B) KILL ONE OF N — three supervised lenet5 replicas behind a live
+       TierRouter; an open-loop arrival schedule fires at the router while
+       SIGKILL lands on replica 0 a third of the way in. Bars: ZERO failed
+       client responses for requests scheduled after the ejection window
+       (connection-refused ejects on the spot and retries mask the rest),
+       goodput after the window within 5% of pre-kill, and the victim back
+       routable through supervised restart (launches >= 2) — warm, via the
+       Phase-A cache.
+    """
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.serve.tier import (ReplicaHandle, TierRouter,
+                                           free_port)
+
+    platform = jax.devices()[0].platform
+    boot_model = os.environ.get("DEEPVISION_SERVE_BENCH_TIER_BOOT_MODEL",
+                                "yolov3_digits")
+    kill_model = os.environ.get("DEEPVISION_SERVE_BENCH_MODEL", "lenet5")
+    cache_dir = tempfile.mkdtemp(prefix="deepvision-tier-bench-cache-")
+    # without this, sub-second bucket compiles stay below JAX's default
+    # persistence threshold and the "warm" boot recompiles everything
+    replica_env = {"DEEPVISION_CACHE_MIN_COMPILE_SECS": "0"}
+
+    def payload(model: str) -> bytes:
+        d = get_config(model).data
+        row = [[0.5] * d.channels for _ in range(d.image_size)]
+        inst = [row for _ in range(d.image_size)]
+        return json.dumps({"instances": [inst]}).encode()
+
+    def replica_argv(model, port, rid, extra=()):
+        return [sys.executable, "-m", "deepvision_tpu.serve.replica",
+                "-m", model, "--port", str(port), "--host", "127.0.0.1",
+                "--replica-id", rid, "--compilation-cache", cache_dir,
+                *extra]
+
+    def boot_to_first_200(rid: str):
+        """(seconds Popen -> first /predict 200, compile stats) for one
+        replica booted against the shared cache dir, then killed."""
+        port = free_port()
+        body = payload(boot_model)
+        env = dict(os.environ)
+        env.update(replica_env)
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            replica_argv(boot_model, port, rid,
+                         ("--buckets", "1,8", "--max-batch", "8")),
+            env=env)
+        url = f"http://127.0.0.1:{port}/predict"
+        try:
+            while True:
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"tier bench: boot replica {rid} exited "
+                        f"{proc.returncode} before its first 200")
+                if time.monotonic() - t0 > 300:
+                    raise SystemExit(
+                        f"tier bench: boot replica {rid} gave no 200 "
+                        f"within 300 s")
+                try:
+                    req = urllib.request.Request(
+                        url, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        if r.status == 200:
+                            r.read()
+                            break
+                except Exception:  # noqa: BLE001 — booting: not up yet
+                    time.sleep(0.05)
+            boot_s = time.monotonic() - t0
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                        timeout=10) as r:
+                health = json.loads(r.read().decode())
+            compile_stats = (health.get("models", {}).get(boot_model)
+                             or {}).get("compile") or {}
+        finally:
+            proc.kill()
+            proc.wait()
+        return boot_s, compile_stats
+
+    try:
+        # -- phase A: warm-vs-cold boot through the shared compile cache --
+        cold_s, cold_compile = boot_to_first_200("bench-cold")
+        warm_s, warm_compile = boot_to_first_200("bench-warm")
+        speedup = cold_s / warm_s if warm_s else 0.0
+        warm_zero_recompiles = (warm_compile.get("cache_misses", -1) == 0
+                                and warm_compile.get("cache_hits", 0) > 0)
+
+        # -- phase B: kill one of three under an open-loop schedule --------
+        handles = []
+        for slot in range(3):
+            port = free_port()
+            handles.append(ReplicaHandle(
+                f"bench-{slot}", f"http://127.0.0.1:{port}",
+                argv=replica_argv(kill_model, port, f"bench-{slot}"),
+                env=replica_env, slot=slot))
+        router = TierRouter(handles, health_every_s=0.15,
+                            probe_timeout_s=1.0, restart_backoff_s=0.3)
+        router.start()
+        try:
+            if not router.wait_ready(n=3, timeout=240):
+                raise SystemExit(
+                    "tier bench: 3 replicas never became routable")
+            total = max(6.0, args.secs * 3)
+            qps = args.qps or 25.0
+            eject_window_s = 1.5
+            n_req = int(total * qps)
+            url = f"http://127.0.0.1:{router.bound_port}/predict"
+            body = payload(kill_model)
+            results: list = [None] * n_req
+            start = time.monotonic()
+
+            def client(w: int, n_workers: int) -> None:
+                # open-loop: arrival i fires at i/qps on the shared clock,
+                # never gated on the previous completion
+                for i in range(w, n_req, n_workers):
+                    t_sched = i / qps
+                    lag = t_sched - (time.monotonic() - start)
+                    if lag > 0:
+                        time.sleep(lag)
+                    try:
+                        req = urllib.request.Request(
+                            url, data=body,
+                            headers={"Content-Type": "application/json",
+                                     "X-Deadline-Ms": "15000"})
+                        with urllib.request.urlopen(req, timeout=20) as r:
+                            ok = r.status == 200
+                            r.read()
+                    except Exception:  # noqa: BLE001 — a failure IS data
+                        ok = False
+                    results[i] = (t_sched, ok)
+
+            n_workers = 16
+            threads = [threading.Thread(target=client, args=(w, n_workers),
+                                        daemon=True)
+                       for w in range(n_workers)]
+            for t in threads:
+                t.start()
+            victim = handles[0]
+            while time.monotonic() - start < total / 3.0:
+                time.sleep(0.02)
+            proc = victim.proc
+            if proc is not None:
+                proc.send_signal(_signal.SIGKILL)
+            kill_at = time.monotonic() - start
+            for t in threads:
+                t.join()
+            # supervised readmission: backoff + warm boot off the shared
+            # cache; must come back routable on its own
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not (
+                    victim.routable and victim.launches >= 2):
+                time.sleep(0.1)
+            readmitted = victim.routable and victim.launches >= 2
+            stats = dict(router.stats)
+            victim_desc = victim.describe()
+        finally:
+            router.close(replica_grace_s=10)
+
+        done = [r for r in results if r is not None]
+        pre = [r for r in done if r[0] < kill_at]
+        window = [r for r in done
+                  if kill_at <= r[0] < kill_at + eject_window_s]
+        post = [r for r in done if r[0] >= kill_at + eject_window_s]
+        pre_good = sum(1 for r in pre if r[1]) / max(1, len(pre))
+        post_good = sum(1 for r in post if r[1]) / max(1, len(post))
+        failed_window = sum(1 for r in window if not r[1])
+        failed_after = sum(1 for r in post if not r[1])
+
+        print(json.dumps({
+            "metric": f"serve_tier_warm_boot_speedup({boot_model},"
+                      f"shared-xla-cache,{platform})",
+            "value": round(speedup, 2),
+            "unit": "x (cold boot-to-first-200 / warm)",
+            "vs_baseline": round(speedup, 2),
+            "baseline": "cold replica boot (empty persistent compile "
+                        "cache) to first /predict 200, identical argv",
+            "boot_model": boot_model,
+            "cold_boot_s": round(cold_s, 2),
+            "warm_boot_s": round(warm_s, 2),
+            "cold_compile": cold_compile,
+            "warm_compile": warm_compile,
+            "warm_zero_recompiles": warm_zero_recompiles,
+            "kill_one": {
+                "model": kill_model,
+                "replicas": 3,
+                "offered_qps": qps,
+                "offered_requests": n_req,
+                "answered": len(done),
+                "kill_at_s": round(kill_at, 2),
+                "eject_window_s": eject_window_s,
+                "goodput_pre_kill": round(pre_good, 4),
+                "goodput_post_window": round(post_good, 4),
+                "failed_in_window": failed_window,
+                "failed_after_window": failed_after,
+                "ejections": stats.get("ejections", 0),
+                "readmissions": stats.get("readmissions", 0),
+                "restarts": stats.get("restarts", 0),
+                "retries": stats.get("retries", 0),
+                "victim_launches": victim_desc["launches"],
+                "victim_readmitted": readmitted,
+            },
+            "secs": args.secs,
+            "cpu_cores": os.cpu_count(),
+            "platform": platform,
+        }))
+        bars = []
+        if not warm_zero_recompiles:
+            bars.append(f"warm boot recompiled: {warm_compile}")
+        if speedup < 2.0:
+            bars.append(f"warm boot speedup {speedup:.2f}x < 2x "
+                        f"(cold {cold_s:.2f}s, warm {warm_s:.2f}s)")
+        if failed_after:
+            bars.append(f"{failed_after} failed responses after the "
+                        f"{eject_window_s:g}s ejection window")
+        if post_good < 0.95 * pre_good:
+            bars.append(f"post-kill goodput {post_good:.3f} fell >5% under "
+                        f"pre-kill {pre_good:.3f}")
+        if not readmitted:
+            bars.append("victim never re-admitted by supervised restart")
+        if bars:
+            raise SystemExit("tier bench bars broke: " + "; ".join(bars))
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--int8", action="store_true",
@@ -956,6 +1211,15 @@ def main(argv=None) -> None:
                         "the same closed-loop load through each precision "
                         "ladder — sustained QPS, p99, bytes/batch as one "
                         "bench line (docs/SERVING.md 'Quantized serving')")
+    p.add_argument("--tier", action="store_true",
+                   help="replica-tier bench (serve/tier.py): warm-vs-cold "
+                        "replica boot-to-first-200 through the shared "
+                        "persistent compile cache (bar: >=2x, zero warm "
+                        "recompiles), then SIGKILL one of 3 supervised "
+                        "replicas under an open-loop schedule (bars: zero "
+                        "failed responses after the ejection window, "
+                        "goodput within 5%% of pre-kill, supervised "
+                        "readmission) — docs/SERVING.md 'Replica tier'")
     p.add_argument("--load", action="store_true",
                    help="open-loop fleet load bench (sustained-QPS arrival "
                         "schedule over --models) instead of the closed-loop "
@@ -1014,6 +1278,10 @@ def main(argv=None) -> None:
                       or args.trace_out):
         raise SystemExit("--int8 is the standalone precision comparison — "
                          "run it without the --load family of modes")
+    if args.tier and (args.int8 or args.load or args.spike
+                      or args.promote_at or args.trace_out):
+        raise SystemExit("--tier is the standalone replica-tier bench — "
+                         "run it without the other modes")
     if args.promote_at and not args.load:
         raise SystemExit("--promote-at needs --load (the promotion bench "
                          "runs under the open-loop arrival schedule)")
@@ -1033,6 +1301,8 @@ def main(argv=None) -> None:
                          else 10.0 if args.promote_at else 5.0)
     if args.int8:
         int8_bench()
+    elif args.tier:
+        tier_bench(args)
     elif args.load and args.promote_at:
         promote_under_load(args)
     elif args.load and args.spike:

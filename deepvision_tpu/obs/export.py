@@ -257,6 +257,99 @@ def render_prometheus(fleet) -> str:
     return "\n".join(lines) + "\n"
 
 
+# -- multi-replica exposition merge (the tier router's /metrics) ---------------
+
+def merge_expositions(texts: Dict[str, str]) -> str:
+    """Merge N replica expositions (`{replica_id: exposition_text}`) into
+    ONE valid exposition — the tier router's `GET /metrics`
+    (serve/tier.py). The merge contract:
+
+    - counters and gauges keep one series PER REPLICA, distinguished by an
+      added `replica` label — a counter stays monotone because each
+      replica's series is its own lifetime store (summing across replicas
+      would go BACKWARDS every time a crashed replica restarts at zero);
+    - histogram families are SUMMED across replicas per label set (bucket
+      counts, `_sum`, `_count`) — the fixed shared bucket edges
+      (serve/metrics.LATENCY_BUCKETS_S) exist exactly so replica
+      histograms aggregate; the sums stay cumulative and `+Inf == _count`
+      by construction. A restart resets the sum, which is the standard
+      Prometheus counter-reset semantics scrapers already handle;
+    - each family's HELP/TYPE is emitted once, with every sample
+      contiguous under it (the format requirement
+      `validate_prometheus_text` enforces), in first-seen order.
+    """
+    order: List[str] = []            # family emission order (first seen)
+    meta: Dict[str, Tuple[str, str]] = {}          # family -> (type, help)
+    # family -> rows: histogram families aggregate into {key: value} with
+    # a parallel first-seen key order; everything else appends per-replica
+    hist_vals: Dict[str, Dict[tuple, float]] = {}
+    hist_order: Dict[str, List[tuple]] = {}
+    rows: Dict[str, List[Tuple[str, Dict[str, str], float]]] = {}
+
+    for replica, text in texts.items():
+        types: Dict[str, str] = {}
+        helps: Dict[str, str] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                parts = line[len("# HELP "):].split(" ", 1)
+                if parts:
+                    helps[parts[0]] = parts[1] if len(parts) > 1 else ""
+                continue
+            if line.startswith("# TYPE "):
+                parts = line[len("# TYPE "):].split()
+                if len(parts) == 2:
+                    types[parts[0]] = parts[1]
+                continue
+            if line.startswith("#"):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name = m.group("name")
+            fam = _family(name, types)
+            if fam not in meta:
+                if fam not in types:
+                    continue   # sample with no TYPE: drop, never corrupt
+                meta[fam] = (types[fam], helps.get(fam, ""))
+                order.append(fam)
+            labels = _parse_labels(m.group("labels"), [], line)
+            try:
+                value = _parse_value(m.group("value"))
+            except ValueError:
+                continue
+            if meta[fam][0] in ("histogram", "summary"):
+                key = (name, tuple(sorted(labels.items())))
+                vals = hist_vals.setdefault(fam, {})
+                if key not in vals:
+                    vals[key] = 0.0
+                    # first-seen order preserves ascending le within a
+                    # series (every replica renders the same fixed edges)
+                    hist_order.setdefault(fam, []).append((key, labels,
+                                                           name))
+                vals[key] += value
+            else:
+                rows.setdefault(fam, []).append(
+                    (name, {**labels, "replica": replica}, value))
+
+    lines: List[str] = []
+    for fam in order:
+        mtype, help_text = meta[fam]
+        lines.append(f"# HELP {fam} {help_text}")
+        lines.append(f"# TYPE {fam} {mtype}")
+        if mtype in ("histogram", "summary"):
+            vals = hist_vals.get(fam, {})
+            for key, labels, name in hist_order.get(fam, []):
+                lines.append(f"{name}{_labels(labels)}"
+                             f" {_fmt(vals[key])}")
+        else:
+            for name, labels, value in rows.get(fam, []):
+                lines.append(f"{name}{_labels(labels)} {_fmt(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 # -- minimal format validation (shared by tests + preflight) -------------------
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")

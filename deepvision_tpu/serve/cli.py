@@ -170,6 +170,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable span tracing entirely: GET /trace serves "
                         "an empty ring and the request path pays a single "
                         "branch")
+    p.add_argument("--drain-grace", type=float, default=0.0,
+                   metavar="SECS",
+                   help="graceful-drain de-admission window: after SIGTERM "
+                        "/healthz flips to 'draining' immediately, then "
+                        "the server keeps accepting (and answering) for "
+                        "SECS seconds before the batcher drain starts "
+                        "refusing work — long enough for a tier router's "
+                        "health poll to stop sending first (default 0: "
+                        "flip and drain at once; the replica entrypoint "
+                        "defaults to 0.75)")
     p.add_argument("--port", type=int, default=8700)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--flush-every", type=float, default=10.0,
@@ -298,12 +308,14 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
     return snap
 
 
-def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = build_parser()
-    args = parser.parse_args(argv)
-    if args.list_models:
-        _list_models(args.runs_root)
-        return 0
+def validate_args(parser: argparse.ArgumentParser, args,
+                  require_reload_for_gate: bool = True) -> None:
+    """The flag-coupling checks shared by every entrypoint built on
+    `build_parser` (serve CLI here, the tier replica in serve/replica.py).
+    `require_reload_for_gate=False` relaxes the --promote-gate /
+    --reload-every coupling: a tier replica arms the gate but is driven
+    through `POST /reload` by the router's rolling promotion instead of
+    polling on its own."""
     if not args.model:
         parser.error("-m/--model is required (see --list-models)")
     names = [s.strip() for s in args.model.split(",") if s.strip()]
@@ -321,7 +333,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.canary_window < 0:
         parser.error(f"--canary-window must be >= 0, got "
                      f"{args.canary_window}")
-    if args.promote_gate is not None and not args.reload_every:
+    if (require_reload_for_gate and args.promote_gate is not None
+            and not args.reload_every):
         parser.error("--promote-gate needs --reload-every: promotion "
                      "evaluates the candidates the hot-reload poller finds")
     if args.workers < 1:
@@ -336,12 +349,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.breaker_cooldown <= 0:
         parser.error(f"--breaker-cooldown must be > 0, got "
                      f"{args.breaker_cooldown}")
+    if args.drain_grace < 0:
+        parser.error(f"--drain-grace must be >= 0, got {args.drain_grace}")
     if args.trace_sample is not None and not 0.0 <= args.trace_sample <= 1.0:
         parser.error(f"--trace-sample must be in [0, 1], got "
                      f"{args.trace_sample}")
     if args.quant_gate < 0:
         parser.error(f"--quant-gate must be >= 0, got {args.quant_gate}")
 
+
+def build_server(args, replica_id: Optional[str] = None):
+    """Construct the full serving stack (compile cache -> engines -> fleet
+    -> InferenceServer -> optional int8 arm) from parsed `build_parser`
+    args. Shared by `main` below and the tier replica entrypoint
+    (serve/replica.py), so a replica behind the router is byte-for-byte
+    the standalone server."""
     from ..cli import setup_compilation_cache
     setup_compilation_cache(args.compilation_cache)
 
@@ -349,6 +371,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from .fleet import ModelFleet
     from .server import InferenceServer
 
+    names = [s.strip() for s in args.model.split(",") if s.strip()]
     try:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
     except ValueError:
@@ -392,7 +415,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         autoscale_every_s=args.autoscale_every,
         default_deadline_s=args.deadline_ms / 1000.0,
         trace=not args.no_trace,
-        trace_sample=args.trace_sample)
+        trace_sample=args.trace_sample,
+        drain_grace_s=args.drain_grace,
+        replica_id=replica_id)
     if args.serve_precision == "int8":
         # arm + gate int8 per model BEFORE traffic: the calibration pass
         # and the bucket compiles are startup cost, never request cost. A
@@ -406,6 +431,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          logger=server.logger)
             except ValueError as e:
                 print(f"[serve:{sm_.name}] int8 skipped: {e}", flush=True)
+    return server
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_models:
+        _list_models(args.runs_root)
+        return 0
+    validate_args(parser, args)
+    server = build_server(args)
     try:
         if args.smoke:
             _smoke(server, args.duration, args.load_threads)

@@ -103,10 +103,22 @@ class ServedModel:
         here, BEFORE anything is queued. `trace` is a sampled request's
         TraceContext (obs/trace.py) — the dispatcher records its queue
         wait and links it to the batch that serves it."""
+        return self.submit_routed(images, deadline_s=deadline_s,
+                                  precision=precision, trace=trace)[0]
+
+    def submit_routed(self, images, *, deadline_s: Optional[float] = None,
+                      precision: Optional[str] = None, trace=None):
+        """`submit` plus the routing verdict: returns `(future,
+        generation)` where `generation` is `"candidate"` when the promotion
+        controller canary-routed this request and `"live"` otherwise — the
+        per-response generation report the tier router's no-mixed-
+        generation audit (serve/tier.py) pins, resolved HERE so the label
+        and the routed batch can never disagree."""
         generation = self.promoter.route() if self.promoter else None
-        return self.batcher.submit(images, generation=generation,
-                                   precision=precision,
-                                   deadline_s=deadline_s, trace=trace)
+        fut = self.batcher.submit(images, generation=generation,
+                                  precision=precision,
+                                  deadline_s=deadline_s, trace=trace)
+        return fut, (generation or "live")
 
     def describe(self) -> dict:
         """The /healthz per-model record: serving shape + weight
@@ -115,8 +127,22 @@ class ServedModel:
             reload_stats = dict(self.reload_stats)
             autoscale_stats = dict(self.autoscale_stats)
         autoscale_stats["workers"] = self.batcher.workers
+        compile_log = list(getattr(self.engine, "compile_log", ()))
         return {
             "buckets": list(self.engine.buckets),
+            # startup compile evidence: how many bucket programs the boot
+            # paid for vs read from the persistent XLA cache — the tier's
+            # warm-boot contract (`misses == 0` on a warm shared cache) is
+            # auditable per replica from one /healthz
+            "compile": {
+                "entries": len(compile_log),
+                "cache_hits": sum(1 for e in compile_log
+                                  if e.get("cache") == "hit"),
+                "cache_misses": sum(1 for e in compile_log
+                                    if e.get("cache") == "miss"),
+                "compile_s": round(sum(e.get("compile_s", 0.0)
+                                       for e in compile_log), 3),
+            },
             # the int8 axis: the ACTIVE precision dispatches default to,
             # and the last calibration-gate decision (why int8 is on/off)
             "precision": getattr(self.engine, "precision", "bf16"),

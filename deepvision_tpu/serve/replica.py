@@ -1,0 +1,68 @@
+"""`python -m deepvision_tpu.serve.replica` — one replica of a serving tier.
+
+A replica IS the standalone fleet server (serve/cli.py builds it through
+the same `build_server`), launched with the small contract the tier router
+(serve/tier.py) supervises it under:
+
+- **Identity**: `--replica-id` is echoed on `/healthz` (`"replica"`), so
+  the router can confirm the process answering a slot's port is the
+  process it respawned into that slot.
+- **Warm boot**: the router passes every replica the SAME persistent XLA
+  compilation cache dir (`--compilation-cache`), so only the tier's FIRST
+  boot ever compiles the bucket programs — a crashed replica's replacement
+  (and every cold start after the first) reads its executables from the
+  shared cache and is serving-warm in seconds. `/healthz` reports per-model
+  compile hit/miss counts, so "zero recompiles on the warm path" is a fact
+  the router (and bench_serve.py --tier) can check, not an assumption.
+- **Graceful de-admission**: `--drain-grace` defaults to 0.75 s here
+  (the standalone CLI defaults to 0): on SIGTERM `/healthz` flips to
+  "draining" in the signal handler, then the replica keeps answering for
+  the grace window so the router's health poll de-admits it BEFORE the
+  batcher drain refuses anything — a drained replica costs zero 5xx.
+- **Router-driven promotion**: `--promote-gate` is allowed WITHOUT
+  `--reload-every` (the standalone CLI couples them): the replica arms the
+  shadow/canary controller but never polls for candidates on its own —
+  the router's rolling promotion drives `POST /reload` one replica at a
+  time, so a regressing candidate is exposed on exactly one replica.
+- **Fault rehearsal**: `DEEPVISION_FAULT_REPLICA_CRASH` /
+  `DEEPVISION_FAULT_REPLICA_WEDGE` (utils/faults.py) are read from the
+  environment by the server itself — the router's ejection paths are
+  CI-rehearsable against a real replica process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .cli import build_parser, build_server, validate_args
+
+
+def build_replica_parser():
+    p = build_parser()
+    p.prog = "python -m deepvision_tpu.serve.replica"
+    p.add_argument("--replica-id", default=None,
+                   help="tier slot identity, echoed on /healthz — set by "
+                        "the router (serve/tier.py) so it can verify which "
+                        "replica answers a supervised slot's port")
+    # replicas live behind a health-polling router: give its poll one
+    # window to de-admit before the drain refuses work
+    p.set_defaults(drain_grace=0.75)
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_replica_parser()
+    args = parser.parse_args(argv)
+    # the router triggers promotion via POST /reload; the replica's own
+    # poller stays off unless explicitly armed
+    validate_args(parser, args, require_reload_for_gate=False)
+    server = build_server(args, replica_id=args.replica_id)
+    try:
+        server.serve(port=args.port, host=args.host)
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

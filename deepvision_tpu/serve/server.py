@@ -39,6 +39,14 @@ threads are exactly the concurrency the micro-batchers coalesce) over a
                                load in Perfetto to follow one request
                                admission -> queue -> batch -> dispatch ->
                                response
+    POST /reload            -> 200 after ONE synchronous hot-reload sweep
+                               (WeightReloader.check_once): new verified
+                               epochs swap in — or run the full
+                               shadow/canary promotion pipeline when one
+                               is attached — before the response, which
+                               carries the outcome. The tier router's
+                               rolling promotion (serve/tier.py) drives
+                               replicas one at a time through this
 
 Request ids: every request gets one — the client's `X-Request-Id` header
 when present, a generated id otherwise — echoed in EVERY response
@@ -60,11 +68,14 @@ zero downtime and zero recompiles; `reload_every_s > 0` arms the poller.
 
 Graceful drain reuses the resilience SIGTERM/SIGINT contract
 (core/resilience.GracefulShutdown — same handler the trainer installs):
-the first signal stops the accept path (new submits get 503), every
-request already accepted finishes and is answered, the reloader stops,
-metrics flush, and the process exits 0 — a preempted serving replica under
-a grace window answers everything it promised and leaves cleanly. A second
-signal aborts immediately, same as training.
+the first signal flips /healthz to "draining" IN the signal handler —
+strictly before any work is refused — then (after `drain_grace_s`, the
+window that lets a router's health poll de-admit this replica while it
+still answers everything) stops the accept path (new submits get 503),
+finishes and answers every request already accepted, stops the reloader,
+flushes metrics, and exits 0 — a preempted serving replica under a grace
+window answers everything it promised and leaves cleanly. A second signal
+aborts immediately, same as training.
 """
 
 from __future__ import annotations
@@ -83,6 +94,7 @@ from ..core.metrics import MetricsLogger
 from ..core.resilience import GracefulShutdown, log_resilience_event
 from ..obs.export import chrome_trace, render_prometheus
 from ..obs.trace import Tracer, new_request_id
+from ..utils.faults import FaultInjector
 from .autoscale import AutoscaleController
 from .batcher import (CircuitOpen, DeadlineExpired, DeadlineUnmeetable,
                       Draining, Overloaded, result_within)
@@ -128,7 +140,10 @@ class InferenceServer:
                  breaker_cooldown_s: float = 5.0,
                  trace: bool = True,
                  trace_sample: Optional[float] = None,
-                 trace_capacity: int = 16384):
+                 trace_capacity: int = 16384,
+                 drain_grace_s: float = 0.0,
+                 replica_id: Optional[str] = None,
+                 faults: Optional[FaultInjector] = None):
         if (engine is None) == (fleet is None):
             raise ValueError("pass exactly one of engine= or fleet=")
         if fleet is None:
@@ -193,6 +208,27 @@ class InferenceServer:
         self._stop = threading.Event()
         self.ready = threading.Event()   # set once the listener is bound
         self.bound_port: Optional[int] = None
+        # the DE-ADMISSION flag: set the INSTANT a drain is requested
+        # (signal handler / stop()), strictly BEFORE the batcher drain
+        # starts rejecting work. /healthz flips to "draining" off this
+        # flag, so a router polling health de-admits the replica while it
+        # is still answering everything — without it the first evidence of
+        # shutdown a router saw was 503s (the bug this flag fixes).
+        self.draining_flag = threading.Event()
+        # drain grace: how long a drain-requested server keeps accepting
+        # (and answering) normally after flipping /healthz, so routers get
+        # at least one health-poll interval to stop sending before submits
+        # start answering 503 Draining. 0 = flip and drain immediately
+        # (the single-process default; the tier replica sets a real grace)
+        self.drain_grace_s = float(drain_grace_s)
+        # identity within a replica tier (serve/tier.py): echoed on
+        # /healthz so the router can confirm it is talking to the replica
+        # it thinks it is (a respawned process keeps its slot's id)
+        self.replica_id = replica_id
+        # replica-level fault injection (utils/faults.py REPLICA_CRASH /
+        # REPLICA_WEDGE): consulted at the top of every HTTP request —
+        # inert injectors cost two None-compares per request
+        self.faults = faults if faults is not None else FaultInjector.from_env()
 
     # -- metrics -----------------------------------------------------------
 
@@ -223,6 +259,7 @@ class InferenceServer:
 
     def stop(self) -> None:
         """Programmatic equivalent of one SIGTERM (tests/embedding use)."""
+        self.draining_flag.set()   # de-admit BEFORE the drain starts
         self._stop.set()
         self._wake.set()
 
@@ -254,7 +291,15 @@ class InferenceServer:
         self.bound_port = httpd.server_address[1]
         http_thread = threading.Thread(target=httpd.serve_forever,
                                        daemon=True, name="http-serve")
-        with GracefulShutdown(on_signal=self._wake.set,
+
+        def on_signal() -> None:
+            # ordering is the de-admission contract: the draining flag (and
+            # with it /healthz) flips IN the signal handler, before the
+            # main loop has even woken to start the batcher drain
+            self.draining_flag.set()
+            self._wake.set()
+
+        with GracefulShutdown(on_signal=on_signal,
                               what=DRAIN_WHAT) as gs:
             self.reloader.start()
             self.autoscaler.start()
@@ -271,6 +316,14 @@ class InferenceServer:
                     self._wake.clear()   # signal/stop — re-check the flag
                     continue
                 self.flush_metrics()     # quiet period: periodic flush
+            # de-admission grace: /healthz already says "draining" (the
+            # signal handler flipped it), and during this window the server
+            # still ACCEPTS and answers everything — a router polling
+            # health stops sending new work before a single submit is
+            # refused, so a graceful replica shutdown costs zero 5xx
+            self.draining_flag.set()   # idempotent (stop() also sets it)
+            if self.drain_grace_s > 0:
+                time.sleep(self.drain_grace_s)
             # drain FIRST: handlers blocked on accepted futures still get
             # their answers while new submits 503; only then stop accepting
             # connections at all
@@ -335,6 +388,7 @@ def _make_handler(server: InferenceServer):
                              "served_models": server.fleet.names()})
 
         def do_GET(self):
+            server.faults.on_replica_request(predict=False)
             self._assign_request_id()
             if self.path == "/metrics":
                 # Prometheus text exposition: counters come from lifetime
@@ -354,8 +408,19 @@ def _make_handler(server: InferenceServer):
             if self.path == "/healthz":
                 d = server.fleet.default
                 self._json(200, {
-                    "status": ("draining" if server.fleet.draining
+                    # de-admission ordering: the draining flag flips in the
+                    # signal handler, BEFORE the batcher drain starts — a
+                    # router sees "draining" while the replica still
+                    # answers everything (the fix pinned by test_tier's
+                    # drain-under-router-traffic test)
+                    "status": ("draining"
+                               if (server.draining_flag.is_set()
+                                   or server.fleet.draining)
                                else "ok"),
+                    # identity + load signals the tier router's
+                    # least-loaded routing reads (serve/tier.py)
+                    "replica": server.replica_id,
+                    "queue_depth": server.fleet.queue_depth,
                     # default-model fields first, exactly the PR 3 shape —
                     # single-model probes keep working unchanged
                     "model": d.name,
@@ -388,6 +453,24 @@ def _make_handler(server: InferenceServer):
 
         def do_POST(self):
             rid = self._assign_request_id()
+            if self.path == "/reload":
+                # tier control plane (serve/tier.py rolling promotion): run
+                # ONE synchronous reload sweep — new verified epochs swap
+                # in (or run the full shadow/canary pipeline when a
+                # promoter is attached) before this returns, so the caller
+                # reads the outcome from the response instead of polling
+                server.faults.on_replica_request(predict=False)
+                try:
+                    swapped = server.reloader.check_once()
+                except Exception as e:  # noqa: BLE001 — control plane must
+                    return self._json(500, {"error": repr(e)})   # answer
+                return self._json(200, {
+                    "swapped": swapped,
+                    "watched": [sm.name for sm in server.reloader.models],
+                    "models": server.fleet.describe(),
+                })
+            server.faults.on_replica_request(
+                predict=self.path.startswith("/predict"))
             sm = (self._resolve("/predict")
                   if self.path.startswith("/predict") else
                   self._unknown_path())
@@ -463,8 +546,17 @@ def _make_handler(server: InferenceServer):
                 # generation, everything else on the live weights.
                 # Admission control, backpressure, and the circuit
                 # breaker all refuse HERE, before anything is queued.
-                fut = sm.submit(x, deadline_s=deadline_s,
-                                precision=precision, trace=ctx)
+                fut, generation = sm.submit_routed(
+                    x, deadline_s=deadline_s, precision=precision,
+                    trace=ctx)
+                # pin the responding generation's weight epoch NOW, at
+                # routing time — a concurrent promote flipping the live
+                # reference later must not relabel this response
+                gen_prov = (sm.engine.candidate_provenance
+                            if (generation == "candidate"
+                                and sm.engine.candidate_provenance)
+                            else sm.engine.provenance)
+                weights_epoch = gen_prov.get("checkpoint_epoch")
                 if ctx is not None:
                     tracer.add("admission", "serve", int(t_adm * 1e9),
                                int((time.monotonic() - t_adm) * 1e9),
@@ -515,14 +607,17 @@ def _make_handler(server: InferenceServer):
             except Exception as e:  # noqa: BLE001 — a failed dispatch must
                 refused("dispatch_error", admission=False)  # not hang the
                 return self._json(500, {"error": repr(e)})  # client
+            # every 200 reports the weight generation that answered it
+            # ("live"/"candidate" + that generation's checkpoint epoch):
+            # the tier's no-mixed-generation audit reads this per response
+            body = {"predictions": jax.tree_util.tree_map(
+                        lambda a: np.asarray(a).tolist(), out),
+                    "generation": generation,
+                    "weights_epoch": weights_epoch}
             if ctx is None:
-                return self._json(200, {"predictions":
-                                        jax.tree_util.tree_map(
-                                            lambda a: np.asarray(a).tolist(),
-                                            out)})
+                return self._json(200, body)
             t_w = time.monotonic()
-            self._json(200, {"predictions": jax.tree_util.tree_map(
-                lambda a: np.asarray(a).tolist(), out)})
+            self._json(200, body)
             now = time.monotonic()
             tracer.add("response_write", "serve", int(t_w * 1e9),
                        int((now - t_w) * 1e9),

@@ -75,6 +75,26 @@ and in-process tests configure it the same way:
                                              preflight's `quant` check arms
                                              this). Fires on every gate
                                              evaluation while set
+    DEEPVISION_FAULT_REPLICA_CRASH=k         the serving replica process
+                                             HARD-EXITS (os._exit, no drain,
+                                             no atexit) on the predict
+                                             request after it has answered k
+                                             — the "replica died mid-request"
+                                             failure the tier router
+                                             (serve/tier.py) must eject on
+                                             the spot, retry elsewhere, and
+                                             supervise back up
+    DEEPVISION_FAULT_REPLICA_WEDGE=k         after k answered predict
+                                             requests the replica STOPS
+                                             ANSWERING but keeps its socket:
+                                             every later request (health
+                                             probes included) blocks forever
+                                             — the failure mode only a
+                                             deadline-bounded probe can
+                                             distinguish from "slow", driving
+                                             the router's breaker ejection
+                                             path rather than the
+                                             connection-refused one
     DEEPVISION_FAULT_PROMOTE_REGRESS=k:kind  make candidate epoch k a
                                              REGRESSION when the promotion
                                              controller (serve/promote.py)
@@ -100,6 +120,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -154,7 +175,9 @@ class FaultInjector:
                  promote_regress_kind: Optional[str] = None,
                  quant_regress: bool = False,
                  serve_dispatch_fail_at: Optional[int] = None,
-                 serve_dispatch_fail_count: int = 1):
+                 serve_dispatch_fail_count: int = 1,
+                 replica_crash_after: Optional[int] = None,
+                 replica_wedge_after: Optional[int] = None):
         self.data_io_step = data_io_step
         self.data_io_remaining = data_io_count if data_io_step is not None else 0
         self.nan_step = nan_step
@@ -169,10 +192,14 @@ class FaultInjector:
         self.serve_dispatch_fail_count = (serve_dispatch_fail_count
                                           if serve_dispatch_fail_at is not None
                                           else 0)
+        self.replica_crash_after = replica_crash_after
+        self.replica_wedge_after = replica_wedge_after
         self._batch_index = 0   # advances once per batch PULLED (post-fault)
         self._save_index = 0
         self._async_index = 0
         self._serve_dispatch_index = 0
+        self._replica_requests = 0   # predict requests ANSWERED so far
+        self._replica_wedged = False
         # serving dispatches run on N concurrent pool workers; the counter
         # must still be exact or the "n CONSECUTIVE failures" contract
         # flakes — the only multi-threaded hook, so the only locked one
@@ -192,6 +219,8 @@ class FaultInjector:
                                 "") not in ("", "0")
         dispatch_at, dispatch_count = _parse_step_count(
             env.get("DEEPVISION_FAULT_SERVE_DISPATCH_FAIL"))
+        crash_raw = env.get("DEEPVISION_FAULT_REPLICA_CRASH")
+        wedge_raw = env.get("DEEPVISION_FAULT_REPLICA_WEDGE")
         return cls(data_io_step=io_step, data_io_count=io_count,
                    nan_step=nan_step,
                    ckpt_save_fails=int(
@@ -204,7 +233,11 @@ class FaultInjector:
                    promote_regress_kind=regress_kind,
                    quant_regress=quant_regress,
                    serve_dispatch_fail_at=dispatch_at,
-                   serve_dispatch_fail_count=dispatch_count)
+                   serve_dispatch_fail_count=dispatch_count,
+                   replica_crash_after=(int(crash_raw) if crash_raw
+                                        else None),
+                   replica_wedge_after=(int(wedge_raw) if wedge_raw
+                                        else None))
 
     @property
     def active(self) -> bool:
@@ -213,7 +246,9 @@ class FaultInjector:
                 or self.ckpt_corrupt_epoch is not None
                 or self.promote_regress_epoch is not None
                 or self.quant_regress
-                or self.serve_dispatch_fail_at is not None)
+                or self.serve_dispatch_fail_at is not None
+                or self.replica_crash_after is not None
+                or self.replica_wedge_after is not None)
 
     # -- hooks -------------------------------------------------------------
     def before_batch(self) -> None:
@@ -283,6 +318,43 @@ class FaultInjector:
                 f"injected serving dispatch failure "
                 f"{i - lo + 1}/{self.serve_dispatch_fail_count} "
                 f"(dispatch {i})")
+
+    def on_replica_request(self, predict: bool = True) -> None:
+        """Called by the HTTP front door (serve/server.py) at the top of
+        every request. Predict requests advance the replica request
+        counter; once it passes the armed threshold the process either
+        HARD-EXITS (`REPLICA_CRASH` — os._exit, so no drain, no flush, the
+        client mid-request sees a reset and later connects are refused:
+        exactly what a SIGKILLed replica looks like to the tier router) or
+        WEDGES (`REPLICA_WEDGE` — this and every later handler thread,
+        health probes included, blocks forever while the listener keeps
+        accepting: the replica holds its socket but stops answering, the
+        failure only a deadline-bounded probe can eject). Non-predict
+        requests never advance the counter — a router's health-poll cadence
+        must not change WHEN the fault fires — but they do hang once the
+        replica is wedged."""
+        crash, wedge = self.replica_crash_after, self.replica_wedge_after
+        if crash is None and wedge is None:
+            return
+        hang = False
+        with self._serve_lock:
+            if predict and not self._replica_wedged:
+                n = self._replica_requests   # answered so far
+                self._replica_requests += 1
+                if crash is not None and n >= crash:
+                    print(f"[faults] replica hard-exit after {crash} "
+                          f"answered predict requests", file=sys.stderr,
+                          flush=True)
+                    os._exit(86)
+                if wedge is not None and n >= wedge:
+                    print(f"[faults] replica wedged after {wedge} answered "
+                          f"predict requests — holding the socket, "
+                          f"answering nothing", file=sys.stderr, flush=True)
+                    self._replica_wedged = True
+            hang = self._replica_wedged
+        if hang:
+            while True:      # hold the connection open, never answer
+                time.sleep(3600)
 
     def quant_regression(self) -> bool:
         """Called by the int8 quantization gate (serve/quantize.py) when it

@@ -1,0 +1,595 @@
+"""Replica tier (serve/tier.py): least-loaded routing, crash/wedge
+ejection + supervised restart + re-admission, rolling promotion, merged
+/metrics, graceful de-admission under router traffic, and the replica
+fault injectors (utils/faults.py).
+
+Router-logic tests run against a stdlib-only FAKE replica subprocess
+(no JAX import: boots in ~100 ms) that speaks the replica HTTP contract
+— /healthz load signals, /predict with request-id echo and the 404
+served-models body, /metrics exposition, /reload with promote/refuse
+behavior, crash/wedge-after-k knobs. The real-stack integration lives in
+preflight check #18 and `bench_serve.py --tier`; the one real-engine test
+here is the drain-under-router-traffic pin (the PR's de-admission
+bugfix), which needs the genuine signal-handler/drain ordering."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepvision_tpu.obs.export import (merge_expositions,
+                                       parse_prometheus_text,
+                                       validate_prometheus_text)
+from deepvision_tpu.serve.tier import ReplicaHandle, TierRouter, free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_REPLICA = r'''
+import json, os, sys, threading, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PORT = int(sys.argv[1])
+RID = os.environ.get("FAKE_REPLICA_ID", "?")
+QUEUE = int(os.environ.get("FAKE_QUEUE_DEPTH", "0"))
+WORKERS = int(os.environ.get("FAKE_WORKERS", "1"))
+LAT = float(os.environ.get("FAKE_LATENCY_S", "0"))
+CRASH = os.environ.get("FAKE_CRASH_AFTER")
+CRASH = int(CRASH) if CRASH else None
+WEDGE = os.environ.get("FAKE_WEDGE_AFTER")
+WEDGE = int(WEDGE) if WEDGE else None
+RELOAD_MODE = os.environ.get("FAKE_RELOAD_MODE", "none")
+
+lock = threading.Lock()
+state = {"predicts": 0, "wedged": False, "reload_calls": 0,
+         "reloads": 0, "refused_gate": 0, "epoch": 1, "last_rid": None}
+
+
+def model():
+    return {"lenet5": {
+        "workers": WORKERS, "queue_depth": QUEUE,
+        "reload": {"reloads": state["reloads"],
+                   "refused_gate": state["refused_gate"],
+                   "rolled_back": 0, "refused_corrupt": 0,
+                   "refused_incompatible": 0},
+        "weights": {"checkpoint_epoch": state["epoch"]},
+        "compile": {"entries": 2, "cache_hits": 2, "cache_misses": 0}}}
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _maybe_fault(self, predict):
+        with lock:
+            if predict and not state["wedged"]:
+                n = state["predicts"]
+                state["predicts"] += 1
+                if CRASH is not None and n >= CRASH:
+                    os._exit(86)
+                if WEDGE is not None and n >= WEDGE:
+                    state["wedged"] = True
+            hang = state["wedged"]
+        if hang:
+            while True:
+                time.sleep(3600)
+
+    def _json(self, code, obj):
+        b = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(b)))
+        rid = self.headers.get("X-Request-Id")
+        if rid:
+            self.send_header("X-Request-Id", rid)
+        self.end_headers()
+        self.wfile.write(b)
+
+    def do_GET(self):
+        self._maybe_fault(False)
+        if self.path == "/healthz":
+            return self._json(200, {
+                "status": "ok", "replica": RID, "queue_depth": QUEUE,
+                "models": model(),
+                "weights": {"checkpoint_epoch": state["epoch"]},
+                "reload_calls": state["reload_calls"],
+                "last_request_id": state["last_rid"]})
+        if self.path == "/metrics":
+            n = state["predicts"]
+            text = (
+                "# HELP deepvision_serve_requests_total t\n"
+                "# TYPE deepvision_serve_requests_total counter\n"
+                'deepvision_serve_requests_total{model="lenet5"} %d\n'
+                "# HELP deepvision_serve_request_latency_seconds t\n"
+                "# TYPE deepvision_serve_request_latency_seconds "
+                "histogram\n"
+                'deepvision_serve_request_latency_seconds_bucket'
+                '{le="0.1"} 1\n'
+                'deepvision_serve_request_latency_seconds_bucket'
+                '{le="+Inf"} 2\n'
+                "deepvision_serve_request_latency_seconds_sum 0.3\n"
+                "deepvision_serve_request_latency_seconds_count 2\n" % n)
+            b = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+            return
+        return self._json(404, {"error": "unknown path"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        if self.path == "/reload":
+            with lock:
+                state["reload_calls"] += 1
+                if RELOAD_MODE == "promote":
+                    state["reloads"] += 1
+                    state["epoch"] += 1
+                    swapped = 1
+                elif RELOAD_MODE == "refuse_gate":
+                    state["refused_gate"] += 1
+                    swapped = 0
+                else:
+                    swapped = 0
+            return self._json(200, {"swapped": swapped,
+                                    "models": model()})
+        self._maybe_fault(self.path.startswith("/predict"))
+        if self.path == "/predict" or self.path.startswith("/predict/"):
+            name = (self.path[len("/predict/"):]
+                    if self.path.startswith("/predict/") else "")
+            if name and name != "lenet5":
+                return self._json(404, {
+                    "error": "unknown model %r" % name,
+                    "served_models": ["lenet5"]})
+            with lock:
+                state["last_rid"] = self.headers.get("X-Request-Id")
+            if LAT:
+                time.sleep(LAT)
+            return self._json(200, {"predictions": [[0.0]],
+                                    "generation": "live",
+                                    "weights_epoch": state["epoch"],
+                                    "replica": RID})
+        return self._json(404, {"error": "unknown path"})
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", PORT), H)
+srv.daemon_threads = True
+srv.serve_forever()
+'''
+
+
+def _script(tmp_path):
+    p = tmp_path / "fake_replica.py"
+    if not p.exists():
+        p.write_text(FAKE_REPLICA)
+    return str(p)
+
+
+def _wait_port(port, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = socket.socket()
+        s.settimeout(0.2)
+        try:
+            if s.connect_ex(("127.0.0.1", port)) == 0:
+                return True
+        finally:
+            s.close()
+        time.sleep(0.02)
+    return False
+
+
+def _start_fake(tmp_path, rid, env=None, port=None):
+    port = port or free_port()
+    e = dict(os.environ)
+    e["FAKE_REPLICA_ID"] = str(rid)
+    e.update(env or {})
+    proc = subprocess.Popen([sys.executable, _script(tmp_path), str(port)],
+                            env=e)
+    assert _wait_port(port), f"fake replica {rid} never bound :{port}"
+    return proc, port
+
+
+def _attach_handle(rid, port, slot, **kw):
+    return ReplicaHandle(str(rid), f"http://127.0.0.1:{port}", slot=slot,
+                         **kw)
+
+
+def _router(handles, **kw):
+    kw.setdefault("health_every_s", 0.1)
+    kw.setdefault("probe_timeout_s", 0.4)
+    kw.setdefault("restart_backoff_s", 0.2)
+    r = TierRouter(handles, port=0, **kw)
+    r.start()
+    return r
+
+
+def _post(base, path="/predict", body=b'{"instances": [[[0.5]]]}',
+          headers=None, timeout=30):
+    req = urllib.request.Request(
+        base + path, data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# -- routing -------------------------------------------------------------------
+
+def test_least_loaded_routing_skews_away_from_deep_queue(tmp_path):
+    pa, porta = _start_fake(tmp_path, "a",
+                            env={"FAKE_QUEUE_DEPTH": "50",
+                                 "FAKE_WORKERS": "1"})
+    pb, portb = _start_fake(tmp_path, "b")
+    router = _router([_attach_handle("a", porta, 0),
+                      _attach_handle("b", portb, 1)])
+    try:
+        assert router.wait_ready(n=2, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        for _ in range(12):
+            with _post(base) as r:
+                assert r.status == 200
+        # replica a advertises 50 queued on 1 worker; every sequential
+        # request must land on the idle replica b
+        a, b = router.replicas
+        assert b.routed == 12 and a.routed == 0
+    finally:
+        router.close()
+        pa.kill()
+        pb.kill()
+
+
+def test_request_id_propagates_router_to_replica_and_back(tmp_path):
+    p, port = _start_fake(tmp_path, "a")
+    router = _router([_attach_handle("a", port, 0)])
+    try:
+        assert router.wait_ready(n=1, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        with _post(base, headers={"X-Request-Id": "tier-demo-1"}) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id") == "tier-demo-1"
+            assert r.headers.get("X-Tier-Replica") == "a"
+        js = _get_json(f"http://127.0.0.1:{port}/healthz")
+        assert js["last_request_id"] == "tier-demo-1"
+        # no client id: the router mints one and still echoes it
+        with _post(base) as r:
+            assert r.headers.get("X-Request-Id")
+    finally:
+        router.close()
+        p.kill()
+
+
+def test_unknown_model_404_passes_through_with_served_list(tmp_path):
+    p, port = _start_fake(tmp_path, "a")
+    router = _router([_attach_handle("a", port, 0)])
+    try:
+        assert router.wait_ready(n=1, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, path="/predict/nope")
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read().decode())
+        assert body["served_models"] == ["lenet5"]
+        # authoritative: answered on the first attempt, no retries burned
+        assert router.stats["retries"] == 0
+    finally:
+        router.close()
+        p.kill()
+
+
+# -- failure handling ----------------------------------------------------------
+
+def test_crash_ejects_restarts_and_readmits_with_zero_failures(tmp_path):
+    script = _script(tmp_path)
+    port0, port1 = free_port(), free_port()
+    env0 = {**os.environ, "FAKE_REPLICA_ID": "0", "FAKE_CRASH_AFTER": "2"}
+    env1 = {**os.environ, "FAKE_REPLICA_ID": "1"}
+    h0 = ReplicaHandle("0", f"http://127.0.0.1:{port0}",
+                       argv=[sys.executable, script, str(port0)],
+                       env=env0, slot=0)
+    h1 = ReplicaHandle("1", f"http://127.0.0.1:{port1}",
+                       argv=[sys.executable, script, str(port1)],
+                       env=env1, slot=1)
+    router = _router([h0, h1])
+    try:
+        assert router.wait_ready(n=2, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        deadline = time.monotonic() + 30
+        failures = 0
+        while time.monotonic() < deadline:
+            try:
+                with _post(base) as r:
+                    assert r.status == 200
+            except Exception:  # noqa: BLE001 — counted, asserted zero
+                failures += 1
+            if h0.launches >= 2 and router.stats["readmissions"] >= 1:
+                break
+            time.sleep(0.02)
+        # the crash (os._exit mid-request) cost the CLIENT nothing: the
+        # router retried onto replica 1 and supervised replica 0 back
+        assert failures == 0
+        assert h0.exits >= 1 and h0.last_exit_code == 86
+        assert h0.launches >= 2
+        assert router.stats["ejections"] >= 1
+        assert router.stats["readmissions"] >= 1
+        assert router.stats["restarts"] >= 1
+    finally:
+        router.close()
+
+
+def test_wedge_opens_breaker_and_ejects_via_bounded_probe(tmp_path):
+    pw, portw = _start_fake(tmp_path, "w", env={"FAKE_WEDGE_AFTER": "0"})
+    pg, portg = _start_fake(tmp_path, "g")
+    hw = _attach_handle("w", portw, 0, breaker_k=2,
+                        breaker_cooldown_s=0.5)
+    hg = _attach_handle("g", portg, 1)
+    router = _router([hw, hg], attempt_timeout_s=0.5)
+    try:
+        assert router.wait_ready(n=2, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        # drive requests with a short deadline until the wedged replica
+        # (accepts the socket, never answers) has been hit once — its hung
+        # request times out and the retry answers from the good replica
+        for _ in range(8):
+            with _post(base, headers={"X-Deadline-Ms": "1500"}) as r:
+                assert r.status == 200
+            if hw.routed == 0 and hw.failures >= 1:
+                break
+        # health probes into the wedge are deadline-bounded; K consecutive
+        # misses open the slot's circuit and it leaves the routing set
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and hw.routable:
+            time.sleep(0.05)
+        assert not hw.routable
+        assert hw.breaker.state != "closed" or not hw.healthy
+        assert router.stats["ejections"] >= 1
+        # the good replica carried everything that answered
+        assert hg.routed >= 1 and hw.routed == 0
+    finally:
+        router.close()
+        pw.kill()
+        pg.kill()
+
+
+# -- rolling promotion ---------------------------------------------------------
+
+def test_rolling_promotion_clean_run_promotes_every_replica(tmp_path):
+    procs, handles = [], []
+    for i in range(3):
+        p, port = _start_fake(tmp_path, str(i),
+                              env={"FAKE_RELOAD_MODE": "promote"})
+        procs.append(p)
+        handles.append(_attach_handle(str(i), port, i))
+    router = _router(handles, roll_model="lenet5")
+    try:
+        assert router.wait_ready(n=3, timeout=30)
+        rec = router.roll.roll_once()
+        assert rec["state"] == "promoted"
+        assert [o["outcome"] for o in rec["outcomes"]] == ["promoted"] * 3
+        assert rec["promoted"] == 3
+        # every replica took exactly one /reload; generations line up
+        for h in handles:
+            js = _get_json(h.url + "/healthz")
+            assert js["reload_calls"] == 1
+            assert js["weights"]["checkpoint_epoch"] == 2
+    finally:
+        router.close()
+        for p in procs:
+            p.kill()
+
+
+def test_rolling_promotion_regression_stops_after_one_replica(tmp_path):
+    modes = ["promote", "refuse_gate", "promote"]
+    procs, handles = [], []
+    for i, mode in enumerate(modes):
+        p, port = _start_fake(tmp_path, str(i),
+                              env={"FAKE_RELOAD_MODE": mode})
+        procs.append(p)
+        handles.append(_attach_handle(str(i), port, i))
+    router = _router(handles, roll_model="lenet5")
+    try:
+        assert router.wait_ready(n=3, timeout=30)
+        rec = router.roll.roll_once()
+        assert rec["state"] == "rolled_back"
+        assert [o["outcome"] for o in rec["outcomes"]] == [
+            "promoted", "rolled_back"]
+        assert rec["outcomes"][1]["refusals"] == {"refused_gate": 1.0}
+        # the roll STOPPED: replica 2 was never asked to reload — the
+        # regressing candidate was exposed on exactly one replica
+        assert _get_json(handles[2].url + "/healthz")["reload_calls"] == 0
+        assert _get_json(handles[1].url + "/healthz")["reload_calls"] == 1
+        # roll state is visible on the router front door
+        js = _get_json(f"http://127.0.0.1:{router.bound_port}/healthz")
+        assert js["roll"]["state"] == "rolled_back"
+    finally:
+        router.close()
+        for p in procs:
+            p.kill()
+
+
+# -- merged /metrics -----------------------------------------------------------
+
+def test_router_metrics_merges_replicas_and_stays_valid(tmp_path):
+    pa, porta = _start_fake(tmp_path, "a")
+    pb, portb = _start_fake(tmp_path, "b")
+    router = _router([_attach_handle("a", porta, 0),
+                      _attach_handle("b", portb, 1)])
+    try:
+        assert router.wait_ready(n=2, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        for _ in range(4):
+            with _post(base) as r:
+                assert r.status == 200
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        assert validate_prometheus_text(text) == []
+        parsed = parse_prometheus_text(text)
+        # counters keep one monotone series per replica...
+        assert ("deepvision_serve_requests_total",
+                (("model", "lenet5"), ("replica", "a"))) in parsed
+        assert ("deepvision_serve_requests_total",
+                (("model", "lenet5"), ("replica", "b"))) in parsed
+        # ...histograms sum across replicas (fixed shared bucket edges)
+        assert parsed[("deepvision_serve_request_latency_seconds_count",
+                       ())] == 4.0
+        # and the router appends its own tier families
+        assert parsed[("deepvision_tier_replicas", ())] == 2.0
+        routed = sum(parsed[k] for k in parsed
+                     if k[0] == "deepvision_tier_routed_total")
+        assert routed == 4.0
+    finally:
+        router.close()
+        pa.kill()
+        pb.kill()
+
+
+def test_merge_expositions_unit_contract():
+    a = ("# HELP c_total t\n# TYPE c_total counter\n"
+         'c_total{model="m"} 5\n'
+         "# HELP g t\n# TYPE g gauge\ng 2\n"
+         "# HELP h t\n# TYPE h histogram\n"
+         'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\n'
+         "h_sum 0.5\nh_count 2\n")
+    b = a.replace(" 5\n", " 7\n")
+    merged = merge_expositions({"r0": a, "r1": b})
+    assert validate_prometheus_text(merged) == []
+    parsed = parse_prometheus_text(merged)
+    assert parsed[("c_total", (("model", "m"), ("replica", "r0")))] == 5.0
+    assert parsed[("c_total", (("model", "m"), ("replica", "r1")))] == 7.0
+    assert parsed[("g", (("replica", "r0"),))] == 2.0
+    assert parsed[("h_count", ())] == 4.0
+    assert parsed[("h_bucket", (("le", "+Inf"),))] == 4.0
+    assert merge_expositions({}) == ""
+
+
+# -- graceful de-admission under router traffic (the PR's bugfix pin) ----------
+
+def test_drain_under_router_traffic_costs_zero_failures(tmp_path):
+    import numpy as np
+
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.server import InferenceServer
+
+    def serve_one(rid):
+        fleet = ModelFleet()
+        fleet.add(PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                            verbose=False),
+                  max_delay_ms=2.0)
+        srv = InferenceServer(fleet=fleet, flush_every_s=60.0,
+                              drain_grace_s=0.6, replica_id=rid)
+        th = threading.Thread(target=srv.serve, kwargs={"port": 0},
+                              daemon=True)
+        th.start()
+        assert srv.ready.wait(120)
+        return srv, th
+
+    sa, ta = serve_one("a")
+    sb, tb = serve_one("b")
+    router = _router([_attach_handle("a", sa.bound_port, 0),
+                      _attach_handle("b", sb.bound_port, 1)])
+    try:
+        assert router.wait_ready(n=2, timeout=30)
+        base = f"http://127.0.0.1:{router.bound_port}"
+        x = np.random.RandomState(0).randn(1, 32, 32, 1).tolist()
+        payload = json.dumps({"instances": x}).encode()
+        stop = threading.Event()
+        failures = []
+
+        def client(i):
+            while not stop.is_set():
+                try:
+                    with _post(base, body=payload) as r:
+                        assert r.status == 200
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)
+        # SIGTERM-equivalent on replica a: /healthz flips to "draining"
+        # BEFORE the batcher drain starts, and the 0.6 s grace outlives
+        # the router's 0.1 s health poll — the router de-admits a while
+        # it is still answering everything
+        sa.stop()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert failures == [], f"drain cost client failures: {failures[:3]}"
+        a, b = router.replicas
+        assert not a.routable          # de-admitted, not crashed
+        assert b.routed > 0
+        assert router.stats["ejections"] >= 1
+        # every response that DID come from a during the grace was a 200 —
+        # zero 5xx is the whole point of flag-before-drain
+    finally:
+        router.close()
+        sb.stop()
+        ta.join(timeout=60)
+        tb.join(timeout=60)
+
+
+# -- replica fault injectors (utils/faults.py) ---------------------------------
+
+FAULTS_PATH = os.path.join(REPO, "deepvision_tpu", "utils", "faults.py")
+
+_LOAD_FAULTS = (
+    "import importlib.util\n"
+    f"spec = importlib.util.spec_from_file_location('faults', "
+    f"{FAULTS_PATH!r})\n"
+    "faults = importlib.util.module_from_spec(spec)\n"
+    "spec.loader.exec_module(faults)\n")
+
+
+def test_fault_replica_crash_exits_after_k_predicts():
+    code = (_LOAD_FAULTS +
+            "fi = faults.FaultInjector(replica_crash_after=2)\n"
+            "fi.on_replica_request(); fi.on_replica_request()\n"
+            "fi.on_replica_request()\n"   # third predict: crash
+            "raise SystemExit(0)\n")
+    p = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert p.returncode == 86
+
+
+def test_fault_replica_crash_ignores_non_predict_requests():
+    code = (_LOAD_FAULTS +
+            "fi = faults.FaultInjector(replica_crash_after=1)\n"
+            "for _ in range(10):\n"
+            "    fi.on_replica_request(predict=False)\n"  # health polls
+            "fi.on_replica_request()\n"   # predict 1 of 1 allowed: answers
+            "raise SystemExit(0)\n")
+    p = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert p.returncode == 0
+
+
+def test_fault_env_parsing():
+    code = (_LOAD_FAULTS +
+            "import os\n"
+            "os.environ['DEEPVISION_FAULT_REPLICA_CRASH'] = '7'\n"
+            "os.environ['DEEPVISION_FAULT_REPLICA_WEDGE'] = '9'\n"
+            "fi = faults.FaultInjector.from_env()\n"
+            "assert fi.replica_crash_after == 7, fi.replica_crash_after\n"
+            "assert fi.replica_wedge_after == 9, fi.replica_wedge_after\n"
+            "assert fi.active\n"
+            "clean = faults.FaultInjector()\n"
+            "assert clean.replica_crash_after is None\n"
+            "assert not clean.active\n")
+    p = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert p.returncode == 0

@@ -62,6 +62,13 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               admission → queue_wait → batch with bucket/generation/worker
               tags) — the joined picture an operator debugs a 504 with
               has to exist BEFORE the incident
+  tier        replica tier (docs/SERVING.md "Replica tier"): a 2-replica
+              router must survive SIGKILL of one replica mid-traffic with
+              zero failed client responses (ejected on connection refused,
+              supervised back through the shared compile cache,
+              re-admitted), then roll a clean checkpoint epoch across the
+              tier one replica at a time — the crash-tolerance and
+              bounded-blast-radius deploy the traffic story depends on
   segment     dense-prediction family (docs/SEGMENTATION.md): a 2-epoch
               synthetic CPU train must improve mIoU, one H-sharded
               spatial train step on a 2-virtual-device mesh must match
@@ -691,6 +698,133 @@ def check_obs(args):
             f"complete, batch tagged bucket={batch['args']['bucket']}")
 
 
+@check("tier")
+def check_tier(args):
+    # the replica tier end to end (docs/SERVING.md "Replica tier"): a
+    # 2-replica router must survive SIGKILL of one replica mid-traffic with
+    # ZERO failed client responses — ejected on the spot (connection
+    # refused), supervised back up through the shared compile cache,
+    # re-admitted — and then roll a clean checkpoint epoch across the tier
+    # one replica at a time. The crash-tolerance the north star's traffic
+    # depends on has to hold BEFORE a router fronts real replicas.
+    import json as _json
+    import shutil
+    import signal
+    import threading
+    import urllib.request
+
+    import jax
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+    from deepvision_tpu.serve.tier import (ReplicaHandle, TierRouter,
+                                           _http_json, free_port)
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_tier_")
+    workdir = os.path.join(tmpdir, "lenet5")
+    router = None
+
+    def commit(epoch, scale=1.0):
+        trainer = trainer_class_for_config("lenet5")(
+            get_config("lenet5"), workdir=workdir)
+        try:
+            trainer.init_state((32, 32, 1))
+            st = trainer.state
+            if scale != 1.0:
+                st = st.replace(params=jax.tree_util.tree_map(
+                    lambda a: a * scale, st.params))
+            trainer.ckpt.save(epoch, st, {"best_metric": 0.0})
+            trainer.ckpt.flush()
+        finally:
+            trainer.close()
+
+    try:
+        commit(1)
+        cache = os.path.join(tmpdir, "xla-cache")
+        handles = []
+        for slot in range(2):
+            port = free_port()
+            argv = [sys.executable, "-m", "deepvision_tpu.serve.replica",
+                    "-m", "lenet5", "--workdir", workdir,
+                    "--port", str(port), "--host", "127.0.0.1",
+                    "--replica-id", f"pf-{slot}", "--buckets", "1,4",
+                    "--compilation-cache", cache]
+            handles.append(ReplicaHandle(
+                f"pf-{slot}", f"http://127.0.0.1:{port}", argv=argv,
+                # persist sub-second bucket compiles too: the respawned
+                # victim must boot warm off the shared cache
+                env={"DEEPVISION_CACHE_MIN_COMPILE_SECS": "0"}, slot=slot))
+        router = TierRouter(handles, health_every_s=0.15,
+                            probe_timeout_s=1.0, restart_backoff_s=0.3,
+                            roll_model="lenet5")
+        router.start()
+        if not router.wait_ready(n=2, timeout=240):
+            raise RuntimeError("2 replicas never became routable")
+        base = f"http://127.0.0.1:{router.bound_port}"
+        body = _json.dumps({"instances": [[[[0.5]] * 32] * 32]}).encode()
+        failures = []
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    req = urllib.request.Request(
+                        base + "/predict", data=body,
+                        headers={"Content-Type": "application/json",
+                                 "X-Deadline-Ms": "15000"})
+                    with urllib.request.urlopen(req, timeout=20) as r:
+                        r.read()
+                        if r.status != 200:
+                            failures.append(r.status)
+                except Exception as e:  # noqa: BLE001 — a failure IS data
+                    failures.append(repr(e))
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        victim = handles[0]
+        time.sleep(0.6)           # traffic flowing through both replicas
+        victim.proc.send_signal(signal.SIGKILL)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not (
+                victim.routable and victim.launches >= 2):
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        if failures:
+            raise RuntimeError(f"{len(failures)} failed responses through "
+                               f"the kill: {failures[:3]}")
+        if not (victim.routable and victim.launches >= 2):
+            raise RuntimeError(f"victim not supervised back: "
+                               f"{victim.describe()}")
+        stats = dict(router.stats)
+        if not stats.get("ejections") or not stats.get("readmissions"):
+            raise RuntimeError(f"ejection/readmission not accounted: "
+                               f"{stats}")
+
+        # rolling promotion of a clean epoch: one replica at a time, both
+        # must land on the new generation
+        commit(2, scale=1.05)
+        code, roll = _http_json(base + "/roll", method="POST", body=b"{}",
+                                timeout=240)
+        if code != 200 or roll.get("state") != "promoted":
+            raise RuntimeError(f"rolling promotion did not complete: "
+                               f"{code} {roll}")
+        outcomes = [o.get("outcome") for o in roll.get("outcomes", [])]
+        epochs = {o.get("epoch") for o in roll.get("outcomes", [])}
+        if outcomes != ["promoted", "promoted"] or epochs != {2}:
+            raise RuntimeError(f"roll outcomes wrong: {roll}")
+    finally:
+        if router is not None:
+            router.close(replica_grace_s=15)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return (f"SIGKILL mid-traffic: 0 failed responses, victim ejected + "
+            f"supervised back (launches={victim.launches}); clean epoch 2 "
+            f"rolled replica-by-replica")
+
+
 @check("segment")
 def check_segment(args):
     # the dense-prediction family end to end (docs/SEGMENTATION.md): a
@@ -1160,6 +1294,7 @@ def main(argv=None):
     check_quant(args)
     check_autoscale(args)
     check_obs(args)
+    check_tier(args)
     check_segment(args)
     check_epoch(args)
     check_devices(args)
