@@ -15,7 +15,7 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
         bench-serve-tier \
         bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
         serve-fleet-smoke serve-tier-smoke preflight preflight-record \
-        lint lint-changed \
+        lint lint-changed lint-concurrency \
         fsck check check-update-cost reshard-parity
 
 lint:        ## jaxlint: donation / retrace / host-sync / trace / rng /
@@ -39,6 +39,14 @@ check-update-cost: ## refresh the committed jaxvet cost baseline
 	## (CHECK_COST.json) after an INTENDED model/step change — review the
 	## diff like a benchmark result
 	env $(CPU_ENV) $(PY) -m deepvision_tpu.check --update-cost
+
+lint-concurrency: ## the jaxsync family alone (docs/LINTING.md
+	## "Concurrency rules"): LCK001/2 unguarded writes and non-atomic
+	## RMWs against inferred lock guards, LCK003 lock-order deadlock
+	## cycles, LCK004 blocking calls under a lock, THR001 never-joined
+	## non-daemon threads — the focused sweep for serve/-side changes
+	## (--select runs bypass the result cache)
+	$(PY) -m deepvision_tpu.lint --select LCK,THR
 
 lint-changed: ## jaxlint over only the files `git diff` touches (staged or
 	## not, vs HEAD) — seconds, for the inner loop; falls back to clean

@@ -5,8 +5,12 @@ tracebacks: use-after-donate aliasing (DON001, the PR 1 checkpoint bug
 class), per-call retraces (JIT001), hot-loop host syncs (SYNC001), side
 effects under trace (EFF001), tracer bools (TRC001), PRNG key reuse and
 un-folded step keys (RNG001/RNG002), dtype-policy leaks (DTY001/DTY002),
-and mesh-axis / placement inconsistencies (SHD001/SHD002). All eleven rules
-run on one shared interprocedural dataflow core (framework.CallGraph +
+mesh-axis / placement inconsistencies (SHD001/SHD002), and the jaxsync
+concurrency family for the threaded serving stack — unguarded writes and
+non-atomic RMWs against inferred lock guards (LCK001/LCK002), lock-order
+deadlock cycles (LCK003), blocking calls under a lock (LCK004), and
+never-joined non-daemon threads (THR001). All sixteen rules run on one
+shared interprocedural dataflow core (framework.CallGraph +
 trace-reach/taint, donation.ProjectIndex), so a hazard that crosses a
 function or module boundary is still visible at the call site.
 

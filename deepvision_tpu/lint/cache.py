@@ -32,7 +32,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .framework import Finding
 
-CACHE_VERSION = 1
+# v2: the LCK/THR concurrency family landed — caches written by the
+# 11-rule linter must never serve silence for rules they didn't run
+CACHE_VERSION = 2
 CACHE_DIR = os.path.join(".cache", "jaxlint")
 CACHE_NAME = "cache.json"
 

@@ -179,7 +179,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m deepvision_tpu.lint",
         description="JAX-aware static analysis: donation-aliasing, retrace, "
-                    "host-sync, trace-side-effect, and tracer-bool hazards. "
+                    "host-sync, trace-side-effect, tracer-bool, and "
+                    "thread/lock-discipline hazards. "
                     "Rules: " + "; ".join(
                         f"{rid}: {doc}"
                         for rid, (_, _, doc) in ALL_RULES.items()))
@@ -191,7 +192,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="github emits ::error/::warning workflow "
                              "annotations for Actions")
     parser.add_argument("--select", default=None,
-                        help="comma-separated rule ids to run (default: all)")
+                        help="comma-separated rule ids or family prefixes "
+                             "to run, e.g. DON001 or LCK,THR "
+                             "(default: all)")
     parser.add_argument("--config", default=None,
                         help="pyproject.toml to read [tool.jaxlint] from "
                              "(default: nearest to the first path)")
@@ -219,9 +222,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return EXIT_USAGE
     select = None
     if args.select:
-        select = [r.strip().upper() for r in args.select.split(",")
-                  if r.strip()]
-        unknown = [r for r in select if r not in ALL_RULES]
+        select, unknown = [], []
+        for token in (r.strip().upper() for r in args.select.split(",")):
+            if not token:
+                continue
+            if token in ALL_RULES:
+                select.append(token)
+                continue
+            # a family prefix selects the whole family: LCK -> LCK001..4
+            family = [r for r in ALL_RULES if r.startswith(token)]
+            if family:
+                select.extend(family)
+            else:
+                unknown.append(token)
         if unknown:
             print(f"usage error: unknown rule(s): {', '.join(unknown)}; "
                   f"known: {', '.join(ALL_RULES)}", file=sys.stderr)

@@ -1,0 +1,1043 @@
+"""jaxsync — lock-discipline, atomicity and deadlock analysis (LCK/THR).
+
+The serving stack is deeply threaded: dispatcher worker pools, the weight
+reloader, the promotion controller, the autoscaler, the tier supervisor and
+every HTTP handler all mutate shared objects concurrently. The JAX-facing
+rules (DON/JIT/TRC/...) understand none of that, and the thread-safety
+invariants the stack relies on were enforced only by tests that catch the
+races they happen to provoke. This module lifts the whole bug class to lint
+time on the same interprocedural CallGraph core the donation pass built:
+
+1.  **Thread-entry index** — every concurrent entrypoint in the project:
+    `threading.Thread(target=...)` / `threading.Timer`, executor `submit`,
+    and `do_*` methods of `BaseHTTPRequestHandler` subclasses. The reach
+    closure over the call graph from those entries is "code that runs on
+    more than one thread".
+
+2.  **Lock-guard inference** — per (class, attribute): which lock do the
+    accesses sit under? An attribute is *guarded* by lock L when at least
+    ``GUARD_RATIO`` of its accesses (reads and writes both count) run with
+    L held, at least ``MIN_GUARDED_ACCESSES`` accesses are under L, and at
+    least one *write* is under L. ``__init__``/``__new__`` bodies are
+    exempt (single-threaded setup), and accesses inside ``*_locked``
+    methods of a single-lock class count as guarded — the repo's
+    caller-holds-the-lock convention (``_reset_locked``, ``_spawn_locked``,
+    ...). Plain reads are NEVER flagged: deliberate lock-free reads of
+    monotonic counters are idiomatic here; they merely dilute the guard
+    signal. Violations are unguarded WRITES (LCK001) and unguarded
+    read-modify-writes (LCK002) in thread-reachable code.
+
+3.  **Lock graph** — lock identities are class-level (``Class.attr`` for
+    ``self.attr = threading.Lock()``; one id per class, not per instance,
+    so self-edges are ignored). Acquiring M while holding L adds edge
+    L -> M, directly or through any resolvable call; a cycle is a
+    lock-order deadlock (LCK003). Holding any lock across a blocking
+    primitive — socket/HTTP I/O, `subprocess`, `future.result()` /
+    `queue.get()` / `join()` / `wait()` without a timeout, `time.sleep` —
+    is LCK004, the deadlock shape the tier drain path dodges by hand.
+
+Receiver typing is deliberately conservative: `self` types to the
+enclosing class, annotated params (including `Optional[X]` / `Sequence[X]`
+element types) and `x = ClassName(...)` locals type to the named project
+class, `self.attr` follows the attribute-type table built from
+constructor assignments, and attributes assigned by exactly one class
+type through that unique owner. A receiver typed to an *external* class
+(threading, queue, subprocess, ...) binds to nothing; an untyped receiver
+falls back to every project method with that name (conservative union —
+safe because findings are gated on guard inference, not on reach alone).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .framework import (Config, Finding, FunctionInfo, Module, dotted_str,
+                        terminal_name)
+
+# -- tunables ----------------------------------------------------------------
+# "large majority" for guard inference: >= 60% of an attribute's accesses
+# under one lock, with a minimum sample so one locked line can't crown a lock
+GUARD_RATIO = 0.6
+MIN_GUARDED_ACCESSES = 2
+# time.sleep under a lock shorter than this is treated as a scheduler nudge,
+# not a blocking call (matches the busy-wait poll intervals in the tree)
+SLEEP_GUARD_S = 0.01
+
+LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+THREAD_FACTORIES = {"threading.Thread", "threading.Timer"}
+EXECUTOR_FACTORIES = {"concurrent.futures.ThreadPoolExecutor",
+                      "futures.ThreadPoolExecutor", "ThreadPoolExecutor"}
+# constructor prefixes that type a receiver as NOT-a-project-class: calls
+# through such receivers bind to no project def (threading.Thread().start()
+# must not alias TierRouter.start)
+EXTERNAL_PREFIXES = ("threading.", "queue.", "concurrent.", "subprocess.",
+                     "socket.", "http.", "urllib.", "logging.", "io.",
+                     "collections.", "itertools.", "multiprocessing.")
+# builtin constructors that can never return project state: receivers typed
+# through them bind to no project method (file.flush() must not alias
+# CheckpointManager.flush)
+BUILTIN_FACTORIES = {"open", "deque", "defaultdict", "Counter",
+                     "OrderedDict", "Event", "Queue", "SimpleQueue",
+                     "Semaphore", "BoundedSemaphore", "Barrier", "Popen"}
+# single-argument wrappers that preserve their argument's element type
+TRANSPARENT_WRAPPERS = {"list", "tuple", "sorted", "reversed", "set",
+                        "frozenset", "iter"}
+# method names that mutate their receiver in place: x.attr.append(...) is a
+# read-modify-write of attr
+MUTATORS = {"append", "extend", "add", "update", "insert", "remove",
+            "discard", "pop", "popitem", "popleft", "appendleft", "clear",
+            "setdefault", "sort"}
+# blocking-call prefixes for LCK004 (resolved through import aliases)
+BLOCKING_PREFIXES = ("urllib.request.", "http.client.", "socket.",
+                     "subprocess.")
+SETUP_METHODS = {"__init__", "__new__"}
+
+READ, WRITE, RMW = "read", "write", "rmw"
+EXTERNAL = "<external>"
+
+
+class _Access:
+    __slots__ = ("cls", "attr", "kind", "node", "module", "fn", "locks")
+
+    def __init__(self, cls, attr, kind, node, module, fn, locks):
+        self.cls = cls          # owning class name
+        self.attr = attr        # attribute name
+        self.kind = kind        # READ | WRITE | RMW
+        self.node = node        # anchor ast node
+        self.module = module
+        self.fn = fn            # FunctionInfo of the enclosing function
+        self.locks = locks      # frozenset of lock ids held at the access
+
+
+class _CallSite:
+    __slots__ = ("call", "module", "fn", "held")
+
+    def __init__(self, call, module, fn, held):
+        self.call = call
+        self.module = module
+        self.fn = fn
+        self.held = held        # tuple of lock ids, acquisition-ordered
+
+
+def _unwrap_annotation(ann: ast.AST, classes: Set[str]) -> Optional[str]:
+    """First project class named anywhere in an annotation — handles
+    `ReplicaHandle`, `Optional[ModelFleet]`, `Sequence[ReplicaHandle]`,
+    string annotations."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    for node in ast.walk(ann):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name in classes:
+            return name
+    return None
+
+
+class ConcurrencyIndex:
+    """Everything the LCK rules consult, built once per lint run from the
+    shared CallGraph and memoized in ``ProjectIndex.cache``."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.classes: Set[str] = set()
+        self.class_bases: Dict[str, List[str]] = {}
+        # class -> lock attribute names (self.x = threading.Lock())
+        self.lock_attrs: Dict[str, Set[str]] = {}
+        self.lock_owners: Dict[str, Set[str]] = {}   # attr -> classes
+        # class -> attr -> class name | EXTERNAL (from ctor assignments)
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # attr -> classes that self-assign it outside lock factories
+        self.attr_owners: Dict[str, Set[str]] = {}
+        self.accesses: List[_Access] = []
+        self.call_sites: List[_CallSite] = []
+        # (lock_id, held_before, node, module) per `with <lock>:`
+        self.acquisitions: List[Tuple[str, Tuple[str, ...], ast.AST,
+                                      Module]] = []
+        self.entries: Dict[int, str] = {}      # id(fn node) -> entry label
+        self.reach: Dict[int, str] = {}        # id(fn node) -> entry label
+        self.guards: Dict[Tuple[str, str], Tuple[str, int, int]] = {}
+        self.acquires: Dict[int, Set[str]] = {}
+        self.blocking: Dict[int, str] = {}
+        # rule -> list of (module, node, message)
+        self.violations: Dict[str, List[Tuple[Module, ast.AST, str]]] = {}
+        self._infos: List[FunctionInfo] = []
+        self._local_cache: Dict[int, Dict[str, str]] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        seen: Set[int] = set()
+        for info in self.graph.info_of.values():
+            if id(info.node) not in seen:
+                seen.add(id(info.node))
+                self._infos.append(info)
+        for module in self.graph.modules:
+            self._scan_classes(module)
+        for module in self.graph.modules:
+            self._scan_attr_types(module)
+        for info in self._infos:
+            self._walk_fn(info)
+        self._infer_guards()
+        self._find_entries()
+        self._compute_reach()
+        self._fix_acquires()
+        self._fix_blocking()
+        self._collect_violations()
+
+    def _scan_classes(self, module: Module) -> None:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            self.classes.add(node.name)
+            self.class_bases[node.name] = [
+                terminal_name(b) for b in node.bases if terminal_name(b)]
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                resolved = module.resolve(sub.value.func)
+                if resolved not in LOCK_FACTORIES:
+                    continue
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name):
+                        self.lock_attrs.setdefault(node.name, set()).add(
+                            tgt.attr)
+                        self.lock_owners.setdefault(tgt.attr, set()).add(
+                            node.name)
+
+    def _scan_attr_types(self, module: Module) -> None:
+        """self.attr = <expr> assignments whose type is statically evident:
+        a project-class constructor, an external-library constructor, an
+        annotated parameter, or a self-method call returning `Cls(...)`."""
+        for cls_node in ast.walk(module.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            table = self.attr_types.setdefault(cls_node.name, {})
+            for fn in cls_node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                self_arg = fn.args.args[0].arg if fn.args.args else None
+                ann_of = {a.arg: a.annotation
+                          for a in fn.args.args + fn.args.kwonlyargs
+                          if a.annotation is not None}
+                for stmt in ast.walk(fn):
+                    tgt = value = None
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1:
+                        tgt, value = stmt.targets[0], stmt.value
+                    elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                        tgt, value = stmt.target, stmt.value
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == self_arg):
+                        continue
+                    if tgt.attr not in self.lock_attrs.get(cls_node.name,
+                                                           ()):
+                        self.attr_owners.setdefault(tgt.attr, set()).add(
+                            cls_node.name)
+                    typ = self._type_of_value(module, cls_node.name, value,
+                                              ann_of)
+                    if typ is None and isinstance(stmt, ast.AnnAssign):
+                        typ = _unwrap_annotation(stmt.annotation,
+                                                 self.classes)
+                    if typ is not None:
+                        prev = table.get(tgt.attr)
+                        if prev is not None and prev != typ:
+                            table[tgt.attr] = EXTERNAL  # ambiguous: no bind
+                        else:
+                            table[tgt.attr] = typ
+
+    def _type_of_value(self, module, cls, value, ann_of):
+        if isinstance(value, ast.Name):
+            return _unwrap_annotation(ann_of.get(value.id), self.classes)
+        if not isinstance(value, ast.Call):
+            return None
+        term = terminal_name(value.func)
+        # list(replicas) et al. carry their argument's (element) type
+        if term in TRANSPARENT_WRAPPERS and len(value.args) == 1:
+            return self._type_of_value(module, cls, value.args[0], ann_of)
+        resolved = module.resolve(value.func)
+        if resolved and resolved.startswith(EXTERNAL_PREFIXES):
+            return EXTERNAL
+        if term in self.classes:
+            return term
+        if term in BUILTIN_FACTORIES:
+            return EXTERNAL
+        # one hop through a factory: self.breaker = self._fresh_breaker()
+        # types through its `return CircuitBreaker(...)`; a factory whose
+        # returns are all non-project (tf writers, file handles) types
+        # EXTERNAL so its receiver binds to no project method
+        callee = None
+        if isinstance(value.func, ast.Attribute) \
+                and isinstance(value.func.value, ast.Name):
+            callee = self.graph.methods.get(cls, {}).get(value.func.attr)
+        if callee is None:
+            cands = self.graph.resolve_call(module, value)
+            callee = cands[0] if len(cands) == 1 else None
+        if callee is not None:
+            votes: Set[Optional[str]] = set()
+            for ret in ast.walk(callee.node):
+                if not isinstance(ret, ast.Return) or ret.value is None:
+                    continue
+                if isinstance(ret.value, ast.Constant):
+                    continue
+                if isinstance(ret.value, ast.Call):
+                    rterm = terminal_name(ret.value.func)
+                    votes.add(rterm if rterm in self.classes else EXTERNAL)
+                else:
+                    votes.add(None)  # untypable return: stay unknown
+            project = {v for v in votes if v not in (None, EXTERNAL)}
+            if len(project) == 1:
+                return next(iter(project))
+            if votes and votes == {EXTERNAL}:
+                return EXTERNAL
+        return None
+
+    # -- receiver typing -----------------------------------------------------
+
+    def _receiver_type(self, info: FunctionInfo, expr: ast.AST,
+                       local_types: Dict[str, str]) -> Optional[str]:
+        """Class name, EXTERNAL, or None (unknown) for a receiver expr."""
+        if isinstance(expr, ast.Name):
+            if info.cls_name and info.params \
+                    and expr.id == info.params[0] \
+                    and info.params[0] in ("self", "cls"):
+                return info.cls_name
+            return local_types.get(expr.id)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            base = self._receiver_type(info, expr.value, local_types)
+            if base in self.attr_types:
+                return self.attr_types[base].get(expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            term = terminal_name(expr.func)
+            if term in self.classes:
+                return term
+        return None
+
+    def _local_types(self, info: FunctionInfo) -> Dict[str, str]:
+        """Parameter annotations + `x = ClassName(...)` locals +
+        `with ThreadPoolExecutor() as p` with-items. Memoized per fn."""
+        got = self._local_cache.get(id(info.node))
+        if got is not None:
+            return got
+        out: Dict[str, str] = {}
+        self._local_cache[id(info.node)] = out
+        fn = info.node
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                typ = _unwrap_annotation(a.annotation, self.classes)
+                if typ:
+                    out[a.arg] = typ
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                typ = self._ctor_type(info.module, node.value)
+                if typ:
+                    out[node.targets[0].id] = typ
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.optional_vars, ast.Name) \
+                            and isinstance(item.context_expr, ast.Call):
+                        typ = self._ctor_type(info.module,
+                                              item.context_expr)
+                        if typ:
+                            out[item.optional_vars.id] = typ
+        # second pass: for-loop / comprehension targets type through their
+        # iterable (`for h in self.replicas:` -> ReplicaHandle)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.comprehension)):
+                tgt = node.target
+                if isinstance(tgt, ast.Name) and tgt.id not in out:
+                    typ = self._element_type(info, node.iter, out)
+                    if typ:
+                        out[tgt.id] = typ
+        return out
+
+    def _element_type(self, info, expr, local_types) -> Optional[str]:
+        """Element type of an iterated expression, best-effort."""
+        if isinstance(expr, ast.Call) \
+                and terminal_name(expr.func) in TRANSPARENT_WRAPPERS \
+                and len(expr.args) == 1:
+            return self._element_type(info, expr.args[0], local_types)
+        if isinstance(expr, ast.Subscript):  # replicas[k:] slices
+            return self._element_type(info, expr.value, local_types)
+        if isinstance(expr, ast.BinOp):      # replicas[k:] + replicas[:k]
+            return (self._element_type(info, expr.left, local_types)
+                    or self._element_type(info, expr.right, local_types))
+        typ = self._receiver_type(info, expr, local_types)
+        if typ is None or typ.startswith("<"):
+            return None
+        # iterating a project class hops through its __iter__ -> Iterator[X]
+        it = self.graph.methods.get(typ, {}).get("__iter__")
+        if it is not None:
+            elem = _unwrap_annotation(getattr(it.node, "returns", None),
+                                      self.classes)
+            if elem:
+                return elem
+        return typ
+
+    def _ctor_type(self, module, call: ast.Call) -> Optional[str]:
+        term = terminal_name(call.func)
+        if term in self.classes:
+            return term
+        resolved = module.resolve(call.func)
+        if resolved and resolved.startswith(EXTERNAL_PREFIXES):
+            if resolved in EXECUTOR_FACTORIES or \
+                    (resolved or "").endswith("ThreadPoolExecutor"):
+                return "<executor>"
+            return EXTERNAL
+        return None
+
+    # -- per-function walk ---------------------------------------------------
+
+    def _lock_id(self, info, expr, local_types) -> Optional[str]:
+        """Lock identity for `with <expr>:` — Class.attr for attribute
+        locks (typed receiver, else unique owner), module/local names for
+        bare `with lock:`."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            rtype = self._receiver_type(info, expr.value, local_types)
+            if rtype == EXTERNAL:
+                return None
+            if rtype and attr in self.lock_attrs.get(rtype, ()):
+                return f"{rtype}.{attr}"
+            if len(self.lock_owners.get(attr, ())) == 1:
+                return f"{next(iter(self.lock_owners[attr]))}.{attr}"
+            return None
+        if isinstance(expr, ast.Name) \
+                and expr.id in self._module_locks(info.module):
+            return f"{info.module.path}::{expr.id}"
+        return None
+
+    def _module_locks(self, module: Module) -> Set[str]:
+        got = getattr(module, "_jaxsync_module_locks", None)
+        if got is None:
+            got = set()
+            for stmt in module.tree.body:
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Call) \
+                        and module.resolve(stmt.value.func) in LOCK_FACTORIES:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            got.add(tgt.id)
+            module._jaxsync_module_locks = got
+        return got
+
+    def _walk_fn(self, info: FunctionInfo) -> None:
+        fn = info.node
+        if isinstance(fn, ast.Lambda):
+            return
+        local_types = self._local_types(info)
+        # the caller-holds-the-lock convention: a *_locked method of a
+        # class with exactly one lock runs entirely under that lock
+        base_held: Tuple[str, ...] = ()
+        if info.cls_name and fn.name.endswith("_locked") \
+                and len(self.lock_attrs.get(info.cls_name, ())) == 1:
+            only = next(iter(self.lock_attrs[info.cls_name]))
+            base_held = (f"{info.cls_name}.{only}",)
+        self._visit_block(info, fn.body, base_held, local_types)
+
+    def _visit_block(self, info, stmts, held, local_types) -> None:
+        for stmt in stmts:
+            self._visit_stmt(info, stmt, held, local_types)
+
+    def _visit_stmt(self, info, stmt, held, local_types) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope: walked via its own FunctionInfo
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in stmt.items:
+                self._visit_expr(info, item.context_expr, held, local_types)
+                lock = self._lock_id(info, item.context_expr, local_types)
+                if lock is not None:
+                    self.acquisitions.append(
+                        (lock, tuple(held), item.context_expr, info.module))
+                    entered.append(lock)
+            self._visit_block(info, stmt.body, tuple(held) + tuple(entered),
+                              local_types)
+            return
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, []) or []:
+                self._visit_stmt(info, sub, held, local_types)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._visit_block(info, handler.body, held, local_types)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # one walk over the whole statement so the store target and the
+            # value reads share RMW folding
+            self._visit_expr(info, stmt, held, local_types,
+                             parent_stmt=stmt)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.excepthandler)):
+                continue
+            self._visit_expr(info, child, held, local_types,
+                             parent_stmt=stmt)
+
+    def _attr_class(self, info, node: ast.Attribute, local_types):
+        """Owning class for an attribute access, or None when untypable."""
+        rtype = self._receiver_type(info, node.value, local_types)
+        if rtype is not None and rtype.startswith("<"):
+            return None  # external / executor: never project state
+        if rtype in self.classes:
+            return rtype
+        owners = self.attr_owners.get(node.attr, ())
+        if len(owners) == 1:
+            return next(iter(owners))
+        return None
+
+    def _record(self, info, node, kind, held, local_types) -> None:
+        cls = self._attr_class(info, node, local_types)
+        if cls is None:
+            return
+        self.accesses.append(_Access(cls, node.attr, kind, node,
+                                     info.module, info, frozenset(held)))
+
+    def _visit_expr(self, info, expr, held, local_types,
+                    parent_stmt=None) -> None:
+        """Record attribute accesses and call sites in an expression tree.
+        `parent_stmt` classifies stores (Assign/AugAssign targets)."""
+        skip: Set[int] = set()
+        # `R.x = f(R.x)` / `R.x += v` is ONE logical read-modify-write: the
+        # store is the RMW, and reads of the same spelling in the value
+        # expression fold into it instead of counting separately
+        rmw_spellings: Set[Tuple[str, str]] = set()
+        for node in ast.walk(expr):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Call):
+                self.call_sites.append(_CallSite(node, info.module, info,
+                                                 tuple(held)))
+                # x.attr.append(v) — in-place mutation of attr
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in MUTATORS \
+                        and isinstance(f.value, ast.Attribute):
+                    self._record(info, f.value, RMW, held, local_types)
+                    skip.add(id(f.value))
+            elif isinstance(node, ast.Attribute):
+                ctx = node.ctx
+                if isinstance(ctx, ast.Load):
+                    spelled = (dotted_str(node.value), node.attr)
+                    if spelled not in rmw_spellings:
+                        self._record(info, node, READ, held, local_types)
+                elif isinstance(ctx, (ast.Store, ast.Del)):
+                    kind = WRITE
+                    if isinstance(parent_stmt, ast.AugAssign):
+                        kind = RMW
+                    elif isinstance(parent_stmt, ast.Assign) \
+                            and self._reads_same_attr(info, parent_stmt,
+                                                      node, local_types):
+                        kind = RMW
+                        rmw_spellings.add((dotted_str(node.value),
+                                           node.attr))
+                    self._record(info, node, kind, held, local_types)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and isinstance(node.value, ast.Attribute):
+                # d[k] = v on a shared dict/list attribute
+                self._record(info, node.value, RMW, held, local_types)
+                skip.add(id(node.value))
+
+    def _reads_same_attr(self, info, assign: ast.Assign,
+                         target: ast.Attribute, local_types) -> bool:
+        """`R.x = f(R.x)` — an Assign whose value reads the stored attr is
+        one logical read-modify-write, not an independent read + write."""
+        want = (dotted_str(target.value), target.attr)
+        if want[0] is None:
+            return False
+        for node in ast.walk(assign.value):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.attr == want[1] \
+                    and dotted_str(node.value) == want[0]:
+                return True
+        return False
+
+    # -- guard inference -----------------------------------------------------
+
+    def _infer_guards(self) -> None:
+        stats: Dict[Tuple[str, str], Dict[str, object]] = {}
+        for acc in self.accesses:
+            if acc.fn.node.name in SETUP_METHODS \
+                    or acc.attr in self.lock_attrs.get(acc.cls, ()):
+                continue
+            st = stats.setdefault((acc.cls, acc.attr),
+                                  {"total": 0, "by_lock": {}})
+            st["total"] += 1
+            for lock in acc.locks:
+                st["by_lock"][lock] = st["by_lock"].get(lock, 0) + 1
+        for key, st in stats.items():
+            if not st["by_lock"]:
+                continue
+            lock, count = max(st["by_lock"].items(),
+                              key=lambda kv: (kv[1], kv[0]))
+            # note: no guarded-WRITE requirement — stripping the lock from
+            # the sole writing site must not erase the guard the remaining
+            # locked reads still witness (violations are writes/RMWs in
+            # thread-reachable code, so read-only guarded attrs stay silent)
+            if count >= MIN_GUARDED_ACCESSES \
+                    and count / st["total"] >= GUARD_RATIO:
+                self.guards[key] = (lock, count, st["total"])
+
+    # -- thread entries and reach --------------------------------------------
+
+    def _resolve_target(self, module, info, target,
+                        local_types) -> List[FunctionInfo]:
+        """FunctionInfos a Thread/submit target expression may name."""
+        if isinstance(target, ast.Name):
+            local = [i for i in self.graph.defs.get(target.id, [])
+                     if i.module is module]
+            # imported target: every project def with that name (union)
+            return local or self.graph.defs.get(target.id, [])
+        if isinstance(target, ast.Attribute):
+            rtype = self._receiver_type(info, target.value, local_types)
+            if rtype in self.graph.methods:
+                got = self.graph.methods[rtype].get(target.attr)
+                return [got] if got else []
+            if rtype is None:
+                return [m[target.attr] for m in self.graph.methods.values()
+                        if target.attr in m]
+        if isinstance(target, ast.Lambda):
+            pass  # lambda bodies hold no attribute state worth tracking
+        return []
+
+    def _find_entries(self) -> None:
+        for site in self.call_sites:
+            call, module, info = site.call, site.module, site.fn
+            local_types = self._local_types(info)
+            resolved = module.resolve(call.func)
+            targets: List[FunctionInfo] = []
+            label = None
+            if resolved in THREAD_FACTORIES:
+                label = f"{resolved}(target=...) in {info.qualname}"
+                tgt = None
+                for kw in call.keywords:
+                    if kw.arg in ("target", "function"):
+                        tgt = kw.value
+                if tgt is None and len(call.args) > 1:
+                    tgt = call.args[1]
+                if tgt is not None:
+                    targets = self._resolve_target(module, info, tgt,
+                                                   local_types)
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "submit" and call.args:
+                rtype = self._receiver_type(info, call.func.value,
+                                            local_types)
+                if rtype in (None, "<executor>"):
+                    label = f"executor.submit in {info.qualname}"
+                    targets = self._resolve_target(module, info,
+                                                   call.args[0],
+                                                   local_types)
+            for t in targets:
+                self.entries.setdefault(id(t.node), label)
+        # HTTP handler methods: do_* of BaseHTTPRequestHandler subclasses
+        # (transitively, by terminal base name within the project)
+        handler_classes: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for cls, bases in self.class_bases.items():
+                if cls in handler_classes:
+                    continue
+                if any(b == "BaseHTTPRequestHandler" or b in handler_classes
+                       for b in bases):
+                    handler_classes.add(cls)
+                    changed = True
+        for cls in handler_classes:
+            for name, meth in self.graph.methods.get(cls, {}).items():
+                if name.startswith("do_"):
+                    self.entries.setdefault(
+                        id(meth.node), f"HTTP handler {cls}.{name}")
+
+    def _callees(self, site: _CallSite) -> List[FunctionInfo]:
+        call, module, info = site.call, site.module, site.fn
+        got = self.graph.resolve_call(module, call)
+        if got:
+            return got
+        if isinstance(call.func, ast.Attribute):
+            local_types = self._local_types(info)
+            rtype = self._receiver_type(info, call.func.value, local_types)
+            if rtype in self.graph.methods:
+                m = self.graph.methods[rtype].get(call.func.attr)
+                return [m] if m else []
+            if rtype is None:
+                name = call.func.attr
+                if name.startswith("__"):
+                    return []
+                return [m[name] for m in self.graph.methods.values()
+                        if name in m]
+        return []
+
+    def _sites_of(self) -> Dict[int, List[_CallSite]]:
+        got: Dict[int, List[_CallSite]] = {}
+        for site in self.call_sites:
+            got.setdefault(id(site.fn.node), []).append(site)
+        return got
+
+    def _compute_reach(self) -> None:
+        sites = self._sites_of()
+        work = list(self.entries.items())
+        self.reach = dict(self.entries)
+        while work:
+            fn_id, label = work.pop()
+            for site in sites.get(fn_id, ()):
+                for callee in self._callees(site):
+                    if id(callee.node) not in self.reach:
+                        self.reach[id(callee.node)] = label
+                        work.append((id(callee.node), label))
+
+    # -- lock graph + blocking fixpoints -------------------------------------
+
+    def _fix_acquires(self) -> None:
+        direct: Dict[int, Set[str]] = {id(i.node): set()
+                                       for i in self._infos}
+        for lock, _held, node, module in self.acquisitions:
+            owner = self._fn_of_node(module, node)
+            if owner is not None:
+                direct.setdefault(id(owner.node), set()).add(lock)
+        self.acquires = {k: set(v) for k, v in direct.items()}
+        sites = self._sites_of()
+        changed = True
+        while changed:
+            changed = False
+            for info in self._infos:
+                mine = self.acquires.setdefault(id(info.node), set())
+                for site in sites.get(id(info.node), ()):
+                    for callee in self._callees(site):
+                        extra = self.acquires.get(id(callee.node), ())
+                        for lock in extra:
+                            if lock not in mine:
+                                mine.add(lock)
+                                changed = True
+
+    def _fn_of_node(self, module: Module,
+                    node: ast.AST) -> Optional[FunctionInfo]:
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.graph.info(cur)
+            cur = module.parent(cur)
+        return None
+
+    def _blocking_primitive(self, module: Module,
+                            call: ast.Call) -> Optional[str]:
+        resolved = module.resolve(call.func)
+        if resolved:
+            if resolved == "time.sleep":
+                val = call.args[0] if call.args else None
+                if isinstance(val, ast.Constant) \
+                        and isinstance(val.value, (int, float)):
+                    if val.value >= SLEEP_GUARD_S:
+                        return f"time.sleep({val.value})"
+                    return None
+                return "time.sleep(...)"
+            if resolved.startswith(BLOCKING_PREFIXES):
+                return f"{resolved}(...) [I/O]"
+        f = call.func
+        if isinstance(f, ast.Attribute) and not call.args:
+            kwargs = {kw.arg for kw in call.keywords}
+            if f.attr == "result" and "timeout" not in kwargs:
+                return "future.result() without a timeout"
+            if f.attr == "get" and not kwargs:
+                return "queue.get() without a timeout"
+            if f.attr == "join" and "timeout" not in kwargs:
+                return "join() without a timeout"
+            if f.attr == "wait" and "timeout" not in kwargs:
+                return "wait() without a timeout"
+        return None
+
+    def _fix_blocking(self) -> None:
+        sites = self._sites_of()
+        for info in self._infos:
+            for site in sites.get(id(info.node), ()):
+                reason = self._blocking_primitive(info.module, site.call)
+                if reason and id(info.node) not in self.blocking:
+                    self.blocking[id(info.node)] = reason
+        changed = True
+        while changed:
+            changed = False
+            for info in self._infos:
+                if id(info.node) in self.blocking:
+                    continue
+                for site in sites.get(id(info.node), ()):
+                    for callee in self._callees(site):
+                        reason = self.blocking.get(id(callee.node))
+                        if reason:
+                            self.blocking[id(info.node)] = \
+                                f"calls {callee.qualname}: {reason}"
+                            changed = True
+                            break
+                    if id(info.node) in self.blocking:
+                        break
+
+    # -- violations ----------------------------------------------------------
+
+    def _emit(self, rule, module, node, message) -> None:
+        self.violations.setdefault(rule, []).append((module, node, message))
+
+    def _collect_violations(self) -> None:
+        # LCK001 / LCK002: unguarded write / RMW on a guarded attribute in
+        # thread-reachable code
+        for acc in self.accesses:
+            if acc.kind == READ or acc.fn.node.name in SETUP_METHODS:
+                continue
+            guard = self.guards.get((acc.cls, acc.attr))
+            if guard is None:
+                continue
+            lock, count, total = guard
+            if lock in acc.locks:
+                continue
+            entry = self.reach.get(id(acc.fn.node))
+            if entry is None:
+                continue
+            where = f"{acc.cls}.{acc.attr}"
+            how = (f"guarded by {lock} ({count} of {total} accesses) but "
+                   f"this {'read-modify-write' if acc.kind == RMW else 'write'} "
+                   f"in {acc.fn.qualname} runs outside it; "
+                   f"thread-reachable via {entry}")
+            rule = "LCK002" if acc.kind == RMW else "LCK001"
+            self._emit(rule, acc.module, acc.node, f"{where} is {how}")
+
+        # LCK003: lock-order cycles over the acquisition graph
+        edges: Dict[str, Dict[str, Tuple[ast.AST, Module]]] = {}
+
+        def add_edge(a, b, node, module):
+            if a != b and b not in edges.setdefault(a, {}):
+                edges[a][b] = (node, module)
+
+        for lock, held, node, module in self.acquisitions:
+            for h in held:
+                add_edge(h, lock, node, module)
+        sites = self._sites_of()
+        for info in self._infos:
+            for site in sites.get(id(info.node), ()):
+                if not site.held:
+                    continue
+                for callee in self._callees(site):
+                    for lock in self.acquires.get(id(callee.node), ()):
+                        for h in site.held:
+                            add_edge(h, lock, site.call, site.module)
+        for cycle in self._cycles(edges):
+            a, b = cycle[0], cycle[1 % len(cycle)]
+            node, module = edges[a][b]
+            path = " -> ".join(cycle + (cycle[0],))
+            self._emit("LCK003", module, node,
+                       f"lock-order cycle {path}: two threads acquiring "
+                       f"these locks in opposite orders can deadlock")
+
+        # LCK004: blocking call while holding a lock
+        for info in self._infos:
+            for site in sites.get(id(info.node), ()):
+                if not site.held:
+                    continue
+                reason = self._blocking_primitive(site.module, site.call)
+                if reason is None:
+                    for callee in self._callees(site):
+                        sub = self.blocking.get(id(callee.node))
+                        if sub:
+                            reason = f"calls {callee.qualname}: {sub}"
+                            break
+                if reason:
+                    self._emit(
+                        "LCK004", site.module, site.call,
+                        f"blocking call while holding "
+                        f"{', '.join(site.held)}: {reason} — any thread "
+                        f"needing the lock stalls behind this call")
+
+    def _cycles(self, edges) -> List[Tuple[str, ...]]:
+        """Elementary cycles, canonicalized (rotated to min node, deduped).
+        The lock graphs here are tiny; simple DFS is plenty."""
+        out: Set[Tuple[str, ...]] = set()
+        for start in sorted(edges):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(edges.get(node, ())):
+                    if nxt == path[0] and len(path) > 1:
+                        i = path.index(min(path))
+                        out.add(path[i:] + path[:i])
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + (nxt,)))
+        return sorted(out)
+
+
+# -- index memoization -------------------------------------------------------
+
+def concurrency_index(index) -> ConcurrencyIndex:
+    cache = getattr(index, "cache", None)
+    if isinstance(cache, dict):
+        got = cache.get("concurrency")
+        if isinstance(got, ConcurrencyIndex):
+            return got
+    built = ConcurrencyIndex(index.graph)
+    if isinstance(cache, dict):
+        cache["concurrency"] = built
+    return built
+
+
+def _emit_for(module: Module, index, config: Config, rule: str,
+              severity: str) -> List[Finding]:
+    if getattr(index, "graph", None) is None:
+        return []  # index not built (unit-style invocation): nothing global
+    conc = concurrency_index(index)
+    findings = []
+    for mod, node, message in conc.violations.get(rule, ()):
+        if mod.path != module.path:
+            continue
+        f = module.finding(node, rule, severity, message)
+        if f is not None:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col))
+    return findings
+
+
+# -- the rules ---------------------------------------------------------------
+
+def check_lck001(module: Module, index, config: Config) -> List[Finding]:
+    return _emit_for(module, index, config, "LCK001", "error")
+
+
+def check_lck002(module: Module, index, config: Config) -> List[Finding]:
+    return _emit_for(module, index, config, "LCK002", "error")
+
+
+def check_lck003(module: Module, index, config: Config) -> List[Finding]:
+    return _emit_for(module, index, config, "LCK003", "error")
+
+
+def check_lck004(module: Module, index, config: Config) -> List[Finding]:
+    return _emit_for(module, index, config, "LCK004", "warning")
+
+
+def check_thr001(module: Module, index, config: Config) -> List[Finding]:
+    """Thread created with neither daemon=True nor a reachable join: on
+    interpreter shutdown a forgotten non-daemon worker hangs the process —
+    the library must either mark threads daemon or own their lifecycle.
+    Purely intra-module: handle spellings are tracked through one level of
+    aliasing (`threads = list(self._threads)` ... `t.join()`)."""
+    findings: List[Finding] = []
+    joined = _joined_spellings(module)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if module.resolve(node.func) not in THREAD_FACTORIES:
+            continue
+        daemon = None
+        for kw in node.keywords:
+            if kw.arg == "daemon":
+                daemon = kw.value
+        if daemon is not None:
+            # daemon=True is the fix; a non-constant daemon flag gets the
+            # benefit of the doubt (caller-controlled lifecycle)
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is False):
+                continue
+        if _handles_of(module, node) & joined:
+            continue
+        f = module.finding(
+            node, "THR001", "warning",
+            "thread started with neither daemon=True nor a reachable "
+            "join(): a forgotten non-daemon worker hangs interpreter "
+            "shutdown — mark it daemon or own its lifecycle")
+        if f is not None:
+            findings.append(f)
+    return findings
+
+
+def _handles_of(module: Module, creation: ast.Call) -> Set[str]:
+    """Every spelling the created thread object is bound to: assignment
+    targets, list-literal/ comprehension targets, containers it is
+    appended to, and later re-bindings of a bare name handle."""
+    out: Set[str] = set()
+    stmt = module.statement_of(creation)
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            spelled = dotted_str(tgt)
+            if spelled:
+                out.add(spelled)
+    # container.append(Thread(...)) — the container is the handle
+    for anc in module.ancestors(creation):
+        if isinstance(anc, ast.Call) \
+                and isinstance(anc.func, ast.Attribute) \
+                and anc.func.attr == "append":
+            spelled = dotted_str(anc.func.value)
+            if spelled:
+                out.add(spelled)
+    # propagate bare-name handles forward one step within the scope:
+    # `self._threads.append(t)`, `pool[i] = t`, `threads = [t, ...]`
+    scope = module.enclosing_scope(creation)
+    names = {s for s in out if "." not in s and "::" not in s}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "append" and node.args \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in names:
+            spelled = dotted_str(node.func.value)
+            if spelled:
+                out.add(spelled)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in names:
+            for tgt in node.targets:
+                spelled = dotted_str(tgt)
+                if spelled:
+                    out.add(spelled)
+    return out
+
+
+def _joined_spellings(module: Module) -> Set[str]:
+    """Spellings that reach a join() somewhere in the module, expanded one
+    aliasing level (`snapshot = list(self._threads)` joins the original)."""
+    joined: Set[str] = set()
+    aliases: Dict[str, Set[str]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            spelled = dotted_str(node.func.value)
+            if spelled:
+                joined.add(spelled)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            iter_expr = node.iter
+            # unwrap list(X) / sorted(X) / reversed(X)
+            if isinstance(iter_expr, ast.Call) and len(iter_expr.args) == 1:
+                iter_expr = iter_expr.args[0]
+            src = dotted_str(iter_expr)
+            tgt = dotted_str(getattr(node, "target", None))
+            if src and tgt:
+                aliases.setdefault(tgt, set()).add(src)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            value = node.value
+            if isinstance(value, ast.Call) and len(value.args) == 1:
+                value = value.args[0]
+            src = dotted_str(value)
+            tgt = dotted_str(node.targets[0])
+            if src and tgt:
+                aliases.setdefault(tgt, set()).add(src)
+    changed = True
+    while changed:
+        changed = False
+        for tgt, srcs in aliases.items():
+            if tgt in joined:
+                for src in srcs:
+                    if src not in joined:
+                        joined.add(src)
+                        changed = True
+    return joined

@@ -528,6 +528,8 @@ def check_trc001(module: Module, index: ProjectIndex,
 # registry
 # ---------------------------------------------------------------------------
 
+from .concurrency import (check_lck001, check_lck002,  # noqa: E402
+                          check_lck003, check_lck004, check_thr001)
 from .rules_dtype import check_dty001, check_dty002  # noqa: E402
 from .rules_rng import check_rng001, check_rng002  # noqa: E402
 from .rules_sharding import check_shd001, check_shd002  # noqa: E402
@@ -566,4 +568,19 @@ ALL_RULES = {
     "SHD002": (SEVERITY_WARNING, check_shd002,
                "device_put without an explicit sharding inside a hot "
                "train/serve loop"),
+    "LCK001": (SEVERITY_ERROR, check_lck001,
+               "unguarded write to lock-guarded shared state from "
+               "thread-reachable code"),
+    "LCK002": (SEVERITY_ERROR, check_lck002,
+               "non-atomic read-modify-write (+=, d[k]=, .append) on "
+               "lock-guarded shared state outside its guard"),
+    "LCK003": (SEVERITY_ERROR, check_lck003,
+               "lock-order cycle: two locks acquired in opposite orders "
+               "(deadlock)"),
+    "LCK004": (SEVERITY_WARNING, check_lck004,
+               "blocking call (HTTP/socket I/O, subprocess, untimed "
+               "result/get/join/wait, sleep) while holding a lock"),
+    "THR001": (SEVERITY_WARNING, check_thr001,
+               "thread started with neither daemon=True nor a reachable "
+               "join()"),
 }

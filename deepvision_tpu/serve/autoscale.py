@@ -138,9 +138,14 @@ class CircuitBreaker:
                     self.opened += 1
                     transition = "opened"
             consecutive = self._consecutive
+            if transition is not None:
+                # event seq allocated under the lock: concurrent dispatcher
+                # workers feed record() and a read-increment-read outside
+                # the guard can collide or skip sequence numbers
+                self._events += 1
+                seq = self._events
         if transition is not None:
-            self._events += 1
-            log_resilience_event(self.logger, self._events,
+            log_resilience_event(self.logger, seq,
                                  {f"breaker_{transition}": 1.0,
                                   "breaker_consecutive_errors":
                                       float(consecutive)},
@@ -193,6 +198,10 @@ class AutoscaleController:
                       "idle_streak": 0, "last_change": 0.0}
             for sm in self.models}
         self._events = 0
+        # serializes sampling sweeps: check_once() is public (tests and
+        # operators call it) and races the daemon _loop thread on the
+        # per-model streak/totals state otherwise
+        self._sample_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -226,9 +235,10 @@ class AutoscaleController:
         """Sample every model once; returns how many scaling decisions
         were taken this sweep."""
         decisions = 0
-        for sm in self.models:
-            if self._check_model(sm):
-                decisions += 1
+        with self._sample_lock:
+            for sm in self.models:
+                if self._check_model(sm):
+                    decisions += 1
         return decisions
 
     def _p99_bound_ms(self, sm) -> Optional[float]:

@@ -418,11 +418,17 @@ class TierRouter:
         """Single source of ejection/readmission accounting: call after
         any state change; only transitions log/count."""
         now_routable = h.routable
-        if now_routable == h.routable_prev:
-            return
-        h.routable_prev = now_routable
+        # transition detection under h.lock: supervisor, prober and router
+        # threads all call this, and two observers of one transition must
+        # not double-log it (or lose the backoff reset); logging stays
+        # outside the lock
+        with h.lock:
+            if now_routable == h.routable_prev:
+                return
+            h.routable_prev = now_routable
+            if now_routable:
+                h.backoff_s = self.restart_backoff_s   # stable again
         if now_routable:
-            h.backoff_s = self.restart_backoff_s   # stable again
             self._bump("readmissions")
             self._event({"tier_replica_readmitted": 1.0,
                          "replica_slot": float(h.slot)})
@@ -511,17 +517,23 @@ class TierRouter:
     def _launch(self, h: ReplicaHandle) -> None:
         env = dict(os.environ)
         env.update(h.env or {})
-        h.proc = subprocess.Popen(h.argv, env=env)
-        h.pending_restart = False
-        h.launches += 1
-        if h.launches > 1:
-            h.breaker = h._fresh_breaker()
+        # spawn BEFORE taking h.lock: process creation is slow I/O, and the
+        # probe/routing threads must not stall behind it (LCK004 shape)
+        proc = subprocess.Popen(h.argv, env=env)
+        with h.lock:
+            h.proc = proc
+            h.pending_restart = False
+            h.launches += 1
+            launches = h.launches
+            if launches > 1:
+                h.breaker = h._fresh_breaker()
+        if launches > 1:
             self._bump("restarts")
             self._event({"tier_replica_restarted": 1.0,
                          "replica_slot": float(h.slot),
-                         "launches": float(h.launches)})
+                         "launches": float(launches)})
             print(f"[tier] replica {h.rid} restarted "
-                  f"(launch #{h.launches}, pid {h.proc.pid}) — awaiting "
+                  f"(launch #{launches}, pid {proc.pid}) — awaiting "
                   f"/healthz before re-admission", file=sys.stderr,
                   flush=True)
 
@@ -540,18 +552,23 @@ class TierRouter:
                         h.exits += 1
                         h.last_exit_code = code
                         h.pending_restart = True
-                        h.next_restart_at = time.monotonic() + h.backoff_s
+                        # backoff bookkeeping stays inside the lock: the
+                        # probe thread's re-admission reset (_note_routable)
+                        # races this doubling, and a lost update either
+                        # stalls the restart or hot-loops it
+                        backoff = h.backoff_s
+                        h.next_restart_at = time.monotonic() + backoff
+                        h.backoff_s = min(backoff * 2.0,
+                                          self.restart_backoff_max_s)
                     self._bump("exits")
                     self._event({"tier_replica_exit": 1.0,
                                  "replica_slot": float(h.slot),
                                  "exit_code": float(code if code is not None
                                                     else -1)})
                     print(f"[tier] replica {h.rid} exited code={code} — "
-                          f"restart in {h.backoff_s:g}s", file=sys.stderr,
+                          f"restart in {backoff:g}s", file=sys.stderr,
                           flush=True)
                     self._note_routable(h, f"process exit code={code}")
-                    h.backoff_s = min(h.backoff_s * 2.0,
-                                      self.restart_backoff_max_s)
                 if (h.proc is None and h.pending_restart
                         and time.monotonic() >= h.next_restart_at
                         and not self.stopped.is_set()):

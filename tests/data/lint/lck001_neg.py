@@ -1,0 +1,29 @@
+"""LCK001 near miss: same shape as the positive, but the worker takes the
+inferred guard around its reset write — nothing races."""
+
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.peak = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            if self.count > self.peak:
+                self.peak = self.count
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+    def _worker(self):
+        with self._lock:
+            self.count = 0
+
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()

@@ -1,0 +1,28 @@
+"""LCK001 true positive: every other access to `count` holds `_lock`, so
+the guard is inferred — but the worker thread's reset write skips it."""
+
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.peak = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            if self.count > self.peak:
+                self.peak = self.count
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+    def _worker(self):
+        self.count = 0  # races with bump() on another thread
+
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()

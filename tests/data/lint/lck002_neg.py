@@ -1,0 +1,26 @@
+"""LCK002 near miss: the dispatcher decrements under the same lock every
+other access holds — the read-modify-write is atomic."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = 0
+
+    def admit(self):
+        with self._lock:
+            self.inflight += 1
+
+    def depth(self):
+        with self._lock:
+            return self.inflight
+
+    def _drain(self):
+        with self._lock:
+            self.inflight -= 1
+
+    def start(self):
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()

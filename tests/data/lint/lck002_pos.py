@@ -1,0 +1,26 @@
+"""LCK002 true positive: the dispatcher thread's `self.inflight -= 1` is a
+read-modify-write outside the lock the other accesses hold — two threads
+decrementing concurrently can lose one of the updates."""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = 0
+
+    def admit(self):
+        with self._lock:
+            self.inflight += 1
+
+    def depth(self):
+        with self._lock:
+            return self.inflight
+
+    def _drain(self):
+        self.inflight -= 1  # lost-update race: load and store are separate
+
+    def start(self):
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()

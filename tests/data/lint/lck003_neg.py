@@ -1,0 +1,22 @@
+"""LCK003 near miss: both paths acquire source-then-sink — a consistent
+global order cannot deadlock."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.source = threading.Lock()
+        self.sink = threading.Lock()
+        self.moved = 0
+        self.checked = 0
+
+    def transfer(self):
+        with self.source:
+            with self.sink:
+                self.moved += 1
+
+    def reconcile(self):
+        with self.source:
+            with self.sink:
+                self.checked += 1

@@ -1,0 +1,22 @@
+"""LCK003 true positive: `transfer` takes source-then-sink, `reconcile`
+takes sink-then-source — two threads running one each can deadlock."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.source = threading.Lock()
+        self.sink = threading.Lock()
+        self.moved = 0
+        self.checked = 0
+
+    def transfer(self):
+        with self.source:
+            with self.sink:
+                self.moved += 1
+
+    def reconcile(self):
+        with self.sink:
+            with self.source:
+                self.checked += 1

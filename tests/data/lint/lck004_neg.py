@@ -1,0 +1,18 @@
+"""LCK004 near miss: the sleep happens before the lock is taken — the
+critical section holds only the fast bookkeeping."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = 0.0
+        self.polls = 0
+
+    def tick(self):
+        time.sleep(0.5)
+        with self._lock:
+            self.last = time.monotonic()
+            self.polls += 1

@@ -1,0 +1,18 @@
+"""LCK004 true positive: a half-second sleep while holding the lock stalls
+every thread that needs it for the full duration."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = 0.0
+        self.polls = 0
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.5)  # the lock is held across the whole wait
+            self.last = time.monotonic()
+            self.polls += 1

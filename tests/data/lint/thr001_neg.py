@@ -1,0 +1,14 @@
+"""THR001 near miss: same non-daemon thread, but the launcher joins it —
+shutdown is bounded by an explicit wait."""
+
+import threading
+
+
+def work():
+    return 1
+
+
+def launch():
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()

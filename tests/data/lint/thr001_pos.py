@@ -1,0 +1,13 @@
+"""THR001 true positive: a non-daemon thread that nothing joins — process
+shutdown hangs until the worker happens to finish."""
+
+import threading
+
+
+def work():
+    return 1
+
+
+def launch():
+    t = threading.Thread(target=work)
+    t.start()

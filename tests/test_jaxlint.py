@@ -43,6 +43,11 @@ def rules_in(*names, config=POLICY):
     ("DTY002", "dty002_pos.py", "dty002_neg.py"),
     ("SHD001", "shd001_pos.py", "shd001_neg.py"),
     ("SHD002", "shd002_pos.py", "shd002_neg.py"),
+    ("LCK001", "lck001_pos.py", "lck001_neg.py"),
+    ("LCK002", "lck002_pos.py", "lck002_neg.py"),
+    ("LCK003", "lck003_pos.py", "lck003_neg.py"),
+    ("LCK004", "lck004_pos.py", "lck004_neg.py"),
+    ("THR001", "thr001_pos.py", "thr001_neg.py"),
 ])
 def test_rule_fires_on_positive_and_not_on_near_miss(rule, pos, neg):
     assert rule in rules_in(pos), f"{rule} must fire on {pos}"
@@ -80,7 +85,7 @@ def test_fixture_corpus_is_complete():
 
 def test_tree_is_clean():
     """The default lint set — the whole project including the repo-root
-    scripts (bench*.py, __graft_entry__.py), all 11 rules, the declared
+    scripts (bench*.py, __graft_entry__.py), all 16 rules, the declared
     bf16 policy — exits 0: every true positive was fixed and every
     deliberate exception suppressed with a justification
     (docs/LINTING.md)."""
@@ -219,6 +224,21 @@ def test_cli_select(capsys):
     capsys.readouterr()
 
 
+def test_cli_select_family_prefix(capsys):
+    """`--select LCK,THR` expands to the whole concurrency family — the
+    `make lint-concurrency` contract."""
+    rc = main(["--select", "LCK,THR",
+               os.path.join(DATA, "lck003_pos.py"),
+               os.path.join(DATA, "thr001_pos.py")])
+    out = capsys.readouterr().out
+    assert rc == EXIT_FINDINGS
+    assert "LCK003" in out and "THR001" in out
+    # the family prefix selects LCK rules ONLY: a DON001 positive is clean
+    rc = main(["--select", "LCK", os.path.join(DATA, "don001_pos.py")])
+    assert rc == EXIT_CLEAN
+    capsys.readouterr()
+
+
 def test_syntax_error_is_a_finding(tmp_path, capsys):
     bad = tmp_path / "bad.py"
     bad.write_text("def broken(:\n")
@@ -314,6 +334,113 @@ def test_replanted_real_bug_is_caught(rule, filename, old, new):
     assert any(f.rule == rule for f in findings), \
         f"{rule} must fire when {filename} is mutated"
     clean = _lint_package_with_mutation(filename, old, old, rule)
+    assert clean == [], "\n".join(f.format() for f in clean)
+
+
+def _lint_package_with_mutations(mutations, select):
+    """Multi-edit variant of `_lint_package_with_mutation`, keyed by path
+    SUFFIX rather than basename (core/metrics.py vs serve/metrics.py both
+    end in metrics.py; a lock-order cycle needs two coordinated edits)."""
+    from deepvision_tpu.lint.cli import collect_files
+    from deepvision_tpu.lint.donation import ProjectIndex
+    from deepvision_tpu.lint.framework import Module, load_config
+    from deepvision_tpu.lint.rules import ALL_RULES as RULES
+    config = load_config(os.path.join(REPO, "pyproject.toml"))
+    files = collect_files([os.path.join(REPO, "deepvision_tpu")], config,
+                          REPO)
+    pending = list(mutations)
+    modules = []
+    for path in files:
+        module = Module.from_path(path)
+        posix = path.replace(os.sep, "/")
+        for suffix, old, new in mutations:
+            if posix.endswith(suffix):
+                assert old in module.source, \
+                    f"mutation anchor gone in {suffix}: {old!r}"
+                module = Module(path, module.source.replace(old, new))
+                pending.remove((suffix, old, new))
+        modules.append(module)
+    assert not pending, f"files not in the package sweep: {pending}"
+    index = ProjectIndex().build(modules)
+    out = []
+    for module in modules:
+        out.extend(RULES[select][1](module, index, config))
+    return out
+
+
+# the four concurrency-bug shapes from the serving stack's own history,
+# replanted into the real files the LCK family was built to protect
+_STATS_SNAPSHOT = '''\
+        with self._stats_lock:
+            stats = dict(self.stats)
+        return {**stats,
+                "replicas": {h.rid: {"routed": h.routed,
+                                     "failures": h.failures,
+                                     "launches": h.launches,
+                                     "inflight": h.inflight}
+                             for h in self.replicas},
+                "roll": self.roll.describe()}'''
+
+_CONCURRENCY_MUTATIONS = {
+    # strip the metrics lock: every observe_batch counter update becomes a
+    # lost-update race against snapshot(reset=True)
+    "LCK002": [("serve/metrics.py",
+                "        with self._lock:\n"
+                "            self._requests += len(request_latencies_s)",
+                "        if True:\n"
+                "            self._requests += len(request_latencies_s)")],
+    # strip the probe-success lock: the health thread's bookkeeping writes
+    # race the supervisor's locked reads of the same fields
+    "LCK001": [("serve/tier.py",
+                "            with h.lock:\n"
+                "                h.dead = False",
+                "            if True:\n"
+                "                h.dead = False")],
+    # hold the replica lock across the health-probe HTTP round trip: the
+    # router stalls behind a slow replica for the full probe timeout
+    "LCK004": [("serve/tier.py",
+                '            code, js = _http_json(h.url + "/healthz",\n'
+                '                                  timeout='
+                'self.probe_timeout_s)',
+                '            with h.lock:\n'
+                '                code, js = _http_json(h.url + "/healthz",\n'
+                '                                      timeout='
+                'self.probe_timeout_s)')],
+    # two coordinated edits that close a handle-lock/stats-lock cycle:
+    # readmission counts under h.lock (h.lock -> _stats_lock) while
+    # stats_body snapshots replicas via describe() under _stats_lock
+    # (_stats_lock -> h.lock)
+    "LCK003": [
+        ("serve/tier.py",
+         "            if now_routable:\n"
+         "                h.backoff_s = self.restart_backoff_s   "
+         "# stable again\n"
+         "        if now_routable:\n"
+         '            self._bump("readmissions")',
+         "            if now_routable:\n"
+         "                h.backoff_s = self.restart_backoff_s   "
+         "# stable again\n"
+         '                self._bump("readmissions")\n'
+         "        if now_routable:"),
+        ("serve/tier.py", _STATS_SNAPSHOT,
+         '''\
+        with self._stats_lock:
+            stats = dict(self.stats)
+            replicas = {h.rid: h.describe() for h in self.replicas}
+        return {**stats,
+                "replicas": replicas,
+                "roll": self.roll.describe()}'''),
+    ],
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_CONCURRENCY_MUTATIONS))
+def test_replanted_concurrency_bug_is_caught(rule):
+    findings = _lint_package_with_mutations(_CONCURRENCY_MUTATIONS[rule],
+                                            rule)
+    assert any(f.rule == rule for f in findings), \
+        f"{rule} must fire on its replanted bug"
+    clean = _lint_package_with_mutations([], rule)
     assert clean == [], "\n".join(f.format() for f in clean)
 
 
@@ -666,3 +793,35 @@ def test_lint_cache_touch_then_relint(tmp_path, monkeypatch):
     analyzed.clear()
     lint_paths([str(proj)], root=str(proj), use_cache=False)
     assert set(analyzed) == {str(proj / "hot.py"), str(proj / "clean.py")}
+
+
+def test_cache_version_bump_invalidates_everything(tmp_path, monkeypatch):
+    """A CACHE_VERSION bump (the concurrency-family release path) must
+    discard every stored entry: a cache written by the 11-rule linter
+    would otherwise serve full-skip silence for rules it never ran."""
+    import shutil
+
+    from deepvision_tpu.lint import cache as cache_mod
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text("[tool.jaxlint]\n")
+    shutil.copy(os.path.join(DATA, "jit001_pos.py"), proj / "hot.py")
+    analyzed = _counting_rules(monkeypatch)
+
+    first = lint_paths([str(proj)], root=str(proj))
+    assert [f.rule for f in first] == ["JIT001"]
+
+    # warm and unbumped: the full-skip path, no rule executions
+    analyzed.clear()
+    lint_paths([str(proj)], root=str(proj))
+    assert analyzed == []
+
+    # same tree, newer linter: the stored findings are unsound (a new rule
+    # never ran over them) — everything re-analyzes
+    monkeypatch.setattr(cache_mod, "CACHE_VERSION",
+                        cache_mod.CACHE_VERSION + 1)
+    analyzed.clear()
+    bumped = lint_paths([str(proj)], root=str(proj))
+    assert [f.to_json() for f in bumped] == [f.to_json() for f in first]
+    assert set(analyzed) == {str(proj / "hot.py")}

@@ -268,6 +268,47 @@ def test_serving_metrics_snapshot_reset():
     assert m.snapshot()["requests"] == 0  # reset wiped the window
 
 
+def test_serving_metrics_totals_survive_concurrent_reset():
+    """The two-horizon contract (jaxsync LCK002's bug shape): lifetime
+    totals() must count every observed request exactly once while the
+    server's periodic flush — snapshot(reset=True) — zeroes the interval
+    counters out from under the observers. A lost update here silently
+    starves the autoscaler's delta sampling."""
+    m = ServingMetrics()
+    rounds, observers = 200, 4
+    start = threading.Barrier(observers + 1)
+    stop = threading.Event()
+
+    def observe():
+        start.wait(timeout=30)
+        for _ in range(rounds):
+            m.observe_batch(n_real=2, bucket=2, dispatch_s=0.001,
+                            request_latencies_s=[0.01])
+            m.observe_shed()
+
+    def flush():
+        start.wait(timeout=30)
+        while not stop.is_set():
+            m.snapshot(reset=True)
+
+    threads = [threading.Thread(target=observe) for _ in range(observers)]
+    flusher = threading.Thread(target=flush)
+    for t in threads + [flusher]:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop.set()
+    flusher.join(timeout=60)
+    totals = m.totals()
+    assert totals["requests"] == observers * rounds
+    assert totals["examples"] == 2 * observers * rounds
+    assert totals["shed"] == observers * rounds
+    # the interval counters were being reset throughout; after one final
+    # reset the next interval starts from zero
+    m.snapshot(reset=True)
+    assert m.snapshot()["requests"] == 0.0
+
+
 # -- HTTP front-end -----------------------------------------------------------
 
 def test_http_server_roundtrip(engine):

@@ -156,7 +156,7 @@ def check_lint(args):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # the default lint set: the whole project rooted at pyproject.toml, so
     # the repo-root scripts (bench*.py, __graft_entry__.py) are swept with
-    # the full 11-rule set (tests/data/lint excluded by [tool.jaxlint])
+    # the full 16-rule set (tests/data/lint excluded by [tool.jaxlint])
     findings = lint_paths([repo])
     if findings:
         head = "; ".join(f.format() for f in findings[:3])
