@@ -10,7 +10,7 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
                tests/test_real_data.py tests/test_gan_quality.py
 
 .PHONY: test test-all verify bench bench-serve bench-serve-int8 \
-        bench-serve-load \
+        bench-serve-mesh bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
         bench-serve-tier \
         bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
@@ -144,6 +144,13 @@ bench-serve-int8: ## int8-vs-bf16 serving: arm the calibrated quantization
 	## gate (accuracy-delta vs the pinned shard), then the same closed-loop
 	## load through each precision ladder — QPS, p99, bytes/batch one line
 	env $(CPU_ENV) $(PY) bench_serve.py --int8
+
+bench-serve-mesh: ## mesh-sharded (GSPMD) predict vs the single-chip
+	## engine on 8 CPU virtual devices: per-chip resident weight bytes
+	## (bar: cut >= 0.98x the model-axis size), p99 at batch-max,
+	## largest-servable-per-chip-budget, and zero recompiles across a
+	## promotion — one JSON line (docs/SERVING.md "Mesh serving")
+	env $(CPU_ENV) $(PY) bench_serve.py --mesh
 
 bench-serve-load: ## open-loop fleet load bench: sustained-QPS arrival
 	## schedule over a 2-model fleet — sustained QPS, p99-under-load,

@@ -36,6 +36,19 @@ server can do. Open-loop arrivals are what real traffic does — they keep
 coming — so p99 and shed rate under a FIXED offered rate are the numbers a
 capacity plan can actually use (Schroeder et al., "Open Versus Closed").
 
+`--mesh` benches the mesh-sharded (GSPMD) predict path (docs/SERVING.md
+"Mesh serving") instead: the SAME model built twice — once single-chip,
+once over a `data x model` serve mesh (CPU virtual devices: run under
+`XLA_FLAGS=--xla_force_host_platform_device_count=8`, which `make
+bench-serve-mesh` pins) — reporting per-chip resident weight bytes (the
+headline: the bar is a cut >= 0.98x the model-axis size vs the
+single-chip engine), p99 at the max-batch bucket for both engines, the
+largest registered config servable under a per-chip HBM budget each way
+(analytic, `jax.eval_shape` — no weights materialized), and the
+zero-recompile proof ACROSS A PROMOTION: a candidate generation is
+staged, shadow-dispatched, and promoted on the mesh engine with the
+compile log unchanged and the jit fallback cache empty.
+
 `--tier` benches the multi-replica tier (serve/tier.py, docs/SERVING.md
 "Replica tier") instead: warm-vs-cold replica boot-to-first-200 through the
 tier's shared persistent XLA compile cache (bars: warm >=2x faster, zero
@@ -956,6 +969,202 @@ def int8_bench() -> None:
                          f"cut below the 1.8x bar")
 
 
+def mesh_record(*, model_name, platform, n_devices, mesh_axes, max_batch,
+                wb_single, wb_mesh, wb_mesh_int8, parity_max_abs_err,
+                p99_ms_single, p99_ms_mesh, batch_ms_single, batch_ms_mesh,
+                recompiles, jit_cache_entries, largest_servable,
+                compile_cache) -> dict:
+    """The `--mesh` bench line (bench.py schema), built pure from measured
+    inputs so the CI schema test can pin its shape without paying for the
+    bench. The headline `value` is per-chip resident weight bytes on the
+    mesh; `vs_baseline` is the single-chip engine's figure over it — the
+    cut the model axis buys, with the acceptance bar
+    `vs_baseline >= 0.98 * mesh["model"]` (0.98 absorbs the handful of
+    small unsharded leaves below the serve-side sharding floor)."""
+    model_axis = int(mesh_axes.get("model", 1))
+    cut = (wb_single / wb_mesh) if wb_mesh else 0.0
+    return {
+        "metric": f"serve_mesh_per_chip_weight_bytes({model_name},"
+                  f"mesh={'x'.join(f'{k}{v}' for k, v in mesh_axes.items())},"
+                  f"b{max_batch},{platform})",
+        "value": int(wb_mesh),
+        "unit": "bytes/chip",
+        # per-chip weight bytes: single-chip engine over the mesh engine —
+        # the acceptance bar is >= 0.98 * the model-axis size
+        "vs_baseline": round(cut, 3),
+        "baseline": f"single-chip engine per-chip resident weight bytes "
+                    f"({wb_single}; vs_baseline is its ratio over the mesh "
+                    f"engine's, bar >= {0.98 * model_axis:g})",
+        "mesh": dict(mesh_axes),
+        "devices": int(n_devices),
+        "weight_bytes_per_chip_single": int(wb_single),
+        "weight_bytes_per_chip_mesh": int(wb_mesh),
+        "weight_bytes_per_chip_mesh_int8": (int(wb_mesh_int8)
+                                            if wb_mesh_int8 else None),
+        "parity_max_abs_err": float(parity_max_abs_err),
+        "p99_ms_batch_max_single": round(p99_ms_single, 3),
+        "p99_ms_batch_max_mesh": round(p99_ms_mesh, 3),
+        "batch_compute_ms_single": round(batch_ms_single, 3),
+        "batch_compute_ms_mesh": round(batch_ms_mesh, 3),
+        # the zero-recompile proof across a staged promotion on the mesh
+        # engine: compile-log delta and the jit fallback cache size
+        "recompiles": int(recompiles),
+        "jit_cache_entries": int(jit_cache_entries),
+        "largest_servable": largest_servable,
+        "cpu_cores": os.cpu_count(),
+        "platform": platform,
+        "compile_cache": compile_cache,
+    }
+
+
+def mesh_bench(args) -> None:
+    """Mesh-sharded vs single-chip predict (see module docstring `--mesh`).
+    Needs >= --model-parallel devices; `make bench-serve-mesh` runs it on
+    8 CPU virtual devices."""
+    import jax
+
+    from deepvision_tpu.cli import (compilation_cache_stats,
+                                    setup_compilation_cache)
+    setup_compilation_cache()
+
+    from deepvision_tpu.configs import (CONFIGS, get_config,
+                                        trainer_class_for_config)
+    from deepvision_tpu.parallel.mesh import make_mesh
+    from deepvision_tpu.serve.engine import PredictEngine
+
+    model_name = os.environ.get("DEEPVISION_SERVE_BENCH_MODEL", "lenet5")
+    max_batch = args.max_batch
+    platform = jax.devices()[0].platform
+    n_devices = len(jax.devices())
+    need = args.model_parallel * args.spatial_parallel
+    if n_devices < need or n_devices % need:
+        raise SystemExit(
+            f"mesh bench: {n_devices} devices for model_parallel="
+            f"{args.model_parallel} x spatial_parallel="
+            f"{args.spatial_parallel} — run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 (make "
+            f"bench-serve-mesh)")
+    mesh = make_mesh(model_parallel=args.model_parallel,
+                     spatial_parallel=args.spatial_parallel)
+    mesh_axes = dict(mesh.shape)
+
+    single = PredictEngine.from_config(
+        model_name, buckets=(1, 8, 32), max_batch=max_batch, verbose=False)
+    single.warmup()
+    sharded = PredictEngine.from_config(
+        model_name, buckets=(1, 8, 32), max_batch=max_batch, verbose=False,
+        mesh=mesh)
+    sharded.warmup()
+
+    # -- per-chip resident weight bytes (the headline) ---------------------
+    wb_single = single.weight_bytes_per_chip()["bf16"]
+    wb_mesh = sharded.weight_bytes_per_chip()["bf16"]
+
+    # -- output parity on one max-batch bucket (same fresh-init weights) ---
+    xb = np.random.RandomState(0).randn(
+        max_batch, *single.example_shape).astype(single.input_dtype)
+    out_single = np.asarray(single.predict(xb), dtype=np.float64)
+    out_mesh = np.asarray(sharded.predict(xb), dtype=np.float64)
+    parity = float(np.max(np.abs(out_single - out_mesh)))
+
+    # -- p99 at the max-batch bucket, both engines -------------------------
+    def p99_batch_max(engine) -> tuple:
+        times = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            engine.predict(xb)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.percentile(times, 99)), float(np.median(times))
+
+    p99_single, med_single = p99_batch_max(single)
+    p99_mesh, med_mesh = p99_batch_max(sharded)
+
+    # -- zero recompiles ACROSS A PROMOTION on the mesh engine -------------
+    n_programs = len(sharded.compile_log)
+    live = jax.device_get(sharded._variables)
+    cand = dict(live, params=jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 1.05, live["params"]))
+    sharded.stage_candidate(cand)
+    sharded.predict(xb, generation="candidate")    # the shadow dispatch
+    sharded.promote_candidate()
+    sharded.predict(xb)                            # post-promotion dispatch
+    recompiles = len(sharded.compile_log) - n_programs
+    jit_entries = sharded._jitted._cache_size()
+
+    # -- largest registered config servable per chip budget ----------------
+    # analytic (jax.eval_shape over each config's init — no weights ever
+    # materialized), under the same shapes->spec rule the engine places
+    # with: which models fit `--hbm-gb` per chip single-chip vs mesh?
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.trainer import build_model_from_config
+    from deepvision_tpu.parallel.mesh import analytic_per_chip_bytes
+    budget = int(args.hbm_gb * (1 << 30))
+    rows = []
+    for name in CONFIGS.names():
+        if trainer_class_for_config(name) is None:
+            continue            # adversarial configs don't serve
+        try:
+            cfg = get_config(name)
+            model, mcfg = build_model_from_config(cfg)
+            sz = mcfg.data.image_size
+            S = jax.ShapeDtypeStruct
+            shaped = jax.eval_shape(
+                lambda r, x: model.init(
+                    {"params": r, "dropout": jax.random.fold_in(r, 1)},
+                    x, train=True),
+                S((2,), jnp.uint32),
+                S((2, sz, sz, mcfg.data.channels), jnp.float32))
+        except Exception:  # noqa: BLE001 — non-servable family: not scanned
+            continue
+        rows.append((name, analytic_per_chip_bytes(shaped),
+                     analytic_per_chip_bytes(shaped, mesh)))
+
+    def largest_fitting(idx: int):
+        fitting = [r for r in rows if r[idx] <= budget]
+        if not fitting:
+            return None
+        best = max(fitting, key=lambda r: r[idx])
+        return {"model": best[0], "bytes_per_chip": int(best[idx])}
+
+    largest = {
+        "budget_gib": args.hbm_gb,
+        "configs_scanned": len(rows),
+        "fits_single_chip": sum(1 for r in rows if r[1] <= budget),
+        "fits_mesh": sum(1 for r in rows if r[2] <= budget),
+        "largest_single_chip": largest_fitting(1),
+        "largest_mesh": largest_fitting(2),
+    }
+
+    print(json.dumps(mesh_record(
+        model_name=model_name, platform=platform, n_devices=n_devices,
+        mesh_axes=mesh_axes, max_batch=max_batch,
+        wb_single=wb_single, wb_mesh=wb_mesh,
+        wb_mesh_int8=sharded.weight_bytes_per_chip()["int8"],
+        parity_max_abs_err=parity,
+        p99_ms_single=p99_single, p99_ms_mesh=p99_mesh,
+        batch_ms_single=med_single, batch_ms_mesh=med_mesh,
+        recompiles=recompiles, jit_cache_entries=jit_entries,
+        largest_servable=largest,
+        compile_cache=compilation_cache_stats())))
+
+    bars = []
+    model_axis = int(mesh_axes.get("model", 1))
+    if wb_single < 0.98 * model_axis * wb_mesh:
+        bars.append(f"per-chip weight bytes {wb_mesh} vs single-chip "
+                    f"{wb_single}: cut {wb_single / wb_mesh:.3f}x below the "
+                    f"{0.98 * model_axis:g}x bar")
+    if recompiles or jit_entries:
+        bars.append(f"promotion on the mesh engine was not recompile-free: "
+                    f"{recompiles} recompiles, {jit_entries} jit cache "
+                    f"entries")
+    if parity > 1e-4:
+        bars.append(f"mesh predict diverged from the single-chip engine "
+                    f"(max abs err {parity:.2e} > 1e-4)")
+    if bars:
+        raise SystemExit("mesh bench bars broke: " + "; ".join(bars))
+
+
 def tier_bench(args) -> None:
     """Replica-tier bench (serve/tier.py), two phases on one shared
     persistent compile-cache dir:
@@ -1211,6 +1420,26 @@ def main(argv=None) -> None:
                         "the same closed-loop load through each precision "
                         "ladder — sustained QPS, p99, bytes/batch as one "
                         "bench line (docs/SERVING.md 'Quantized serving')")
+    p.add_argument("--mesh", action="store_true",
+                   help="mesh-sharded (GSPMD) predict vs the single-chip "
+                        "engine: per-chip resident weight bytes (bar: cut "
+                        ">= 0.98x the model-axis size), p99 at batch-max, "
+                        "largest config servable per chip HBM budget each "
+                        "way, and the zero-recompile-across-a-promotion "
+                        "proof — run on CPU virtual devices (XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8; make "
+                        "bench-serve-mesh) — docs/SERVING.md 'Mesh serving'")
+    p.add_argument("--model-parallel", type=int, default=2,
+                   help="--mesh: model-axis size of the serve mesh "
+                        "(default 2)")
+    p.add_argument("--spatial-parallel", type=int, default=1,
+                   help="--mesh: spatial-axis size of the serve mesh "
+                        "(default 1)")
+    p.add_argument("--hbm-gb", type=float, default=0.0625, metavar="GIB",
+                   help="--mesh: per-chip HBM budget for the "
+                        "largest-servable scan (default 0.0625 = 64 MiB — "
+                        "small enough that the registry's largest models "
+                        "only fit model-parallel)")
     p.add_argument("--tier", action="store_true",
                    help="replica-tier bench (serve/tier.py): warm-vs-cold "
                         "replica boot-to-first-200 through the shared "
@@ -1282,6 +1511,12 @@ def main(argv=None) -> None:
                       or args.promote_at or args.trace_out):
         raise SystemExit("--tier is the standalone replica-tier bench — "
                          "run it without the other modes")
+    if args.mesh and (args.int8 or args.tier or args.load or args.spike
+                      or args.promote_at or args.trace_out):
+        raise SystemExit("--mesh is the standalone mesh-vs-single-chip "
+                         "bench — run it without the other modes")
+    if args.mesh and (args.model_parallel < 1 or args.spatial_parallel < 1):
+        raise SystemExit("--model-parallel/--spatial-parallel must be >= 1")
     if args.promote_at and not args.load:
         raise SystemExit("--promote-at needs --load (the promotion bench "
                          "runs under the open-loop arrival schedule)")
@@ -1301,6 +1536,8 @@ def main(argv=None) -> None:
                          else 10.0 if args.promote_at else 5.0)
     if args.int8:
         int8_bench()
+    elif args.mesh:
+        mesh_bench(args)
     elif args.tier:
         tier_bench(args)
     elif args.load and args.promote_at:

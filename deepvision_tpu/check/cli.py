@@ -44,16 +44,19 @@ def audit(names: Optional[Sequence[str]] = None,
     # re-emit its verdicts under the alias's unit names. The sweep still
     # reports one unit set PER REGISTERED NAME (the registry-hygiene
     # non-vacuity contract); it just doesn't pay for the same jaxpr twice.
-    # "spatial" / "epoch" / "quant" are pseudo-targets: the collective
-    # probes, the epoch-scan units, and the int8 predict twins (all part of
-    # every full sweep; naming one audits that layer alone)
+    # "spatial" / "epoch" / "quant" / "mesh" are pseudo-targets: the
+    # collective probes, the epoch-scan units, the int8 predict twins, and
+    # the mesh-sharded predict units (all part of every full sweep; naming
+    # one audits that layer alone)
     full_sweep = not names
     spatial_only = bool(names) and "spatial" in names
     epoch_only = bool(names) and "epoch" in names
     quant_only = bool(names) and "quant" in names
-    pseudo_only = spatial_only or epoch_only or quant_only
+    mesh_only = bool(names) and "mesh" in names
+    pseudo_only = spatial_only or epoch_only or quant_only or mesh_only
     if pseudo_only:
-        names = [n for n in names if n not in ("spatial", "epoch", "quant")]
+        names = [n for n in names
+                 if n not in ("spatial", "epoch", "quant", "mesh")]
     requested = (list(names) if names
                  else ([] if pseudo_only else CONFIGS.names()))
     canonical: dict = {}     # config-identity -> first name seen
@@ -80,7 +83,8 @@ def audit(names: Optional[Sequence[str]] = None,
     for unit in build_units(sweep_names, progress=progress,
                             spatial=full_sweep or spatial_only,
                             epoch=full_sweep or epoch_only,
-                            quant=full_sweep or quant_only):
+                            quant=full_sweep or quant_only,
+                            mesh_serve=full_sweep or mesh_only):
         audited.append(unit.name)
         if unit.quant is not None:
             quant_facts[unit.name] = dict(unit.quant)
@@ -230,10 +234,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from ..configs import CONFIGS
     bad = [n for n in args.configs
-           if n not in CONFIGS and n not in ("spatial", "epoch", "quant")]
+           if n not in CONFIGS
+           and n not in ("spatial", "epoch", "quant", "mesh")]
     if bad:
         print(f"usage error: unknown config(s): {', '.join(bad)}; known: "
-              f"spatial, epoch, quant, {', '.join(CONFIGS.names())}",
+              f"spatial, epoch, quant, mesh, {', '.join(CONFIGS.names())}",
               file=sys.stderr)
         return EXIT_USAGE
     if args.update_cost and args.configs:

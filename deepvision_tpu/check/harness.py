@@ -12,7 +12,11 @@ Determinism contract: every unit traces with `mesh=None` and the fixed
 the package source — not on the host's device count or the config's pod
 batch size. The spatial COLL probes trace the real collective layer of
 `parallel/spatial_shard.py` through tiny shard_map bodies over an
-`AbstractMesh` (no devices needed at all).
+`AbstractMesh` (no devices needed at all). The mesh-serve units are the
+one deliberate exception: they trace jit-with-shardings over a FIXED
+2-device (data=1, model=2) mesh built from the first two host devices, so
+their rows too are a pure function of the package source on any host with
+>= 2 devices, and they skip gracefully (no row, no finding) below that.
 """
 
 from __future__ import annotations
@@ -603,6 +607,100 @@ def _quant_unit(cname: str) -> TracedUnit:
                "baseline_unit": f"{cname}/serve"})
 
 
+# -- mesh-sharded (GSPMD) predict units ---------------------------------------
+
+# The serving mesh axis audited at the IR level: the same predict fn the
+# SERVE units trace, re-traced as a GSPMD computation over a FIXED 2-device
+# (data=1, model=2) mesh with the engine's own placement rule
+# (parallel/mesh.serve_shardings). Same configs as the int8 twins: the
+# flagship bandwidth-bound config plus the tiny one preflight runs. Fixed
+# topology keeps the jaxpr and the analytic per-chip bytes a pure function
+# of the package source on any host with >= 2 devices; 1-device hosts skip
+# gracefully (same env-skew pattern as the spatial shard_map step).
+MESH_SERVE_CONFIGS = ("lenet5", "resnet50")
+MESH_SERVE_MODEL_AXIS = 2
+
+
+def mesh_serve_unit_names() -> List[str]:
+    """The audit units the mesh-sharded predict programs contribute —
+    pinned by the cost-baseline coverage test next to the per-config unit
+    names."""
+    return [f"mesh_serve/{name}" for name in MESH_SERVE_CONFIGS]
+
+
+def _mesh_serve_units() -> List[TracedUnit]:
+    units: List[TracedUnit] = []
+    for cname in MESH_SERVE_CONFIGS:
+        name = f"mesh_serve/{cname}"
+        try:
+            units.append(_mesh_serve_unit(name, cname))
+        except Exception as e:
+            units.append(TracedUnit(name, "", "predict",
+                                    error=f"{type(e).__name__}: {e}"))
+    return units
+
+
+def _mesh_serve_unit(name: str, cname: str) -> TracedUnit:
+    """One config's predict program traced THROUGH jit-with-shardings over
+    the serve mesh. The jaxpr must stay collective-free (the COLL bar:
+    GSPMD owns placement — `declared_collectives = {}`), its cost row
+    gains the analytic per-chip weight bytes, and check_cost's
+    divisibility bar holds param_bytes to an even model-axis split."""
+    import numpy as np
+
+    from ..configs import get_config
+    from ..core.config import UNIT_RANGE_NORM
+    from ..core.steps import _normalize_input
+    from ..core.trainer import build_model_from_config
+    from ..parallel import mesh as mesh_lib
+
+    devs = np.asarray(jax.devices())
+    if devs.size < MESH_SERVE_MODEL_AXIS:
+        return TracedUnit(
+            name, "", "predict",
+            skipped=f"needs >= {MESH_SERVE_MODEL_AXIS} devices for a "
+                    f"model-parallel serve mesh (have {devs.size})")
+    mesh = mesh_lib.make_mesh(devs[:MESH_SERVE_MODEL_AXIS],
+                              model_parallel=MESH_SERVE_MODEL_AXIS)
+    cfg = get_config(cname)
+    model, cfg = build_model_from_config(cfg)
+    sz, ch = cfg.data.image_size, cfg.data.channels
+    dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+    input_norm = UNIT_RANGE_NORM if cfg.data.normalize_on_device else None
+    in_dtype = jnp.uint8 if input_norm is not None else jnp.float32
+    take_first = cfg.family == "classification"
+
+    variables = jax.eval_shape(
+        lambda r, x: model.init({"params": r,
+                                 "dropout": jax.random.fold_in(r, 1)},
+                                x, train=True),
+        S((2,), jnp.uint32), S((2, sz, sz, ch), jnp.float32))
+
+    def predict(vars_, images):   # mirrors PredictEngine.__init__'s predict
+        x = _normalize_input(images, input_norm, dt)
+        out = model.apply(vars_, x, train=False)
+        if take_first and isinstance(out, (tuple, list)):
+            out = out[0]
+        return jax.tree_util.tree_map(
+            lambda y: y.astype(jnp.float32)
+            if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
+
+    param_sh, in_sh, out_sh = mesh_lib.serve_shardings(
+        mesh, variables, (sz, sz, ch))
+    jitted = jax.jit(predict, in_shardings=(param_sh, in_sh),
+                     out_shardings=out_sh)
+    closed, donated, outs = _trace(
+        jitted, variables, S((AUDIT_BATCH, sz, sz, ch), in_dtype))
+    return TracedUnit(
+        name, "", "predict", closed, donated, outs,
+        meta={"donate": False, "compute_dtype": dt, "kind": "predict",
+              "mesh": dict(mesh.shape),
+              "param_bytes_per_chip":
+                  mesh_lib.analytic_per_chip_bytes(variables, mesh)},
+        declared_collectives={},
+        head_dims=_head_dims(cfg))
+
+
 # -- spatial collective probes ------------------------------------------------
 
 def _spatial_probe_units() -> List[TracedUnit]:
@@ -736,7 +834,7 @@ def config_unit_names(name: str) -> List[str]:
 def build_units(names: Optional[List[str]] = None,
                 progress: Optional[Callable[[str], None]] = None,
                 spatial: bool = True, epoch: bool = True,
-                quant: bool = True):
+                quant: bool = True, mesh_serve: bool = True):
     """Yield TracedUnits for the named configs (default: whole registry,
     plus the spatial collective probes and the epoch-scan units). Each
     unit's jaxpr is yielded and then released by the caller — keeping the
@@ -782,5 +880,9 @@ def build_units(names: Optional[List[str]] = None,
         gc.collect()
     if quant:
         for u in _quant_units():
+            yield u
+        gc.collect()
+    if mesh_serve:
+        for u in _mesh_serve_units():
             yield u
         gc.collect()

@@ -30,9 +30,13 @@ ALL_CHECKS: Dict[str, str] = {
     "COLL": "spatial shard_map code carries the collectives "
             "parallel/spatial_shard.py declares (ppermute/all_to_all/psum "
             "over the right mesh axes); single-program jit steps carry "
-            "none",
+            "none, and mesh-sharded (GSPMD) predict programs carry "
+            "exactly what they declare — none, the partitioner owns "
+            "collective placement",
     "COST": "per-step FLOPs / bytes-accessed / equation count from the "
-            "jaxpr, diffed against the committed CHECK_COST.json baseline",
+            "jaxpr, diffed against the committed CHECK_COST.json baseline; "
+            "mesh-sharded predict rows also pin param_bytes_per_chip and "
+            "require param_bytes to divide by the model-axis size",
     "SERVE": "PredictEngine bucket signatures {1, 8, 32, max_batch} cover "
              "each servable config's input spec with f32 outputs",
     "QUANT": "the int8 predict twins run their planned conv/dot equations "
@@ -47,7 +51,11 @@ ALL_CHECKS: Dict[str, str] = {
 # so any drift is a real model/step change; the bytes proxy may wobble a
 # hair with jax's trace-level canonicalization, eqn counts a bit more.
 COST_TOLERANCE = {"flops": 1e-6, "bytes": 0.01, "eqns": 0.05,
-                  "param_bytes": 1e-6}
+                  "param_bytes": 1e-6,
+                  # mesh-serve rows: the per-chip share is analytic (pure
+                  # shapes x sharding rule) and the axis size is topology —
+                  # both are exact, any drift is a placement-rule change
+                  "param_bytes_per_chip": 1e-6, "mesh_model": 0.0}
 
 # the int8 serve units' hard byte bar: weight-argument bytes must undercut
 # the bf16 twin's by at least this factor (f32 -> int8 is ~4x on the
@@ -195,6 +203,23 @@ def check_coll(unit: TracedUnit) -> List[Finding]:
         return findings
     if unit.closed is None:
         return []
+    if unit.declared_collectives is not None:
+        # mesh-sharded (GSPMD) predict: the traced program must carry
+        # EXACTLY what the harness declares — the empty set, because
+        # collective insertion (the fc partial-sum all-reduces, the output
+        # all-gather) is the partitioner's business at lowering time; an
+        # explicit collective in the jaxpr would bake one mesh's topology
+        # into code every mesh shape shares
+        declared = {(p, tuple(a)): n
+                    for (p, a), n in unit.declared_collectives.items()}
+        traced = collect_collectives(unit.closed)
+        if declared != traced:
+            findings.append(Finding(
+                unit.name, "COLL",
+                f"mesh-sharded predict carries {_fmt_colls(traced)} != "
+                f"declared {_fmt_colls(declared)} — GSPMD predict programs "
+                f"must leave collective placement to the partitioner"))
+        return findings
     if unit.traced_collectives is not None:
         # full shard_map step: the grad psum over both manual axes must be
         # present, and every collective must run over known spatial axes
@@ -346,22 +371,46 @@ def cost_of(unit: TracedUnit) -> Optional[dict]:
         # blind `bytes` proxy cannot see it: int32 accumulators and
         # quantize chains that fuse away dominate it)
         cost["param_bytes"] = param_bytes(unit.closed)
+        mesh_axes = unit.meta.get("mesh")
+        if mesh_axes:
+            # mesh-sharded predict: pin the per-chip share beside the
+            # global row (analytic — pure function of leaf shapes and the
+            # serve sharding rule, computed by the harness) plus the
+            # model-axis size the divisibility bar below checks against
+            cost["mesh_model"] = float(mesh_axes.get("model", 1))
+            if unit.meta.get("param_bytes_per_chip") is not None:
+                cost["param_bytes_per_chip"] = float(
+                    unit.meta["param_bytes_per_chip"])
     return cost
 
 
 def check_cost(unit_name: str, cost: dict,
                baseline_units: Optional[dict]) -> List[Finding]:
     """Diff one unit's cost row against the committed baseline. `None`
-    baseline (file absent / --update-cost run) checks nothing."""
+    baseline (file absent / --update-cost run) skips the diff; the mesh
+    divisibility bar below is baseline-free and always runs."""
+    findings: List[Finding] = []
+    model_ax = int(cost.get("mesh_model") or 0)
+    if (model_ax > 1 and cost.get("param_bytes") is not None
+            and int(cost["param_bytes"]) % model_ax):
+        # the ISSUE-18 bar: a mesh-sharded predict's weight bytes must
+        # divide evenly by the model-axis size, or the placement rule is
+        # leaving some chip a ragged share
+        findings.append(Finding(
+            unit_name, "COST",
+            f"mesh-sharded predict param_bytes {int(cost['param_bytes'])} "
+            f"does not divide by the model-axis size {model_ax} — per-chip "
+            f"shares would be ragged"))
     if baseline_units is None:
-        return []
+        return findings
     base = baseline_units.get(unit_name)
     if base is None:
-        return [Finding(unit_name, "COST",
-                        "no baseline row in CHECK_COST.json — run "
-                        "`python -m deepvision_tpu.check --update-cost` "
-                        "and commit the diff")]
-    findings = []
+        findings.append(Finding(
+            unit_name, "COST",
+            "no baseline row in CHECK_COST.json — run "
+            "`python -m deepvision_tpu.check --update-cost` "
+            "and commit the diff"))
+        return findings
     for field, tol in COST_TOLERANCE.items():
         want, got = base.get(field), cost.get(field)
         if want is None or got is None:

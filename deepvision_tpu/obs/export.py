@@ -235,6 +235,41 @@ def render_prometheus(fleet) -> str:
           "Active serving precision, one-hot over {bf16, int8}",
           precision_samples)
 
+    # the mesh serving axis (docs/SERVING.md "Mesh serving"): device count
+    # always (1 = single-chip engine), axis sizes per meshed model, and the
+    # per-chip weight-byte accounting per compiled precision — the scrape
+    # that proves a model-parallel engine actually CUT its HBM footprint
+    mesh_device_samples = []
+    mesh_axis_samples = []
+    byte_samples = []
+    for sm in models:
+        axes = getattr(sm.engine, "mesh_axes", None)
+        devices = 1
+        if axes:
+            for axis, size in axes.items():
+                devices *= int(size)
+                mesh_axis_samples.append(
+                    ("", {"model": sm.name, "axis": axis}, size))
+        mesh_device_samples.append(("", {"model": sm.name}, devices))
+        if hasattr(sm.engine, "weight_bytes_per_chip"):
+            for precision, nbytes in sorted(
+                    sm.engine.weight_bytes_per_chip().items()):
+                if nbytes is not None:
+                    byte_samples.append(
+                        ("", {"model": sm.name, "precision": precision},
+                         nbytes))
+    _emit(lines, PREFIX + "mesh_devices", "gauge",
+          "Devices the engine's GSPMD programs span (1 = single chip)",
+          mesh_device_samples)
+    if mesh_axis_samples:
+        _emit(lines, PREFIX + "mesh_axis_size", "gauge",
+              "Mesh axis sizes of a mesh-sharded engine, one sample per "
+              "axis", mesh_axis_samples)
+    if byte_samples:
+        _emit(lines, PREFIX + "weight_bytes_per_chip", "gauge",
+              "Resident weight bytes on the busiest device, per compiled "
+              "precision", byte_samples)
+
     for hist_name, help_text in (
             ("request_latency_seconds",
              "Request latency, submit to result (fixed buckets, lifetime)"),
@@ -516,14 +551,25 @@ _PRECISION_LABELED = ("deepvision_serve_request_latency_seconds",
                       "deepvision_serve_queue_wait_seconds",
                       "deepvision_serve_dispatch_seconds")
 
+# mesh-serving gauges (the GSPMD predict axis) and their required labels:
+# per-chip weight bytes must keep the precision split (averaging bf16 and
+# int8 per-chip bytes would hide exactly the win int8-on-a-mesh buys), and
+# axis-size samples are meaningless without naming WHICH axis
+_MESH_LABELED = {"deepvision_serve_weight_bytes_per_chip":
+                 ("model", "precision"),
+                 "deepvision_serve_mesh_axis_size": ("model", "axis"),
+                 "deepvision_serve_mesh_devices": ("model",)}
+
 
 def validate_serve_exposition(text: str) -> List[str]:
     """Format validation (`validate_prometheus_text`) PLUS the serving
     fleet's own labeling contract: model+precision labels on every
     dispatch/latency histogram sample, precision values from the compiled
-    ladder, and the `active_precision` gauge family present. The shared
-    validator preflight's `obs`/`quant` checks and tests/test_obs.py run
-    against GET /metrics."""
+    ladder, the `active_precision` gauge family present, and the mesh
+    gauges (`mesh_devices`, `mesh_axis_size`, `weight_bytes_per_chip`)
+    carrying their model/axis/precision labels. The shared validator
+    preflight's `obs`/`quant` checks and tests/test_obs.py run against
+    GET /metrics."""
     errors = validate_prometheus_text(text)
     saw_active = False
     for line in text.splitlines():
@@ -536,6 +582,17 @@ def validate_serve_exposition(text: str) -> List[str]:
         name = m.group("name")
         if name.startswith("deepvision_serve_active_precision"):
             saw_active = True
+        if name in _MESH_LABELED:
+            labels = _parse_labels(m.group("labels"), errors, line)
+            for required in _MESH_LABELED[name]:
+                if required not in labels:
+                    errors.append(f"{name}: mesh gauge sample missing the "
+                                  f"{required!r} label")
+            if ("precision" in _MESH_LABELED[name]
+                    and labels.get("precision") not in (None, *_PRECISIONS)):
+                errors.append(f"{name}: unknown precision label "
+                              f"{labels.get('precision')!r}")
+            continue
         base = name
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix):

@@ -382,6 +382,86 @@ def param_sharding_rules(mesh: Mesh, params, min_size_to_shard: int = 2**20):
     return jax.tree_util.tree_map(rule, params)
 
 
+# -- predict-side (serving) placement --------------------------------------
+#
+# Training shards params to fit OPTIMIZER state; serving shards them to fit
+# WEIGHTS — per-chip HBM is the objective and there are no gradients, so the
+# sharding floor is much lower than training's 1MB: below ~1KB a leaf is
+# cheaper to replicate than to manage, above it sharding is pure per-chip
+# byte savings (the transfer happens once at placement, never per request).
+SERVE_MIN_SHARD_BYTES = 1024
+
+
+def serve_param_shardings(mesh: Mesh, variables):
+    """Param placement for a mesh-sharded PredictEngine: the same pure
+    (topology, leaf shapes) -> spec rule training uses, with the serve-side
+    size floor. Determinism contract matters double here: hot reload and
+    promotion re-place candidate weights with this same function, so equal
+    shapes mean equal shardings mean the AOT bucket programs run the new
+    generation as-is (zero recompiles)."""
+    return param_sharding_rules(mesh, variables,
+                                min_size_to_shard=SERVE_MIN_SHARD_BYTES)
+
+
+def serve_shardings(mesh: Mesh, variables, example_shape: Sequence[int]):
+    """The engine's full placement contract on a mesh, as
+    ``(param_shardings, input_sharding, output_sharding)``:
+
+    - params sharded over 'model' (`serve_param_shardings`),
+    - the input batch over 'data' with H over 'spatial' when it divides
+      (`batch_sharding` owns the floor/divisibility policy),
+    - outputs fully REPLICATED — every layer above the engine boundary
+      (batcher, fleet, promotion, HTTP) sees exactly the single-device
+      payload; the gather is compiled into the bucket program.
+    """
+    h = example_shape[0] if len(example_shape) == 3 else None
+    return (serve_param_shardings(mesh, variables),
+            batch_sharding(mesh, ndim=1 + len(example_shape), dim1=h),
+            replicated(mesh))
+
+
+def per_chip_bytes(tree) -> int:
+    """Largest per-device resident byte count of a placed pytree — the
+    HBM-per-chip weight footprint /healthz and the mesh bench report.
+    Host (numpy) leaves count in full, as a 1-chip placement would."""
+    per_dev: dict = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                per_dev[sh.device] = (per_dev.get(sh.device, 0)
+                                      + sh.data.nbytes)
+        else:
+            per_dev[None] = (per_dev.get(None, 0)
+                             + np.asarray(leaf).nbytes)
+    return max(per_dev.values()) if per_dev else 0
+
+
+def analytic_per_chip_bytes(shaped_tree, mesh: Optional[Mesh] = None) -> int:
+    """Per-chip weight bytes of a (possibly abstract — ShapeDtypeStruct)
+    variables tree under the serve placement, WITHOUT placing anything:
+    drives `--list-models`' HBM-budget annotation and the mesh bench's
+    largest-servable-model scan. Computed through `serve_param_shardings`
+    itself, so the estimate can never drift from the real placement."""
+    total = 0
+    if mesh is None:
+        for leaf in jax.tree_util.tree_leaves(shaped_tree):
+            total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        return total
+    shardings = serve_param_shardings(mesh, shaped_tree)
+    for leaf, sh in zip(jax.tree_util.tree_leaves(shaped_tree),
+                        jax.tree_util.tree_leaves(
+                            shardings,
+                            is_leaf=lambda s: isinstance(s, NamedSharding))):
+        nbytes = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        div = 1
+        for axis in sh.spec:
+            if axis is not None:
+                div *= mesh.shape[axis]
+        total += nbytes // div
+    return total
+
+
 _distributed_initialized = False
 
 

@@ -28,6 +28,17 @@ depth and rolling p99 against the model's documented p99 bound
   between decisions so it measures the EFFECT of the last one before
   taking the next.
 
+Mesh-aware ordering (docs/SERVING.md 'Mesh serving'): on a GSPMD-sharded
+engine a dispatcher worker is STILL just a thread over the shared sharded
+AOT cache — the free lever — so the controller always exhausts workers
+WITHIN the mesh first. When the ceiling is reached and the model is still
+shedding, the next lever is a replica across meshes (a whole new mesh
+worth of chips + compiles, owned by the PR 16 tier): the controller
+ESCALATES instead of silently saturating — `escalations` counts it,
+`wants_scale_out` flags it on /healthz (the tier router aggregates the
+flag per replica), the optional `scale_out` hook is invoked, and the flag
+drops as soon as a sweep finds the pressure gone.
+
 Every decision is logged to the `resilience_` metrics stream
 (core/resilience.log_resilience_event), printed to stderr, and surfaced
 per model on `/healthz` and `/stats`.
@@ -180,6 +191,7 @@ class AutoscaleController:
                  down_after: int = 10,
                  cooldown_s: float = 2.0,
                  p99_factor: float = 2.0,
+                 scale_out=None,
                  logger=None):
         if max_workers < min_workers:
             raise ValueError(f"max_workers={max_workers} below "
@@ -192,6 +204,10 @@ class AutoscaleController:
         self.down_after = max(1, int(down_after))
         self.cooldown_s = float(cooldown_s)
         self.p99_factor = float(p99_factor)
+        # across-mesh lever: called as scale_out(sm, refused=, queue_depth=)
+        # when within-mesh workers are exhausted and the model still sheds
+        # (e.g. a tier supervisor adding a replica); None = flag-only
+        self.scale_out = scale_out
         self.logger = logger
         self._state: Dict[str, dict] = {
             sm.name: {"last": sm.metrics.totals(), "up_streak": 0,
@@ -277,17 +293,29 @@ class AutoscaleController:
                 overload = (p99 > self.p99_factor * bound
                             and queue_depth >= sm.batcher.max_batch)
         now = time.monotonic()
+        if not overload and st.get("wants_scale_out"):
+            # pressure receded without a scale-out: drop the escalation
+            # flag so /healthz stops advertising a want that expired
+            st["wants_scale_out"] = False
+            with sm.reload_lock:
+                sm.autoscale_stats["wants_scale_out"] = False
         if overload:
             st["up_streak"] += 1
             st["idle_streak"] = 0
             if (st["up_streak"] >= self.up_after
-                    and workers < self.max_workers
                     and now - st["last_change"] >= self.cooldown_s):
+                if workers < self.max_workers:
+                    st["up_streak"] = 0
+                    st["last_change"] = now
+                    sm.batcher.set_workers(workers + 1)
+                    self._decide(sm, "scale_up", workers + 1,
+                                 refused=refused, queue_depth=queue_depth)
+                    return True
+                # worker ceiling reached and still shedding: within-mesh
+                # capacity is exhausted — escalate to the across-mesh lever
                 st["up_streak"] = 0
                 st["last_change"] = now
-                sm.batcher.set_workers(workers + 1)
-                self._decide(sm, "scale_up", workers + 1,
-                             refused=refused, queue_depth=queue_depth)
+                self._escalate(sm, refused=refused, queue_depth=queue_depth)
                 return True
         elif queue_depth == 0:
             st["idle_streak"] += 1
@@ -307,6 +335,44 @@ class AutoscaleController:
             st["up_streak"] = 0
             st["idle_streak"] = 0
         return False
+
+    def _escalate(self, sm, *, refused: int, queue_depth: int) -> None:
+        """Within-mesh capacity is exhausted (worker ceiling, still
+        shedding): record that the next lever is ACROSS meshes — a tier
+        replica (serve/tier.py) — and tell whoever owns that lever. The
+        ordering is deliberate: a worker is a thread over the shared
+        (possibly mesh-sharded) AOT cache, free; a replica is a whole new
+        mesh worth of chips and compiles, the expensive last resort."""
+        self._state[sm.name]["wants_scale_out"] = True
+        mesh = getattr(sm.engine, "mesh_axes", None)
+        with sm.reload_lock:
+            stats = sm.autoscale_stats
+            stats["escalations"] = stats.get("escalations", 0) + 1
+            stats["wants_scale_out"] = True
+            stats["last_decision"] = "escalate"
+            stats["last_decision_unix"] = time.time()
+        self._events += 1
+        log_resilience_event(self.logger, self._events,
+                             {"autoscale_escalate": 1.0,
+                              "autoscale_workers":
+                                  float(sm.batcher.workers),
+                              "autoscale_refused_delta": float(refused),
+                              "autoscale_queue_depth": float(queue_depth)})
+        print(f"[serve-autoscale:{sm.name}] escalate: worker ceiling "
+              f"{self.max_workers} reached on mesh "
+              f"{mesh or 'single-chip'} and still shedding ({refused} "
+              f"requests refused since last sample, queue depth "
+              f"{queue_depth}) — next lever is a replica across meshes "
+              f"(serve/tier.py); wants_scale_out flagged on /healthz",
+              file=sys.stderr, flush=True)
+        if self.scale_out is not None:
+            try:
+                self.scale_out(sm, refused=refused,
+                               queue_depth=queue_depth)
+            except Exception as e:  # noqa: BLE001 — the hook is advisory;
+                # a broken across-mesh lever must not kill the sampler
+                print(f"[serve-autoscale:{sm.name}] scale_out hook "
+                      f"failed: {e!r}", file=sys.stderr, flush=True)
 
     def _decide(self, sm, decision: str, workers: int, *,
                 refused: int, queue_depth: int) -> None:

@@ -109,6 +109,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "serves bf16 and the refusal is logged")
     p.add_argument("--image-size", type=int, default=None,
                    help="serving resolution (default: each config's)")
+    p.add_argument("--model-parallel", type=int, default=1,
+                   help="mesh 'model' axis size (shard big params / "
+                        "matmuls): the engine places weights under GSPMD "
+                        "shardings and AOT-compiles every bucket as one "
+                        "sharded program whose outputs gather back to a "
+                        "single replicated array, so models bigger than "
+                        "one chip's HBM serve across the axis and nothing "
+                        "above the engine changes (docs/SERVING.md 'Mesh "
+                        "serving'). Leftover devices fill the 'data' axis "
+                        "(batch-sharded buckets). Default 1 = single chip")
+    p.add_argument("--spatial-parallel", type=int, default=1,
+                   help="mesh 'spatial' axis size: shard activations along "
+                        "image height (context parallelism; GSPMD "
+                        "halo-exchanges the convs) — the lever when the "
+                        "RESOLUTION, not the params, exceeds one chip. "
+                        "Composes with --model-parallel. Default 1")
+    p.add_argument("--hbm-gb", type=float, default=None, metavar="GIB",
+                   help="--list-models: annotate each servable config with "
+                        "its analytic per-chip weight bytes on the mesh "
+                        "the --model-parallel/--spatial-parallel flags "
+                        "describe, and whether it fits this per-chip HBM "
+                        "budget (GiB) at bf16 and (estimated) int8")
     p.add_argument("--no-verify", action="store_true",
                    help="serve weights whose checkpoint fails (or skips) "
                         "integrity verification — by default a corrupt "
@@ -195,7 +217,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-models", action="store_true",
                    help="list servable registered configs — annotated with "
                         "whether a restorable checkpoint exists under "
-                        "--runs-root (default runs/) — and exit")
+                        "--runs-root (default runs/), and with per-chip "
+                        "weight bytes / HBM-budget fit per precision when "
+                        "--hbm-gb or a mesh flag is given — and exit")
     p.add_argument("--compilation-cache",
                    default=os.environ.get("DEEPVISION_COMPILATION_CACHE",
                                           "auto"),
@@ -215,12 +239,74 @@ def restorable_epoch(runs_root: str, name: str) -> Optional[int]:
     return epochs[-1] if epochs else None
 
 
-def _list_models(runs_root: Optional[str]) -> None:
+def _build_serve_mesh(args):
+    """The serve mesh the --model-parallel/--spatial-parallel flags
+    describe, or None for the single-chip default. make_mesh's
+    divisibility error (N devices not divisible by model x spatial) is an
+    operator mistake, so it surfaces verbatim as the exit message, not a
+    stack trace."""
+    if args.model_parallel <= 1 and args.spatial_parallel <= 1:
+        return None
+    from ..parallel.mesh import make_mesh
+    try:
+        return make_mesh(model_parallel=args.model_parallel,
+                         spatial_parallel=args.spatial_parallel)
+    except ValueError as e:
+        raise SystemExit(f"mesh: {e}")
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB"):
+        if n < 1024:
+            return f"{n:.0f}{unit}"
+        n /= 1024.0
+    return f"{n:.2f}GiB"
+
+
+def _hbm_note(cfg, mesh, hbm_gb: Optional[float]) -> str:
+    """Per-chip weight-byte annotation for one servable config: analytic
+    bytes under the serve-mesh sharding rule (parallel/mesh — the same
+    pure shapes->spec function the engine places with, evaluated over
+    `jax.eval_shape` so no weights are ever materialized), int8 estimated
+    at the 1.8x byte-cut floor jaxvet's QUANT bar enforces."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.trainer import build_model_from_config
+    from ..parallel.mesh import analytic_per_chip_bytes
+    model, mcfg = build_model_from_config(cfg)
+    sz = mcfg.data.image_size
+    S = jax.ShapeDtypeStruct
+    shaped = jax.eval_shape(
+        lambda r, x: model.init({"params": r,
+                                 "dropout": jax.random.fold_in(r, 1)},
+                                x, train=True),
+        S((2,), jnp.uint32),
+        S((2, sz, sz, mcfg.data.channels), jnp.float32))
+    bf16 = analytic_per_chip_bytes(shaped, mesh)
+    int8 = int(bf16 / 1.8)
+    note = (f"per_chip[bf16]={_fmt_bytes(bf16)} "
+            f"per_chip[int8]~{_fmt_bytes(int8)}")
+    if hbm_gb is not None:
+        budget = int(hbm_gb * (1 << 30))
+        note += (f" fits[{hbm_gb:g}GiB]="
+                 f"bf16:{'yes' if bf16 <= budget else 'NO'}"
+                 f"/int8:{'yes' if int8 <= budget else 'NO'}")
+    return note
+
+
+def _list_models(args) -> None:
     """One line per registered config: family, model, servability, and —
     so operators can see what a fleet can ACTUALLY serve — the newest
-    restorable checkpoint epoch under the runs root."""
+    restorable checkpoint epoch under the runs root. With --hbm-gb (or a
+    mesh flag > 1), each servable line is also annotated with analytic
+    per-chip weight bytes on that mesh per precision — which configs FIT
+    a chip's HBM budget, before paying any compile."""
     from ..configs import CONFIGS
-    root = runs_root or "runs"
+    root = args.runs_root or "runs"
+    want_bytes = (args.hbm_gb is not None or args.model_parallel > 1
+                  or args.spatial_parallel > 1)
+    mesh = _build_serve_mesh(args) if want_bytes else None
     for name, cfg in CONFIGS.items():
         servable = "-" if cfg.family == "gan" else "yes"
         if cfg.family == "gan":
@@ -228,8 +314,10 @@ def _list_models(runs_root: Optional[str]) -> None:
         else:
             epoch = restorable_epoch(root, name)
             ckpt = f"epoch {epoch}" if epoch is not None else "-"
+        note = ("" if not want_bytes or cfg.family == "gan"
+                else " " + _hbm_note(cfg, mesh, args.hbm_gb))
         print(f"{name:24s} family={cfg.family:16s} model={cfg.model:16s} "
-              f"servable={servable:3s} ckpt={ckpt}")
+              f"servable={servable:3s} ckpt={ckpt}{note}")
 
 
 def _smoke(server, duration: float, n_threads: int) -> dict:
@@ -356,6 +444,12 @@ def validate_args(parser: argparse.ArgumentParser, args,
                      f"{args.trace_sample}")
     if args.quant_gate < 0:
         parser.error(f"--quant-gate must be >= 0, got {args.quant_gate}")
+    if args.model_parallel < 1:
+        parser.error(f"--model-parallel must be >= 1, got "
+                     f"{args.model_parallel}")
+    if args.spatial_parallel < 1:
+        parser.error(f"--spatial-parallel must be >= 1, got "
+                     f"{args.spatial_parallel}")
 
 
 def build_server(args, replica_id: Optional[str] = None):
@@ -378,6 +472,7 @@ def build_server(args, replica_id: Optional[str] = None):
         raise SystemExit(f"--buckets must be comma-separated ints, got "
                          f"{args.buckets!r}")
 
+    mesh = _build_serve_mesh(args)
     fleet = ModelFleet()
     for name in names:
         workdir = args.workdir
@@ -395,7 +490,8 @@ def build_server(args, replica_id: Optional[str] = None):
         engine = PredictEngine.from_config(
             name, workdir=workdir, checkpoint=args.checkpoint,
             image_size=args.image_size, buckets=buckets,
-            max_batch=args.max_batch, verify=not args.no_verify)
+            max_batch=args.max_batch, verify=not args.no_verify,
+            mesh=mesh)
         engine.warmup()
         fleet.add(engine, workdir=workdir, max_batch=args.max_batch,
                   max_delay_ms=args.max_delay_ms,
@@ -438,7 +534,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.list_models:
-        _list_models(args.runs_root)
+        _list_models(args)
         return 0
     validate_args(parser, args)
     server = build_server(args)

@@ -18,11 +18,18 @@ Dtype policy matches the training step (core/steps.py): inputs cast to the
 config's compute dtype (bf16 unless the config pins f32), outputs returned
 as f32.
 
-The engine is single-device on purpose: serving parallelism is one engine
-process per chip behind a load balancer (each process owns its params on
-`jax.devices()[0]`), not a mesh — the mesh is training's tool for batches
-too big for one chip, which serving buckets never are. The batch-of-1
-utilization problem is the dynamic micro-batcher's job (serve/batcher.py).
+The engine is single-device by DEFAULT — serving parallelism starts as one
+engine process per chip behind a load balancer — but scales UP when handed a
+mesh (`PredictEngine(..., mesh=make_mesh(...))`): params are placed once
+under `NamedSharding` (big leaves sharded over the 'model' axis, the
+predict-side rule in parallel/mesh.serve_param_shardings), the request batch
+shards over 'data' (H rows over 'spatial' when present), and every bucket ×
+precision program AOT-compiles as ONE GSPMD computation over that mesh with
+**fully replicated outputs** — the gather is inside the executable, so the
+batcher, fleet, promotion and HTTP layers above the engine boundary see
+exactly the single-device payload. That is the lever for a model too big
+(or a batch too hot) for one chip; the batch-of-1 utilization problem
+remains the dynamic micro-batcher's job (serve/batcher.py).
 
 The engine carries a **precision axis** beside the bucket axis: bf16 (the
 train-matched policy above) always, plus optional int8 bucket twins armed
@@ -55,6 +62,10 @@ import numpy as np
 # the ONE definition of on-device input normalization, shared with the
 # train/eval steps so serving can never drift from the training dtype policy
 from ..core.steps import _normalize_input
+# predict-side placement contract (mesh serving): param/input/output
+# shardings and the per-chip byte accounting /healthz reports
+from ..parallel.mesh import (per_chip_bytes, serve_param_shardings,
+                             serve_shardings)
 
 # the engine's precision axis: "bf16" is the train-matched compute policy
 # (f32 for configs that pin f32), "int8" the calibrated post-training
@@ -132,15 +143,25 @@ def load_checkpoint_weights(name: str, workdir: str, *,
     return apply_fn, variables, provenance, cfg
 
 
-def weight_signature(variables):
-    """(treedef, [(shape, dtype), ...]) of a variables pytree — the
+def weight_signature(variables, shardings=None):
+    """(treedef, [(shape, dtype[, spec]), ...]) of a variables pytree — the
     compiled-executable compatibility key hot reload checks before a swap:
     equal signatures mean the AOT bucket programs run the new weights
-    as-is (zero recompiles); anything else needs a new engine."""
+    as-is (zero recompiles); anything else needs a new engine. On a mesh
+    engine the per-leaf PLACEMENT is part of that key: `shardings` (the
+    per-leaf NamedSharding tree the weights are — or would be — placed
+    under) extends each entry with its partition spec, so a swap is refused
+    unless the candidate lands under shardings equal to the compiled
+    ones, not just equal shapes."""
     leaves, treedef = jax.tree_util.tree_flatten(variables)
-    return treedef, [(tuple(np.shape(leaf)),
-                      str(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
-                     for leaf in leaves]
+    sig = [(tuple(np.shape(leaf)),
+            str(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+           for leaf in leaves]
+    if shardings is not None:
+        specs = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda s: hasattr(s, "spec"))
+        sig = [(*entry, str(s.spec)) for entry, s in zip(sig, specs)]
+    return treedef, sig
 
 
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -185,10 +206,22 @@ class PredictEngine:
                  take_first_output: bool = False,
                  output_transform: Optional[Callable] = None,
                  name: str = "model", verbose: bool = True,
-                 provenance: Optional[dict] = None):
+                 provenance: Optional[dict] = None,
+                 mesh=None):
         bs = sorted({int(b) for b in buckets})
         if not bs or bs[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.mesh = mesh
+        self.mesh_axes = dict(mesh.shape) if mesh is not None else None
+        if mesh is not None:
+            # the 'data' axis shards the request batch, so every bucket
+            # (and max_batch) must be a data-axis multiple: round them UP —
+            # the padding machinery already pads n -> bucket, so a bucket
+            # of 1 on a data=2 mesh simply becomes 2 with one padding row
+            data = int(self.mesh_axes.get("data", 1))
+            bs = sorted({-(-b // data) * data for b in bs})
+            if max_batch:
+                max_batch = -(-int(max_batch) // data) * data
         max_batch = int(max_batch) if max_batch else bs[-1]
         if max_batch < bs[-1]:
             raise ValueError(f"max_batch={max_batch} below the largest "
@@ -207,10 +240,26 @@ class PredictEngine:
             "verified": False, "manifest_sha256": None, "resharded": False})
         self.input_dtype = np.dtype(np.uint8 if input_norm is not None
                                     else np.float32)
-        # params live on ONE device, committed once — compiled calls reuse
-        # the buffers instead of re-staging them per request
-        self._device = jax.devices()[0]
-        self._variables = jax.device_put(variables, self._device)
+        # params are committed ONCE — compiled calls reuse the buffers
+        # instead of re-staging them per request. Single device by default;
+        # on a mesh each leaf lands under its NamedSharding from the
+        # predict-side placement contract (parallel/mesh.serve_shardings):
+        # big leaves sharded over 'model', the batch over 'data' (+H rows
+        # over 'spatial' when it divides), outputs fully REPLICATED so the
+        # layers above the engine boundary see single-device payloads
+        if mesh is not None:
+            (self._param_shardings, self._in_sharding,
+             self._out_sharding) = serve_shardings(
+                 mesh, variables, self.example_shape)
+            self._placement = self._param_shardings
+            self._device = None
+        else:
+            self._param_shardings = None
+            self._in_sharding = self._out_sharding = None
+            self._device = jax.devices()[0]
+            self._placement = self._device
+        self._variables = jax.device_put(variables, self._placement)
+        self._stamp_provenance()
         # second weight generation (the promotion pipeline's CANDIDATE,
         # serve/promote.py): staged on the same device, served only to
         # dispatches that ask for generation="candidate" — shadow eval and
@@ -233,6 +282,7 @@ class PredictEngine:
         self._quantizer = None
         self._qvariables = None
         self._qcandidate = None
+        self._qplacement = None   # mesh: the quantized tree's own shardings
         self._compiled_int8: dict = {}
 
         def predict(variables, images):
@@ -254,10 +304,57 @@ class PredictEngine:
                 if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
 
         self._predict_fn = predict
-        self._jitted = jax.jit(predict)
+        if mesh is not None:
+            # ONE GSPMD computation per bucket over the mesh: in_shardings
+            # pin the placement contract (sharded params, 'data'-sharded
+            # batch), out_shardings=replicated compiles the gather INTO the
+            # executable — still AOT (.lower().compile() below), still zero
+            # per-request traces
+            self._jitted = jax.jit(
+                predict,
+                in_shardings=(self._param_shardings, self._in_sharding),
+                out_shardings=self._out_sharding)
+        else:
+            self._jitted = jax.jit(predict)
         self._compiled = {}
         self.compile_log: list = []
         self._compile_all(verbose)
+
+    # -- mesh placement ----------------------------------------------------
+
+    def _stamp_provenance(self) -> None:
+        # the serve-side placement is ENGINE state, not checkpoint state:
+        # re-stamped after every provenance-carrying swap so /healthz always
+        # shows the mesh the current weights are placed on (None = one chip)
+        self.provenance["mesh"] = self.mesh_axes
+
+    def _sig(self, variables):
+        """Signature of `variables` as this engine would compile/place it —
+        shape/dtype per leaf, plus the per-leaf partition spec on a mesh
+        engine (the placement rule is a pure function of leaf shapes, so
+        candidates are keyed by the shardings they WOULD land under)."""
+        if self.mesh is None:
+            return weight_signature(variables)
+        return weight_signature(
+            variables, serve_param_shardings(self.mesh, variables))
+
+    def _place_input(self, x: np.ndarray):
+        # host batch -> the compiled program's input placement ('data'-
+        # sharded on a mesh); single-device executables take host arrays
+        # directly
+        if self._in_sharding is None:
+            return x
+        return jax.device_put(x, self._in_sharding)
+
+    def weight_bytes_per_chip(self) -> dict:
+        """Resident weight bytes on the single busiest device, per compiled
+        precision (`int8` is None until the quant gate arms it) — the
+        HBM-per-chip accounting /healthz, /stats and `bench_serve.py
+        --mesh` report. On a model-parallel mesh this is the whole point:
+        the figure drops by ~the model-axis size vs a single-chip engine."""
+        return {"bf16": per_chip_bytes(self._variables),
+                "int8": (per_chip_bytes(self._qvariables)
+                         if self._qvariables is not None else None)}
 
     # -- construction ------------------------------------------------------
 
@@ -267,7 +364,8 @@ class PredictEngine:
                     buckets: Sequence[int] = (1, 8, 32),
                     max_batch: Optional[int] = None,
                     verbose: bool = True,
-                    verify: bool = True) -> "PredictEngine":
+                    verify: bool = True,
+                    mesh=None) -> "PredictEngine":
         """Build an engine for a registered config. With a `workdir`, the
         latest (or given-epoch) checkpoint is restored through the config's
         own trainer family — EMA weights win when present, exactly the
@@ -281,7 +379,14 @@ class PredictEngine:
         with no manifests serves with a warning and `verified: false`
         provenance). The resulting provenance — checkpoint epoch, manifest
         hash, verified flag — lands on `engine.provenance` and the
-        server's /healthz and /stats."""
+        server's /healthz and /stats.
+
+        `mesh` (parallel/mesh.make_mesh) makes this a mesh-sharded engine:
+        the restore path is unchanged — the trainer's mesh-aware
+        CheckpointManager already lands ANY saved topology on this host
+        (`resharded` provenance), and the engine then places the host tree
+        under the serve mesh's shardings — so a 1-chip checkpoint serves
+        model-parallel and a pod checkpoint serves on one chip."""
         from ..configs import get_config
         cfg = get_config(name)
         if cfg.family == "gan":
@@ -322,7 +427,8 @@ class PredictEngine:
                    compute_dtype=compute_dtype, input_norm=input_norm,
                    take_first_output=cfg.family == "classification",
                    output_transform=output_transform,
-                   name=cfg.name, verbose=verbose, provenance=provenance)
+                   name=cfg.name, verbose=verbose, provenance=provenance,
+                   mesh=mesh)
 
     # -- compilation -------------------------------------------------------
 
@@ -359,10 +465,11 @@ class PredictEngine:
         runtime setup so the first real request doesn't pay it."""
         x = np.zeros((self.max_batch, *self.example_shape), self.input_dtype)
         for b in self.buckets:
-            jax.block_until_ready(self._compiled[b](self._variables, x[:b]))
+            xb = self._place_input(x[:b])
+            jax.block_until_ready(self._compiled[b](self._variables, xb))
             if b in self._compiled_int8:
                 jax.block_until_ready(
-                    self._compiled_int8[b](self._qvariables, x[:b]))
+                    self._compiled_int8[b](self._qvariables, xb))
 
     # -- int8 precision axis (serve/quantize.py) ---------------------------
 
@@ -380,8 +487,18 @@ class PredictEngine:
         from ..cli import compilation_cache_stats, install_cache_stats_hooks
         install_cache_stats_hooks()
         self._quantizer = quantizer
-        qvars = quantizer.quantize(self._variables)
-        self._qvariables = jax.device_put(qvars, self._device)
+        # the quantized tree has its OWN structure (int8 payloads + f32
+        # scales), so on a mesh it gets its own shardings from the same
+        # predict-side placement rule — precision and mesh COMPOSE: sharded
+        # int8 buckets cut HBM-per-chip twice over. The scale math itself
+        # is placement-independent (run on a host copy on a mesh), so both
+        # engine kinds quantize bit-identically.
+        src = (jax.device_get(self._variables) if self.mesh is not None
+               else self._variables)
+        qvars = quantizer.quantize(src)
+        self._qplacement = (serve_param_shardings(self.mesh, qvars)
+                            if self.mesh is not None else self._device)
+        self._qvariables = jax.device_put(qvars, self._qplacement)
         jax.block_until_ready(self._qvariables)
         for b in self.buckets:
             before = compilation_cache_stats()
@@ -409,7 +526,14 @@ class PredictEngine:
         spec = jax.ShapeDtypeStruct((b, *self.example_shape),
                                     self.input_dtype)
         qfn = quantizer.quantized_fn(self._variables, spec)
-        self._compiled_int8[b] = jax.jit(qfn).lower(
+        if self.mesh is not None:
+            jitted = jax.jit(qfn,
+                             in_shardings=(self._qplacement,
+                                           self._in_sharding),
+                             out_shardings=self._out_sharding)
+        else:
+            jitted = jax.jit(qfn)
+        self._compiled_int8[b] = jitted.lower(
             self._qvariables, spec).compile()
 
     def disable_int8(self) -> None:
@@ -421,6 +545,7 @@ class PredictEngine:
         self._quantizer = None
         self._qvariables = None
         self._qcandidate = None
+        self._qplacement = None
         self._compiled_int8 = {}
 
     def set_precision(self, precision: str) -> None:
@@ -451,15 +576,20 @@ class PredictEngine:
         assignment, so in-flight dispatches — which captured the old
         reference on entry to `_dispatch` — complete against the old
         weights and every later dispatch sees the new ones."""
-        new_sig = weight_signature(variables)
-        old_sig = weight_signature(self._variables)
+        new_sig = self._sig(variables)
+        old_sig = weight_signature(self._variables, self._param_shardings)
         if new_sig != old_sig:
             raise ValueError(
                 f"refusing hot swap for {self.name!r}: new weights do not "
-                f"match the compiled signature (tree structure or leaf "
-                f"shapes/dtypes differ) — the AOT bucket programs would "
-                f"need a recompile; build a fresh engine instead")
-        staged = jax.device_put(variables, self._device)
+                f"match the compiled signature (tree structure, leaf "
+                f"shapes/dtypes or shardings differ) — the AOT bucket "
+                f"programs would need a recompile; build a fresh engine "
+                f"instead")
+        # candidate weights RE-PLACE under the exact shardings the programs
+        # were compiled against (on a mesh: the same NamedShardings the
+        # signature just keyed on) — so hot reload lands a checkpoint from
+        # ANY saved topology on this serve mesh with zero recompiles
+        staged = jax.device_put(variables, self._placement)
         qstaged = None
         if self._quantizer is not None:
             # int8 stays a first-class citizen through hot reload: the new
@@ -467,7 +597,7 @@ class PredictEngine:
             # (weight scales are data-free) — same shapes/dtypes, so the
             # compiled int8 buckets run it as-is, zero recompiles
             qstaged = jax.device_put(self._quantizer.quantize(variables),
-                                     self._device)
+                                     self._qplacement)
             jax.block_until_ready(qstaged)
         jax.block_until_ready(staged)   # fully resident before going live
         self._variables = staged
@@ -475,6 +605,7 @@ class PredictEngine:
             self._qvariables = qstaged
         if provenance is not None:
             self.provenance = dict(provenance)
+            self._stamp_provenance()
 
     # -- candidate generation (staged promotion, serve/promote.py) ---------
 
@@ -494,15 +625,16 @@ class PredictEngine:
         `inject_delay_s` is the deterministic canary latency-spike fault
         (DEEPVISION_FAULT_PROMOTE_REGRESS=<epoch>:latency) — every
         candidate-generation dispatch sleeps that long."""
-        new_sig = weight_signature(variables)
-        old_sig = weight_signature(self._variables)
+        new_sig = self._sig(variables)
+        old_sig = weight_signature(self._variables, self._param_shardings)
         if new_sig != old_sig:
             raise ValueError(
                 f"refusing to stage candidate for {self.name!r}: weights do "
-                f"not match the compiled signature (tree structure or leaf "
-                f"shapes/dtypes differ) — the AOT bucket programs would "
-                f"need a recompile; build a fresh engine instead")
-        staged = jax.device_put(variables, self._device)
+                f"not match the compiled signature (tree structure, leaf "
+                f"shapes/dtypes or shardings differ) — the AOT bucket "
+                f"programs would need a recompile; build a fresh engine "
+                f"instead")
+        staged = jax.device_put(variables, self._placement)
         jax.block_until_ready(staged)
         if self._quantizer is not None:
             # both generations exist at BOTH precisions while staged: the
@@ -510,7 +642,7 @@ class PredictEngine:
             # active precision, or the comparison would measure precision,
             # not weights
             qcand = jax.device_put(self._quantizer.quantize(variables),
-                                   self._device)
+                                   self._qplacement)
             jax.block_until_ready(qcand)
             self._qcandidate = qcand
         self._candidate = staged
@@ -531,6 +663,7 @@ class PredictEngine:
             self._qvariables = self._qcandidate   # int8 flips in lockstep
         if self.candidate_provenance is not None:
             self.provenance = dict(self.candidate_provenance)
+            self._stamp_provenance()
         self.drop_candidate()
         return self.provenance
 
@@ -617,7 +750,7 @@ class PredictEngine:
             x = np.pad(x, [(0, b - n)] + [(0, 0)] * (x.ndim - 1))
         compiled = (self._compiled_int8 if precision == "int8"
                     else self._compiled)
-        out = compiled[b](variables, x)
+        out = compiled[b](variables, self._place_input(x))
         return tree_slice(jax.device_get(out), 0, n)
 
     def reference(self, images, generation: Optional[str] = None):
@@ -628,6 +761,10 @@ class PredictEngine:
         scores against."""
         x = self._coerce(images)
         variables, _ = self._resolve_generation(generation, "bf16")
+        if self.mesh is not None:
+            # eager apply against mesh-sharded params: replicate the batch
+            # so computation-follows-sharding has an unambiguous layout
+            x = jax.device_put(x, self._out_sharding)
         return jax.device_get(self._predict_fn(variables, jnp.asarray(x)))
 
     # -- measurement -------------------------------------------------------
@@ -641,7 +778,8 @@ class PredictEngine:
         contract (docs/SERVING.md)."""
         precision = self._resolve_precision(precision)
         b = pick_bucket(bucket or self.max_batch, self.buckets)
-        x = np.zeros((b, *self.example_shape), self.input_dtype)
+        x = self._place_input(
+            np.zeros((b, *self.example_shape), self.input_dtype))
         if precision == "int8":
             c, variables = self._compiled_int8[b], self._qvariables
         else:

@@ -75,7 +75,8 @@ class ServedModel:
         # autoscale decision record, mutated by the AutoscaleController
         # under reload_lock (the control-plane lock) and read by /healthz
         self.autoscale_stats: Dict[str, float] = {
-            "scale_ups": 0, "scale_downs": 0,
+            "scale_ups": 0, "scale_downs": 0, "escalations": 0,
+            "wants_scale_out": False,
             "workers": self.batcher.workers}
         # the model's documented p99 contract (max_delay + one max-bucket
         # compute time, ms) — measured lazily by the autoscaler's first
@@ -147,6 +148,13 @@ class ServedModel:
             # and the last calibration-gate decision (why int8 is on/off)
             "precision": getattr(self.engine, "precision", "bf16"),
             "quant": getattr(self.engine, "quant_decision", None),
+            # the mesh axis beside it: axis names x sizes when the engine
+            # is GSPMD-sharded (None = single chip), plus the per-chip
+            # weight-byte accounting that makes the HBM win auditable
+            "mesh": getattr(self.engine, "mesh_axes", None),
+            "weight_bytes_per_chip": (
+                self.engine.weight_bytes_per_chip()
+                if hasattr(self.engine, "weight_bytes_per_chip") else None),
             "max_batch": self.batcher.max_batch,
             "queue_depth": self.batcher.queue_depth,
             "workers": self.batcher.workers,
@@ -167,6 +175,10 @@ class ServedModel:
             "workers": float(self.batcher.workers),
             "weights": self.engine.provenance,
             "precision": getattr(self.engine, "precision", "bf16"),
+            "mesh": getattr(self.engine, "mesh_axes", None),
+            "weight_bytes_per_chip": (
+                self.engine.weight_bytes_per_chip()
+                if hasattr(self.engine, "weight_bytes_per_chip") else None),
         }
         if self.breaker is not None:
             snap["breaker_state"] = self.breaker.describe()["state"]

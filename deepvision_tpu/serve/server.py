@@ -26,7 +26,9 @@ threads are exactly the concurrency the micro-batchers coalesce) over a
     GET  /healthz           -> 200 aggregate status + per-model weight
                                provenance (epoch, manifest hash, verified),
                                reload outcomes, worker count, autoscale
-                               decisions, and breaker state — diff across
+                               decisions, breaker state, and the mesh axis
+                               (axis names x sizes + per-chip weight
+                               bytes when GSPMD-sharded) — diff across
                                replicas to audit a fleet for weight skew
     GET  /stats[/<model>]   -> 200 per-model ServingMetrics snapshot(s)
     GET  /metrics           -> 200 Prometheus text exposition (0.0.4):
@@ -433,6 +435,17 @@ def _make_handler(server: InferenceServer):
                     # buried in stderr
                     "precision": getattr(d.engine, "precision", "bf16"),
                     "quant": getattr(d.engine, "quant_decision", None),
+                    # the mesh serving axis beside it: axis names x sizes
+                    # when the engine is GSPMD-sharded (None = one chip)
+                    # and the per-chip weight-byte accounting — provenance
+                    # also carries "mesh" + "resharded", so one /healthz
+                    # shows which checkpoints crossed a topology to get
+                    # here (docs/SERVING.md "Mesh serving")
+                    "mesh": getattr(d.engine, "mesh_axes", None),
+                    "weight_bytes_per_chip": (
+                        d.engine.weight_bytes_per_chip()
+                        if hasattr(d.engine, "weight_bytes_per_chip")
+                        else None),
                     # the fleet view: per-model weight provenance
                     # (checkpoint epoch + integrity-manifest hash +
                     # verified flag) and reload outcomes — diff across

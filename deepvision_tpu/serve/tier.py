@@ -208,6 +208,14 @@ class ReplicaHandle:
                 # the first means the shared compile cache is doing its job
                 "compile": {name: (m.get("compile") or {})
                             for name, m in models.items()},
+                # the replica autoscaler's across-mesh escalation: within-
+                # mesh workers are exhausted and the model still sheds —
+                # the tier (this layer) owns the next lever, a new replica
+                "wants_scale_out": any(
+                    (m.get("autoscale") or {}).get("wants_scale_out")
+                    for m in models.values()),
+                "mesh": {name: m.get("mesh")
+                         for name, m in models.items()},
             }
         return d
 
@@ -775,6 +783,11 @@ class TierRouter:
                   else "unavailable" if routable == 0 else "degraded")
         return {"status": status, "size": len(reps), "routable": routable,
                 "served_models": served, "replicas": reps,
+                # replicas whose autoscaler exhausted within-mesh workers
+                # and wants a replica across meshes — the operator's (or a
+                # supervisor's) add-a-slot signal, aggregated tier-wide
+                "scale_out_wanted": [r["replica"] for r in reps
+                                     if r.get("wants_scale_out")],
                 "roll": self.roll.describe()}
 
     def stats_body(self) -> dict:

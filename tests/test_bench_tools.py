@@ -162,3 +162,46 @@ def test_traffic_accounting_structure_and_prediction():
     assert 0.6 < out["baseline_gb"] / 85.4 < 1.0   # named-buffer coverage
     saved = out["baseline_gb"] - out["lean_gb"]
     assert 16.0 < saved < 20.0                      # GB the lowp flags remove
+
+
+def test_mesh_bench_record_schema():
+    """`bench_serve.py --mesh` must emit one bench.py-schema line carrying
+    the mesh shape, per-chip weight bytes, and the recompile count — the
+    CI-side pin for the mesh serving bench, checked against the pure
+    record builder so the bench itself (two engines, 8 virtual devices)
+    isn't paid for here."""
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", os.path.join(TOOLS, "..", "bench_serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.mesh_record(
+        model_name="lenet5", platform="cpu", n_devices=8,
+        mesh_axes={"data": 4, "model": 2}, max_batch=32,
+        wb_single=246824, wb_mesh=125864, wb_mesh_int8=None,
+        parity_max_abs_err=9e-8, p99_ms_single=12.0, p99_ms_mesh=20.0,
+        batch_ms_single=8.0, batch_ms_mesh=16.0,
+        recompiles=0, jit_cache_entries=0,
+        largest_servable={"budget_gib": 0.0625, "configs_scanned": 27,
+                          "fits_single_chip": 11, "fits_mesh": 16,
+                          "largest_single_chip": None,
+                          "largest_mesh": None},
+        compile_cache={"hits": 0, "misses": 0})
+    # the bench.py core schema every bench line shares
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, key
+    assert json.loads(json.dumps(rec)) == rec   # one JSON-printable line
+    # the mesh-specific pins: mesh shape, per-chip bytes, recompile count
+    assert rec["mesh"] == {"data": 4, "model": 2}
+    assert "mesh=data4xmodel2" in rec["metric"]
+    assert rec["value"] == rec["weight_bytes_per_chip_mesh"] == 125864
+    assert rec["weight_bytes_per_chip_single"] == 246824
+    assert rec["unit"] == "bytes/chip"
+    # vs_baseline IS the per-chip byte cut, against the documented bar
+    assert rec["vs_baseline"] == round(246824 / 125864, 3)
+    assert rec["vs_baseline"] >= 0.98 * rec["mesh"]["model"]
+    assert rec["recompiles"] == 0
+    assert rec["jit_cache_entries"] == 0
+    assert rec["largest_servable"]["fits_mesh"] >= \
+        rec["largest_servable"]["fits_single_chip"]

@@ -331,7 +331,7 @@ def test_roofline_family_steps(capsys):
 # itself every round, so the fast lane re-running it buys nothing
 @pytest.mark.slow
 def test_preflight_tool(tmp_path):
-    """tools/preflight.py: all eighteen checks (incl. the jaxlint gate,
+    """tools/preflight.py: all nineteen checks (incl. the jaxlint gate,
     the jaxvet IR-audit gate, the serving-stack smoke, the fleet/hot-reload
     cycle, the accuracy-gated promotion check, the int8 quantization gate
     — clean arm enables int8, the fault-armed regression is refused and
@@ -342,8 +342,9 @@ def test_preflight_tool(tmp_path):
     readmission, then a clean epoch rolled replica-by-replica — the
     segmentation-family gate, the
     on-device-epoch-scan parity check, the device-augment smoke, the
-    checkpoint-integrity fsck, and the elastic save-on-8/restore-on-2
-    reshard check) pass on the virtual mesh; an unreachable input floor
+    checkpoint-integrity fsck, the elastic save-on-8/restore-on-2
+    reshard check, and the 2-device GSPMD mesh-serve parity/hot-swap
+    check) pass on the virtual mesh; an unreachable input floor
     turns into one FAIL line + exit 1 while the remaining checks still
     run."""
     import json
@@ -352,7 +353,12 @@ def test_preflight_tool(tmp_path):
 
     script = os.path.join(os.path.dirname(__file__), "..", "tools",
                           "preflight.py")
+    # the tier check's replica children (`python -m deepvision_tpu...`)
+    # and the mesh-serve child inherit cwd=tmp_path, so the package must
+    # come from PYTHONPATH — same contract as the other subprocess tests
     env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.abspath(
+                   os.path.join(os.path.dirname(__file__), "..")),
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     base = [sys.executable, script, "--model", "lenet5", "--batch-size", "32",
@@ -361,14 +367,14 @@ def test_preflight_tool(tmp_path):
     ok = subprocess.run(base, capture_output=True, text=True, timeout=600,
                         env=env, cwd=str(tmp_path))
     assert ok.returncode == 0, ok.stdout + ok.stderr[-1000:]
-    assert ok.stdout.count("PASS") == 18 and "FAIL" not in ok.stdout
+    assert ok.stdout.count("PASS") == 19 and "FAIL" not in ok.stdout
     assert json.loads(ok.stdout.strip().splitlines()[-1])["preflight"] == "pass"
 
     bad = subprocess.run(base + ["--input-floor", "1e12"],
                          capture_output=True, text=True, timeout=600, env=env,
                          cwd=str(tmp_path))
     assert bad.returncode == 1
-    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 17
+    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 18
     assert json.loads(bad.stdout.strip().splitlines()[-1])["preflight"] == "fail"
 
 

@@ -52,15 +52,20 @@ def test_cost_baseline_covers_whole_registry():
     cost row for every traced unit of every registered config — the
     committed artifact IS the proof that sweep count equals registry
     count, refreshed every time the baseline is — plus the epoch-scan
-    units (the whole-epoch lax.scan wrapper's own rows)."""
+    units (the whole-epoch lax.scan wrapper's own rows) and the
+    mesh-sharded predict units (written on a >= 2-device host; the
+    committed baseline is refreshed under the Makefile's 8-virtual-device
+    CPU env so the rows are always present)."""
     from deepvision_tpu.check.harness import (config_unit_names,
                                               epoch_unit_names,
+                                              mesh_serve_unit_names,
                                               quant_unit_names)
     from deepvision_tpu.configs import CONFIGS
 
     with open(os.path.join(REPO, "CHECK_COST.json")) as fp:
         baseline = json.load(fp)
-    expected = set(epoch_unit_names()) | set(quant_unit_names())
+    expected = (set(epoch_unit_names()) | set(quant_unit_names())
+                | set(mesh_serve_unit_names()))
     for name in CONFIGS.names():
         # cost rows exist for jaxpr-traced units: train/eval steps and —
         # since the serve units grew a full trace (the int8 twins' bf16
@@ -76,6 +81,17 @@ def test_cost_baseline_covers_whole_registry():
         q = baseline["units"][qname]["param_bytes"]
         b = baseline["units"][f"{cname}/serve"]["param_bytes"]
         assert b >= 1.8 * q, (qname, b, q)
+    # the mesh-serve rows must pin the per-chip share, an even model-axis
+    # split, and a per-chip cut vs the single-chip serve row's full bytes
+    for mname in mesh_serve_unit_names():
+        cname = mname.split("/", 1)[1]
+        row = baseline["units"][mname]
+        model_ax = int(row["mesh_model"])
+        assert model_ax >= 2, mname
+        assert row["param_bytes"] % model_ax == 0, mname
+        full = baseline["units"][f"{cname}/serve"]["param_bytes"]
+        assert row["param_bytes_per_chip"] * (0.98 * model_ax) <= full, \
+            (mname, row["param_bytes_per_chip"], full)
 
 
 # -- in-process clean halves + spatial probes --------------------------------

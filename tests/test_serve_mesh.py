@@ -1,0 +1,227 @@
+"""Mesh-sharded serving parity (docs/SERVING.md "Mesh serving").
+
+The GSPMD predict programs must be a pure PLACEMENT change: same model,
+same weights, same answers as the single-chip engine — across mesh shapes
+(model- and spatial-parallel), across precisions (the bf16 ladder and the
+int8 twins, sharing ONE quantizer so the quantized weights are
+bit-identical), with zero per-request recompiles and nothing falling back
+to silent jit. Float payloads compare at the compute dtype's reassociation
+bound, scaled to each leaf's magnitude (the partitioner reorders partial
+sums across shards; bf16 noise compounds multiplicatively through a
+50–100-layer backbone): f32 configs at 2e-6, shallow bf16 at 2e-2, the
+deep bf16 backbones at 6e-2. Integer payloads (segmentation class-id
+masks) compare EXACTLY.
+
+Every servable family's smallest config is pinned; the two whose XLA-CPU
+compiles run minutes (yolov3_digits's Darknet53, hourglass104 at 256px)
+are `slow`-marked out of the default run, like every other big-convnet
+compile in this suite.
+"""
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.parallel import mesh as mesh_lib
+from deepvision_tpu.serve.engine import PredictEngine
+
+# (config, reassociation tolerance for float payloads) — the smallest
+# registered config of every servable family; tolerance keyed to the
+# config's compute dtype and depth (f32 vs bf16 partial sums; darknet53
+# and the 104-layer hourglass compound bf16 noise to a few percent of
+# the output scale)
+FAMILY_SMALLEST = [
+    pytest.param("lenet5", 2e-6, id="classification-lenet5"),
+    pytest.param("unet_synthetic", 2e-6, id="segmentation-unet_synthetic"),
+    pytest.param("centernet_digits", 2e-2, id="centernet-centernet_digits"),
+    pytest.param("yolov3_digits", 6e-2, id="detection-yolov3_digits",
+                 marks=pytest.mark.slow),
+    pytest.param("hourglass104", 6e-2, id="pose-hourglass104",
+                 marks=pytest.mark.slow),
+]
+
+
+def _serve_meshes():
+    """The two pinned shapes of the parity contract: data=2 x model=2 and
+    data=2 x spatial=2, on 4 of the suite's 8 virtual CPU devices."""
+    devs = np.asarray(jax.devices())[:4]
+    return [("model", mesh_lib.make_mesh(devs, model_parallel=2)),
+            ("spatial", mesh_lib.make_mesh(devs, spatial_parallel=2))]
+
+
+def _assert_parity(ref, out, tol, ctx):
+    refs = jax.tree_util.tree_leaves(ref)
+    outs = jax.tree_util.tree_leaves(out)
+    assert len(refs) == len(outs), ctx
+    for r, o in zip(refs, outs):
+        r, o = np.asarray(r), np.asarray(o)
+        assert r.dtype == o.dtype and r.shape == o.shape, ctx
+        if np.issubdtype(r.dtype, np.integer):
+            # class-id masks: placement must not flip a single pixel
+            np.testing.assert_array_equal(o, r, err_msg=ctx)
+        else:
+            # scale-aware: raw heatmap/logit leaves run to magnitude
+            # ~1e2-1e3, sigmoid/probability leaves to ~1 — the bound is
+            # tol x the leaf's own scale, never below tol itself
+            atol = tol * max(1.0, float(np.max(np.abs(r))))
+            np.testing.assert_allclose(o, r, rtol=0, atol=atol, err_msg=ctx)
+
+
+@pytest.mark.parametrize("config,tol", FAMILY_SMALLEST)
+def test_mesh_predict_parity_both_shapes_both_precisions(config, tol):
+    """One single-chip engine vs a model-parallel AND a spatial-parallel
+    mesh engine, bf16 and int8, same fresh-init weights and ONE shared
+    quantizer — answers must agree, with zero per-request recompiles and
+    an empty jit fallback cache on the mesh engines."""
+    from deepvision_tpu.core import scoring
+    from deepvision_tpu.serve.quantize import Quantizer
+    from deepvision_tpu.configs import get_config
+
+    single = PredictEngine.from_config(config, buckets=(2,), max_batch=2,
+                                       verbose=False)
+    x = np.random.RandomState(0).randn(
+        2, *single.example_shape).astype(single.input_dtype)
+    try:
+        quantizer = Quantizer(single._predict_fn, single._variables,
+                              np.asarray(x),
+                              head_dims=scoring.serving_head_dims(
+                                  get_config(config)))
+    except ValueError:
+        # every conv sits in the protected f32 head dims (e.g. the
+        # 64-wide centernet_digits backbone) — int8 is a no-op for this
+        # config by design, so the pin is bf16-only
+        quantizer = None
+    precisions = ("bf16", "int8") if quantizer is not None else ("bf16",)
+    ref = {"bf16": single.predict(x)}
+    if quantizer is not None:
+        single.enable_int8(quantizer, verbose=False)
+        ref["int8"] = single.predict(x, precision="int8")
+
+    for shape_name, mesh in _serve_meshes():
+        eng = PredictEngine.from_config(config, buckets=(2,), max_batch=2,
+                                        verbose=False, mesh=mesh)
+        assert eng.mesh_axes == dict(mesh.shape)
+        if quantizer is not None:
+            eng.enable_int8(quantizer, verbose=False)
+        n_programs = len(eng.compile_log)
+        for precision in precisions:
+            out = eng.predict(x, precision=precision)
+            # int8 adds a dequant boundary per planned eqn, so the
+            # shard-order reassociation bound doubles
+            _assert_parity(ref[precision], out,
+                           tol if precision == "bf16" else 2 * tol,
+                           f"{config} {shape_name} {precision}")
+        # the serving contract on a mesh: every dispatch ran an AOT GSPMD
+        # program — no per-request compiles, no silent jit fallback
+        assert len(eng.compile_log) == n_programs, \
+            f"{config} {shape_name}: per-request recompile"
+        assert eng._jitted._cache_size() == 0, \
+            f"{config} {shape_name}: fell back to silent jit"
+
+
+def test_one_chip_checkpoint_serves_model_parallel():
+    """The reshard-on-load leg of the tentpole: a checkpoint saved on ONE
+    device restores onto the serve mesh (PR 9's elastic machinery),
+    provenance says so, and the answers match the single-chip engine
+    restored from the same checkpoint."""
+    import shutil
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+
+    tmpdir = tempfile.mkdtemp(prefix="serve_mesh_ckpt_")
+    try:
+        workdir = os.path.join(tmpdir, "lenet5")
+        trainer = trainer_class_for_config("lenet5")(
+            get_config("lenet5"), workdir=workdir)
+        try:
+            trainer.init_state((32, 32, 1))
+            trainer.ckpt.save(3, trainer.state, {"best_metric": 0.0})
+            trainer.ckpt.flush()
+        finally:
+            trainer.close()
+
+        single = PredictEngine.from_config(
+            "lenet5", workdir=workdir, buckets=(2,), max_batch=2,
+            verbose=False)
+        mesh = mesh_lib.make_mesh(np.asarray(jax.devices())[:4],
+                                  model_parallel=2)
+        meshed = PredictEngine.from_config(
+            "lenet5", workdir=workdir, buckets=(2,), max_batch=2,
+            verbose=False, mesh=mesh)
+        assert meshed.provenance["checkpoint_epoch"] == 3
+        assert meshed.provenance["verified"]
+        assert meshed.provenance["mesh"] == {"data": 2, "model": 2}
+        assert single.provenance["mesh"] is None
+        x = np.random.RandomState(1).randn(2, 32, 32, 1).astype(
+            single.input_dtype)
+        np.testing.assert_allclose(
+            np.asarray(meshed.predict(x)), np.asarray(single.predict(x)),
+            rtol=0, atol=2e-6)
+        # per-chip accounting: the model axis roughly halves residency
+        wb_single = single.weight_bytes_per_chip()["bf16"]
+        wb_mesh = meshed.weight_bytes_per_chip()["bf16"]
+        assert wb_single >= 1.96 * wb_mesh, (wb_single, wb_mesh)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def test_mesh_promotion_zero_recompiles_and_signature_guard():
+    """Hot-reload invariants survive the mesh axis: staging + promoting a
+    signature-equal candidate recompiles nothing (compile log pinned, jit
+    cache empty), the promoted weights actually serve, and a
+    differently-shaped candidate is REFUSED."""
+    mesh = mesh_lib.make_mesh(np.asarray(jax.devices())[:4],
+                              model_parallel=2)
+    eng = PredictEngine.from_config("lenet5", buckets=(2,), max_batch=2,
+                                    verbose=False, mesh=mesh)
+    x = np.random.RandomState(0).randn(2, 32, 32, 1).astype(eng.input_dtype)
+    before = np.asarray(eng.predict(x))
+    n_programs = len(eng.compile_log)
+
+    live = jax.device_get(eng._variables)
+    cand = dict(live, params=jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 1.05, live["params"]))
+    eng.stage_candidate(cand)
+    eng.predict(x, generation="candidate")      # the shadow dispatch
+    eng.promote_candidate()
+    after = np.asarray(eng.predict(x))
+    assert not np.allclose(before, after), "promotion left old weights live"
+    assert len(eng.compile_log) == n_programs
+    assert eng._jitted._cache_size() == 0
+
+    bad = dict(live, params=jax.tree_util.tree_map(
+        lambda a: np.concatenate([np.asarray(a)] * 2, axis=-1),
+        live["params"]))
+    with pytest.raises(ValueError, match="signature"):
+        eng.swap_variables(bad)
+
+
+def test_mesh_fleet_exposition_and_snapshot():
+    """Satellite 2's observable surface: the fleet snapshot (what /healthz
+    and /stats serve) carries the mesh axes and per-chip weight bytes, and
+    the Prometheus exposition validates with the mesh gauge labels."""
+    from deepvision_tpu.obs.export import (render_prometheus,
+                                           validate_serve_exposition)
+    from deepvision_tpu.serve.fleet import ModelFleet
+
+    mesh = mesh_lib.make_mesh(np.asarray(jax.devices())[:4],
+                              model_parallel=2)
+    fleet = ModelFleet()
+    fleet.add(PredictEngine.from_config("lenet5", buckets=(2,), max_batch=2,
+                                        verbose=False, mesh=mesh),
+              max_delay_ms=5.0)
+    try:
+        sm = fleet.get("lenet5")
+        desc = sm.describe()
+        assert desc["mesh"] == {"data": 2, "model": 2}
+        wb = desc["weight_bytes_per_chip"]
+        assert wb["bf16"] > 0 and wb["int8"] is None
+        text = render_prometheus(fleet)
+        assert validate_serve_exposition(text) == []
+        assert 'deepvision_serve_mesh_axis_size{model="lenet5",' \
+               'axis="model"} 2' in text
+        assert "deepvision_serve_weight_bytes_per_chip" in text
+    finally:
+        fleet.drain(timeout=30)

@@ -101,6 +101,15 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               2 devices, assert leaf-exact params under the new mesh —
               the save-on-N/resume-on-M path a preempted pod relaunch
               (or a 1-chip serving host) depends on
+  mesh-serve  mesh-sharded serving (docs/SERVING.md "Mesh serving"): on a
+              2-virtual-device (data x model) mesh the GSPMD predict
+              programs must answer within the f32 reassociation bound of
+              the single-chip engine, per-chip resident weight bytes must
+              drop by ~the model-axis size, and one hot weight swap must
+              land with the compile log unchanged and the silent-jit
+              fallback cache empty — the placement contract the serving
+              tier depends on has to hold BEFORE a model too big for one
+              chip is pointed at traffic
   mesh_parity (--verify-mesh only) one seeded train step on the requested
               spatial/model mesh matches the pure-DP oracle per-leaf
               (tools/verify_mesh.py — run before the first run on a new
@@ -1252,6 +1261,75 @@ def check_reshard(args):
     return lines[-1] if lines else "ok"
 
 
+# the mesh-serve child (docs/SERVING.md "Mesh serving"), run on a forced
+# 2-virtual-device CPU backend: GSPMD predict parity vs the single-chip
+# engine, per-chip weight-byte cut ~= the model-axis size, and one hot
+# weight swap with zero recompiles and nothing falling back to silent jit
+_MESH_SERVE_CHILD = """
+import jax
+import numpy as np
+
+from deepvision_tpu.parallel.mesh import make_mesh
+from deepvision_tpu.serve.engine import PredictEngine
+
+devs = np.asarray(jax.devices())
+assert len(devs) >= 2, f"need 2 virtual devices, got {len(devs)}"
+mesh = make_mesh(devs[:2], model_parallel=2)
+single = PredictEngine.from_config("lenet5", buckets=(2,), max_batch=2,
+                                   verbose=False)
+eng = PredictEngine.from_config("lenet5", buckets=(2,), max_batch=2,
+                                verbose=False, mesh=mesh)
+x = np.random.RandomState(0).randn(
+    2, *single.example_shape).astype(single.input_dtype)
+ref = np.asarray(single.predict(x))
+out = np.asarray(eng.predict(x))
+np.testing.assert_allclose(out, ref, rtol=0, atol=2e-6)
+err = float(np.max(np.abs(out - ref)))
+wb_single = single.weight_bytes_per_chip()["bf16"]
+wb_mesh = eng.weight_bytes_per_chip()["bf16"]
+assert wb_single >= 1.96 * wb_mesh, (wb_single, wb_mesh)
+
+programs = len(eng.compile_log)
+live = jax.device_get(eng._variables)
+eng.swap_variables(dict(live, params=jax.tree_util.tree_map(
+    lambda a: np.asarray(a) * 1.05, live["params"])))
+swapped = np.asarray(eng.predict(x))
+assert not np.allclose(out, swapped), "swap left old weights live"
+assert len(eng.compile_log) == programs, "hot swap recompiled"
+assert eng._jitted._cache_size() == 0, "fell back to silent jit"
+axes = "x".join(f"{k}{v}" for k, v in eng.mesh_axes.items())
+print(f"gspmd parity max|err| {err:.1e} on {axes}; per-chip weights "
+      f"{wb_single} -> {wb_mesh} bytes; hot swap zero-recompile")
+"""
+
+
+@check("mesh-serve")
+def check_mesh_serve(args):
+    import subprocess
+
+    # subprocess on a 2-virtual-device CPU backend, same isolation
+    # rationale as check_reshard: the placement contract is device-count
+    # logic, identical on the virtual mesh, and must not fight the parent
+    # for an in-process TPU
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child_env["XLA_FLAGS"] = (
+        child_env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", _MESH_SERVE_CHILD],
+                          capture_output=True, text=True, env=child_env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))),
+                          timeout=600)
+    if proc.returncode != 0:
+        lines = ((proc.stderr.strip() + "\n" + proc.stdout.strip())
+                 .strip().splitlines())
+        raise RuntimeError("; ".join(lines[-3:]) if lines else
+                           f"mesh-serve child exited {proc.returncode}")
+    lines = proc.stdout.strip().splitlines()
+    return lines[-1] if lines else "ok"
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Validate a host before a pod run (see module docstring).")
@@ -1306,6 +1384,7 @@ def main(argv=None):
     check_checkpoint(args)
     check_fsck(args)
     check_reshard(args)
+    check_mesh_serve(args)
 
     ok = all(RESULTS)
     print(json.dumps({"preflight": "pass" if ok else "fail",
