@@ -12,9 +12,10 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
 .PHONY: test test-all verify bench bench-serve bench-serve-int8 \
         bench-serve-mesh bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
-        bench-serve-tier \
+        bench-serve-tier bench-serve-flywheel \
         bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
-        serve-fleet-smoke serve-tier-smoke preflight preflight-record \
+        serve-fleet-smoke serve-tier-smoke flywheel-smoke \
+        preflight preflight-record \
         lint lint-changed lint-concurrency \
         fsck check check-update-cost reshard-parity
 
@@ -140,6 +141,30 @@ serve-tier-smoke: ## replica-tier smoke: router over 2 supervised replica
 	env $(CPU_ENV) $(PY) -m deepvision_tpu.serve.tier -m lenet5 \
 	    --replicas 2 --smoke --kill-one --duration 4
 
+flywheel-smoke: ## serve->train->serve flywheel smoke: commit one quick
+	## lenet5 epoch, then serve it under synthetic load with the
+	## DRIFT_SHIFT fault armed — the drift monitor must confirm the
+	## shift, fine-tune a bounded epoch through the model's own trainer,
+	## and promote it through the shadow/canary gate DURING the smoke;
+	## the final JSON's flywheel section is asserted
+	## (docs/FAILURES.md "Flywheel decisions")
+	rm -rf /tmp/deepvision_flywheel_smoke
+	env $(CPU_ENV) $(PY) LeNet/jax/train.py -m lenet5 --synthetic \
+	    --epochs 1 --steps-per-epoch 8 \
+	    --workdir /tmp/deepvision_flywheel_smoke/lenet5
+	env $(CPU_ENV) DEEPVISION_FAULT_DRIFT_SHIFT=0:3.0 $(PY) \
+	    -m deepvision_tpu.serve -m lenet5 \
+	    --workdir /tmp/deepvision_flywheel_smoke/lenet5 \
+	    --smoke --duration 30 --reload-every 3600 --promote-gate -0.5 \
+	    --flywheel-every 0.5 \
+	    | tee /tmp/deepvision_flywheel_smoke/smoke.out
+	$(PY) -c "import json; \
+rec = [json.loads(l) for l in open('/tmp/deepvision_flywheel_smoke/smoke.out') \
+       if l.strip().startswith('{')][-1]; \
+fw = rec['flywheel']['lenet5']; \
+assert fw.get('promoted', 0) >= 1, f'no flywheel promotion: {rec}'; \
+print('flywheel smoke: episode promoted, state', fw['state'])"
+
 bench-serve-int8: ## int8-vs-bf16 serving: arm the calibrated quantization
 	## gate (accuracy-delta vs the pinned shard), then the same closed-loop
 	## load through each precision ladder — QPS, p99, bytes/batch one line
@@ -179,6 +204,15 @@ bench-serve-promote: ## accuracy-gated promotion under open-loop load: a
 	## docs/SERVING.md "Promotion")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
 	    --load --promote-at 1.5 --secs 5
+
+bench-serve-flywheel: ## serve->train->serve flywheel under open-loop load:
+	## the drift-shift fault fires mid-bench and the monitor must confirm
+	## drift, fine-tune a bounded epoch, and promote it through the gate
+	## while arrivals keep firing — time-to-detect, time-to-promoted,
+	## goodput during the episode vs steady state (one JSON line;
+	## docs/FAILURES.md "Flywheel decisions")
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_serve.py \
+	    --flywheel
 
 bench-serve-tier: ## replica-tier bench: warm-vs-cold replica boot through
 	## the shared persistent compile cache (>=2x, zero warm recompiles),

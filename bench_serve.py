@@ -49,6 +49,19 @@ zero-recompile proof ACROSS A PROMOTION: a candidate generation is
 staged, shadow-dispatched, and promoted on the mesh engine with the
 compile log unchanged and the jit fallback cache empty.
 
+`--flywheel` benches the serve->train->serve flywheel (flywheel/,
+docs/FAILURES.md "Flywheel decisions") instead: the deterministic
+drift-shift fault moves the live input distribution from the first
+reservoir window, closed-loop clients keep firing, and the bench drives
+the drift monitor tick-by-tick — reporting time-to-detect (monitor armed
+-> hysteresis streak confirmed), time-to-promoted (confirmed -> the
+fine-tuned epoch live through the shadow/canary gate) as the headline
+`value`, and goodput during the episode over steady state as
+`vs_baseline`. Hard bars: zero failed responses, zero shed, zero
+serve-path recompiles, decision == promoted — the loop that answers
+drift with a gated retrain must not cost healthy traffic anything but
+shared CPU.
+
 `--tier` benches the multi-replica tier (serve/tier.py, docs/SERVING.md
 "Replica tier") instead: warm-vs-cold replica boot-to-first-200 through the
 tier's shared persistent XLA compile cache (bars: warm >=2x faster, zero
@@ -828,6 +841,224 @@ def promote_under_load(args) -> None:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def flywheel_record(*, model_name, platform, max_batch, time_to_detect_s,
+                    time_to_promoted_s, goodput_rps_steady,
+                    goodput_rps_episode, detect_windows, hysteresis_windows,
+                    finetune_epoch, decision, flywheel_id, responses_total,
+                    responses_failed, shed_requests, recompiles, counters,
+                    compile_cache) -> dict:
+    """The `--flywheel` bench line (bench.py schema), built pure from
+    measured inputs so the CI schema test can pin its shape without paying
+    for the bench. The headline `value` is time-to-promoted (confirmed
+    drift -> retrained candidate live, wall clock); `vs_baseline` is
+    serving goodput DURING the episode over steady-state goodput — the
+    "the flywheel must not shed healthy traffic" claim. The hard bars the
+    bench itself enforces are zero failed responses and zero shed across
+    the whole run; the goodput ratio is reported for the capacity plan
+    (fine-tune and serving share the host's cores on CPU, so a dip is
+    honest — shed or failure is not)."""
+    ratio = (goodput_rps_episode / goodput_rps_steady
+             if goodput_rps_steady else 0.0)
+    return {
+        "metric": f"serve_flywheel_time_to_promoted({model_name},"
+                  f"b{max_batch},drift-fault,{platform})",
+        "value": round(time_to_promoted_s, 3),
+        "unit": "sec",
+        # goodput during the drift->retrain->promote episode over steady
+        # state: the episode must not shed healthy traffic
+        "vs_baseline": round(ratio, 3),
+        "baseline": f"steady-state goodput before the monitor arms "
+                    f"({goodput_rps_steady:.1f} rsp/s; vs_baseline is "
+                    f"goodput during the episode over it — zero shed and "
+                    f"zero failures are the hard bars)",
+        "time_to_detect_s": round(time_to_detect_s, 3),
+        "time_to_promoted_s": round(time_to_promoted_s, 3),
+        "goodput_rps_steady": round(goodput_rps_steady, 1),
+        "goodput_rps_episode": round(goodput_rps_episode, 1),
+        "detect_windows": int(detect_windows),
+        "hysteresis_windows": int(hysteresis_windows),
+        "finetune_epoch": int(finetune_epoch),
+        "decision": decision,
+        "flywheel_id": flywheel_id,
+        "responses_total": int(responses_total),
+        "responses_failed": int(responses_failed),
+        "shed_requests": int(shed_requests),
+        "recompiles": int(recompiles),
+        "counters": dict(counters),
+        "cpu_cores": os.cpu_count(),
+        "platform": platform,
+        "compile_cache": compile_cache,
+    }
+
+
+def flywheel_bench(args) -> None:
+    """The serve->train->serve flywheel under closed-loop load
+    (docs/FAILURES.md "Flywheel decisions"): the DRIFT_SHIFT fault is
+    armed from the first reservoir window, synthetic clients hammer the
+    batcher, and the bench drives the monitor tick-by-tick — measuring
+    time-to-detect (monitor armed -> hysteresis streak confirmed),
+    time-to-promoted (confirmed -> the fine-tuned epoch live through the
+    shadow/canary gate), and serving goodput through the whole episode.
+    Hard bars: zero failed responses, zero shed, zero serve-path
+    recompiles, decision == promoted."""
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    import jax
+
+    from deepvision_tpu.cli import (compilation_cache_stats,
+                                    setup_compilation_cache)
+    setup_compilation_cache()
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+    from deepvision_tpu.core.metrics import MetricsLogger
+    from deepvision_tpu.flywheel import FlywheelController
+    from deepvision_tpu.serve.batcher import result_within
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.promote import PromotionController
+    from deepvision_tpu.utils.faults import FaultInjector
+
+    target = "lenet5"
+    cfg = get_config(target)
+    sample = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
+    tmpdir = tempfile.mkdtemp(prefix="bench_flywheel_")
+    workdir = os.path.join(tmpdir, target)
+
+    trainer = trainer_class_for_config(target)(cfg, workdir=workdir)
+    try:
+        trainer.init_state(sample)
+        trainer.ckpt.save(1, trainer.state, {"best_metric": 0.0})
+        trainer.ckpt.flush()
+    finally:
+        trainer.close()
+
+    fleet = ModelFleet()
+    logger = MetricsLogger(tmpdir, name="serve")
+    # warm the metrics stream NOW: the first logged event lazily builds the
+    # TensorBoard writer — paying that inside the episode would be charged
+    # to time_to_promoted
+    logger.log(0, {"flywheel_bench_armed": 1.0}, prefix="resilience_",
+               echo=False)
+    try:
+        engine = PredictEngine.from_config(target, workdir=workdir,
+                                           buckets=(1, 4, 8), verbose=False)
+        engine.warmup()
+        sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+        PromotionController(sm, canary_frac=0.25, canary_window_s=0.2,
+                            logger=logger)
+        hysteresis = 2
+        fw = FlywheelController(
+            sm, tick_every_s=0, logger=logger,
+            finetune_epochs=1, finetune_batches=4,
+            faults=FaultInjector(drift_shift_window=0,
+                                 drift_shift_magnitude=3.0),
+            window_examples=32, sample_per_batch=4,
+            hysteresis_windows=hysteresis)
+        platform = jax.devices()[0].platform
+        n_programs = len(engine.compile_log)
+        x = np.random.RandomState(0).randn(
+            4, *engine.example_shape).astype(engine.input_dtype)
+        result_within(sm.submit(x), BENCH_WAIT_S, what="bench warmup")
+        sm.metrics.snapshot(reset=True)
+
+        stop = _threading.Event()
+        done_ts: list = []          # completion timestamps, merged later
+        failures: list = []
+
+        def client(i: int) -> None:
+            rs = np.random.RandomState(i)
+            xi = rs.randn(4, *engine.example_shape).astype(
+                engine.input_dtype)
+            ts = []
+            while not stop.is_set():
+                try:
+                    result_within(sm.submit(xi), BENCH_WAIT_S,
+                                  what="bench request")
+                    ts.append(time.perf_counter())
+                except Exception as e:  # noqa: BLE001 — every failure
+                    failures.append(e)  # fails the bench's hard bar
+                    break
+            done_ts.extend(ts)          # list.extend is atomic enough here
+
+        threads = [_threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(3)]
+        t_traffic = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        # phase 1 — steady state, monitor idle: the goodput baseline
+        steady_secs = 2.0
+        time.sleep(steady_secs)
+        t_arm = time.perf_counter()
+
+        # phase 2 — the monitor ticks: drift (present from window 0 via
+        # the fault) must confirm through the hysteresis streak
+        fid = None
+        deadline = t_arm + 120.0
+        while fid is None and time.perf_counter() < deadline:
+            fid = fw.monitor.tick()
+            if fid is None:
+                time.sleep(0.02)
+        if fid is None:
+            raise SystemExit(f"drift never confirmed: "
+                             f"{fw.monitor.describe()}")
+        t_detect = time.perf_counter()
+        detect_windows = fw.monitor.windows
+
+        # phase 3 — the episode, synchronous on this thread: fine-tune ->
+        # gate -> canary -> promote, while the clients keep firing
+        state = fw.tick()
+        t_promoted = time.perf_counter()
+        if state != "promoted":
+            raise SystemExit(f"flywheel episode did not promote: {state} "
+                             f"{fw.describe()}")
+
+        time.sleep(0.5)             # a beat of post-episode serving
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        snap = sm.metrics.snapshot()
+        shed = snap.get("shed_requests", 0)
+        epoch = engine.provenance["checkpoint_epoch"]
+        recompiles = len(engine.compile_log) - n_programs
+        if failures:
+            raise SystemExit(f"failed responses during the episode: "
+                             f"{failures[:1]!r}")
+        if shed:
+            raise SystemExit(f"the flywheel episode shed {shed} healthy "
+                             f"requests")
+        if recompiles:
+            raise SystemExit(f"{recompiles} serve-path recompiles during "
+                             f"the episode")
+
+        def goodput(t0: float, t1: float) -> float:
+            n = sum(1 for t in done_ts if t0 <= t < t1)
+            return n / (t1 - t0) if t1 > t0 else 0.0
+
+        print(json.dumps(flywheel_record(
+            model_name=target, platform=platform,
+            max_batch=engine.max_batch,
+            time_to_detect_s=t_detect - t_arm,
+            time_to_promoted_s=t_promoted - t_detect,
+            goodput_rps_steady=goodput(t_traffic + 0.5, t_arm),
+            goodput_rps_episode=goodput(t_detect, t_promoted),
+            detect_windows=detect_windows,
+            hysteresis_windows=hysteresis,
+            finetune_epoch=epoch, decision=fw.last_decision,
+            flywheel_id=fw.last_flywheel_id,
+            responses_total=len(done_ts), responses_failed=len(failures),
+            shed_requests=shed, recompiles=recompiles,
+            counters=fw.counters,
+            compile_cache=compilation_cache_stats())))
+    finally:
+        fleet.drain(timeout=30)
+        logger.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def int8_bench() -> None:
     """int8-vs-bf16 serving comparison (docs/SERVING.md "Quantized
     serving"): one engine, both precision ladders compiled in its AOT
@@ -1449,6 +1680,15 @@ def main(argv=None) -> None:
                         "failed responses after the ejection window, "
                         "goodput within 5%% of pre-kill, supervised "
                         "readmission) — docs/SERVING.md 'Replica tier'")
+    p.add_argument("--flywheel", action="store_true",
+                   help="serve->train->serve flywheel bench "
+                        "(flywheel/): arm the DRIFT_SHIFT fault under "
+                        "closed-loop load and measure time-to-detect, "
+                        "time-to-promoted, and serving goodput through "
+                        "the drift->fine-tune->gate->promote episode "
+                        "(bars: zero failed responses, zero shed, zero "
+                        "serve-path recompiles) — docs/FAILURES.md "
+                        "'Flywheel decisions'")
     p.add_argument("--load", action="store_true",
                    help="open-loop fleet load bench (sustained-QPS arrival "
                         "schedule over --models) instead of the closed-loop "
@@ -1517,6 +1757,11 @@ def main(argv=None) -> None:
                          "bench — run it without the other modes")
     if args.mesh and (args.model_parallel < 1 or args.spatial_parallel < 1):
         raise SystemExit("--model-parallel/--spatial-parallel must be >= 1")
+    if args.flywheel and (args.int8 or args.tier or args.mesh or args.load
+                          or args.spike or args.promote_at
+                          or args.trace_out):
+        raise SystemExit("--flywheel is the standalone drift->retrain->"
+                         "promote bench — run it without the other modes")
     if args.promote_at and not args.load:
         raise SystemExit("--promote-at needs --load (the promotion bench "
                          "runs under the open-loop arrival schedule)")
@@ -1536,6 +1781,8 @@ def main(argv=None) -> None:
                          else 10.0 if args.promote_at else 5.0)
     if args.int8:
         int8_bench()
+    elif args.flywheel:
+        flywheel_bench(args)
     elif args.mesh:
         mesh_bench(args)
     elif args.tier:

@@ -119,7 +119,8 @@ def resilient_batches(batches: Iterable, policy: RetryPolicy,
 def log_resilience_event(logger, step: int, metrics: dict,
                          epoch: Optional[int] = None, *,
                          request_id: Optional[str] = None,
-                         trace_ref: Optional[str] = None) -> None:
+                         trace_ref: Optional[str] = None,
+                         flywheel_id: Optional[str] = None) -> None:
     """Write one event onto the `resilience_` metrics stream — the single
     forensics channel every recovery path shares (divergence rollbacks and
     checkpoint fallbacks in the trainers, refused hot reloads in
@@ -134,7 +135,12 @@ def log_resilience_event(logger, step: int, metrics: dict,
     and/or the ``span:<id>`` of the span that produced it, written as
     string fields on the JSONL line — a shed, breaker-open, or rollback
     event joins the exact spans (GET /trace) and client log line behind
-    it on these keys."""
+    it on these keys. `flywheel_id` is the third correlation field: the
+    episode id the flywheel controller (flywheel/controller.py) mints at
+    a drift event and threads through every decision of one
+    drift→retrain→promote episode, so a single grep over the stream
+    reconstructs the whole loop (docs/FAILURES.md "Flywheel
+    decisions")."""
     if logger is None:
         return
     extra = {}
@@ -142,6 +148,8 @@ def log_resilience_event(logger, step: int, metrics: dict,
         extra["request_id"] = str(request_id)
     if trace_ref is not None:
         extra["trace_ref"] = str(trace_ref)
+    if flywheel_id is not None:
+        extra["flywheel_id"] = str(flywheel_id)
     logger.log(step, {k: float(v) for k, v in metrics.items()},
                epoch=epoch, prefix="resilience_", echo=False,
                extra=extra or None)

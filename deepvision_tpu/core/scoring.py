@@ -152,6 +152,37 @@ def pinned_shard(cfg, *, image_size: int, input_dtype,
         f"watch metric — gated families: {GATED_FAMILIES}")
 
 
+def input_moments(images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel (mean, std) of one `(n, h, w, c)` image batch, float64.
+    The single moment recipe both sides of the flywheel drift comparison
+    use (flywheel/drift.py): the pinned calibration shard's reference
+    moments and every live reservoir window are reduced HERE, so the two
+    can never disagree on normalization, dtype, or axis order."""
+    x = np.asarray(images, np.float64)
+    if x.ndim != 4:
+        raise ValueError(f"expected a (n, h, w, c) image batch, got shape "
+                         f"{x.shape}")
+    mean = x.mean(axis=(0, 1, 2))
+    std = x.std(axis=(0, 1, 2))
+    return mean, std
+
+
+def moment_shift(ref_mean: np.ndarray, ref_std: np.ndarray,
+                 mean: np.ndarray, std: np.ndarray) -> float:
+    """Scalar drift score between two per-channel moment sets: the worst
+    channel's |Δmean| in reference-std units, plus the worst relative std
+    change — dimensionless, 0.0 for identical distributions, ~1.0 when a
+    channel's mean moved one reference-σ (the flywheel gate's unit)."""
+    ref_mean = np.asarray(ref_mean, np.float64)
+    ref_std = np.asarray(ref_std, np.float64)
+    eps = 1e-6
+    dmean = float(np.max(np.abs(np.asarray(mean, np.float64) - ref_mean)
+                         / (ref_std + eps)))
+    dstd = float(np.max(np.abs(np.asarray(std, np.float64) - ref_std)
+                        / (ref_std + eps)))
+    return dmean + 0.5 * dstd
+
+
 def _sigmoid(x: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-np.clip(x, -30.0, 30.0)))
 

@@ -1,0 +1,17 @@
+"""Flywheel: drift-triggered continuous training (ROADMAP item 4).
+
+The loop that closes serve→train→serve: `DriftMonitor` (drift.py) watches
+one served model's live inputs/outputs against the pinned calibration
+shard, and `FlywheelController` (controller.py) turns a confirmed drift
+event into a bounded fine-tune, re-gates the result through the existing
+promotion pipeline, and backs off — or opens a circuit — when candidates
+keep failing. Every decision of one episode carries one `flywheel_id`
+across the resilience stream, spans, /healthz, and /metrics.
+
+docs/FAILURES.md "Flywheel decisions" is the operator contract.
+"""
+
+from .controller import FLYWHEEL_STATES, FlywheelController
+from .drift import DriftMonitor
+
+__all__ = ["DriftMonitor", "FlywheelController", "FLYWHEEL_STATES"]

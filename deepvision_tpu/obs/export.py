@@ -221,6 +221,48 @@ def render_prometheus(fleet) -> str:
               "Accuracy-gated promotion decisions (shadow/canary verdicts)",
               promo_samples)
 
+    # the flywheel axis (flywheel/controller.py): one-hot state over the
+    # controller's state machine (the breaker_state pattern — an alert on
+    # `flywheel_state{state="circuit_open"} == 1` is one PromQL line), the
+    # monitor's latest drift evidence as gauges, and episode outcomes as a
+    # labeled counter family. Conditional like promotion_decisions: only
+    # models with a flywheel armed emit the families at all.
+    fw_state_samples = []
+    fw_shift_samples = []
+    fw_decay_samples = []
+    fw_outcome_samples = []
+    for sm in models:
+        fw = getattr(sm, "flywheel", None)
+        if fw is None:
+            continue
+        from ..flywheel.controller import FLYWHEEL_STATES
+        desc = fw.describe()
+        for s in FLYWHEEL_STATES:
+            fw_state_samples.append(
+                ("", {"model": sm.name, "state": s},
+                 1 if desc["state"] == s else 0))
+        drift = desc["drift"]
+        fw_shift_samples.append(
+            ("", {"model": sm.name}, drift["last_input_shift"]))
+        fw_decay_samples.append(
+            ("", {"model": sm.name}, drift["last_watch_decay"]))
+        fw_outcome_samples += [
+            ("", {"model": sm.name, "outcome": k}, v)
+            for k, v in sorted(desc["counters"].items())]
+    if fw_state_samples:
+        _emit(lines, PREFIX + "flywheel_state", "gauge",
+              "Flywheel controller state, one-hot over the retrain state "
+              "machine", fw_state_samples)
+        _emit(lines, PREFIX + "flywheel_input_shift", "gauge",
+              "Latest window's input moment shift vs the pinned reference "
+              "(reference-sigma units)", fw_shift_samples)
+        _emit(lines, PREFIX + "flywheel_watch_decay", "gauge",
+              "Latest window's watched-metric decay vs the arm-time "
+              "baseline on the pinned shard", fw_decay_samples)
+        _emit(lines, PREFIX + "flywheel_episodes_total", "counter",
+              "Flywheel episode outcomes (retrains, promotions, refusals, "
+              "rollbacks, circuit opens)", fw_outcome_samples)
+
     # weight-precision provenance, one-hot over the compiled ladder: which
     # precision this model's dispatches run at (the int8 gate's outcome as
     # a scrapeable fact, not just a /healthz field)
@@ -558,7 +600,13 @@ _PRECISION_LABELED = ("deepvision_serve_request_latency_seconds",
 _MESH_LABELED = {"deepvision_serve_weight_bytes_per_chip":
                  ("model", "precision"),
                  "deepvision_serve_mesh_axis_size": ("model", "axis"),
-                 "deepvision_serve_mesh_devices": ("model",)}
+                 "deepvision_serve_mesh_devices": ("model",),
+                 # the flywheel's one-hot state gauge rides the same
+                 # required-labels contract: a state sample without the
+                 # state label cannot be alerted on
+                 "deepvision_serve_flywheel_state": ("model", "state"),
+                 "deepvision_serve_flywheel_episodes_total":
+                 ("model", "outcome")}
 
 
 def validate_serve_exposition(text: str) -> List[str]:

@@ -205,13 +205,20 @@ class DynamicBatcher:
         self.tracer = None
         self.faults = faults if faults is not None else FaultInjector.from_env()
         # optional per-batch tap `observer(generation, latencies_s,
-        # dispatch_s, error)` — the promotion controller's
-        # canary-vs-baseline comparison feed (generation is 'live' or
+        # dispatch_s, error, sample=None)` — the promotion controller's
+        # canary-vs-baseline comparison feed and the flywheel drift
+        # monitor's live-sample source (generation is 'live' or
         # 'candidate'; dispatch_s is the device-dispatch wall time, the
         # part of latency wholly owned by ONE generation; error is the
-        # dispatch exception or None). Called from a dispatcher worker; an
-        # observer exception is counted on the metrics and logged once per
-        # distinct error (never silently swallowed).
+        # dispatch exception or None; `sample` is a dict carrying
+        # REFERENCES — never copies — to the dispatched batch:
+        # {'images': <(n, *example_shape) input array>, 'outputs': <engine
+        # output pytree, None on a failed dispatch>, 'trace_ref':
+        # 'span:<id>' or None}. Observers that retain anything must sample/
+        # copy on their side — the reservoir in flywheel/drift.py does).
+        # Called from a dispatcher worker; an observer exception is counted
+        # on the metrics and logged once per distinct error (never silently
+        # swallowed).
         self.observer = None
         self._observer_errors_seen: set = set()
         self._observer_error_seq = 0
@@ -445,7 +452,7 @@ class DynamicBatcher:
             for r in batch:
                 _settle(r.future, exc=e)
             self._observe(generation, [now - r.t_submit for r in batch],
-                          now - t0, e, trace_ref=trace_ref)
+                          now - t0, e, trace_ref=trace_ref, images=images)
             return
         now = time.monotonic()
         with self._lock:
@@ -470,7 +477,7 @@ class DynamicBatcher:
         trace_ref = self._trace_batch(batch, total, t_collect, t0, now,
                                       generation, precision_label)
         self._observe(generation, latencies, now - t0, None,
-                      trace_ref=trace_ref)
+                      trace_ref=trace_ref, images=images, outputs=out)
 
     def _trace_batch(self, batch: List[_Request], total: int,
                      t_collect: Optional[float], t0: float, now: float,
@@ -512,12 +519,20 @@ class DynamicBatcher:
         return f"span:{bid}"
 
     def _observe(self, generation, latencies, dispatch_s, error,
-                 trace_ref: Optional[str] = None) -> None:
+                 trace_ref: Optional[str] = None,
+                 images=None, outputs=None) -> None:
         observer = self.observer
         if observer is None:
             return
+        # references only, assembled AFTER every future is settled: a slow
+        # (or broken) tap can never delay or damage a client's result
+        sample = None
+        if images is not None:
+            sample = {"images": images, "outputs": outputs,
+                      "trace_ref": trace_ref}
         try:
-            observer(generation or "live", latencies, dispatch_s, error)
+            observer(generation or "live", latencies, dispatch_s, error,
+                     sample=sample)
         except Exception as e:  # noqa: BLE001 — a broken tap must not take
             # the dispatcher worker (and every future) with it, but it must
             # also never be SILENT: count it, and log one resilience event

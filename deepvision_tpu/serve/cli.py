@@ -89,6 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="canary decision window: how long candidate and "
                         "baseline traffic are compared (p99, error rate) "
                         "before promote/rollback (default 5)")
+    p.add_argument("--flywheel-every", type=float, default=0.0,
+                   metavar="SECS",
+                   help="drift-triggered continuous training "
+                        "(docs/FAILURES.md 'Flywheel decisions'): monitor "
+                        "live inputs/outputs against the pinned calibration "
+                        "shard every SECS seconds; a confirmed drift "
+                        "(consecutive-window hysteresis) fine-tunes a "
+                        "bounded candidate and ships it through the "
+                        "--promote-gate shadow/canary pipeline, with "
+                        "exponential backoff and a retrain circuit on "
+                        "repeated failures. Needs --promote-gate. 0 "
+                        "disables (default)")
     p.add_argument("--serve-precision", choices=("bf16", "int8"),
                    default="bf16",
                    help="serving precision (docs/SERVING.md 'Quantized "
@@ -358,6 +370,8 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
                                "work, then exiting 0") as gs:
         server.reloader.start()
         server.autoscaler.start()
+        for fw in server.flywheels:
+            fw.start()
         threads = [threading.Thread(target=client, args=(i,), daemon=True)
                    for i in range(max(n_threads, len(models)))]
         print(f"[serve:{server.engine.name}] ready: synthetic load "
@@ -386,6 +400,11 @@ def _smoke(server, duration: float, n_threads: int) -> dict:
                    for n, s in per_model.items()},
         "requests_total": round(float(requests_total), 1),
         "buckets": list(server.engine.buckets),
+        # flywheel-armed smokes (make flywheel-smoke) assert on this
+        # section: state machine + episode outcome counters per model
+        **({"flywheel": {fw.sm.name: {"state": fw.state, **fw.counters}
+                         for fw in server.flywheels}}
+           if server.flywheels else {}),
         **{k: round(float(v), 4) for k, v in snap.items()},
     }), flush=True)
     if not ok:
@@ -425,6 +444,13 @@ def validate_args(parser: argparse.ArgumentParser, args,
             and not args.reload_every):
         parser.error("--promote-gate needs --reload-every: promotion "
                      "evaluates the candidates the hot-reload poller finds")
+    if args.flywheel_every < 0:
+        parser.error(f"--flywheel-every must be >= 0, got "
+                     f"{args.flywheel_every}")
+    if args.flywheel_every and args.promote_gate is None:
+        parser.error("--flywheel-every needs --promote-gate: the flywheel "
+                     "only ships retrained candidates through the shadow/"
+                     "canary promotion pipeline, never a direct swap")
     if args.workers < 1:
         parser.error(f"--workers must be >= 1, got {args.workers}")
     if args.max_workers < args.workers:
@@ -509,6 +535,7 @@ def build_server(args, replica_id: Optional[str] = None):
         canary_window_s=args.canary_window,
         max_workers=args.max_workers,
         autoscale_every_s=args.autoscale_every,
+        flywheel_every_s=args.flywheel_every,
         default_deadline_s=args.deadline_ms / 1000.0,
         trace=not args.no_trace,
         trace_sample=args.trace_sample,

@@ -68,6 +68,11 @@ class ServedModel:
         # deployment runs candidates through shadow/canary before they go
         # live; None = the plain integrity-verified direct-swap path
         self.promoter = None
+        # flywheel controller (flywheel/controller.py) when drift-triggered
+        # continuous training is armed: monitors this model's live inputs
+        # against the pinned calibration shard and drives
+        # retrain -> re-gate -> promote episodes; None = no flywheel
+        self.flywheel = None
         self.reload_lock = threading.Lock()
         self.reload_stats: Dict[str, float] = {
             "reloads": 0, "refused_corrupt": 0, "refused_incompatible": 0,
@@ -166,6 +171,8 @@ class ServedModel:
             "breaker": (self.breaker.describe() if self.breaker else None),
             "promotion": (self.promoter.describe()
                           if self.promoter else None),
+            "flywheel": (self.flywheel.describe()
+                         if self.flywheel else None),
         }
 
     def snapshot(self) -> dict:

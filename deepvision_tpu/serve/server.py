@@ -137,6 +137,7 @@ class InferenceServer:
                  workers: int = 1,
                  max_workers: int = 4,
                  autoscale_every_s: float = 0.0,
+                 flywheel_every_s: float = 0.0,
                  default_deadline_s: Optional[float] = None,
                  breaker_k: int = 5,
                  breaker_cooldown_s: float = 5.0,
@@ -231,6 +232,20 @@ class InferenceServer:
         # REPLICA_WEDGE): consulted at the top of every HTTP request —
         # inert injectors cost two None-compares per request
         self.faults = faults if faults is not None else FaultInjector.from_env()
+        # drift-triggered continuous training (flywheel/): armed by
+        # flywheel_every_s > 0, one controller per promotion-gated,
+        # workdir-backed model. Shares the server's logger (resilience_
+        # stream), tracer (episode spans beside request spans), and fault
+        # injector (DEEPVISION_FAULT_DRIFT_SHIFT rehearsals).
+        self.flywheels: list = []
+        if flywheel_every_s > 0:
+            from ..flywheel.controller import attach_flywheels
+            attach_flywheels(fleet, logger=self.logger, tracer=self.tracer,
+                             tick_every_s=flywheel_every_s,
+                             faults=self.faults,
+                             warn=lambda msg: print(msg, flush=True))
+            self.flywheels = [sm.flywheel for sm in fleet
+                              if sm.flywheel is not None]
 
     # -- metrics -----------------------------------------------------------
 
@@ -274,6 +289,8 @@ class InferenceServer:
         for sm in self.fleet:
             if sm.promoter is not None:
                 sm.promoter.abort()
+        for fw in self.flywheels:
+            fw.stop()
         self.autoscaler.stop()
         self.reloader.stop()
         print(f"[serve:{self.engine.name}] graceful drain: rejecting new "
@@ -283,6 +300,8 @@ class InferenceServer:
         return self.flush_metrics(reset=False)
 
     def close(self) -> None:
+        for fw in self.flywheels:
+            fw.stop()
         self.autoscaler.stop()
         self.reloader.stop()
         self.fleet.drain()
@@ -305,6 +324,8 @@ class InferenceServer:
                               what=DRAIN_WHAT) as gs:
             self.reloader.start()
             self.autoscaler.start()
+            for fw in self.flywheels:
+                fw.start()
             http_thread.start()
             self.ready.set()
             print(f"[serve:{self.engine.name}] listening on "

@@ -110,6 +110,27 @@ and in-process tests configure it the same way:
                                              of epoch k (the refusal cache,
                                              not the injector, is what stops
                                              re-evaluation)
+    DEEPVISION_FAULT_DRIFT_SHIFT=w:mag       make the flywheel drift monitor
+                                             (flywheel/drift.py) see a moved
+                                             input distribution: every
+                                             serving input SAMPLED at the
+                                             batcher observer tap from
+                                             reservoir window w onward
+                                             (0-based) is shifted by the
+                                             constant `mag` before it enters
+                                             the live statistics — the
+                                             per-channel moment comparison
+                                             against the pinned calibration
+                                             shard must cross its gate and,
+                                             after the hysteresis windows,
+                                             trigger a retrain. Deliberately
+                                             NOT one-shot and not a single
+                                             window: real drift persists,
+                                             and the K-consecutive-window
+                                             hysteresis only trips on a
+                                             shift that stays — a rehearsal
+                                             of a transient spike arms a
+                                             LATER window than it feeds
 
 An unset environment yields an inert injector (`active` False) whose hooks
 are cheap no-ops — production runs pay two integer compares per batch.
@@ -159,6 +180,34 @@ def _parse_promote_regress(raw: Optional[str]
     return int(epoch), kind
 
 
+def _parse_drift_shift(raw: Optional[str]) -> Tuple[Optional[int], float]:
+    if not raw:
+        return None, 0.0
+    window, _, magnitude = raw.partition(":")
+    try:
+        w = int(window)
+    except ValueError:
+        raise ValueError(
+            f"DEEPVISION_FAULT_DRIFT_SHIFT window must be an int "
+            f"(got {window!r}); expected <window>:<magnitude>")
+    if not magnitude:
+        raise ValueError(
+            "DEEPVISION_FAULT_DRIFT_SHIFT needs an explicit magnitude "
+            "(<window>:<magnitude>) — a zero-magnitude shift would arm a "
+            "fault that can never fire")
+    try:
+        m = float(magnitude)
+    except ValueError:
+        raise ValueError(
+            f"DEEPVISION_FAULT_DRIFT_SHIFT magnitude must be a float "
+            f"(got {magnitude!r}); expected <window>:<magnitude>")
+    if m == 0.0:
+        raise ValueError(
+            "DEEPVISION_FAULT_DRIFT_SHIFT magnitude must be non-zero — a "
+            "zero shift arms a fault that can never fire")
+    return w, m
+
+
 class FaultInjector:
     """Process-local fault state: counters advance as the hooks are called,
     so a fault fires at a deterministic batch/save index and then clears —
@@ -173,6 +222,8 @@ class FaultInjector:
                  ckpt_corrupt_mode: Optional[str] = None,
                  promote_regress_epoch: Optional[int] = None,
                  promote_regress_kind: Optional[str] = None,
+                 drift_shift_window: Optional[int] = None,
+                 drift_shift_magnitude: float = 0.0,
                  quant_regress: bool = False,
                  serve_dispatch_fail_at: Optional[int] = None,
                  serve_dispatch_fail_count: int = 1,
@@ -187,6 +238,10 @@ class FaultInjector:
         self.ckpt_corrupt_mode = ckpt_corrupt_mode
         self.promote_regress_epoch = promote_regress_epoch
         self.promote_regress_kind = promote_regress_kind
+        self.drift_shift_window = drift_shift_window
+        self.drift_shift_magnitude = (float(drift_shift_magnitude)
+                                      if drift_shift_window is not None
+                                      else 0.0)
         self.quant_regress = bool(quant_regress)
         self.serve_dispatch_fail_at = serve_dispatch_fail_at
         self.serve_dispatch_fail_count = (serve_dispatch_fail_count
@@ -215,6 +270,8 @@ class FaultInjector:
             env.get("DEEPVISION_FAULT_CKPT_CORRUPT"))
         regress_epoch, regress_kind = _parse_promote_regress(
             env.get("DEEPVISION_FAULT_PROMOTE_REGRESS"))
+        drift_window, drift_magnitude = _parse_drift_shift(
+            env.get("DEEPVISION_FAULT_DRIFT_SHIFT"))
         quant_regress = env.get("DEEPVISION_FAULT_QUANT_REGRESS",
                                 "") not in ("", "0")
         dispatch_at, dispatch_count = _parse_step_count(
@@ -231,6 +288,8 @@ class FaultInjector:
                    ckpt_corrupt_mode=corrupt_mode,
                    promote_regress_epoch=regress_epoch,
                    promote_regress_kind=regress_kind,
+                   drift_shift_window=drift_window,
+                   drift_shift_magnitude=drift_magnitude,
                    quant_regress=quant_regress,
                    serve_dispatch_fail_at=dispatch_at,
                    serve_dispatch_fail_count=dispatch_count,
@@ -245,6 +304,7 @@ class FaultInjector:
                 or self.ckpt_save_fails > 0 or self.ckpt_async_fails > 0
                 or self.ckpt_corrupt_epoch is not None
                 or self.promote_regress_epoch is not None
+                or self.drift_shift_window is not None
                 or self.quant_regress
                 or self.serve_dispatch_fail_at is not None
                 or self.replica_crash_after is not None
@@ -376,6 +436,21 @@ class FaultInjector:
         if epoch is None or epoch != self.promote_regress_epoch:
             return None
         return self.promote_regress_kind
+
+    def drift_shift(self, window_index: int) -> float:
+        """Called by the flywheel drift monitor (flywheel/drift.py) as each
+        sampled serving input enters the live reservoir: returns the
+        constant to ADD to the sample when reservoir window `window_index`
+        has reached the armed window, else 0.0. Deliberately NOT one-shot —
+        real drift persists, and the monitor's K-consecutive-window
+        hysteresis must see the shift on every window from `w` on to
+        trigger (a single-window transient is exactly what hysteresis
+        exists to reject, and a test proves that by arming a window the
+        rehearsal never reaches again)."""
+        if (self.drift_shift_window is None
+                or window_index < self.drift_shift_window):
+            return 0.0
+        return self.drift_shift_magnitude
 
     def corrupt_checkpoint(self, epoch: int, step_dir: str,
                            manifest_name: str = "integrity_manifest.json"

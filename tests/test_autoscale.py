@@ -475,7 +475,8 @@ def test_observer_exceptions_counted_not_swallowed(engine):
     m = ServingMetrics()
     b = DynamicBatcher(engine, max_delay_ms=1.0, metrics=m)
 
-    def broken_observer(generation, latencies, dispatch_s, error):
+    def broken_observer(generation, latencies, dispatch_s, error,
+                        sample=None):
         raise ValueError("tap exploded")
 
     b.observer = broken_observer

@@ -205,3 +205,49 @@ def test_mesh_bench_record_schema():
     assert rec["jit_cache_entries"] == 0
     assert rec["largest_servable"]["fits_mesh"] >= \
         rec["largest_servable"]["fits_single_chip"]
+
+
+def test_flywheel_bench_record_schema():
+    """`bench_serve.py --flywheel` must emit one bench.py-schema line
+    carrying time-to-detect, time-to-promoted (the headline value), the
+    goodput-through-the-episode ratio, the episode's flywheel_id, and the
+    zero-shed/zero-failed/zero-recompile audit fields — the CI-side pin
+    for the flywheel bench, checked against the pure record builder so
+    the bench itself (an engine, a fine-tune, a canary window) isn't paid
+    for here."""
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", os.path.join(TOOLS, "..", "bench_serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.flywheel_record(
+        model_name="lenet5", platform="cpu", max_batch=8,
+        time_to_detect_s=0.118, time_to_promoted_s=6.128,
+        goodput_rps_steady=1036.7, goodput_rps_episode=351.0,
+        detect_windows=2, hysteresis_windows=2, finetune_epoch=2,
+        decision="promoted", flywheel_id="fw-bf05e1a5b66d",
+        responses_total=4870, responses_failed=0, shed_requests=0,
+        recompiles=0,
+        counters={"retrains": 1, "promoted": 1, "refused": 0,
+                  "rolled_back": 0, "circuit_opened": 0},
+        compile_cache={"hits": 0, "misses": 0})
+    # the bench.py core schema every bench line shares
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, key
+    assert json.loads(json.dumps(rec)) == rec   # one JSON-printable line
+    # the flywheel-specific pins: the headline is time-to-promoted, the
+    # ratio is episode goodput over steady state, and the hard-bar audit
+    # fields are present and zeroed
+    assert rec["value"] == rec["time_to_promoted_s"] == 6.128
+    assert rec["unit"] == "sec"
+    assert rec["vs_baseline"] == round(351.0 / 1036.7, 3)
+    assert rec["time_to_detect_s"] == 0.118
+    assert rec["decision"] == "promoted"
+    assert rec["flywheel_id"].startswith("fw-")
+    assert rec["responses_failed"] == 0
+    assert rec["shed_requests"] == 0
+    assert rec["recompiles"] == 0
+    assert rec["counters"]["promoted"] == 1
+    assert rec["detect_windows"] >= rec["hysteresis_windows"]
+    assert "drift-fault" in rec["metric"]

@@ -331,13 +331,16 @@ def test_roofline_family_steps(capsys):
 # itself every round, so the fast lane re-running it buys nothing
 @pytest.mark.slow
 def test_preflight_tool(tmp_path):
-    """tools/preflight.py: all nineteen checks (incl. the jaxlint gate,
+    """tools/preflight.py: all twenty checks (incl. the jaxlint gate,
     the jaxvet IR-audit gate, the serving-stack smoke, the fleet/hot-reload
     cycle, the accuracy-gated promotion check, the int8 quantization gate
     — clean arm enables int8, the fault-armed regression is refused and
     logged — the overload-control autoscale/breaker check, the
     observability check — request-id echo, Prometheus /metrics validation,
-    /trace span-chain — the replica-tier check — SIGKILL one of two
+    /trace span-chain — the flywheel check — injected drift confirmed
+    through the hysteresis streak, one bounded fine-tune promoted through
+    the shadow/canary gate with zero recompiles — the replica-tier check
+    — SIGKILL one of two
     replicas mid-traffic with zero failed responses, supervised
     readmission, then a clean epoch rolled replica-by-replica — the
     segmentation-family gate, the
@@ -367,14 +370,14 @@ def test_preflight_tool(tmp_path):
     ok = subprocess.run(base, capture_output=True, text=True, timeout=600,
                         env=env, cwd=str(tmp_path))
     assert ok.returncode == 0, ok.stdout + ok.stderr[-1000:]
-    assert ok.stdout.count("PASS") == 19 and "FAIL" not in ok.stdout
+    assert ok.stdout.count("PASS") == 20 and "FAIL" not in ok.stdout
     assert json.loads(ok.stdout.strip().splitlines()[-1])["preflight"] == "pass"
 
     bad = subprocess.run(base + ["--input-floor", "1e12"],
                          capture_output=True, text=True, timeout=600, env=env,
                          cwd=str(tmp_path))
     assert bad.returncode == 1
-    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 18
+    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 19
     assert json.loads(bad.stdout.strip().splitlines()[-1])["preflight"] == "fail"
 
 

@@ -1,0 +1,402 @@
+"""The serve→train→serve flywheel (deepvision_tpu/flywheel/) on CPU.
+
+The contracts pinned here are the PR's acceptance criteria
+(docs/FAILURES.md "Flywheel decisions"):
+
+- a sustained injected input shift (DEEPVISION_FAULT_DRIFT_SHIFT) drives
+  the full loop in-process: drift monitor → bounded fine-tune → promotion
+  gate → promoted, with ZERO serve-path recompiles, zero failed requests,
+  and the drift reference rebaselined so the episode does not re-trigger;
+- one `flywheel_id`, minted at the drift event, appears on every
+  resilience event, every span, the promotion decision record, and the
+  /healthz flywheel record of that episode — one grep reconstructs it;
+- K-consecutive-window hysteresis: a single-window spike resets the
+  streak and never triggers;
+- a regressing candidate (the PROMOTE_REGRESS fault) ends the episode
+  `refused` with exponential backoff, each retry commits a NEW epoch
+  (the reloader's per-epoch refusal cache never wedges the loop), and
+  `max_attempts` consecutive failures open the retrain circuit — the
+  incumbent keeps serving throughout;
+- the batcher's extended observer tap (sample payload) keeps the
+  isolation guarantee: an observer that throws ON the new payload never
+  affects dispatches or futures.
+"""
+
+import glob
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config, trainer_class_for_config
+from deepvision_tpu.core import integrity
+from deepvision_tpu.core.metrics import MetricsLogger
+from deepvision_tpu.flywheel import (FLYWHEEL_STATES, DriftMonitor,
+                                     FlywheelController)
+from deepvision_tpu.obs.export import (render_prometheus,
+                                       validate_serve_exposition)
+from deepvision_tpu.obs.trace import Tracer
+from deepvision_tpu.serve.batcher import DynamicBatcher
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet
+from deepvision_tpu.serve.metrics import ServingMetrics
+from deepvision_tpu.serve.promote import PromotionController
+from deepvision_tpu.utils.faults import FaultInjector
+
+SAMPLE = (32, 32, 1)
+
+
+def _save_epoch(workdir, epoch, state=None, scale=None):
+    """Commit one manifested checkpoint epoch the way training does."""
+    trainer = trainer_class_for_config("lenet5")(get_config("lenet5"),
+                                                 workdir=workdir)
+    try:
+        trainer.init_state(SAMPLE)
+        st = state if state is not None else trainer.state
+        if scale is not None:
+            st = st.replace(params=jax.tree_util.tree_map(
+                lambda a: a * scale, st.params))
+        trainer.ckpt.save(epoch, st, {"best_metric": 0.0})
+        trainer.ckpt.flush()
+        return trainer.state
+    finally:
+        trainer.close()
+
+
+def _gated_model(workdir, *, logger=None):
+    engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                       buckets=(1, 4), verbose=False)
+    fleet = ModelFleet()
+    sm = fleet.add(engine, workdir=workdir, max_delay_ms=2.0)
+    promoter = PromotionController(sm, canary_frac=0.3, canary_window_s=0.1,
+                                   logger=logger)
+    return fleet, sm, promoter
+
+
+def _imgs(n, seed=0, shift=0.0):
+    x = np.random.RandomState(seed).randn(n, *SAMPLE).astype(np.float32)
+    return x + np.float32(shift)
+
+
+def _feed_window(sm, monitor, *, shift=0.0, n=4, seed=0):
+    """Push live traffic through the batcher until the monitor has one full
+    window buffered. The batcher settles futures BEFORE the observer tap
+    fires (results never wait on observers), so `.result()` returning does
+    not mean the sample landed — poll the buffer, don't assume."""
+    deadline = time.monotonic() + 60
+    i = 0
+    while time.monotonic() < deadline:
+        desc = monitor.describe()
+        if desc["buffered"] >= desc["window_examples"]:
+            return
+        sm.submit(_imgs(n, seed=seed + i, shift=shift)).result(timeout=120)
+        i += 1
+        settle = time.monotonic() + 2.0
+        while time.monotonic() < settle:
+            if monitor.describe()["buffered"] >= min(
+                    desc["buffered"] + n, desc["window_examples"]):
+                break
+            time.sleep(0.002)
+    raise AssertionError("monitor window never filled")
+
+
+@pytest.fixture()
+def run_with_epoch1(tmp_path):
+    workdir = str(tmp_path / "lenet5")
+    state1 = _save_epoch(workdir, 1)
+    return workdir, state1
+
+
+# -- construction contracts ---------------------------------------------------
+
+def test_flywheel_requires_workdir_and_gate(run_with_epoch1):
+    workdir, _ = run_with_epoch1
+    fleet = ModelFleet()
+    static = fleet.add(PredictEngine.from_config("lenet5", buckets=(1,),
+                                                 verbose=False))
+    try:
+        with pytest.raises(ValueError, match="static weights"):
+            FlywheelController(static, tick_every_s=0)
+    finally:
+        fleet.drain(timeout=30)
+    fleet2 = ModelFleet()
+    sm = fleet2.add(PredictEngine.from_config("lenet5", workdir=workdir,
+                                              buckets=(1,), verbose=False),
+                    workdir=workdir)
+    try:
+        with pytest.raises(ValueError, match="promotion controller"):
+            FlywheelController(sm, tick_every_s=0)
+    finally:
+        fleet2.drain(timeout=30)
+
+
+def test_drift_shift_fault_env_contract():
+    """The DEEPVISION_FAULT_DRIFT_SHIFT parse is loud on malformed specs
+    and round-trips through from_env."""
+    fi = FaultInjector.from_env({"DEEPVISION_FAULT_DRIFT_SHIFT": "3:2.5"})
+    assert fi.active
+    assert fi.drift_shift(2) == 0.0      # below the armed window
+    assert fi.drift_shift(3) == 2.5      # at it — and it PERSISTS
+    assert fi.drift_shift(9) == 2.5
+    for bad in ("x:1.0", "3", "3:", "3:abc", "3:0.0"):
+        with pytest.raises(ValueError, match="DEEPVISION_FAULT_DRIFT_SHIFT"):
+            FaultInjector.from_env({"DEEPVISION_FAULT_DRIFT_SHIFT": bad})
+
+
+# -- hysteresis: transients never trigger -------------------------------------
+
+def test_hysteresis_rejects_single_window_spike(run_with_epoch1):
+    """One breaching window followed by a clean one resets the streak; only
+    K CONSECUTIVE breaches mint a flywheel_id."""
+    workdir, _ = run_with_epoch1
+    fleet, sm, _ = _gated_model(workdir)
+    try:
+        monitor = DriftMonitor(sm, window_examples=8, sample_per_batch=4,
+                               hysteresis_windows=2)
+        # a transient spike: one shifted window, then clean traffic
+        _feed_window(sm, monitor, shift=5.0)
+        assert monitor.tick() is None
+        assert monitor.consecutive == 1 and monitor.breaches == 1
+        _feed_window(sm, monitor, shift=0.0)
+        assert monitor.tick() is None
+        assert monitor.consecutive == 0          # streak reset
+        assert monitor.triggered_id is None
+        # sustained drift: two consecutive shifted windows trigger
+        _feed_window(sm, monitor, shift=5.0)
+        assert monitor.tick() is None            # streak 1/2
+        _feed_window(sm, monitor, shift=5.0)
+        fid = monitor.tick()                     # streak 2/2: minted NOW
+        assert fid is not None and fid.startswith("fw-")
+        assert monitor.triggered_id == fid
+        assert monitor.tick() is None            # already triggered: no remint
+        desc = monitor.describe()
+        assert desc["windows"] == 4 and desc["breaches"] == 3
+    finally:
+        fleet.drain(timeout=30)
+
+
+# -- the full episode: drift -> finetune -> gate -> promoted ------------------
+
+def test_flywheel_episode_promotes_with_one_id_everywhere(
+        run_with_epoch1, tmp_path):
+    """The tentpole rehearsal: an injected sustained shift drives
+    monitor→finetune→gate→promote in-process. Zero serve recompiles, zero
+    failed requests, and the minted flywheel_id appears on the resilience
+    stream, the spans, the promotion decision, and /healthz."""
+    workdir, _ = run_with_epoch1
+    logger = MetricsLogger(str(tmp_path / "logs"), name="serve")
+    tracer = Tracer(sample=1.0)
+    fleet, sm, promoter = _gated_model(workdir, logger=logger)
+    engine = sm.engine
+    n_programs = len(engine.compile_log)
+    fw = FlywheelController(
+        sm, tick_every_s=0, logger=logger, tracer=tracer,
+        finetune_epochs=1, finetune_batches=2,
+        faults=FaultInjector(drift_shift_window=0,
+                             drift_shift_magnitude=3.0),
+        window_examples=8, sample_per_batch=4, hysteresis_windows=2)
+    assert sm.flywheel is fw
+    try:
+        states = []
+        for _ in range(4):
+            _feed_window(sm, fw.monitor)
+            states.append(fw.tick())
+            if "promoted" in states:
+                break
+        assert "promoted" in states, states
+        assert fw.state == "monitoring"          # episode closed cleanly
+        assert fw.counters["retrains"] == 1
+        assert fw.counters["promoted"] == 1
+        assert fw.failures == 0
+
+        # the fine-tuned epoch went live through the gate, zero recompiles
+        assert engine.provenance["checkpoint_epoch"] == 2
+        assert engine.provenance["verified"] is True
+        assert len(engine.compile_log) == n_programs
+        assert sm.reload_stats["reloads"] == 1
+
+        # ONE id across every surface of the episode
+        fid = fw.last_flywheel_id
+        assert fid and fid.startswith("fw-")
+        assert promoter.history[-1]["decision"] == "promoted"
+        assert promoter.history[-1]["flywheel_id"] == fid
+        health = sm.describe()["flywheel"]       # what /healthz renders
+        assert health["flywheel_id"] == fid
+        assert health["state"] == "monitoring"
+        assert health["counters"]["promoted"] == 1
+        span_names = {s["name"] for s in tracer.spans()
+                      if s["args"].get("flywheel_id") == fid}
+        assert {"flywheel_finetune", "flywheel_train_epoch",
+                "flywheel_gate"} <= span_names
+        jsonl = glob.glob(str(tmp_path / "logs" / "*.jsonl"))
+        assert jsonl
+        with open(jsonl[0]) as fp:
+            events = [json.loads(line) for line in fp if line.strip()]
+        tagged = [e for e in events if e.get("flywheel_id") == fid]
+        keys = {k for e in tagged for k in e}
+        # drift detection, every state transition, and the promotion
+        # verdict all joined on the one id
+        assert "resilience_flywheel_drift_detected" in keys
+        assert "resilience_flywheel_finetuning" in keys
+        assert "resilience_flywheel_gating" in keys
+        assert "resilience_flywheel_promoted" in keys
+        assert "resilience_promote_promoted" in keys
+
+        # rebaselined: the shifted distribution is the new normal — the
+        # same shift does not re-trigger
+        assert fw.monitor.triggered_id is None
+        _feed_window(sm, fw.monitor)
+        assert fw.tick() == "monitoring"
+        assert fw.counters["promoted"] == 1
+
+        # /metrics: the one-hot state gauge + episode counters render and
+        # the exposition stays valid under the shared validator
+        text = render_prometheus(fleet)
+        assert validate_serve_exposition(text) == []
+        assert ('deepvision_serve_flywheel_state'
+                '{model="lenet5",state="monitoring"} 1') in text
+        assert ('deepvision_serve_flywheel_episodes_total'
+                '{model="lenet5",outcome="promoted"} 1') in text
+        for state in FLYWHEEL_STATES:
+            assert f'state="{state}"' in text
+    finally:
+        fleet.drain(timeout=30)
+        logger.close()
+
+
+# -- failure path: refused -> backoff -> circuit ------------------------------
+
+class _AlwaysRegress(FaultInjector):
+    """Every candidate epoch regresses — the per-epoch PROMOTE_REGRESS
+    fault generalized so each retry's NEW epoch still fails the gate."""
+
+    def promote_regression(self, epoch):
+        return "accuracy"
+
+
+def test_refused_candidates_back_off_then_open_circuit(
+        run_with_epoch1, tmp_path):
+    workdir, _ = run_with_epoch1
+    logger = MetricsLogger(str(tmp_path / "logs"), name="serve")
+    fleet, sm, promoter = _gated_model(workdir, logger=logger)
+    promoter.faults = _AlwaysRegress()
+    engine = sm.engine
+    x = _imgs(2, seed=11)
+    ref_old = engine.predict(x)
+    fw = FlywheelController(
+        sm, tick_every_s=0, logger=logger,
+        finetune_epochs=1, finetune_batches=2,
+        max_attempts=2, backoff_base_s=0.2, backoff_max_s=5.0,
+        faults=FaultInjector(drift_shift_window=0,
+                             drift_shift_magnitude=3.0),
+        window_examples=8, sample_per_batch=4, hysteresis_windows=2)
+    try:
+        # episode 1: drift confirmed, fine-tune commits epoch 2, gate
+        # refuses it -> backoff armed
+        _feed_window(sm, fw.monitor)
+        assert fw.tick() == "monitoring"
+        _feed_window(sm, fw.monitor)
+        assert fw.tick() == "refused"
+        assert fw.failures == 1
+        assert fw.counters["refused"] == 1
+        assert promoter.history[-1]["decision"] == "refused_gate"
+        fid1 = fw.last_flywheel_id
+        assert promoter.history[-1]["flywheel_id"] == fid1
+        assert fw.describe()["backoff_s"] > 0.0
+
+        # while backing off, confirmed drift does NOT start an episode
+        _feed_window(sm, fw.monitor)
+        _feed_window(sm, fw.monitor)
+        assert fw.tick() == "refused"
+        assert fw.tick() == "refused"
+        assert fw.episodes == 1
+
+        # backoff expires -> retry commits a NEW epoch (3) — the refusal
+        # cache on epoch 2 never wedges the loop — and the second refusal
+        # trips max_attempts: the retrain circuit OPENS
+        time.sleep(0.25)
+        deadline = time.monotonic() + 60
+        while fw.state != "circuit_open" and time.monotonic() < deadline:
+            _feed_window(sm, fw.monitor)
+            fw.tick()
+        assert fw.state == "circuit_open"
+        assert fw.counters["circuit_opened"] == 1
+        assert fw.counters["refused"] == 2
+        assert fw.episodes == 2
+        assert promoter.history[-1]["epoch"] == 3    # a NEW epoch per retry
+        committed = integrity.committed_epochs(os.path.join(workdir, "ckpt"))
+        assert set(committed) == {1, 2, 3}
+
+        # open circuit: no more retraining, loud state, incumbent serving
+        evals = promoter.shadow_evals
+        _feed_window(sm, fw.monitor)
+        assert fw.tick() == "circuit_open"
+        assert promoter.shadow_evals == evals        # nothing re-evaluated
+        assert engine.provenance["checkpoint_epoch"] == 1
+        np.testing.assert_array_equal(engine.predict(x), ref_old)
+        assert logger.history["resilience_flywheel_circuit_open"][
+            "value"] == [1.0]
+
+        # operator re-arm: monitoring resumes, drift must re-confirm
+        fw.reset_circuit()
+        assert fw.state == "monitoring"
+        assert fw.failures == 0
+        assert fw.monitor.triggered_id is None
+    finally:
+        fleet.drain(timeout=30)
+        logger.close()
+
+
+# -- the batcher tap: sample payload + isolation ------------------------------
+
+def test_observer_sample_payload_and_isolation():
+    """The extended observer tap hands out (references to) the batch's
+    inputs/outputs — and an observer that THROWS on the new payload still
+    never affects dispatches or futures (the observer_errors isolation
+    guarantee, re-pinned over the sample argument)."""
+    engine = PredictEngine.from_config("lenet5", buckets=(1, 4),
+                                       verbose=False)
+    metrics = ServingMetrics()
+    seen = []
+
+    def greedy_observer(gen, lats, disp, err, sample=None):
+        seen.append((gen, sample))
+        raise RuntimeError("observer exploded on the sample payload")
+
+    batcher = DynamicBatcher(engine, max_delay_ms=2.0, metrics=metrics)
+    batcher.observer = greedy_observer
+    try:
+        x = _imgs(3, seed=5)
+        ref = engine.reference(x)
+        for _ in range(3):
+            out = batcher.submit(x).result(timeout=120)
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    finally:
+        batcher.drain(timeout=30)
+    # the payload reached the observer before it blew up: references to
+    # the dispatched inputs and the settled outputs, tagged live
+    assert len(seen) == 3
+    for gen, sample in seen:
+        assert gen == "live"
+        assert sample is not None
+        assert sample["images"].shape == (3, *SAMPLE)
+        assert sample["outputs"] is not None
+        assert "trace_ref" in sample
+    # counted loudly, deduplicated to one resilience key, zero lost work
+    assert metrics.totals()["observer_errors"] == 3
+    assert len(batcher._observer_errors_seen) == 1
+
+
+# -- CLI surface --------------------------------------------------------------
+
+def test_flywheel_cli_flag_contract():
+    from deepvision_tpu.serve.cli import main
+
+    with pytest.raises(SystemExit):   # the flywheel needs the gate
+        main(["-m", "lenet5", "--flywheel-every", "1"])
+    with pytest.raises(SystemExit):   # and a sane cadence
+        main(["-m", "lenet5", "--reload-every", "1",
+              "--promote-gate", "-0.02", "--flywheel-every", "-1"])

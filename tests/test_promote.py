@@ -140,7 +140,7 @@ def test_batcher_never_mixes_generations():
         lambda a: np.asarray(a) * 1.2, live))
     batches = []
     batcher = DynamicBatcher(engine, max_delay_ms=20.0)
-    batcher.observer = (lambda gen, lats, disp, err:
+    batcher.observer = (lambda gen, lats, disp, err, sample=None:
                         batches.append((gen, len(lats), err)))
     try:
         x = _imgs(1, seed=3)

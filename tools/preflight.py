@@ -53,6 +53,16 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               after K consecutive errors, fail fast, and close through
               a half-open probe — the two loops that keep a traffic
               spike (or a broken dispatch path) from becoming an outage
+  flywheel    serve->train->serve flywheel (docs/FAILURES.md "Flywheel
+              decisions"): the deterministic DRIFT_SHIFT fault must move
+              the live input moments past the drift gate for the full
+              hysteresis streak, the confirmed drift must fine-tune one
+              bounded epoch through the model's own trainer, and the
+              candidate must promote through the existing shadow->canary
+              gate with zero serve-path recompiles, one flywheel_id on
+              the promotion record, and the drift reference rebaselined
+              — the drift->retrain->promote loop has to close BEFORE
+              production leans on --flywheel-every
   obs         observability (docs/OBSERVABILITY.md): serve a model over
               HTTP, POST a request with an explicit X-Request-Id and
               assert the id is echoed, scrape GET /metrics twice (the
@@ -614,6 +624,93 @@ def check_autoscale(args):
         batcher.drain(timeout=60)
     return (f"shed -> scale-up to {workers} workers (zero recompiles) -> "
             f"absorbed; breaker opened after 3 faults, probe closed it")
+
+
+@check("flywheel")
+def check_flywheel(args):
+    # the serve->train->serve flywheel end to end (docs/FAILURES.md
+    # "Flywheel decisions"): the deterministic DRIFT_SHIFT fault must move
+    # the monitor's live window moments past the input gate for the
+    # hysteresis streak, the confirmed drift must run one bounded
+    # fine-tune episode through the model's own trainer, and the
+    # candidate must promote through the existing shadow->canary gate
+    # with the AOT bucket cache reused (zero recompiles) and the drift
+    # reference rebaselined — the loop that answers drift with a gated
+    # retrain instead of a page has to close BEFORE production leans
+    # on --flywheel-every.
+    import shutil
+
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config, trainer_class_for_config
+    from deepvision_tpu.flywheel import FlywheelController
+    from deepvision_tpu.serve.engine import PredictEngine
+    from deepvision_tpu.serve.fleet import ModelFleet
+    from deepvision_tpu.serve.promote import PromotionController
+    from deepvision_tpu.utils.faults import FaultInjector
+
+    tmpdir = tempfile.mkdtemp(prefix="preflight_flywheel_")
+    fleet = None
+    try:
+        workdir = os.path.join(tmpdir, "lenet5")
+        trainer = trainer_class_for_config("lenet5")(
+            get_config("lenet5"), workdir=workdir)
+        try:
+            trainer.init_state((32, 32, 1))
+            trainer.ckpt.save(1, trainer.state, {"best_metric": 0.0})
+            trainer.ckpt.flush()
+        finally:
+            trainer.close()
+        fleet = ModelFleet()
+        engine = PredictEngine.from_config("lenet5", workdir=workdir,
+                                           buckets=(1, 4), verbose=False)
+        sm = fleet.add(engine, workdir=workdir, max_delay_ms=5.0)
+        PromotionController(sm, canary_frac=0.25, canary_window_s=0.2)
+        fw = FlywheelController(
+            sm, tick_every_s=0, finetune_epochs=1, finetune_batches=2,
+            faults=FaultInjector(drift_shift_window=0,
+                                 drift_shift_magnitude=3.0),
+            window_examples=8, sample_per_batch=4, hysteresis_windows=2)
+        n_programs = len(engine.compile_log)
+        x = np.random.RandomState(0).randn(
+            4, *engine.example_shape).astype(engine.input_dtype)
+
+        deadline = time.perf_counter() + 120.0
+        state = fw.state
+        while fw.counters["promoted"] == 0:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"flywheel never promoted: state={state} "
+                    f"{fw.monitor.describe()}")
+            sm.submit(x).result(timeout=60)
+            # the batcher settles futures BEFORE the observer tap fires;
+            # wait for a full window rather than assuming ingestion
+            if fw.monitor.describe()["buffered"] < 8:
+                time.sleep(0.01)
+                continue
+            state = fw.tick()
+
+        if engine.provenance["checkpoint_epoch"] != 2:
+            raise RuntimeError(f"fine-tuned epoch did not go live: "
+                               f"{engine.provenance}")
+        if len(engine.compile_log) != n_programs:
+            raise RuntimeError("the flywheel episode recompiled the "
+                               "serve-path bucket cache")
+        fid = fw.last_flywheel_id
+        if not fid or sm.promoter.history[-1].get("flywheel_id") != fid:
+            raise RuntimeError(f"flywheel_id not threaded through the "
+                               f"promotion decision: {fid!r} vs "
+                               f"{sm.promoter.history[-1]}")
+        if fw.state != "monitoring" or fw.monitor.triggered_id is not None:
+            raise RuntimeError(f"episode did not close back to monitoring "
+                               f"+ rebaseline: {fw.describe()}")
+    finally:
+        if fleet is not None:
+            fleet.drain(timeout=60)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return (f"injected drift confirmed over 2 windows -> fine-tuned epoch "
+            f"2 promoted through the gate ({fid}, zero recompiles), "
+            f"reference rebaselined")
 
 
 @check("obs")
@@ -1371,6 +1468,7 @@ def main(argv=None):
     check_promote(args)
     check_quant(args)
     check_autoscale(args)
+    check_flywheel(args)
     check_obs(args)
     check_tier(args)
     check_segment(args)
