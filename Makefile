@@ -13,7 +13,8 @@ VERIFY_FILES = tests/test_multihost.py tests/test_preemption.py \
         bench-serve-mesh bench-serve-load \
         bench-serve-promote bench-serve-spike bench-serve-trace \
         bench-serve-tier bench-serve-flywheel \
-        bench-input bench-epoch dryrun smoke seg-smoke serve-smoke \
+        bench-input bench-epoch bench-attn dryrun smoke seg-smoke \
+        vit-smoke serve-smoke \
         serve-fleet-smoke serve-tier-smoke flywheel-smoke \
         preflight preflight-record \
         lint lint-changed lint-concurrency \
@@ -122,6 +123,14 @@ bench-epoch: ## dispatch amortization: per-step vs steps_per_dispatch=k vs
 	## double-buffered staging overlap proof (one JSON line, exit 1 on
 	## any gate; docs/INPUT_PIPELINE.md "On-device epochs")
 	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_epoch.py
+
+bench-attn:  ## fused (Pallas flash) vs naive attention at the seq-196 ViT
+	## working point: HBM-bytes cut on the jaxvet walker proxy gated at
+	## 2x, bf16/f32 parity gated at 2e-2/2e-5, zero recompiles across a
+	## promotion cycle with the fused kernel armed; CPU wall-clock rides
+	## along with its regime note (one JSON line, exit 1 on any gate;
+	## docs/ATTENTION.md)
+	env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu $(PY) bench_attn.py
 
 serve-smoke: ## serving-stack smoke: bucketed AOT cache, micro-batcher,
 	## metrics, graceful drain — synthetic load, exit 0 on pass
@@ -232,3 +241,8 @@ seg-smoke:   ## one epoch of the segmentation family on synthetic
 	## shapes-and-masks scenes (docs/SEGMENTATION.md) — prints val mIoU
 	env $(CPU_ENV) $(PY) UNet/jax/train.py -m unet_synthetic --epochs 1 \
 	    --batch-size 16
+
+vit-smoke:   ## one synthetic epoch of the ViT family (naive attention on
+	## CPU; the fused-kernel bars live in `make bench-attn`)
+	env $(CPU_ENV) $(PY) ViT/jax/train.py -m vit_tiny --synthetic \
+	    --epochs 1

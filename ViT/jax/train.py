@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Train ViT models on TPU — `python train.py -m <model> [-c latest] [--synthetic]`.
+
+Per-family entrypoint matching the other families' UX (LeNet/jax/train.py),
+backed by the shared deepvision_tpu Trainer. The attention lowering is
+per-config (`model_kwargs.attention_impl`): "auto" resolves to the Pallas
+flash kernel on TPU and the naive einsum elsewhere (ops/attention.py,
+docs/ATTENTION.md).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from deepvision_tpu.cli import run_classification
+
+MODELS = ["vit_tiny", "vit_small"]
+
+if __name__ == "__main__":
+    run_classification("ViT", MODELS)

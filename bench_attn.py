@@ -1,0 +1,214 @@
+"""Benchmark: fused (Pallas flash) vs naive attention — bytes, parity, serving.
+
+Prints ONE JSON line in bench.py's schema ({"metric", "value", "unit",
+"vs_baseline", ...}). `value` is the HBM-bytes cut of the fused lowering at
+the ViT working point the kernel is tiled for (B=8, H=6, N=196, D=64, bf16 —
+a 224px/16px-patch ViT-Small's attention op), measured on the jaxvet
+walker's fusion-blind bytes proxy (check/jaxpr_walk.cost_summary): the naive
+lowering is charged every equation's operands and results — including both
+(N, N) HBM materializations of the score matrix — while the pallas_call is
+charged exactly its per-program block DMAs. `vs_baseline` divides the cut by
+the 2x bar.
+
+Hard gates (exit 1 on violation — the kernel's correctness and serving
+contract, not throughput bars):
+
+- bytes cut >= 2x at the seq-196 working point (the kernel's reason to
+  exist: the (N, N) softmax chain never reaches HBM);
+- fused-vs-naive parity <= 2e-2 at bf16 and <= 2e-5 at f32 on identical
+  inputs (docs/ATTENTION.md derives why bf16 parity is a one-rounding
+  story: both paths accumulate in f32, naive rounds its scores once);
+- zero recompiles across a stage -> predict -> promote cycle on a ViT
+  engine with the fused kernel armed (interpret mode — the same kernel
+  jaxpr the TPU path compiles) — promotion must reuse every AOT bucket.
+
+steps/sec rides along HONESTLY: on CPU the fused kernel runs under the
+Pallas interpreter, whose unrolled per-program bodies are far slower than
+the naive XLA fusion, so `steps_per_sec.fused / steps_per_sec.naive` is
+WELL BELOW 1 here. That mirrors docs/TUNING.md item 8's dispatch-axis
+lesson inverted: the fused win is proportional to what the fusion removes
+(HBM round-trips of the (N, N) matrix), i.e. it lands exactly in the
+bandwidth-bound TPU regime the bytes proxy models — judge wall-clock on a
+real chip, judge bytes here.
+
+    python bench_attn.py                  # one JSON line
+    python bench_attn.py --batch 4 --heads 6 --seq 196 --head-dim 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BYTES_CUT_BAR = 2.0        # fused must at least halve the naive bytes proxy
+PARITY_BF16 = 2e-2         # one extra rounding of the naive scores (bf16)
+PARITY_F32 = 2e-5          # reassociation-only error (f32)
+
+
+def _bytes_proxy(b, h, n, d, dtype):
+    """Walker-proxy cost rows for the attention op alone, both lowerings."""
+    import jax
+
+    from deepvision_tpu.check.jaxpr_walk import cost_summary
+    from deepvision_tpu.ops.attention import attention
+
+    def jitted(impl):
+        return jax.jit(lambda q, k, v: attention(q, k, v, impl=impl))
+
+    sds = jax.ShapeDtypeStruct((b, h, n, d), dtype)
+    return {name: cost_summary(jitted(impl).trace(sds, sds, sds).jaxpr)
+            for name, impl in (("naive", "naive"), ("fused", "interpret"))}
+
+
+def _parity_and_speed(b, h, n, d, timed_calls):
+    """Max-abs parity at f32 and bf16 plus compiled calls/sec per lowering
+    (fused runs under the interpreter on CPU — see the module docstring for
+    why that wall-clock number is reported but not gated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.ops.attention import attention
+
+    def jitted(impl):
+        return jax.jit(lambda q, k, v: attention(q, k, v, impl=impl))
+
+    # jits hoisted out of the dtype/timing loops (factory pattern): one
+    # compiled callable per lowering, retraced only per input dtype
+    fns = {"naive": jitted("naive"), "interpret": jitted("interpret")}
+    parity = {}
+    speed = {}
+    for dtype, bound_name in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+        ks = jax.random.split(jax.random.PRNGKey(n + d), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, n, d), dtype) for kk in ks)
+        outs = {}
+        for impl, fn in fns.items():
+            out = jax.block_until_ready(fn(q, k, v))
+            outs[impl] = out.astype(jnp.float32)
+            if dtype == jnp.bfloat16:      # time the serving dtype only
+                t0 = time.perf_counter()
+                for _ in range(timed_calls):
+                    out = fn(q, k, v)
+                jax.block_until_ready(out)
+                key = "fused" if impl == "interpret" else impl
+                speed[key] = timed_calls / (time.perf_counter() - t0)
+        parity[bound_name] = float(
+            jnp.max(jnp.abs(outs["naive"] - outs["interpret"])))
+    return parity, speed
+
+
+def _promotion_recompiles():
+    """stage -> predict(candidate) -> promote -> predict on a ViT engine
+    with the fused kernel armed; returns (programs compiled at startup,
+    programs compiled after the cycle) — equal means zero recompiles."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.train_state import init_model
+    from deepvision_tpu.core.trainer import build_model_from_config
+    from deepvision_tpu.serve.engine import PredictEngine
+
+    cfg = get_config("vit_tiny")
+    # "interpret" arms the SAME fused kernel the TPU path compiles, under
+    # the Pallas interpreter — the engine's AOT buckets carry pallas_call
+    cfg = cfg.replace(model_kwargs={**cfg.model_kwargs,
+                                    "attention_impl": "interpret"})
+    model, cfg = build_model_from_config(cfg)
+    sz, ch = cfg.data.image_size, cfg.data.channels
+    params, batch_stats = init_model(model, jax.random.PRNGKey(cfg.seed),
+                                     jnp.zeros((2, sz, sz, ch), jnp.float32))
+    variables = {"params": params}
+    if jax.tree_util.tree_leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+    engine = PredictEngine(model.apply, variables,
+                           example_shape=(sz, sz, ch), buckets=(1, 8),
+                           compute_dtype=jnp.dtype(cfg.dtype),
+                           take_first_output=True, name=cfg.name,
+                           verbose=False)
+    n_startup = len(engine.compile_log)
+    x = np.random.RandomState(0).randn(2, sz, sz, ch).astype(np.float32)
+    live_out = engine.predict(x)
+    cand = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.01,
+                                  jax.device_get(engine._variables))
+    engine.stage_candidate(cand, {"verified": True})
+    engine.predict(x, generation="candidate")
+    engine.promote_candidate()
+    promoted_out = engine.predict(x)
+    assert not np.allclose(live_out, promoted_out)  # new weights really live
+    return n_startup, len(engine.compile_log)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=6)
+    p.add_argument("--seq", type=int, default=196,
+                   help="sequence length of the bytes/parity working point")
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--timed-calls", type=int, default=5)
+    args = p.parse_args(argv)
+
+    # bandwidth-model measurement: never implicitly claim a relayed TPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.cli import setup_compilation_cache
+    setup_compilation_cache()
+    platform = jax.devices()[0].platform
+
+    b, h, n, d = args.batch, args.heads, args.seq, args.head_dim
+    rows = _bytes_proxy(b, h, n, d, jnp.bfloat16)
+    cut = rows["naive"]["bytes"] / rows["fused"]["bytes"]
+    parity, speed = _parity_and_speed(b, h, n, d, args.timed_calls)
+    n_startup, n_after = _promotion_recompiles()
+
+    failures = []
+    if cut < BYTES_CUT_BAR:
+        failures.append(f"bytes cut {cut:.2f}x below the {BYTES_CUT_BAR}x "
+                        f"bar at seq {n}")
+    if parity["bf16"] > PARITY_BF16:
+        failures.append(f"bf16 parity {parity['bf16']:.3e} exceeds "
+                        f"{PARITY_BF16:.0e}")
+    if parity["f32"] > PARITY_F32:
+        failures.append(f"f32 parity {parity['f32']:.3e} exceeds "
+                        f"{PARITY_F32:.0e}")
+    if n_after != n_startup:
+        failures.append(f"promotion with fused armed compiled "
+                        f"{n_after - n_startup} new programs (want 0)")
+
+    print(json.dumps({
+        "metric": f"fused_attention_bytes_cut"
+                  f"(b{b},h{h},n{n},d{d},bf16,walker_proxy,{platform})",
+        "value": round(cut, 3),
+        "unit": "x_vs_naive",
+        "vs_baseline": round(cut / BYTES_CUT_BAR, 3),
+        "platform": platform,
+        "bytes_per_step": {"naive": rows["naive"]["bytes"],
+                           "fused": rows["fused"]["bytes"]},
+        "flops_per_step": {"naive": rows["naive"]["flops"],
+                           "fused": rows["fused"]["flops"]},
+        "parity_max_abs_err": {k: round(v, 8) for k, v in parity.items()},
+        # honest CPU wall-clock: interpreter-mode fused vs XLA naive.
+        # The regime note is the point (docs/TUNING.md item 8's lesson,
+        # attention edition): this ratio inverts on hardware whose HBM
+        # round-trips the fusion actually removes.
+        "attn_calls_per_sec": {k: round(v, 2) for k, v in speed.items()},
+        "cpu_regime_note": "fused runs under the Pallas interpreter on "
+                           "CPU; judge wall-clock on a real chip, judge "
+                           "bytes here",
+        "promotion_programs": {"startup": n_startup, "after_cycle": n_after},
+        "timed_calls": args.timed_calls,
+    }))
+    if failures:
+        for f in failures:
+            print(f"bench_attn: FAIL {f}", file=sys.stderr, flush=True)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,19 +44,21 @@ def audit(names: Optional[Sequence[str]] = None,
     # re-emit its verdicts under the alias's unit names. The sweep still
     # reports one unit set PER REGISTERED NAME (the registry-hygiene
     # non-vacuity contract); it just doesn't pay for the same jaxpr twice.
-    # "spatial" / "epoch" / "quant" / "mesh" are pseudo-targets: the
-    # collective probes, the epoch-scan units, the int8 predict twins, and
-    # the mesh-sharded predict units (all part of every full sweep; naming
-    # one audits that layer alone)
+    # "spatial" / "epoch" / "quant" / "mesh" / "attn" are pseudo-targets:
+    # the collective probes, the epoch-scan units, the int8 predict twins,
+    # the mesh-sharded predict units, and the attention-lowering units
+    # (all part of every full sweep; naming one audits that layer alone)
     full_sweep = not names
     spatial_only = bool(names) and "spatial" in names
     epoch_only = bool(names) and "epoch" in names
     quant_only = bool(names) and "quant" in names
     mesh_only = bool(names) and "mesh" in names
-    pseudo_only = spatial_only or epoch_only or quant_only or mesh_only
+    attn_only = bool(names) and "attn" in names
+    pseudo_only = (spatial_only or epoch_only or quant_only or mesh_only
+                   or attn_only)
     if pseudo_only:
         names = [n for n in names
-                 if n not in ("spatial", "epoch", "quant", "mesh")]
+                 if n not in ("spatial", "epoch", "quant", "mesh", "attn")]
     requested = (list(names) if names
                  else ([] if pseudo_only else CONFIGS.names()))
     canonical: dict = {}     # config-identity -> first name seen
@@ -84,7 +86,8 @@ def audit(names: Optional[Sequence[str]] = None,
                             spatial=full_sweep or spatial_only,
                             epoch=full_sweep or epoch_only,
                             quant=full_sweep or quant_only,
-                            mesh_serve=full_sweep or mesh_only):
+                            mesh_serve=full_sweep or mesh_only,
+                            attn=full_sweep or attn_only):
         audited.append(unit.name)
         if unit.quant is not None:
             quant_facts[unit.name] = dict(unit.quant)
@@ -235,10 +238,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..configs import CONFIGS
     bad = [n for n in args.configs
            if n not in CONFIGS
-           and n not in ("spatial", "epoch", "quant", "mesh")]
+           and n not in ("spatial", "epoch", "quant", "mesh", "attn")]
     if bad:
         print(f"usage error: unknown config(s): {', '.join(bad)}; known: "
-              f"spatial, epoch, quant, mesh, {', '.join(CONFIGS.names())}",
+              f"spatial, epoch, quant, mesh, attn, "
+              f"{', '.join(CONFIGS.names())}",
               file=sys.stderr)
         return EXIT_USAGE
     if args.update_cost and args.configs:

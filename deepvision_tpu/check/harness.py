@@ -96,6 +96,19 @@ def _optimizer_for(cfg):
                            cfg.total_epochs)
 
 
+def _pin_trace_impls(cfg):
+    """Committed COST rows must be platform-independent, but a config whose
+    `attention_impl` is "auto" resolves by backend at trace time (fused on
+    TPU, naive elsewhere) — an audit run on a TPU host would drift every
+    ViT row. Pin "auto" to the portable naive lowering for the config
+    units; the fused lowering has its own committed rows via the attn/
+    unit family (traced through the interpreter, same jaxpr)."""
+    if cfg.model_kwargs.get("attention_impl") == "auto":
+        cfg = cfg.replace(model_kwargs={**cfg.model_kwargs,
+                                        "attention_impl": "naive"})
+    return cfg
+
+
 def _family_setup(cfg):
     """(model, config, sample SDS, input images SDS, input_norm) shared by
     every supervised family — the host pipeline's uint8-vs-f32 contract
@@ -103,6 +116,7 @@ def _family_setup(cfg):
     from ..core.config import UNIT_RANGE_NORM
     from ..core.trainer import build_model_from_config
 
+    cfg = _pin_trace_impls(cfg)
     kwarg = "num_heatmap" if cfg.family == "pose" else "num_classes"
     model, cfg = build_model_from_config(cfg, num_classes_kwarg=kwarg)
     sz, ch = cfg.data.image_size, cfg.data.channels
@@ -399,6 +413,7 @@ def _serve_unit(name, cfg) -> TracedUnit:
     from ..core.steps import _normalize_input
     from ..core.trainer import build_model_from_config
 
+    cfg = _pin_trace_impls(cfg)
     kwarg = "num_heatmap" if cfg.family == "pose" else "num_classes"
     model, cfg = build_model_from_config(cfg, num_classes_kwarg=kwarg)
     sz, ch = cfg.data.image_size, cfg.data.channels
@@ -458,7 +473,7 @@ def _serve_unit(name, cfg) -> TracedUnit:
 # rides inside the scanned step). Fixed scan length: the COST rows scale
 # linearly with it (scan bodies are trip-weighted), so the baseline stays a
 # pure function of the package source.
-EPOCH_UNIT_CONFIGS = ("lenet5", "unet_synthetic")
+EPOCH_UNIT_CONFIGS = ("lenet5", "unet_synthetic", "vit_tiny")
 EPOCH_SCAN_LEN = 4
 
 
@@ -528,7 +543,7 @@ def _epoch_scan_units() -> List[TracedUnit]:
 # the tiny fixed config preflight's `quant` gate runs. The quantization
 # PLAN is structural, so the audit needs no calibration data — unit
 # activation scales stand in (scale VALUES never change the jaxpr shape).
-QUANT_UNIT_CONFIGS = ("lenet5", "resnet50")
+QUANT_UNIT_CONFIGS = ("lenet5", "resnet50", "vit_tiny")
 
 
 def quant_unit_names() -> List[str]:
@@ -563,7 +578,7 @@ def _quant_unit(cname: str) -> TracedUnit:
     from ..configs import get_config
     from ..ops import quant as quant_lib
 
-    cfg = get_config(cname)
+    cfg = _pin_trace_impls(get_config(cname))
     model, cfg = build_model_from_config(cfg)
     sz, ch = cfg.data.image_size, cfg.data.channels
     dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
@@ -604,7 +619,95 @@ def _quant_unit(cname: str) -> TracedUnit:
         head_dims=head,
         quant={"planned": len(plan.eqns),
                "skipped_head": plan.skipped_head,
+               # the declared float-attention budget: QK^T/PV contractions
+               # have no weight operand and deliberately stay float — the
+               # QUANT rule allows exactly this many float heavy eqns
+               "skipped_attention": plan.skipped_attention,
+               "fused_attention": plan.fused_attention,
                "baseline_unit": f"{cname}/serve"})
+
+
+# -- attention-lowering units (naive vs Pallas fused) -------------------------
+
+# The ViT serve predict traced under BOTH attention lowerings
+# (ops/attention.py): the naive einsum path (what CPU serving runs) and the
+# Pallas flash kernel (what TPU serving runs — traced via the interpreter
+# impl, whose jaxpr is structurally identical to the compiled kernel's, so
+# the committed COST rows are a pure function of the package source on any
+# host). The pair is the audit-level pin of the kernel's whole point: the
+# fused row's bytes proxy must undercut the naive row's (the (N, N) softmax
+# chain never reaches HBM) while both carry the same serving contract —
+# bench_attn.py enforces the ratio, these rows keep it reviewable PR over PR.
+ATTN_UNIT_CONFIG = "vit_tiny"
+ATTN_IMPLS = ("naive", "fused")
+# Traced at 112 px, not vit_tiny's 32: with patch 8 that is 14 x 14 + cls =
+# 197 tokens — the seq ~196 regime the kernel is tiled for. At vit_tiny's
+# native 17 tokens the pad-to-BLOCK_K panel (128 keys) would dominate the
+# fused row's DMA bytes and the pair would pin the wrong lesson (padding
+# overhead, not the (N, N) HBM cut — TUNING.md's regime rule, attention
+# edition; docs/ATTENTION.md spells out the crossover).
+ATTN_AUDIT_IMAGE = 112
+
+
+def attn_unit_names() -> List[str]:
+    """The audit units the attention-lowering pair contributes — pinned by
+    the cost-baseline coverage test next to the per-config unit names."""
+    return [f"attn/{ATTN_UNIT_CONFIG}/{impl}" for impl in ATTN_IMPLS]
+
+
+def _attn_units() -> List[TracedUnit]:
+    units: List[TracedUnit] = []
+    for impl in ATTN_IMPLS:
+        name = f"attn/{ATTN_UNIT_CONFIG}/{impl}"
+        try:
+            units.append(_attn_unit(name, impl))
+        except Exception as e:
+            units.append(TracedUnit(name, "", "predict",
+                                    error=f"{type(e).__name__}: {e}"))
+    return units
+
+
+def _attn_unit(name: str, impl: str) -> TracedUnit:
+    """The ViT serve predict pinned to one attention lowering."""
+    from ..core.config import UNIT_RANGE_NORM
+    from ..core.steps import _normalize_input
+    from ..core.trainer import build_model_from_config
+    from ..configs import get_config
+
+    cfg = get_config(ATTN_UNIT_CONFIG)
+    # "fused" is traced through the interpreter impl: same kernel, same
+    # grid/block structure, platform-independent jaxpr
+    traced_impl = "interpret" if impl == "fused" else impl
+    cfg = cfg.replace(
+        model_kwargs={**cfg.model_kwargs, "attention_impl": traced_impl},
+        data=dataclasses.replace(cfg.data, image_size=ATTN_AUDIT_IMAGE))
+    model, cfg = build_model_from_config(cfg)
+    sz, ch = cfg.data.image_size, cfg.data.channels
+    dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
+    input_norm = UNIT_RANGE_NORM if cfg.data.normalize_on_device else None
+    in_dtype = jnp.uint8 if input_norm is not None else jnp.float32
+
+    variables = jax.eval_shape(
+        lambda r, x: model.init({"params": r,
+                                 "dropout": jax.random.fold_in(r, 1)},
+                                x, train=True),
+        S((2,), jnp.uint32), S((2, sz, sz, ch), jnp.float32))
+
+    def predict(vars_, images):   # mirrors PredictEngine.__init__'s predict
+        x = _normalize_input(images, input_norm, dt)
+        out = model.apply(vars_, x, train=False)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return jax.tree_util.tree_map(
+            lambda y: y.astype(jnp.float32)
+            if jnp.issubdtype(y.dtype, jnp.floating) else y, out)
+
+    closed, donated, outs = _trace(jax.jit(predict), variables,
+                                   S((AUDIT_BATCH, sz, sz, ch), in_dtype))
+    return TracedUnit(
+        name, "", "predict", closed, donated, outs,
+        meta={"donate": False, "compute_dtype": dt, "kind": "predict"},
+        head_dims=_head_dims(cfg))
 
 
 # -- mesh-sharded (GSPMD) predict units ---------------------------------------
@@ -617,7 +720,7 @@ def _quant_unit(cname: str) -> TracedUnit:
 # topology keeps the jaxpr and the analytic per-chip bytes a pure function
 # of the package source on any host with >= 2 devices; 1-device hosts skip
 # gracefully (same env-skew pattern as the spatial shard_map step).
-MESH_SERVE_CONFIGS = ("lenet5", "resnet50")
+MESH_SERVE_CONFIGS = ("lenet5", "resnet50", "vit_tiny")
 MESH_SERVE_MODEL_AXIS = 2
 
 
@@ -662,7 +765,7 @@ def _mesh_serve_unit(name: str, cname: str) -> TracedUnit:
                     f"model-parallel serve mesh (have {devs.size})")
     mesh = mesh_lib.make_mesh(devs[:MESH_SERVE_MODEL_AXIS],
                               model_parallel=MESH_SERVE_MODEL_AXIS)
-    cfg = get_config(cname)
+    cfg = _pin_trace_impls(get_config(cname))
     model, cfg = build_model_from_config(cfg)
     sz, ch = cfg.data.image_size, cfg.data.channels
     dt = jnp.dtype(cfg.dtype) if cfg.dtype else jnp.bfloat16
@@ -834,7 +937,8 @@ def config_unit_names(name: str) -> List[str]:
 def build_units(names: Optional[List[str]] = None,
                 progress: Optional[Callable[[str], None]] = None,
                 spatial: bool = True, epoch: bool = True,
-                quant: bool = True, mesh_serve: bool = True):
+                quant: bool = True, mesh_serve: bool = True,
+                attn: bool = True):
     """Yield TracedUnits for the named configs (default: whole registry,
     plus the spatial collective probes and the epoch-scan units). Each
     unit's jaxpr is yielded and then released by the caller — keeping the
@@ -880,6 +984,10 @@ def build_units(names: Optional[List[str]] = None,
         gc.collect()
     if quant:
         for u in _quant_units():
+            yield u
+        gc.collect()
+    if attn:
+        for u in _attn_units():
             yield u
         gc.collect()
     if mesh_serve:

@@ -26,26 +26,73 @@ COLLECTIVE_PRIMS = frozenset({
 HEAVY_PRIMS = frozenset({"conv_general_dilated", "dot_general"})
 
 
-def _sub_jaxprs(eqn: JaxprEqn) -> Iterator[Tuple[Jaxpr, int]]:
-    """(inner jaxpr, trip multiplier) pairs nested in an equation's params.
-    scan bodies multiply by `length`; everything else counts once (while
-    bodies have no static trip count — counted once, an explicit floor)."""
-    mult = eqn.params.get("length", 1) if eqn.primitive.name == "scan" else 1
+def pallas_grid_size(eqn: JaxprEqn) -> int:
+    """Total program count of a pallas_call: prod of its grid axes (1 for a
+    gridless call). The kernel body runs once per program, so this is the
+    trip multiplier for every equation inside it — the exact analog of
+    scan's `length`."""
+    gm = eqn.params.get("grid_mapping")
+    grid = getattr(gm, "grid", ()) or ()
+    size = 1
+    for g in grid:
+        size *= int(g) if isinstance(g, (int, np.integer)) else 1
+    return size
+
+
+def pallas_block_bytes(eqn: JaxprEqn) -> int:
+    """HBM traffic of a pallas_call under the walker's fusion-blind proxy:
+    per grid program, each operand/result block is DMAed between HBM and
+    VMEM once — grid_size × Σ prod(block_shape)·itemsize over the block
+    mappings. Everything INSIDE the kernel (score tiles, running softmax
+    stats) lives in VMEM/registers and never touches HBM, which is the whole
+    point of fusing — so kernel-body equations contribute zero bytes and the
+    call's cost is exactly its block transfers."""
+    gm = eqn.params.get("grid_mapping")
+    size = pallas_grid_size(eqn)
+    total = 0
+    for bm in getattr(gm, "block_mappings", ()) or ():
+        shape = tuple(int(d) if isinstance(d, (int, np.integer)) else 1
+                      for d in getattr(bm, "block_shape", ()) or ())
+        sds = getattr(bm, "array_shape_dtype", None)
+        dtype = getattr(sds, "dtype", None)
+        try:
+            itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        except TypeError:
+            itemsize = 4
+        total += int(math.prod(shape)) * itemsize
+    return size * total
+
+
+def _sub_jaxprs(eqn: JaxprEqn) -> Iterator[Tuple[Jaxpr, int, bool]]:
+    """(inner jaxpr, trip multiplier, is_pallas_kernel) triples nested in an
+    equation's params. scan bodies multiply by `length`; pallas kernel bodies
+    multiply by the grid size (one run per grid program); everything else
+    counts once (while bodies have no static trip count — counted once, an
+    explicit floor)."""
+    name = eqn.primitive.name
+    if name == "scan":
+        mult, kernel = eqn.params.get("length", 1), False
+    elif name == "pallas_call":
+        mult, kernel = pallas_grid_size(eqn), True
+    else:
+        mult, kernel = 1, False
     for value in eqn.params.values():
         for item in (value if isinstance(value, (list, tuple)) else (value,)):
             if isinstance(item, ClosedJaxpr):
-                yield item.jaxpr, mult
+                yield item.jaxpr, mult, kernel
             elif isinstance(item, Jaxpr):
-                yield item, mult
+                yield item, mult, kernel
 
 
-def iter_eqns(jaxpr: Jaxpr, _mult: int = 1) -> Iterator[Tuple[JaxprEqn, int]]:
-    """Depth-first (eqn, trip multiplier) over a jaxpr and every nested
-    sub-jaxpr (pjit bodies, scan/while/cond, custom_vjp, remat)."""
+def iter_eqns(jaxpr: Jaxpr, _mult: int = 1,
+              _in_kernel: bool = False) -> Iterator[Tuple[JaxprEqn, int, bool]]:
+    """Depth-first (eqn, trip multiplier, inside-pallas-kernel) over a jaxpr
+    and every nested sub-jaxpr (pjit bodies, scan/while/cond, custom_vjp,
+    remat, pallas kernel bodies)."""
     for eqn in jaxpr.eqns:
-        yield eqn, _mult
-        for sub, mult in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub, _mult * mult)
+        yield eqn, _mult, _in_kernel
+        for sub, mult, kernel in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, _mult * mult, _in_kernel or kernel)
 
 
 def _aval_bytes(aval) -> int:
@@ -74,7 +121,7 @@ def _axes_of(eqn: JaxprEqn) -> Tuple[str, ...]:
 def collect_collectives(closed: ClosedJaxpr) -> Dict[Tuple[str, Tuple[str, ...]], int]:
     """{(primitive, axes): count} over the whole (nested) jaxpr."""
     out: Dict[Tuple[str, Tuple[str, ...]], int] = {}
-    for eqn, mult in iter_eqns(closed.jaxpr):
+    for eqn, mult, _in_kernel in iter_eqns(closed.jaxpr):
         if eqn.primitive.name in COLLECTIVE_PRIMS:
             key = (eqn.primitive.name, _axes_of(eqn))
             out[key] = out.get(key, 0) + mult
@@ -102,16 +149,22 @@ def _dot_flops(eqn: JaxprEqn) -> int:
     return 2 * int(math.prod(out.shape)) * int(k)
 
 
-def heavy_eqns(closed: ClosedJaxpr) -> List[Tuple[JaxprEqn, int, int]]:
-    """(eqn, trip multiplier, flops) for every conv/dot in the jaxpr."""
+def heavy_eqns(closed: ClosedJaxpr) -> List[Tuple[JaxprEqn, int, int, bool]]:
+    """(eqn, trip multiplier, flops, inside-pallas-kernel) for every
+    conv/dot in the jaxpr — including dots inside pallas kernel bodies,
+    whose multiplier carries the grid size (each program contracts one tile,
+    so grid × tile-flops is the kernel's true MXU work and fused COST rows
+    stay comparable to naive ones). The kernel flag lets policy rules
+    (DTYPE) treat in-VMEM register precision separately from HBM-visible
+    compute."""
     out = []
-    for eqn, mult in iter_eqns(closed.jaxpr):
+    for eqn, mult, in_kernel in iter_eqns(closed.jaxpr):
         name = eqn.primitive.name
         if name not in HEAVY_PRIMS:
             continue
         flops = _conv_flops(eqn) if name == "conv_general_dilated" \
             else _dot_flops(eqn)
-        out.append((eqn, mult, flops))
+        out.append((eqn, mult, flops, in_kernel))
     return out
 
 
@@ -130,17 +183,31 @@ def cost_summary(closed: ClosedJaxpr) -> Dict[str, int]:
 
     Literals (inline scalars) are skipped; consts are counted once via the
     outer jaxpr's constvars.
+
+    pallas_call is NOT an opaque zero-cost call: its kernel body contributes
+    grid-weighted FLOPs and equation counts like any scan body, but zero
+    bytes — everything inside the kernel lives in VMEM/registers. The call
+    itself is charged its block transfers (`pallas_block_bytes`): per grid
+    program, each operand/result block crosses HBM↔VMEM once. That is what
+    makes a fused-attention COST row comparable to the naive lowering's — the
+    naive (N, N) softmax chain is charged at every equation, the kernel only
+    at its tile DMAs.
     """
     flops = 0
     nbytes = 0
     n_eqns = 0
-    for eqn, mult in iter_eqns(closed.jaxpr):
+    for eqn, mult, in_kernel in iter_eqns(closed.jaxpr):
         n_eqns += mult
+        if in_kernel:
+            continue  # VMEM traffic, not HBM — charged via the block DMAs
+        if eqn.primitive.name == "pallas_call":
+            nbytes += mult * pallas_block_bytes(eqn)
+            continue
         io = sum(_aval_bytes(v.aval) for v in eqn.invars
                  if not isinstance(v, Literal))
         io += sum(_aval_bytes(v.aval) for v in eqn.outvars)
         nbytes += mult * io
-    for eqn, mult, f in heavy_eqns(closed):
+    for _eqn, mult, f, _in_kernel in heavy_eqns(closed):
         flops += mult * f
     nbytes += sum(_aval_bytes(v.aval) for v in closed.jaxpr.constvars)
     return {"flops": int(flops), "bytes": int(nbytes), "eqns": int(n_eqns)}

@@ -336,6 +336,42 @@ CONFIGS.register("unet_digits", TrainConfig(
 ))
 
 
+# -- Vision Transformer (Dosovitskiy 2021; ROADMAP item 2 — the first
+#    non-ConvNet family, pairing with the Pallas fused-attention kernel in
+#    ops/attention.py). `attention_impl="auto"` lowers the flash kernel on
+#    TPU and the naive einsum path on CPU (docs/ATTENTION.md).
+#
+#    vit_tiny: the CPU-feasible smoke/parity/preflight surface on the
+#    synthetic loader — 32px / patch 8 → 17 tokens, d=192, 3 heads of 64.
+#    Internal dims (192/768/17) avoid num_classes (10) so
+#    `serving_head_dims` stays unambiguous for the dtype and quant rules. ----
+CONFIGS.register("vit_tiny", TrainConfig(
+    name="vit_tiny", model="vit", batch_size=32, total_epochs=4,
+    model_kwargs={"patch_size": 8, "embed_dim": 192, "depth": 4,
+                  "num_heads": 3, "mlp_dim": 768, "attention_impl": "auto"},
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+    schedule=ScheduleConfig(name="constant"),
+    data=DataConfig(dataset="synthetic", image_size=32, channels=3,
+                    num_classes=10, train_examples=512, val_examples=128),
+))
+
+# -- ViT-Small/16 on the flattened-dir ImageNet loader (DeiT-style recipe:
+#    AdamW-ish adam + cosine warmup; 224px / patch 16 → 197 tokens, d=384,
+#    6 heads of 64 — the seq length the bench pins (196 patches + cls)). ----
+CONFIGS.register("vit_small", TrainConfig(
+    name="vit_small", model="vit", batch_size=256, total_epochs=90,
+    model_kwargs={"patch_size": 16, "embed_dim": 384, "depth": 8,
+                  "num_heads": 6, "mlp_dim": 1536, "dropout_rate": 0.1,
+                  "attention_impl": "auto"},
+    optimizer=OptimizerConfig(name="adam", learning_rate=1e-3,
+                              weight_decay=5e-2, grad_clip_norm=1.0),
+    schedule=ScheduleConfig(name="cosine", warmup_epochs=5),
+    label_smoothing=0.1,
+    data=DataConfig(dataset="imagenet_flat", image_size=224, num_classes=1000,
+                    train_examples=1281167, val_examples=50000),
+))
+
+
 def get_config(name: str) -> TrainConfig:
     return CONFIGS.get(name)
 

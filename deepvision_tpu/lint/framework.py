@@ -429,6 +429,13 @@ def traced_functions(module: Module) -> Set[ast.AST]:
     for node in ast.walk(module.tree):
         if isinstance(node, ast.Call) and module.resolve(node.func) in TRACE_FNS:
             for arg in node.args:
+                # `pallas_call(functools.partial(kernel, ...), ...)` — the
+                # kernel-binding idiom of ops/attention.py: the partial's
+                # target runs under the trace exactly like a bare name
+                if (isinstance(arg, ast.Call)
+                        and module.resolve(arg.func) == "functools.partial"
+                        and arg.args):
+                    arg = arg.args[0]
                 if isinstance(arg, ast.Lambda):
                     traced.add(arg)
                 elif isinstance(arg, ast.Name):
@@ -447,6 +454,34 @@ def traced_functions(module: Module) -> Set[ast.AST]:
                 if module.resolve(target) in TRACE_FNS:
                     traced.add(node)
     return traced
+
+
+def partial_bound_statics(module: Module) -> Dict[int, Set[str]]:
+    """For each directly-traced def (by node id), the parameter names a
+    `functools.partial` at the trace call site binds to concrete values —
+    trace-time statics, not tracers (the partial's keywords plus the
+    leading positionals it fills). Branching on these inside the kernel is
+    the normal block-size specialization idiom, so the seed taint in
+    `compute_trace_reach` excludes them."""
+    statics: Dict[int, Set[str]] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and module.resolve(node.func) in TRACE_FNS):
+            continue
+        for arg in node.args:
+            if not (isinstance(arg, ast.Call)
+                    and module.resolve(arg.func) == "functools.partial"
+                    and arg.args and isinstance(arg.args[0], ast.Name)):
+                continue
+            fd = find_local_def(module, node, arg.args[0].id)
+            fd_args = getattr(fd, "args", None)
+            if fd is None or fd_args is None:
+                continue
+            bound = {kw.arg for kw in arg.keywords if kw.arg}
+            pos = fd_args.posonlyargs + fd_args.args
+            bound |= {a.arg for a in pos[:len(arg.args) - 1]}
+            statics.setdefault(id(fd), set()).update(bound)
+    return statics
 
 
 def traced_closure(module: Module, traced: Set[ast.AST]) -> Set[ast.AST]:
@@ -755,6 +790,7 @@ def compute_trace_reach(graph: CallGraph) -> Dict[int, ReachedFn]:
             work.append(info)
 
     for module in graph.modules:
+        statics = partial_bound_statics(module)
         for fn in traced_closure(module, traced_functions(module)):
             info = graph.info(fn)
             if info is None:  # lambdas: no params worth tracking, no calls
@@ -766,7 +802,7 @@ def compute_trace_reach(graph: CallGraph) -> Dict[int, ReachedFn]:
                 if args.vararg:
                     params.add(args.vararg.arg)
                 params |= {a.arg for a in args.kwonlyargs}
-            add(info, params, seed=True)
+            add(info, params - statics.get(id(fn), set()), seed=True)
 
     while work:
         caller = work.pop()

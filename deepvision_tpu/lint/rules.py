@@ -514,8 +514,11 @@ def check_trc001(module: Module, index: ProjectIndex,
         for entry in index.reached_in(module):
             if isinstance(entry.info.node, ast.Lambda):
                 continue  # a lambda body has no if/while statements
+            # seeds carry their own taint set too: all params EXCEPT the
+            # trace-time statics a functools.partial binds at the call
+            # site (framework.partial_bound_statics)
             _check_traced_fn(module, entry.info.node, findings,
-                             initial=None if entry.seed else entry.tainted)
+                             initial=entry.tainted)
     else:
         for fn in traced_closure(module, traced_functions(module)):
             if isinstance(fn, ast.Lambda):

@@ -1,6 +1,6 @@
 """Model zoo — importing this package registers all models in MODELS."""
 
 from . import (alexnet, centernet, gan, hourglass, inception, lenet,  # noqa: F401
-               mobilenet, resnet, segment, shufflenet, vgg, yolo)
+               mobilenet, resnet, segment, shufflenet, vgg, vit, yolo)
 
 from ..utils.registry import MODELS  # noqa: F401

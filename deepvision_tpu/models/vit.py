@@ -1,0 +1,113 @@
+"""Vision Transformer (Dosovitskiy et al. 2021, "An Image is Worth 16x16
+Words"), the first non-ConvNet family in the zoo.
+
+Patchify (strided Conv) → learned cls token + position embedding → pre-LN
+transformer encoder → LayerNorm → f32 classification head.  The attention hot
+path dispatches through `ops.attention.attention`: `attention_impl="auto"`
+picks the Pallas flash kernel on TPU and the naive einsum lowering elsewhere;
+"fused"/"interpret"/"naive" pin it (tests trace both lowerings — see
+docs/ATTENTION.md for the fallback matrix).
+
+QKV/out/MLP projections are explicit `nn.Dense` layers so the int8 PTQ
+planner's weight provenance survives (per-out-channel scales cover per-head:
+the out axis is heads × head_dim).  The head runs in f32 like every other
+family (`serving_head_dims` keys off num_classes — internal dims must not
+collide, so embed/mlp/seq dims avoid 10 and 1000).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..utils.registry import MODELS
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with explicit Q/K/V/out Dense projections."""
+
+    num_heads: int
+    attention_impl: str = "auto"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, n, c = x.shape
+        h = self.num_heads
+        d = c // h
+
+        def split(y):
+            return y.reshape(b, n, h, d).transpose(0, 2, 1, 3)
+
+        q = split(nn.Dense(c, dtype=self.dtype, name="query")(x))
+        k = split(nn.Dense(c, dtype=self.dtype, name="key")(x))
+        v = split(nn.Dense(c, dtype=self.dtype, name="value")(x))
+        out = attention(q, k, v, impl=self.attention_impl)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="out")(out)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        y = MultiHeadAttention(self.num_heads, self.attention_impl,
+                               self.dtype, name="attn")(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=not train)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_out")(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=not train)
+        return x + y
+
+
+@MODELS.register("vit")
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 8
+    embed_dim: int = 192
+    depth: int = 4
+    num_heads: int = 3
+    mlp_dim: int = 768
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        b = x.shape[0]
+        p = self.patch_size
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.embed_dim)
+
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.embed_dim))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype),
+                              (b, 1, self.embed_dim)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.embed_dim))
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=not train)
+
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dropout_rate,
+                             self.attention_impl, self.dtype,
+                             name=f"block{i}")(x, train=train)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = x[:, 0].astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -1,0 +1,206 @@
+"""Fused multi-head attention: a flash-style Pallas TPU kernel vs naive einsum.
+
+Attention is the op mix XLA fuses worst: the naive lowering materializes the
+(N, N) score matrix in HBM twice (once for QK^T, once for the softmax-ed
+probabilities) before the PV contraction reads it back.  The kernel below
+follows the tiling discipline proven in `ops/pallas_kernels.py` for the YOLO
+IoU hot spot: each grid program owns one (BLOCK_Q, D) query tile plus the full
+(padded) K/V panel in VMEM and runs the online-softmax recurrence over
+BLOCK_K-sized key tiles — running row max `m`, running denominator `l`, and a
+rescaled PV accumulator — so no (N, N) tile ever exists outside VMEM.
+
+Invariant (see docs/ATTENTION.md): after key tile j,
+    acc = sum_{i<=j} exp(s_i - m_j) @ v_i,   l = sum_{i<=j} exp(s_i - m_j) 1
+and `acc / l` equals softmax(QK^T * scale) @ V exactly in infinite precision;
+in f32 the reassociation error is bounded by the tests in tests/test_vit.py.
+
+Inside the kernel, softmax statistics and both contractions accumulate in f32
+regardless of input dtype (`preferred_element_type`) — VMEM-resident, so the
+policy checker never sees it. The naive path instead runs its einsums AT the
+operand dtype and promotes only the (elementwise) softmax to f32: explicit f32
+dot outputs would push f32 cotangents through the einsum transposes and put
+f32 matmuls into a declared-bf16 train step. bf16 parity between the two
+lowerings is therefore a rounding story (one extra rounding of the naive
+scores), bounded by tests/test_vit.py.
+
+CPU fallback: `interpret=True` runs the same kernel under the Pallas
+interpreter (tests, preflight); `impl="naive"` is the pure-XLA path.
+`DEEPVISION_NO_PALLAS=1` forces naive even on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+
+#: Default tile sizes. 128 keys/queries per tile keeps the score tile at
+#: (128, 128) f32 = 64 KiB, far under VMEM, and aligns both axes to the lane
+#: width so Mosaic never pads internally.
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def naive_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference dot-product attention on (B, H, N, D) operands.
+
+    The (N, N) score and probability matrices are materialized — this is
+    the baseline the walker's bytes proxy charges for.
+
+    Both contractions run AT the operand dtype: only the softmax is
+    promoted to f32 (elementwise, so it adds no f32 matmul to a bf16
+    step and its backward carries bf16 cotangents into both einsum
+    transposes — jaxvet's DTYPE rule audits exactly that). The MXU
+    accumulates bf16 products in f32 internally regardless, so dropping
+    `preferred_element_type` here costs one rounding of the scores, which
+    the bf16 parity bound in tests/test_vit.py covers.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    p = jax.nn.softmax(s.astype(jnp.float32) * scale, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, n_valid: int,
+                  scale: float):
+    """One (BLOCK_Q, D) query tile against all key tiles, online softmax.
+
+    q_ref: (1, 1, BLOCK_Q, Dp); k_ref/v_ref: (1, 1, Npad, Dp) — the full
+    padded panel for this (batch, head) program; o_ref: (1, 1, BLOCK_Q, Dp).
+    Padded key rows (index >= n_valid) are masked to -inf before the max/exp;
+    padded D lanes are zero so they add nothing to either contraction.
+    """
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # (bq, Dp)
+    n_pad = k_ref.shape[2]
+
+    def body(j, carry):
+        m, l, acc = carry
+        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kj, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        key_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(key_idx < n_valid, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)                     # rescale old running sums
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    bq = q.shape[0]
+    init = (jnp.full((bq, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((bq, 1), jnp.float32),
+            jnp.zeros(q.shape, jnp.float32))
+    _, l, acc = jax.lax.fori_loop(0, n_pad // block_k, body, init)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _fused_forward(q, k, v, *, scale: float, block_q: int, block_k: int,
+                   interpret: bool) -> jnp.ndarray:
+    """Pallas forward on (B, H, N, D): pad, tile, run the flash kernel.
+
+    Tiles directly on the 4D layout (grid (B, H, q_blocks)) — no reshape, no
+    nested jit — so the only HBM traffic beyond the block DMAs is the seq/lane
+    padding itself, and the walker's bytes proxy sees the kernel at its true
+    cost. Not jit-wrapped: callers are already inside jit (train/serve steps)
+    or wrap it themselves (bench); interpret mode also runs eagerly.
+    """
+    b, h, n, d = q.shape
+    n_extra = -n % max(block_q, block_k)
+    d_extra = -d % LANE
+    # lax.pad, not jnp.pad: the jnp wrapper traces as a nested pjit call
+    # whose operands the fusion-blind bytes proxy would double-charge
+    cfg = ((0, 0, 0), (0, 0, 0), (0, n_extra, 0), (0, d_extra, 0))
+    qp, kp, vp = (jax.lax.pad(x, jnp.zeros((), x.dtype), cfg)
+                  for x in (q, k, v))
+    np_, dp = n + n_extra, d + d_extra
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, n_valid=n,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, h, np_, dp), q.dtype),
+        grid=(b, h, np_ // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, dp), lambda i, j, l: (i, j, l, 0)),
+            pl.BlockSpec((1, 1, np_, dp), lambda i, j, l: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, np_, dp), lambda i, j, l: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, dp), lambda i, j, l: (i, j, l, 0)),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :n, :d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_attention(q, k, v, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
+                    interpret=False):
+    """Flash attention with a trainable VJP.
+
+    Forward is the Pallas kernel (no (N, N) HBM intermediate).  Backward
+    differentiates the mathematically-identical naive formulation — the flash
+    backward kernel is future work (docs/ATTENTION.md), so training pays the
+    naive backward bytes while serving stays fused.
+    """
+    return _fused_forward(q, k, v, scale=scale, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+
+
+def _fused_fwd(q, k, v, scale, block_q, block_k, interpret):
+    out = _fused_forward(q, k, v, scale=scale, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+    return out, (q, k, v)
+
+
+def _fused_bwd(scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: naive_attention(q_, k_, v_, scale=scale), q, k, v)
+    return vjp(g)
+
+
+fused_attention.defvjp(_fused_fwd, _fused_bwd)
+
+
+def resolve_impl(impl: str = "auto") -> str:
+    """Resolve "auto" to a concrete implementation for this backend.
+
+    TPU → "fused" (unless `DEEPVISION_NO_PALLAS=1`, the same escape hatch as
+    `best_iou_auto`); everything else → "naive".  "interpret" forces the
+    kernel under the Pallas interpreter on any backend (tests/preflight).
+    """
+    if impl != "auto":
+        return impl
+    if (jax.default_backend() == "tpu"
+            and os.environ.get("DEEPVISION_NO_PALLAS") != "1"):
+        return "fused"
+    return "naive"
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+              impl: str = "auto", scale: Optional[float] = None,
+              block_q: int = BLOCK_Q, block_k: int = BLOCK_K) -> jnp.ndarray:
+    """Multi-head attention on (B, H, N, D): softmax(QK^T·scale) @ V.
+
+    impl: "auto" | "naive" | "fused" | "interpret".  "fused" lowers the Pallas
+    kernel for the real TPU backend; "interpret" runs the identical kernel
+    under the interpreter (the CPU correctness path).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    impl = resolve_impl(impl)
+    if impl == "naive":
+        return naive_attention(q, k, v, scale=scale)
+    if impl in ("fused", "interpret"):
+        return fused_attention(q, k, v, scale, block_q, block_k,
+                               impl == "interpret")
+    raise ValueError(f"unknown attention impl {impl!r}")

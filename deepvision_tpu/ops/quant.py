@@ -79,6 +79,20 @@ MAX_ACC_TAPS = (2 ** 31 - 1) // (127 * 127)
 QMAX = 127.0
 
 
+class QuantRefusal(ValueError):
+    """PTQ refused the whole program, loudly, with a machine-readable
+    `reason` (surfaced on /healthz via the arm-time decision record,
+    serve/quantize.arm_int8). Raised instead of returning a plan that would
+    silently serve a model whose hot path cannot quantize — the ViT case:
+    attention's softmax-adjacent contractions are activation×activation
+    (no weight operand, nothing to hold scales for), so if the QKV/out/MLP
+    projections cannot be planned either, int8 would be a pure no-op lie."""
+
+    def __init__(self, message: str, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
 @dataclasses.dataclass(frozen=True)
 class QuantEqn:
     """One heavy equation the plan quantizes."""
@@ -102,6 +116,16 @@ class QuantPlan:
     n_var_leaves: int                  # leaves of the variables pytree
     skipped_head: int = 0              # heavy eqns exempted as f32 heads
     skipped_other: int = 0             # non-weight rhs / unsupported layout
+    # softmax-adjacent activation×activation contractions (QK^T, PV): no
+    # weight operand exists, so int8 would need calibrated scales on BOTH
+    # sides plus an int32 accumulator across the full key depth — skipped BY
+    # NAME so /healthz can report a ViT's float attention honestly instead
+    # of burying it in skipped_other
+    skipped_attention: int = 0
+    # attention already fused into a Pallas kernel (pallas_call in the
+    # trace): its contractions live in VMEM at the kernel's own precision
+    # and are not PTQ targets; counted so the decision record names them
+    fused_attention: int = 0
     act_scales: Optional[Dict[int, float]] = None   # eqn_index -> s_x
 
     @property
@@ -112,6 +136,8 @@ class QuantPlan:
         return {"quantized": len(self.eqns),
                 "skipped_head": self.skipped_head,
                 "skipped_other": self.skipped_other,
+                "skipped_attention": self.skipped_attention,
+                "fused_attention": self.fused_attention,
                 "calibrated": self.act_scales is not None}
 
 
@@ -181,6 +207,25 @@ def _eqn_dims(eqn) -> set:
     return dims
 
 
+def _contains_pallas(eqn) -> bool:
+    """True when a pallas_call hides anywhere under this equation's params
+    (the fused-attention custom_vjp wrapper is the zoo's only producer)."""
+    if eqn.primitive.name == "pallas_call":
+        return True
+    stack = [v for v in eqn.params.values()]
+    while stack:
+        item = stack.pop()
+        if isinstance(item, (list, tuple)):
+            stack.extend(item)
+            continue
+        inner = item.jaxpr if hasattr(item, "jaxpr") else item
+        if isinstance(inner, Jaxpr):
+            if any(e.primitive.name == "pallas_call" or _contains_pallas(e)
+                   for e in inner.eqns):
+                return True
+    return False
+
+
 def plan_quantization(closed, head_dims=frozenset()) -> QuantPlan:
     """Structural quantization plan over a predict jaxpr traced as
     `predict(variables, images)`. Abstract-safe: only shapes/dtypes and the
@@ -193,7 +238,7 @@ def plan_quantization(closed, head_dims=frozenset()) -> QuantPlan:
     # provenance: var -> variables leaf index, through dtype casts only
     prov: Dict[Any, int] = {v: i for i, v in enumerate(jaxpr.invars[:-1])}
     plan_eqns: List[QuantEqn] = []
-    skipped_head = skipped_other = 0
+    skipped_head = skipped_other = skipped_attention = fused_attention = 0
     for idx, eqn in enumerate(jaxpr.eqns):
         name = eqn.primitive.name
         if name in _CAST_PRIMS and not isinstance(eqn.invars[0], Literal):
@@ -203,13 +248,27 @@ def plan_quantization(closed, head_dims=frozenset()) -> QuantPlan:
                 prov[eqn.outvars[0]] = prov[src]
             continue
         if name not in HEAVY_PRIMS:
+            if name.startswith(("custom_vjp_call", "custom_jvp_call")) \
+                    and _contains_pallas(eqn):
+                fused_attention += 1
             continue
         lhs, rhs = eqn.invars[0], eqn.invars[1]
         lhs_aval, rhs_aval = _aval(lhs), _aval(rhs)
         if (isinstance(rhs, Literal) or rhs not in prov
                 or not jnp.issubdtype(lhs_aval.dtype, jnp.floating)
                 or not jnp.issubdtype(rhs_aval.dtype, jnp.floating)):
-            skipped_other += 1
+            # activation×activation float contraction with no weight operand
+            # on either side: the attention shape (QK^T, PV). Named so the
+            # serve decision record can say "attention stays float" instead
+            # of hiding it — and past the int32-accumulator bound these
+            # could not quantize even with dual activation scales.
+            if (not isinstance(rhs, Literal) and rhs not in prov
+                    and lhs not in prov
+                    and jnp.issubdtype(lhs_aval.dtype, jnp.floating)
+                    and jnp.issubdtype(rhs_aval.dtype, jnp.floating)):
+                skipped_attention += 1
+            else:
+                skipped_other += 1
             continue
         if head_dims & _eqn_dims(eqn):
             skipped_head += 1          # deliberate f32 head: stays float
@@ -226,8 +285,22 @@ def plan_quantization(closed, head_dims=frozenset()) -> QuantPlan:
             eqn_index=idx, prim=name, leaf_index=prov[rhs],
             w_reduce_axes=reduce_axes, scale_shape=scale_shape,
             out_broadcast=out_bcast))
+    if (skipped_attention or fused_attention) and not plan_eqns:
+        # a transformer whose projections could not be planned: int8 would
+        # quantize NOTHING while the name promises a byte cut — refuse, by
+        # name, rather than serve the lie (arm_int8 turns this into a
+        # refusal decision record on /healthz)
+        raise QuantRefusal(
+            f"attention program has {skipped_attention} float "
+            f"activation×activation contraction(s) and "
+            f"{fused_attention} fused kernel call(s) but ZERO quantizable "
+            f"projection weights — int8 serving would be a no-op; refusing "
+            f"rather than silently serving a half-quantized model",
+            reason="attention_projections_unquantizable")
     return QuantPlan(eqns=plan_eqns, n_var_leaves=n_leaves,
-                     skipped_head=skipped_head, skipped_other=skipped_other)
+                     skipped_head=skipped_head, skipped_other=skipped_other,
+                     skipped_attention=skipped_attention,
+                     fused_attention=fused_attention)
 
 
 # -- jaxpr replay -------------------------------------------------------------

@@ -61,6 +61,10 @@ FAULT_SCORE_DROP = 0.5
 
 QUANT_ENABLED = "int8_enabled"
 QUANT_REFUSED = "refused_regression"
+# the PLAN itself refused (ops/quant.QuantRefusal — e.g. a transformer whose
+# projections cannot quantize): no int8 twin exists at all, the engine keeps
+# serving bf16, and the named reason lands on /healthz
+QUANT_REFUSED_PLAN = "refused_plan"
 
 
 class Quantizer:
@@ -159,9 +163,28 @@ def arm_int8(engine, cfg=None, *,
     watch = scoring.watch_metric_name(cfg)
 
     # calibrate + compile the int8 bucket twins (one-time arm cost)
-    quantizer = Quantizer(engine._predict_fn, engine._variables,
-                          jnp.asarray(images),
-                          head_dims=scoring.serving_head_dims(cfg))
+    try:
+        quantizer = Quantizer(engine._predict_fn, engine._variables,
+                              jnp.asarray(images),
+                              head_dims=scoring.serving_head_dims(cfg))
+    except quant.QuantRefusal as exc:
+        # the plan refused by name (never silently serve a half-quantized
+        # transformer): loud record on stderr, the resilience stream, and
+        # /healthz — the engine is untouched, still serving bf16
+        decision = {
+            "decision": QUANT_REFUSED_PLAN,
+            "reason": exc.reason,
+            "detail": str(exc),
+            "watch": watch,
+            "secs": round(time.monotonic() - t0, 3),
+            "unix": time.time(),
+        }
+        engine.quant_decision = decision
+        log_resilience_event(logger, 1, {"quant_refused": 1.0})
+        print(f"[serve-quant:{engine.name}] {QUANT_REFUSED_PLAN} "
+              f"({exc.reason}): {exc} — serving bf16",
+              file=sys.stderr, flush=True)
+        return decision
     engine.enable_int8(quantizer, verbose=verbose)
 
     # the hard gate: identical pinned inputs, two precisions
@@ -182,6 +205,10 @@ def arm_int8(engine, cfg=None, *,
         "gate": abs(gate),
         "calibration_examples": int(np.shape(images)[0]),
         "quantized_eqns": quantizer.summary()["quantized"],
+        # the full plan split — in particular `skipped_attention`, the
+        # float softmax-adjacent contractions a ViT deliberately keeps
+        # (named on /healthz; never a silent half-quantization)
+        "plan": quantizer.summary(),
         "weight_bytes_bf16": quant.tree_nbytes(engine._variables),
         "weight_bytes_int8": quant.tree_nbytes(engine._qvariables),
         "secs": round(time.monotonic() - t0, 3),

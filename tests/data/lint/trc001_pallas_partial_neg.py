@@ -1,0 +1,22 @@
+"""TRC001 near miss: the same partial-bound kernel shape, but the branch is
+on the partial's STATIC keyword (a python int, fixed at trace time) — the
+normal way a kernel specializes on its block size."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref, *, block: int):
+    x = x_ref[...]
+    if block > 64:           # trace-time static from the partial binding
+        o_ref[...] = x
+    else:
+        o_ref[...] = -x
+
+
+def run(x):
+    return pl.pallas_call(
+        functools.partial(_kernel, block=128),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

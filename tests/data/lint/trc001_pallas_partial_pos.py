@@ -1,0 +1,23 @@
+"""TRC001 true positive through the pallas kernel-binding idiom: the kernel
+is passed to `pallas_call` as `functools.partial(kernel, ...)` (the
+ops/attention.py shape), so its body runs under the trace — a concrete
+bool on a ref-loaded value raises at trace time."""
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref, *, block: int):
+    x = x_ref[...]
+    if x.sum() > 0:          # tracer bool inside the traced kernel body
+        o_ref[...] = x
+    else:
+        o_ref[...] = -x
+
+
+def run(x):
+    return pl.pallas_call(
+        functools.partial(_kernel, block=128),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -370,14 +370,14 @@ def test_preflight_tool(tmp_path):
     ok = subprocess.run(base, capture_output=True, text=True, timeout=600,
                         env=env, cwd=str(tmp_path))
     assert ok.returncode == 0, ok.stdout + ok.stderr[-1000:]
-    assert ok.stdout.count("PASS") == 20 and "FAIL" not in ok.stdout
+    assert ok.stdout.count("PASS") == 21 and "FAIL" not in ok.stdout
     assert json.loads(ok.stdout.strip().splitlines()[-1])["preflight"] == "pass"
 
     bad = subprocess.run(base + ["--input-floor", "1e12"],
                          capture_output=True, text=True, timeout=600, env=env,
                          cwd=str(tmp_path))
     assert bad.returncode == 1
-    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 19
+    assert "FAIL input" in bad.stdout and bad.stdout.count("PASS") == 20
     assert json.loads(bad.stdout.strip().splitlines()[-1])["preflight"] == "fail"
 
 

@@ -69,6 +69,15 @@ def test_don001_through_factory_and_attr_idiom():
     assert "self.state" in findings[0].message
 
 
+def test_trace_reach_seeds_through_pallas_partial():
+    """The ops/attention.py kernel-binding idiom: a kernel passed to
+    `pallas_call` as `functools.partial(kernel, ...)` is traced — TRC001
+    must reach its body, and the partial's static keyword must stay a
+    trace-time constant (near miss clean)."""
+    assert "TRC001" in rules_in("trc001_pallas_partial_pos.py")
+    assert rules_in("trc001_pallas_partial_neg.py") == set()
+
+
 def test_inline_suppression():
     assert rules_in("suppress.py") == set()
 

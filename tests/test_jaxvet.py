@@ -52,11 +52,13 @@ def test_cost_baseline_covers_whole_registry():
     cost row for every traced unit of every registered config — the
     committed artifact IS the proof that sweep count equals registry
     count, refreshed every time the baseline is — plus the epoch-scan
-    units (the whole-epoch lax.scan wrapper's own rows) and the
+    units (the whole-epoch lax.scan wrapper's own rows), the
     mesh-sharded predict units (written on a >= 2-device host; the
     committed baseline is refreshed under the Makefile's 8-virtual-device
-    CPU env so the rows are always present)."""
-    from deepvision_tpu.check.harness import (config_unit_names,
+    CPU env so the rows are always present), and the attention-lowering
+    units (naive vs fused predict, the BENCH bytes-cut evidence)."""
+    from deepvision_tpu.check.harness import (attn_unit_names,
+                                              config_unit_names,
                                               epoch_unit_names,
                                               mesh_serve_unit_names,
                                               quant_unit_names)
@@ -65,7 +67,7 @@ def test_cost_baseline_covers_whole_registry():
     with open(os.path.join(REPO, "CHECK_COST.json")) as fp:
         baseline = json.load(fp)
     expected = (set(epoch_unit_names()) | set(quant_unit_names())
-                | set(mesh_serve_unit_names()))
+                | set(mesh_serve_unit_names()) | set(attn_unit_names()))
     for name in CONFIGS.names():
         # cost rows exist for jaxpr-traced units: train/eval steps and —
         # since the serve units grew a full trace (the int8 twins' bf16
@@ -92,6 +94,14 @@ def test_cost_baseline_covers_whole_registry():
         full = baseline["units"][f"{cname}/serve"]["param_bytes"]
         assert row["param_bytes_per_chip"] * (0.98 * model_ax) <= full, \
             (mname, row["param_bytes_per_chip"], full)
+    # the attention-lowering rows pin the flash kernel's whole point: at
+    # the audit's 197-token regime the fused WHOLE-MODEL predict must
+    # strictly undercut the naive lowering's bytes proxy (MLP and patch
+    # embed dilute the cut here; the >= 2x bar on the attention op alone
+    # is bench_attn.py's)
+    naive_b = baseline["units"]["attn/vit_tiny/naive"]["bytes"]
+    fused_b = baseline["units"]["attn/vit_tiny/fused"]["bytes"]
+    assert naive_b > fused_b, (naive_b, fused_b)
 
 
 # -- in-process clean halves + spatial probes --------------------------------

@@ -1,0 +1,268 @@
+"""ViT family (models/vit.py) + fused attention (ops/attention.py) on CPU.
+
+The transformer contracts pinned here (docs/ATTENTION.md has the math):
+
+- fused-vs-naive parity on identical inputs: f32 at the reassociation-only
+  bound (the two lowerings differ solely in summation order), bf16 at the
+  documented one-rounding bound (naive rounds its f32 scores to bf16 once
+  before PV; the kernel keeps them in f32 VMEM), and GRADIENTS exactly
+  equal (the custom_vjp differentiates the naive composition both ways);
+- ragged sequence lengths: the kernel pads N up to its block shape and
+  masks the phantom keys at -inf BEFORE the running max — awkward lengths
+  straddling block boundaries must match naive bit-for-bound;
+- a 2-epoch synthetic vit_tiny train improves top-1 over the untrained
+  eval (slow-marked: one real XLA-CPU train-step compile);
+- the served family end to end: an HTTP roundtrip through the fleet front
+  door answers the engine's own reference logits;
+- promotion with the FUSED kernel armed (interpret mode — the same kernel
+  jaxpr the TPU path compiles) recompiles nothing: stage -> predict ->
+  promote reuses every AOT bucket program;
+- int8 planning on a transformer is never silent: vit_tiny's projections
+  quantize while the softmax-adjacent contractions are skipped BY NAME,
+  and a program with attention but zero quantizable projections refuses
+  loudly (ops/quant.QuantRefusal) with the named reason arm_int8 surfaces
+  on /healthz instead of serving a half-quantized model.
+"""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepvision_tpu.configs import get_config
+from deepvision_tpu.core import scoring
+from deepvision_tpu.ops import quant
+from deepvision_tpu.ops.attention import attention, naive_attention
+from deepvision_tpu.serve import quantize
+from deepvision_tpu.serve.engine import PredictEngine
+from deepvision_tpu.serve.fleet import ModelFleet
+from deepvision_tpu.serve.server import InferenceServer
+
+# bounds derived in docs/ATTENTION.md and gated again by bench_attn.py
+PARITY_F32 = 2e-5
+PARITY_BF16 = 2e-2
+
+
+def _qkv(b, h, n, d, dtype, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, n, d), dtype) for k in ks)
+
+
+@pytest.fixture(scope="module")
+def vit_engine():
+    """One bucketed vit_tiny engine shared by the serve-side tests (the
+    registry resolves attention_impl="auto" to naive on this CPU host)."""
+    return PredictEngine.from_config("vit_tiny", buckets=(1, 4),
+                                      verbose=False)
+
+
+# ---------------------------------------------------------------- parity
+
+def test_fused_naive_parity_f32():
+    q, k, v = _qkv(2, 3, 33, 16, jnp.float32)
+    fused = attention(q, k, v, impl="interpret")
+    naive = attention(q, k, v, impl="naive")
+    assert float(jnp.max(jnp.abs(fused - naive))) <= PARITY_F32
+
+
+def test_fused_naive_parity_bf16():
+    q, k, v = _qkv(2, 3, 33, 16, jnp.bfloat16)
+    fused = attention(q, k, v, impl="interpret").astype(jnp.float32)
+    naive = attention(q, k, v, impl="naive").astype(jnp.float32)
+    assert float(jnp.max(jnp.abs(fused - naive))) <= PARITY_BF16
+
+
+def test_fused_gradients_match_naive():
+    """The custom_vjp's backward is the naive composition differentiated —
+    gradients must agree to f32 roundoff, not just the primal."""
+    q, k, v = _qkv(2, 2, 33, 16, jnp.float32, seed=3)
+
+    def loss(fn):
+        return lambda q_, k_, v_: jnp.sum(jnp.sin(fn(q_, k_, v_)))
+
+    g_fused = jax.grad(loss(lambda *a: attention(*a, impl="interpret")),
+                       argnums=(0, 1, 2))(q, k, v)
+    g_naive = jax.grad(loss(lambda *a: attention(*a, impl="naive")),
+                       argnums=(0, 1, 2))(q, k, v)
+    for gf, gn in zip(g_fused, g_naive):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [5, 17, 197])
+def test_ragged_seq_lens_masked_padding(n):
+    """Sequence lengths straddling the kernel's block shape (5 and 17 well
+    under one block, 197 one past a full block) — the -inf key mask must
+    keep the phantom padded keys out of the softmax."""
+    q, k, v = _qkv(1, 2, n, 16, jnp.float32, seed=n)
+    fused = attention(q, k, v, impl="interpret")
+    naive = naive_attention(q, k, v)
+    assert fused.shape == (1, 2, n, 16)
+    assert float(jnp.max(jnp.abs(fused - naive))) <= PARITY_F32
+
+
+# ---------------------------------------------------------------- training
+
+@pytest.mark.slow
+def test_vit_tiny_two_epoch_synthetic_improves(tmp_path):
+    """Top-1 after 2 synthetic epochs must beat the untrained eval — the
+    whole-family smoke (patchify -> encoder -> head under the bf16 policy,
+    whole-epoch scan, checkpointing) in one CPU-feasible run."""
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.synthetic import SyntheticClassification
+
+    cfg = get_config("vit_tiny").replace(batch_size=16, total_epochs=2)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, train_examples=16 * 8, val_examples=32))
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    try:
+        trainer.init_state((32, 32, 3))
+
+        def batches(steps, seed):
+            return SyntheticClassification(cfg.batch_size, 32, 3,
+                                           cfg.data.num_classes, steps,
+                                           seed=seed)
+
+        top1_0 = trainer.evaluate(batches(2, 10 ** 6)).get("top1", 0.0)
+        result = trainer.fit(lambda epoch: batches(8, epoch),
+                             lambda epoch: batches(2, 10 ** 6),
+                             sample_shape=(32, 32, 3))
+        top1_2 = result.get("val_top1", result.get("best_metric", 0.0))
+        assert np.isfinite(top1_2) and top1_2 > top1_0, (top1_0, top1_2)
+    finally:
+        trainer.close()
+
+
+# ---------------------------------------------------------------- serving
+
+def test_vit_serve_http_roundtrip(vit_engine):
+    """POST /predict/vit_tiny through the fleet front door returns the
+    engine's own reference logits for the same batch."""
+    fleet = ModelFleet()
+    fleet.add(vit_engine, max_delay_ms=3.0)
+    srv = InferenceServer(fleet=fleet, flush_every_s=60.0)
+    t = threading.Thread(target=lambda: srv.serve(port=0), daemon=True)
+    t.start()
+    assert srv.ready.wait(60)
+    try:
+        base = f"http://127.0.0.1:{srv.bound_port}"
+        x = np.random.RandomState(0).rand(
+            3, *vit_engine.example_shape).astype(np.float32) * 2 - 1
+        req = urllib.request.Request(
+            f"{base}/predict/vit_tiny",
+            data=json.dumps({"instances": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = np.asarray(json.loads(resp.read())["predictions"])
+        num_classes = get_config("vit_tiny").data.num_classes
+        assert out.shape == (3, num_classes)
+        # the front door must answer exactly what the engine's bucketed
+        # (bf16) path answers — the f32 `reference` differs by accumulated
+        # bf16 rounding across the encoder stack, so it is not the oracle
+        np.testing.assert_allclose(out, np.asarray(vit_engine.predict(x)),
+                                   rtol=1e-5, atol=1e-6)
+        assert np.all(np.isfinite(out))
+    finally:
+        srv.stop()
+        t.join(timeout=60)
+        srv.close()
+
+
+def test_zero_recompile_promotion_with_fused_armed():
+    """stage -> predict(candidate) -> promote -> predict on an engine whose
+    AOT buckets carry the pallas_call (interpret mode): the compile log
+    must not grow — promotion never traces with the fused kernel armed."""
+    from deepvision_tpu.core.train_state import init_model
+    from deepvision_tpu.core.trainer import build_model_from_config
+
+    cfg = get_config("vit_tiny")
+    cfg = cfg.replace(model_kwargs={**cfg.model_kwargs,
+                                    "attention_impl": "interpret"})
+    model, cfg = build_model_from_config(cfg)
+    sz, ch = cfg.data.image_size, cfg.data.channels
+    params, batch_stats = init_model(model, jax.random.PRNGKey(cfg.seed),
+                                     jnp.zeros((2, sz, sz, ch), jnp.float32))
+    variables = {"params": params}
+    if jax.tree_util.tree_leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+    engine = PredictEngine(model.apply, variables,
+                           example_shape=(sz, sz, ch), buckets=(1, 4),
+                           compute_dtype=jnp.dtype(cfg.dtype),
+                           take_first_output=True, name=cfg.name,
+                           verbose=False)
+    n_startup = len(engine.compile_log)
+    x = np.random.RandomState(1).randn(2, sz, sz, ch).astype(np.float32)
+    live_out = engine.predict(x)
+    cand = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.01,
+                                  jax.device_get(engine._variables))
+    engine.stage_candidate(cand, {"verified": True})
+    engine.predict(x, generation="candidate")
+    engine.promote_candidate()
+    promoted_out = engine.predict(x)
+    assert not np.allclose(live_out, promoted_out)
+    assert len(engine.compile_log) == n_startup, engine.compile_log
+
+
+# ---------------------------------------------------------------- int8 plan
+
+def test_vit_quant_plan_names_skipped_attention(vit_engine):
+    """vit_tiny's int8 plan: every QKV/out/MLP projection quantizes, the
+    two softmax-adjacent contractions per block are skipped BY NAME — the
+    split /healthz reports instead of a silent half-quantization."""
+    cfg = get_config("vit_tiny")
+    calib = jnp.asarray(np.random.RandomState(0).rand(
+        4, *vit_engine.example_shape).astype(np.float32))
+    quantizer = quantize.Quantizer(
+        vit_engine._predict_fn, vit_engine._variables, calib,
+        head_dims=scoring.serving_head_dims(cfg))
+    plan = quantizer.summary()
+    assert plan["quantized"] > 0
+    # 2 float contractions (QK^T, PV) per encoder block under the naive
+    # lowering this CPU host resolves to
+    assert plan["skipped_attention"] == 2 * cfg.model_kwargs["depth"]
+    assert plan["fused_attention"] == 0
+
+
+def test_attention_only_program_refuses_by_name():
+    """A program that is ALL attention and no quantizable projection must
+    refuse with the named reason — never a silent int8 no-op."""
+    x = jnp.zeros((1, 2, 17, 16), jnp.float32)
+
+    def attn_only_predict(variables, images):
+        # the planner's `predict(variables, images)` signature with ZERO
+        # weight leaves: every contraction is activation×activation
+        del variables
+        return naive_attention(images, images * 0.5, images + 1.0)
+
+    closed = jax.make_jaxpr(attn_only_predict)({}, x)
+    with pytest.raises(quant.QuantRefusal) as exc:
+        quant.plan_quantization(closed)
+    assert exc.value.reason == "attention_projections_unquantizable"
+
+
+def test_arm_int8_surfaces_plan_refusal(vit_engine, monkeypatch):
+    """When the plan refuses, arm_int8 must leave the engine serving bf16
+    and publish the named reason as the /healthz decision record."""
+    def raising_quantizer(*args, **kwargs):
+        raise quant.QuantRefusal(
+            "attention program has no quantizable projection",
+            reason="attention_projections_unquantizable")
+
+    monkeypatch.setattr(quantize, "Quantizer", raising_quantizer)
+    decision = quantize.arm_int8(vit_engine, get_config("vit_tiny"),
+                                 verbose=False)
+    try:
+        assert decision["decision"] == quantize.QUANT_REFUSED_PLAN
+        assert decision["reason"] == "attention_projections_unquantizable"
+        assert vit_engine.quant_decision is decision
+        fleet = ModelFleet()
+        fleet.add(vit_engine, max_delay_ms=3.0)
+        # the /healthz per-model record carries the named reason
+        assert fleet.describe()["vit_tiny"]["quant"] is decision
+    finally:
+        vit_engine.quant_decision = None

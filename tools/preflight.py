@@ -84,6 +84,11 @@ Checks, each printed as one `PASS`/`FAIL` line (exit 1 on any FAIL):
               spatial train step on a 2-virtual-device mesh must match
               the pure-DP oracle per-leaf, and the bucketed AOT engine
               must answer with int32 class-id masks
+  vit         transformer family (docs/ATTENTION.md): a 2-epoch synthetic
+              vit_tiny train must improve top-1, the fused attention
+              kernel under the Pallas interpreter must match the naive
+              einsum at the f32 reassociation bound, and the bucketed
+              AOT engine must answer finite logits
   epoch       whole-epoch on-device training (docs/INPUT_PIPELINE.md
               "On-device epochs"): a 2-epoch synthetic run through the
               device cache + epoch scan must make exactly ONE train
@@ -1011,6 +1016,83 @@ def check_segment(args):
             f"DP oracle; serve returns int32 masks")
 
 
+@check("vit")
+def check_vit(args):
+    # the transformer family end to end (docs/ATTENTION.md): a 2-epoch
+    # synthetic CPU-feasible vit_tiny train whose top-1 must IMPROVE over
+    # the untrained eval, fused-vs-naive attention parity through the
+    # Pallas interpreter (the SAME kernel jaxpr the TPU path compiles,
+    # gated at the f32 reassociation bound), and a serve smoke proving the
+    # bucketed AOT engine answers finite logits with the per-config
+    # attention lowering resolved.
+    import dataclasses
+    import shutil
+
+    import numpy as np
+
+    from deepvision_tpu.configs import get_config
+    from deepvision_tpu.core.trainer import Trainer
+    from deepvision_tpu.data.synthetic import SyntheticClassification
+
+    cfg = get_config("vit_tiny").replace(batch_size=16, total_epochs=2)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, train_examples=16 * 8, val_examples=32))
+    tmpdir = tempfile.mkdtemp(prefix="preflight_vit_")
+    trainer = None
+    try:
+        trainer = Trainer(cfg, workdir=tmpdir)
+        trainer.init_state((32, 32, 3))
+
+        def batches(steps, seed):
+            return SyntheticClassification(cfg.batch_size, 32, 3,
+                                           cfg.data.num_classes, steps,
+                                           seed=seed)
+
+        top1_0 = trainer.evaluate(batches(2, 10 ** 6)).get("top1", 0.0)
+        result = trainer.fit(lambda epoch: batches(8, epoch),
+                             lambda epoch: batches(2, 10 ** 6),
+                             sample_shape=(32, 32, 3))
+        top1_2 = result.get("val_top1", result.get("best_metric", 0.0))
+        if not np.isfinite(top1_2) or top1_2 <= top1_0:
+            raise RuntimeError(f"2-epoch synthetic train did not improve "
+                               f"top-1: {top1_0:.3f} -> {top1_2:.3f}")
+    finally:
+        if trainer is not None:
+            trainer.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    # fused == naive through the interpreter (identical kernel jaxpr to the
+    # TPU lowering), at the f32 reassociation bound bench_attn also gates
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.ops.attention import attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 3, 33, 16), jnp.float32)
+               for kk in ks)
+    err = float(jnp.max(jnp.abs(attention(q, k, v, impl="naive")
+                                - attention(q, k, v, impl="interpret"))))
+    if err > 2e-5:
+        raise RuntimeError(f"fused (interpret) vs naive attention parity "
+                           f"{err:.2e} exceeds the 2e-5 f32 bound")
+
+    # serve smoke: the bucketed engine must answer finite class logits
+    from deepvision_tpu.serve.engine import PredictEngine
+    engine = PredictEngine.from_config("vit_tiny", buckets=(1, 4),
+                                       verbose=False)
+    x = np.random.RandomState(0).rand(
+        2, *engine.example_shape).astype(np.float32) * 2 - 1
+    logits = engine.predict(x)
+    if (logits.shape != (2, cfg.data.num_classes)
+            or not np.all(np.isfinite(logits))):
+        raise RuntimeError(f"serve logits contract broken: "
+                           f"shape={logits.shape} finite="
+                           f"{bool(np.all(np.isfinite(logits)))}")
+    return (f"2-epoch top-1 {top1_0:.2f}->{top1_2:.2f}; interpret==naive "
+            f"({err:.1e}); serve answers {logits.shape}")
+
+
 @check("epoch")
 def check_epoch(args):
     # whole-epoch on-device training end to end (docs/INPUT_PIPELINE.md
@@ -1472,6 +1554,7 @@ def main(argv=None):
     check_obs(args)
     check_tier(args)
     check_segment(args)
+    check_vit(args)
     check_epoch(args)
     check_devices(args)
     check_input(args)
